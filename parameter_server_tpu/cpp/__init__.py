@@ -1,0 +1,69 @@
+"""ctypes loader for the native host library (``libpsnative.so``).
+
+Builds lazily with ``make`` on first use if g++ is available; all callers
+must handle ``native() is None`` and fall back to NumPy paths. This mirrors
+the reference's split: C++ for the host data plane, accelerator code
+elsewhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libpsnative.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.ps_crc32c.argtypes = [u8p, ctypes.c_uint64]
+    lib.ps_crc32c.restype = ctypes.c_uint32
+    lib.ps_mix64.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.ps_mix64.restype = ctypes.c_uint64
+    lib.ps_mix64_array.argtypes = [u64p, ctypes.c_uint64, ctypes.c_uint64, u64p]
+    lib.ps_mix64_array.restype = None
+    for name in ("ps_parse_libsvm", "ps_parse_criteo"):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            f32p, i64p, u64p, f32p,
+            ctypes.c_int64, ctypes.c_int64, i64p,
+        ]
+        fn.restype = ctypes.c_int64
+    return lib
+
+
+def native() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _DIR],
+                    capture_output=True,
+                    timeout=120,
+                    check=True,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib

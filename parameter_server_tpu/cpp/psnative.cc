@@ -1,0 +1,186 @@
+// Native host runtime for parameter_server_tpu.
+//
+// Plays the role of the reference's C++ data plane (src/util/crc32c.cc,
+// murmurhash3.cc, src/data/text_parser.cc): checksums, hashing and text
+// parsing are host-CPU bound, so they live here; the TPU compute path stays
+// in JAX/XLA. Exposed with a plain C ABI and loaded via ctypes.
+//
+// Build: make -C parameter_server_tpu/cpp   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cstdio>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, poly 0x82F63B78), slicing-by-8.
+// Same polynomial/masking as the reference's util/crc32c.{h,cc} so
+// signatures agree with the Python fallback.
+// ---------------------------------------------------------------------------
+
+static uint32_t kCrcTable[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  if (crc_init_done) return;
+  for (int i = 0; i < 256; ++i) {
+    uint32_t c = (uint32_t)i;
+    for (int k = 0; k < 8; ++k) c = (c >> 1) ^ ((c & 1) ? 0x82F63B78u : 0);
+    kCrcTable[0][i] = c;
+  }
+  for (int t = 1; t < 8; ++t) {
+    for (int i = 0; i < 256; ++i) {
+      uint32_t c = kCrcTable[t - 1][i];
+      kCrcTable[t][i] = (c >> 8) ^ kCrcTable[0][c & 0xFF];
+    }
+  }
+  crc_init_done = true;
+}
+
+uint32_t ps_crc32c(const uint8_t* data, uint64_t n) {
+  crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  uint64_t i = 0;
+  while (i + 8 <= n) {
+    uint64_t word;
+    memcpy(&word, data + i, 8);
+    word ^= (uint64_t)crc;
+    crc = kCrcTable[7][word & 0xFF] ^ kCrcTable[6][(word >> 8) & 0xFF] ^
+          kCrcTable[5][(word >> 16) & 0xFF] ^ kCrcTable[4][(word >> 24) & 0xFF] ^
+          kCrcTable[3][(word >> 32) & 0xFF] ^ kCrcTable[2][(word >> 40) & 0xFF] ^
+          kCrcTable[1][(word >> 48) & 0xFF] ^ kCrcTable[0][(word >> 56) & 0xFF];
+    i += 8;
+  }
+  for (; i < n; ++i) crc = (crc >> 8) ^ kCrcTable[0][(crc ^ data[i]) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// 64-bit mixing hash — must match utils/murmur.py (splitmix64 finalizer).
+// ---------------------------------------------------------------------------
+
+uint64_t ps_mix64(uint64_t z, uint64_t seed) {
+  z += seed + 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+void ps_mix64_array(const uint64_t* keys, uint64_t n, uint64_t seed,
+                    uint64_t* out) {
+  for (uint64_t i = 0; i < n; ++i) out[i] = ps_mix64(keys[i], seed);
+}
+
+// ---------------------------------------------------------------------------
+// Text parsers (libsvm / criteo). Parse a buffer of newline-separated
+// examples into CSR arrays. Caller supplies output buffers sized by
+// ps_parse_* return contract: returns #examples parsed, fills nnz via
+// out_nnz. On overflow of caller capacity, parsing stops early (the Python
+// wrapper re-calls with a bigger buffer).
+// ---------------------------------------------------------------------------
+
+static inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+// libsvm: "label idx:val idx:val ..." (ref data/text_parser.cc ParseLibsvm)
+int64_t ps_parse_libsvm(const char* buf, int64_t len,
+                        float* y, int64_t* indptr, uint64_t* indices,
+                        float* values, int64_t max_rows, int64_t max_nnz,
+                        int64_t* out_nnz) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0, nnz = 0;
+  indptr[0] = 0;
+  while (p < end && row < max_rows) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    p = skip_ws(p, line_end);
+    if (p >= line_end) { p = line_end + 1; continue; }
+    char* q;
+    double label = strtod(p, &q);
+    if (q == p) { p = line_end + 1; continue; }  // malformed: skip line
+    p = q;
+    int64_t row_start = nnz;
+    while (p < line_end) {
+      p = skip_ws(p, line_end);
+      if (p >= line_end) break;
+      char* e1;
+      uint64_t idx = strtoull(p, &e1, 10);
+      if (e1 == p || e1 >= line_end || *e1 != ':') break;
+      const char* vp = e1 + 1;
+      char* e2;
+      double val = strtod(vp, &e2);
+      if (e2 == vp) break;
+      if (nnz >= max_nnz) return row;  // capacity hit: report rows done
+      indices[nnz] = idx;
+      values[nnz] = (float)val;
+      ++nnz;
+      p = e2;
+    }
+    y[row] = (float)(label <= 0 ? -1.0 : 1.0);
+    (void)row_start;
+    indptr[++row] = nnz;
+    p = line_end + 1;
+  }
+  *out_nnz = nnz;
+  return row;
+}
+
+// criteo tsv: "label \t i1..i13 numeric \t c14..c39 hex-categorical"
+// (ref data/text_parser.cc ParseCriteo: numeric slots keyed by slot id,
+// categorical values hashed into a per-slot key space)
+int64_t ps_parse_criteo(const char* buf, int64_t len,
+                        float* y, int64_t* indptr, uint64_t* indices,
+                        float* values, int64_t max_rows, int64_t max_nnz,
+                        int64_t* out_nnz) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0, nnz = 0;
+  indptr[0] = 0;
+  const uint64_t kSlotSpace = 1ull << 52;  // per-slot key stripe
+  while (p < end && row < max_rows) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    if (p >= line_end) { p = line_end + 1; continue; }
+    char* q;
+    long label = strtol(p, &q, 10);
+    if (q == p) { p = line_end + 1; continue; }
+    p = q;
+    int slot = 0;
+    while (p < line_end && slot < 39) {
+      if (*p != '\t') break;
+      ++p;  // consume tab
+      ++slot;
+      if (p >= line_end || *p == '\t') continue;  // missing field
+      if (nnz >= max_nnz) return row;
+      if (slot <= 13) {  // integer feature: value = log-ish raw, key = slot
+        char* e;
+        double v = strtod(p, &e);
+        if (e == p) { continue; }
+        indices[nnz] = (uint64_t)slot * kSlotSpace;
+        values[nnz] = (float)v;
+        ++nnz;
+        p = e;
+      } else {  // categorical: 8-hex-char id, hashed into slot stripe
+        char* e;
+        uint64_t h = strtoull(p, &e, 16);
+        if (e == p) { continue; }
+        indices[nnz] = (uint64_t)slot * kSlotSpace + (h % (kSlotSpace - 1)) + 1;
+        values[nnz] = 1.0f;
+        ++nnz;
+        p = e;
+      }
+    }
+    y[row] = label > 0 ? 1.0f : -1.0f;
+    indptr[++row] = nnz;
+    p = line_end + 1;
+  }
+  *out_nnz = nnz;
+  return row;
+}
+
+}  // extern "C"

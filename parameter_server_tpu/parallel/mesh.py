@@ -1,0 +1,81 @@
+"""Device mesh construction — the TPU replacement for node groups.
+
+The reference organizes nodes into groups (``src/system/executor.h``:
+kServerGroup/kWorkerGroup/kCompGroup) connected by ZMQ. Here those roles are
+axes of a ``jax.sharding.Mesh``:
+
+- ``data`` axis ≙ kWorkerGroup — examples are sharded along it; gradient
+  aggregation is a psum/reduce_scatter across it (rides ICI).
+- ``server`` axis ≙ kServerGroup — parameter tables are sharded along it by
+  contiguous key range, like the reference's server key ranges
+  (``Range<Key>::EvenDivide`` in manager.cc).
+
+A chip may sit on both axes (2-D mesh): that's the common TPU layout where
+every chip holds a parameter shard *and* computes gradients, unlike the
+reference where workers and servers are disjoint processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SERVER_AXIS = "server"
+
+
+def make_mesh(
+    num_data: Optional[int] = None,
+    num_server: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, server)`` mesh over available devices.
+
+    Defaults to all devices on the data axis (pure data parallel with
+    replicated-then-sharded tables handled by NamedSharding specs).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if num_data is None:
+        num_data = n // num_server
+    if num_data * num_server != n:
+        raise ValueError(f"mesh {num_data}x{num_server} != {n} devices")
+    arr = np.asarray(devs).reshape(num_data, num_server)
+    return Mesh(arr, (DATA_AXIS, SERVER_AXIS))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Parameter tables: sharded by key range over the server axis,
+    replicated over data."""
+    return NamedSharding(mesh, P(SERVER_AXIS, None))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Example batches: sharded over the data axis, replicated over server."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def num_servers(mesh: Mesh) -> int:
+    return mesh.shape[SERVER_AXIS]
+
+
+def num_workers(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def force_host_mesh(n: int = 8) -> None:
+    """Test helper: must run before jax initializes. Forces an n-device CPU
+    platform so multi-chip sharding logic is exercised without TPUs."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()

@@ -1,0 +1,43 @@
+"""Assignment operators for push/merge (ref ``src/util/assign_op.h``).
+
+The reference enumerates ASSIGN/PLUS/MINUS/TIMES/DIVIDE/AND/OR/XOR as
+``AssignOpType`` and applies them in ``AssignFunc``; pushes default to PLUS
+(gradient aggregation) and pulls to ASSIGN.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class AssignOp(enum.Enum):
+    ASSIGN = "assign"
+    PLUS = "plus"
+    MINUS = "minus"
+    TIMES = "times"
+    DIVIDE = "divide"
+    AND = "and"
+    OR = "or"
+    XOR = "xor"
+
+
+def apply_op(op: AssignOp, dst, src):
+    if op is AssignOp.ASSIGN:
+        return src
+    if op is AssignOp.PLUS:
+        return dst + src
+    if op is AssignOp.MINUS:
+        return dst - src
+    if op is AssignOp.TIMES:
+        return dst * src
+    if op is AssignOp.DIVIDE:
+        return dst / src
+    if op is AssignOp.AND:
+        return np.logical_and(dst, src)
+    if op is AssignOp.OR:
+        return np.logical_or(dst, src)
+    if op is AssignOp.XOR:
+        return np.logical_xor(dst, src)
+    raise ValueError(f"unknown op {op}")

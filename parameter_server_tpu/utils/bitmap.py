@@ -1,0 +1,40 @@
+"""Dense bitmap (ref ``src/util/bitmap.h``): set/clear/test/nnz/fill.
+
+Used by darlin's active set. Host side only — on device the active set is a
+float/bool mask array (static shapes); this class backs host bookkeeping and
+tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Bitmap:
+    def __init__(self, size: int = 0, value: bool = False):
+        self._bits = np.full(size, bool(value), dtype=bool)
+
+    def resize(self, size: int, value: bool = False) -> None:
+        self._bits = np.full(size, bool(value), dtype=bool)
+
+    def set(self, i: int) -> None:
+        self._bits[i] = True
+
+    def clear(self, i: int) -> None:
+        self._bits[i] = False
+
+    def test(self, i: int) -> bool:
+        return bool(self._bits[i])
+
+    def fill(self, value: bool) -> None:
+        self._bits.fill(bool(value))
+
+    def nnz(self) -> int:
+        return int(self._bits.sum())
+
+    @property
+    def size(self) -> int:
+        return len(self._bits)
+
+    def array(self) -> np.ndarray:
+        return self._bits

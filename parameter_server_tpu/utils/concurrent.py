@@ -1,0 +1,121 @@
+"""Host-side concurrency helpers.
+
+Counterparts of ``src/util/threadpool.h``, ``producer_consumer.h``,
+``threadsafe_queue.h`` and ``threadsafe_limited_queue.h``. On TPU the device
+does the math; these keep the *host* busy — prefetching/parsing minibatches
+while the chip runs — which is exactly the role the reference's
+ProducerConsumer plays for MinibatchReader.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Generic, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ThreadsafeQueue(Generic[T]):
+    """Unbounded thread-safe FIFO (ref threadsafe_queue.h)."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[T]" = queue.Queue()
+
+    def push(self, item: T) -> None:
+        self._q.put(item)
+
+    def wait_and_pop(self, timeout: Optional[float] = None) -> T:
+        return self._q.get(timeout=timeout)
+
+    def try_pop(self) -> Optional[T]:
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+
+class ProducerConsumer(Generic[T]):
+    """Bounded producer/consumer with a capacity budget (ref
+    producer_consumer.h: startProducer(fn) where fn fills an item and reports
+    its size; pop() blocks until data or producer end)."""
+
+    _END = object()
+
+    def __init__(self, capacity: int = 16):
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
+        self._thread: Optional[threading.Thread] = None
+
+    def start_producer(self, produce: Callable[[], Optional[T]]) -> None:
+        """``produce`` returns the next item or None at end of stream."""
+
+        def run():
+            while True:
+                item = produce()
+                if item is None:
+                    self._q.put(self._END)
+                    return
+                self._q.put(item)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def pop(self) -> Optional[T]:
+        item = self._q.get()
+        if item is self._END:
+            # re-queue the sentinel so every later pop() (another consumer,
+            # a second iteration) also sees end-of-stream instead of hanging —
+            # matches the reference pop() returning false repeatedly at end.
+            self._q.put(self._END)
+            return None
+        return item
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            item = self.pop()
+            if item is None:
+                return
+            yield item
+
+
+class ThreadPool:
+    """Fixed-size pool mirroring ref threadpool.h's add()/startWorkers()."""
+
+    def __init__(self, num_workers: int):
+        self._num = max(1, num_workers)
+        self._tasks: list[Callable[[], None]] = []
+
+    def add(self, fn: Callable[[], None]) -> None:
+        self._tasks.append(fn)
+
+    def start_workers(self) -> None:
+        """Run all queued tasks across the pool and join (the reference
+        blocks in the destructor; we block here)."""
+        it = iter(self._tasks)
+        lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def worker():
+            while True:
+                with lock:
+                    task = next(it, None)
+                if task is None:
+                    return
+                try:
+                    task()
+                except BaseException as e:  # surface to caller, don't die silently
+                    with lock:
+                        errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker) for _ in range(self._num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._tasks.clear()
+        if errors:
+            raise errors[0]

@@ -1,0 +1,65 @@
+"""CRC32C (Castagnoli) checksums (ref ``src/util/crc32c.{h,cc}``).
+
+Used for recordio framing and key-caching signatures. Table-driven Python
+with optional C++ fast path (``cpp/libpsnative``); identical polynomial
+(0x82F63B78) and masking scheme to the reference so signatures are stable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_POLY = 0x82F63B78
+
+
+def _make_table() -> np.ndarray:
+    tbl = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (_POLY if (c & 1) else 0)
+        tbl[i] = c
+    return tbl
+
+
+_TABLE = _make_table()
+_MASK_DELTA = 0xA282EAD8
+
+
+def value(data: bytes | np.ndarray) -> int:
+    """CRC32C of a byte string (ref crc32c::Value).
+
+    Uses the C++ slicing-by-8 implementation in ``cpp/libpsnative`` when
+    available; the pure-Python loop is the portability fallback.
+    """
+    raw = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+    from ..cpp import native
+
+    lib = native()
+    if lib is not None:
+        import ctypes
+
+        buf = (ctypes.c_uint8 * len(raw)).from_buffer_copy(raw) if raw else (ctypes.c_uint8 * 1)()
+        return int(lib.ps_crc32c(buf, len(raw)))
+    tbl = _TABLE
+    c = 0xFFFFFFFF
+    for b in raw:
+        c = (c >> 8) ^ int(tbl[(c ^ b) & 0xFF])
+    return (c ^ 0xFFFFFFFF) & 0xFFFFFFFF
+
+
+def masked(crc: int) -> int:
+    """Rotate+offset masking for storing CRCs of CRCs (ref crc32c::Mask)."""
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF
+
+
+def unmask(m: int) -> int:
+    rot = (m - _MASK_DELTA) & 0xFFFFFFFF
+    return ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
+
+
+def array_signature(arr: np.ndarray, max_len: int = 2048) -> int:
+    """Signature of a (prefix of a) key array — role of the key-caching
+    filter's ``crc32c::Value(key.data(), min(size, max_sig_len))``."""
+    view = np.ascontiguousarray(arr).view(np.uint8)
+    return value(view[: max_len].tobytes())

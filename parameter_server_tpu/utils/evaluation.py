@@ -1,0 +1,56 @@
+"""Classification metrics (ref ``src/util/evaluation.h``, ``auc.h``).
+
+``auc``/``accuracy``/``logloss`` match the reference's semantics: labels in
+{-1,+1}, predictions are raw margins Xw. Vectorized NumPy on host; jnp
+variants used inside jitted evaluation steps live in apps/linear/loss.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def auc(y: np.ndarray, xw: np.ndarray) -> float:
+    """Area under ROC via rank statistic (ref Evaluation<V>::auc)."""
+    y = np.asarray(y)
+    xw = np.asarray(xw)
+    pos = y > 0
+    npos = int(pos.sum())
+    nneg = len(y) - npos
+    if npos == 0 or nneg == 0:
+        return 1.0
+    order = np.argsort(xw, kind="stable")
+    ranks = np.empty(len(xw), dtype=np.float64)
+    ranks[order] = np.arange(1, len(xw) + 1)
+    # average ties for exactness
+    sxw = xw[order]
+    i = 0
+    while i < len(sxw):
+        j = i
+        while j + 1 < len(sxw) and sxw[j + 1] == sxw[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    return float((ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+def accuracy(y: np.ndarray, xw: np.ndarray, threshold: float = 0.0) -> float:
+    """Fraction with sign(Xw-threshold) == sign(y) (ref Evaluation<V>::accuracy)."""
+    y = np.asarray(y)
+    xw = np.asarray(xw)
+    correct = ((xw > threshold) & (y > 0)) | ((xw <= threshold) & (y <= 0))
+    return float(correct.mean()) if len(y) else 0.0
+
+
+def logloss(y: np.ndarray, xw: np.ndarray) -> float:
+    """Mean log(1+exp(-y*Xw)) — the logit objective per example."""
+    y = np.asarray(y, dtype=np.float64)
+    xw = np.asarray(xw, dtype=np.float64)
+    return float(np.mean(np.logaddexp(0.0, -y * xw))) if len(y) else 0.0
+
+
+def rmse(y: np.ndarray, xw: np.ndarray) -> float:
+    y = np.asarray(y, dtype=np.float64)
+    xw = np.asarray(xw, dtype=np.float64)
+    return float(np.sqrt(np.mean((y - xw) ** 2))) if len(y) else 0.0

@@ -1,0 +1,50 @@
+"""File IO helpers (ref ``src/util/file.{h,cc}``, ``filelinereader.{h,cc}``,
+``hdfs.h``).
+
+Local + gzip reading, glob expansion of DataConfig-style file patterns, and
+a line reader. HDFS/S3 URLs are recognized and rejected with a clear error
+(gated, no hadoop client in this environment — ref hdfs.h shells out to
+``hadoop fs``).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import gzip
+import os
+from typing import IO, Iterable, Iterator, List
+
+
+def is_remote(path: str) -> bool:
+    return path.startswith("hdfs://") or path.startswith("s3://")
+
+
+def open_read(path: str, mode: str = "rt") -> IO:
+    if is_remote(path):
+        raise NotImplementedError(
+            f"remote filesystem not available in this environment: {path} "
+            "(reference shells out to `hadoop fs`; gate your DataConfig to local files)"
+        )
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode)
+
+
+def expand_globs(patterns: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in patterns:
+        if is_remote(p):
+            out.append(p)
+            continue
+        hits = sorted(_glob.glob(p))
+        out.extend(hits if hits else ([p] if os.path.exists(p) else []))
+    return out
+
+
+def read_lines(path: str) -> Iterator[str]:
+    """Line reader (ref FileLineReader::Reload loop)."""
+    with open_read(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line:
+                yield line

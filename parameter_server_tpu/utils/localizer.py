@@ -1,0 +1,74 @@
+"""Key localization: global feature keys → contiguous local indices.
+
+TPU-native counterpart of ``src/util/localizer.h`` (Localizer<K,V>):
+``count_uniq_keys`` ≙ ``CountUniqIndex`` (sorted unique keys + appearance
+counts) and ``remap`` ≙ ``RemapIndex`` (rewrite a batch's feature keys to
+positions within a chosen key set, dropping filtered keys).
+
+This is load-bearing for the TPU design: device code must see dense int32
+ids with static shapes, so all uint64-key bookkeeping happens here on host
+(NumPy vectorized; the C++ fast path in ``cpp/`` accelerates the sort for
+large blocks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .sparse import SparseBatch
+
+
+def count_uniq_keys(batch: SparseBatch, cap: int = 255) -> Tuple[np.ndarray, np.ndarray]:
+    """Sorted unique feature keys and their (capped) appearance counts.
+
+    Counts are capped at ``cap`` to mirror the reference's uint8 counters
+    (localizer.h stores counts as uint8 for the countmin filter).
+    """
+    keys, counts = np.unique(batch.indices, return_counts=True)
+    return keys, np.minimum(counts, cap).astype(np.uint32)
+
+
+def remap(batch: SparseBatch, keep_keys: np.ndarray) -> SparseBatch:
+    """Rewrite ``batch.indices`` to positions in sorted ``keep_keys``.
+
+    Entries whose key is not in ``keep_keys`` are dropped (tail-feature
+    filtering, ref localizer.h RemapIndex with filtered key set). Returns a
+    new CSR batch with ``num_cols == len(keep_keys)``.
+    """
+    from .ordered_match import match_positions
+
+    hit, new_idx = match_positions(keep_keys, batch.indices)
+    # new per-row counts after dropping misses
+    rows = batch.row_ids()
+    new_counts = np.zeros(batch.n, dtype=np.int64)
+    np.add.at(new_counts, rows[hit], 1)
+    indptr = np.zeros(batch.n + 1, dtype=np.int64)
+    np.cumsum(new_counts, out=indptr[1:])
+    return SparseBatch(
+        y=batch.y,
+        indptr=indptr,
+        indices=new_idx.astype(np.int64),
+        values=None if batch.binary else batch.values[hit],
+        num_cols=len(keep_keys),
+    )
+
+
+class Localizer:
+    """Stateful convenience wrapper mirroring the reference class's two-call
+    protocol (CountUniqIndex then RemapIndex)."""
+
+    def __init__(self) -> None:
+        self._keys: Optional[np.ndarray] = None
+        self._batch: Optional[SparseBatch] = None
+
+    def count_uniq_index(self, batch: SparseBatch, cap: int = 255):
+        self._batch = batch
+        keys, cnt = count_uniq_keys(batch, cap)
+        self._keys = keys
+        return keys, cnt
+
+    def remap_index(self, keep_keys: np.ndarray) -> SparseBatch:
+        assert self._batch is not None, "call count_uniq_index first"
+        return remap(self._batch, np.asarray(keep_keys, dtype=np.int64))

@@ -1,0 +1,26 @@
+"""64-bit mixing hash (role of ``src/util/murmurhash3.{h,cc}``).
+
+The reference uses MurmurHash3 to hash feature keys into sketches. We use a
+splitmix64-style finalizer — same statistical quality, fully vectorizable in
+NumPy, and trivially portable to the C++ fast path in ``cpp/``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def murmur64_np(keys: np.ndarray, seed: np.uint64 = np.uint64(0)) -> np.ndarray:
+    """Vectorized 64-bit finalizer hash over a uint64 array."""
+    with np.errstate(over="ignore"):
+        z = np.asarray(keys, dtype=np.uint64) + seed + np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * _M1
+        z = (z ^ (z >> np.uint64(27))) * _M2
+        return z ^ (z >> np.uint64(31))
+
+
+def murmur64(key: int, seed: int = 0) -> int:
+    return int(murmur64_np(np.asarray([key], dtype=np.uint64), np.uint64(seed))[0])

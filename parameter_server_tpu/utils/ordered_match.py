@@ -1,0 +1,51 @@
+"""Ordered key matching/merging.
+
+TPU-native counterpart of ``src/util/parallel_ordered_match.h``: given two
+sorted unique key arrays and values attached to the source keys, merge the
+source values into the destination positions whose keys match. The reference
+recurses and multithreads; NumPy ``searchsorted`` vectorizes the same thing.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .assign_op import AssignOp, apply_op
+
+
+def match_positions(dst_keys: np.ndarray, src_keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """For each src key present in dst, its position in dst.
+
+    Returns ``(src_hit_mask, dst_pos_of_hits)``. Both key arrays must be
+    sorted ascending and unique.
+    """
+    pos = np.searchsorted(dst_keys, src_keys)
+    posc = np.minimum(pos, max(len(dst_keys) - 1, 0))
+    hit = (
+        (pos < len(dst_keys)) & (dst_keys[posc] == src_keys)
+        if len(dst_keys)
+        else np.zeros(len(src_keys), dtype=bool)
+    )
+    return hit, pos[hit]
+
+
+def ordered_match(
+    dst_keys: np.ndarray,
+    dst_vals: np.ndarray,
+    src_keys: np.ndarray,
+    src_vals: np.ndarray,
+    op: AssignOp = AssignOp.ASSIGN,
+    k: int = 1,
+) -> int:
+    """Merge ``src_vals`` into ``dst_vals`` where keys match; returns #matched.
+
+    ``k`` is the per-key value width (ref: ``ParallelOrderedMatch`` template
+    param ``k``); values are laid out row-major ``[nkeys, k]`` or flat.
+    """
+    hit, pos = match_positions(dst_keys, src_keys)
+    dv = dst_vals.reshape(len(dst_keys), k)
+    sv = src_vals.reshape(len(src_keys), k)
+    dv[pos] = apply_op(op, dv[pos], sv[hit])
+    return int(hit.sum())

@@ -1,0 +1,53 @@
+"""CRC-framed record files (ref ``src/util/recordio.{h,cc}``).
+
+Frame layout mirrors the reference's RecordWriter/RecordReader: per record a
+fixed header ``[masked_crc32c(payload):4][length:4]`` then the payload. The
+reference stores protobuf ``Example``s; we store any bytes (the data layer
+serializes SparseBatch rows with np.save-style packing in data/text2record).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import BinaryIO, Iterator, Optional
+
+from . import crc32c
+
+_HEADER = struct.Struct("<II")  # masked crc, length
+
+
+class RecordWriter:
+    def __init__(self, f: BinaryIO):
+        self._f = f
+
+    def write_record(self, payload: bytes) -> None:
+        crc = crc32c.masked(crc32c.value(payload))
+        self._f.write(_HEADER.pack(crc, len(payload)))
+        self._f.write(payload)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class RecordReader:
+    def __init__(self, f: BinaryIO):
+        self._f = f
+
+    def read_record(self) -> Optional[bytes]:
+        hdr = self._f.read(_HEADER.size)
+        if len(hdr) < _HEADER.size:
+            return None
+        crc, length = _HEADER.unpack(hdr)
+        payload = self._f.read(length)
+        if len(payload) < length:
+            raise IOError("truncated record")
+        if crc32c.unmask(crc) != crc32c.value(payload):
+            raise IOError("record crc mismatch")
+        return payload
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.read_record()
+            if rec is None:
+                return
+            yield rec

@@ -1,0 +1,79 @@
+"""Probabilistic sketches: bloom filter and count-min.
+
+TPU-native counterparts of ``src/util/bloom_filter.h``,
+``block_bloom_filter.h``, ``countmin.h`` and ``sketch.h``. Vectorized NumPy:
+these run on host in the data pipeline (tail-feature filtering), exactly
+where the reference runs them (MinibatchReader, FreqencyFilter).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .murmur import murmur64_np
+
+
+def _hashes(keys: np.ndarray, num_hash: int, mod: int, seed0: int = 0x9E3779B9) -> np.ndarray:
+    """[num_hash, n] hashed positions via double hashing (Kirsch–Mitzenmacher)."""
+    keys = np.asarray(keys, dtype=np.uint64)
+    h1 = murmur64_np(keys, np.uint64(seed0))
+    h2 = murmur64_np(keys, np.uint64(0xC2B2AE3D27D4EB4F)) | np.uint64(1)
+    i = np.arange(num_hash, dtype=np.uint64)[:, None]
+    return ((h1[None, :] + i * h2[None, :]) % np.uint64(mod)).astype(np.int64)
+
+
+class BloomFilter:
+    """Standard bloom filter (ref bloom_filter.h: insert/query by key)."""
+
+    def __init__(self, num_bits: int = 1 << 20, num_hash: int = 2):
+        self.num_bits = int(num_bits)
+        self.num_hash = int(num_hash)
+        self.bits = np.zeros(self.num_bits, dtype=bool)
+
+    def insert(self, keys: np.ndarray) -> None:
+        pos = _hashes(keys, self.num_hash, self.num_bits)
+        self.bits[pos.reshape(-1)] = True
+
+    def query(self, keys: np.ndarray) -> np.ndarray:
+        pos = _hashes(keys, self.num_hash, self.num_bits)
+        return self.bits[pos].all(axis=0)
+
+    def __contains__(self, key: int) -> bool:
+        return bool(self.query(np.asarray([key]))[0])
+
+
+class CountMin:
+    """Count-min sketch with saturating uint32 counters (ref countmin.h).
+
+    ``insert(keys, counts)`` adds capped counts; ``query`` returns the
+    min over hash rows — an upper-biased frequency estimate used by the
+    tail-feature ``FreqencyFilter``.
+    """
+
+    def __init__(self, n: int = 1 << 20, k: int = 2, cap: int = 255):
+        self.n = int(n)
+        self.k = int(k)
+        self.cap = int(cap)
+        self.data = np.zeros((self.k, self.n), dtype=np.uint32)
+
+    def insert(self, keys: np.ndarray, counts: np.ndarray | int = 1) -> None:
+        keys = np.asarray(keys, dtype=np.uint64)
+        counts = np.broadcast_to(np.asarray(counts, dtype=np.uint32), keys.shape)
+        pos = _hashes(keys, self.k, self.n)
+        for r in range(self.k):
+            # scatter-add with saturation; np.add.at handles duplicate pos.
+            # Clamp only the touched buckets, not the whole 2^20-entry row.
+            row = self.data[r]
+            np.add.at(row, pos[r], counts)
+            touched = pos[r]
+            row[touched] = np.minimum(row[touched], self.cap)
+
+    def query(self, keys: np.ndarray) -> np.ndarray:
+        pos = _hashes(np.asarray(keys, dtype=np.uint64), self.k, self.n)
+        est = self.data[0][pos[0]]
+        for r in range(1, self.k):
+            est = np.minimum(est, self.data[r][pos[r]])
+        return est
+
+    def clear(self) -> None:
+        self.data.fill(0)

@@ -1,0 +1,221 @@
+"""Sparse example batches.
+
+TPU-native counterpart of the reference's matrix stack
+(``src/util/matrix.h``, ``sparse_matrix.h``, ``dense_matrix.h``): row-major
+CSR batches of examples on the host, plus fixed-shape device encodings.
+
+Where the reference hands Eigen a CSR and loops, TPU kernels need *static
+shapes*. The device format here is a padded COO/"row-block CSR": a batch is
+``(row_ids[nnz_pad], col_ids[nnz_pad], values[nnz_pad])`` padded to a fixed
+nnz budget, with padding rows pointed at a sentinel column whose weight is
+pinned to zero. Gathers/segment-sums over this layout tile cleanly onto the
+VPU/MXU, and every minibatch compiles once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SparseBatch:
+    """A minibatch of sparse examples (host side, CSR).
+
+    Mirrors the role of ``PS::SparseMatrix<I,V>`` (ref sparse_matrix.h) plus
+    the label vector: ``y`` is ``[n]``, CSR arrays describe an ``n x p``
+    feature matrix. ``binary`` marks 0/1 features stored without values
+    (ref sparse_matrix.h ``binary()`` fast path).
+    """
+
+    y: np.ndarray  # [n] float32, labels in {-1, +1} (or regression targets)
+    indptr: np.ndarray  # [n+1] int64
+    indices: np.ndarray  # [nnz] int64 — feature keys (global or localized)
+    values: Optional[np.ndarray] = None  # [nnz] float32, None if binary
+    num_cols: Optional[int] = None  # p; None = max(indices)+1
+
+    @property
+    def n(self) -> int:
+        return len(self.y)
+
+    @property
+    def nnz(self) -> int:
+        return len(self.indices)
+
+    @property
+    def binary(self) -> bool:
+        return self.values is None
+
+    @property
+    def cols(self) -> int:
+        if self.num_cols is not None:
+            return self.num_cols
+        return int(self.indices.max()) + 1 if self.nnz else 0
+
+    def row_ids(self) -> np.ndarray:
+        """Expand indptr to per-nnz row ids (COO rows)."""
+        return np.repeat(
+            np.arange(self.n, dtype=np.int32), np.diff(self.indptr).astype(np.int64)
+        )
+
+    def value_array(self) -> np.ndarray:
+        if self.values is not None:
+            return self.values
+        return np.ones(self.nnz, dtype=np.float32)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros((self.n, self.cols), dtype=np.float32)
+        rows = self.row_ids()
+        np.add.at(out, (rows, self.indices.astype(np.int64)), self.value_array())
+        return out
+
+    def to_csc(self) -> "SparseCols":
+        """Column-major view for block coordinate descent (ref bcd/darlin use
+        colMajor CSC; sparse_matrix.h toColMajor)."""
+        order = np.argsort(self.indices, kind="stable")
+        cols = self.indices[order]
+        rows = self.row_ids()[order]
+        vals = None if self.binary else self.values[order]
+        p = self.cols
+        colptr = np.zeros(p + 1, dtype=np.int64)
+        np.add.at(colptr, cols.astype(np.int64) + 1, 1)
+        np.cumsum(colptr, out=colptr)
+        return SparseCols(
+            colptr=colptr, row_ids=rows.astype(np.int32), values=vals, num_rows=self.n
+        )
+
+    def slice_rows(self, begin: int, end: int) -> "SparseBatch":
+        lo, hi = self.indptr[begin], self.indptr[end]
+        return SparseBatch(
+            y=self.y[begin:end],
+            indptr=(self.indptr[begin : end + 1] - lo),
+            indices=self.indices[lo:hi],
+            values=None if self.binary else self.values[lo:hi],
+            num_cols=self.num_cols,
+        )
+
+    def pad_device(
+        self, nnz_pad: int, rows_pad: Optional[int] = None, pad_col: Optional[int] = None
+    ) -> "PaddedBatch":
+        """Encode for device: COO padded to ``nnz_pad`` entries / ``rows_pad`` rows.
+
+        Padding entries get ``row=rows_pad-1`` is wrong (would pollute that
+        example) — instead they point at ``pad_col`` (default: ``cols``, one
+        extra sentinel column) with value 0, and a valid-row mask is emitted.
+        """
+        if rows_pad is None:
+            rows_pad = self.n
+        if self.nnz > nnz_pad:
+            raise ValueError(f"nnz {self.nnz} exceeds budget {nnz_pad}")
+        if self.n > rows_pad:
+            raise ValueError(f"rows {self.n} exceed budget {rows_pad}")
+        if pad_col is None:
+            pad_col = self.cols
+        rows = np.zeros(nnz_pad, dtype=np.int32)
+        cols = np.full(nnz_pad, pad_col, dtype=np.int32)
+        vals = np.zeros(nnz_pad, dtype=np.float32)
+        rows[: self.nnz] = self.row_ids()
+        cols[: self.nnz] = self.indices
+        vals[: self.nnz] = self.value_array()
+        y = np.zeros(rows_pad, dtype=np.float32)
+        y[: self.n] = self.y
+        mask = np.zeros(rows_pad, dtype=np.float32)
+        mask[: self.n] = 1.0
+        return PaddedBatch(y=y, rows=rows, cols=cols, vals=vals, row_mask=mask)
+
+
+@dataclasses.dataclass
+class SparseCols:
+    """CSC view: per-column row lists (ref sparse_matrix.h colMajor)."""
+
+    colptr: np.ndarray  # [p+1]
+    row_ids: np.ndarray  # [nnz] int32
+    values: Optional[np.ndarray]  # [nnz] or None if binary
+    num_rows: int
+
+    @property
+    def cols(self) -> int:
+        return len(self.colptr) - 1
+
+    def col(self, j: int):
+        lo, hi = self.colptr[j], self.colptr[j + 1]
+        v = None if self.values is None else self.values[lo:hi]
+        return self.row_ids[lo:hi], v
+
+
+@dataclasses.dataclass
+class PaddedBatch:
+    """Static-shape device encoding of a SparseBatch (COO + sentinel padding)."""
+
+    y: np.ndarray  # [rows_pad]
+    rows: np.ndarray  # [nnz_pad] int32
+    cols: np.ndarray  # [nnz_pad] int32 — padding points at sentinel column
+    vals: np.ndarray  # [nnz_pad] float32 — padding is 0
+    row_mask: np.ndarray  # [rows_pad] float32 1=real example
+
+    @property
+    def rows_pad(self) -> int:
+        return len(self.y)
+
+    @property
+    def nnz_pad(self) -> int:
+        return len(self.rows)
+
+
+def from_dense(x: np.ndarray, y: np.ndarray) -> SparseBatch:
+    n, p = x.shape
+    indptr = [0]
+    indices = []
+    values = []
+    for i in range(n):
+        (nz,) = np.nonzero(x[i])
+        indices.append(nz)
+        values.append(x[i, nz])
+        indptr.append(indptr[-1] + len(nz))
+    return SparseBatch(
+        y=y.astype(np.float32),
+        indptr=np.asarray(indptr, dtype=np.int64),
+        indices=np.concatenate(indices).astype(np.int64) if indices else np.zeros(0, np.int64),
+        values=np.concatenate(values).astype(np.float32) if values else np.zeros(0, np.float32),
+        num_cols=p,
+    )
+
+
+def random_sparse(
+    n: int,
+    p: int,
+    nnz_per_row: int,
+    seed: int = 0,
+    binary: bool = False,
+    w_true: Optional[np.ndarray] = None,
+) -> SparseBatch:
+    """Synthetic sparse logistic data (test/bench helper).
+
+    Plays the role of the reference's generated test matrices in
+    ``src/test/sparse_matrix_test.cc`` and gives learners a ground-truth
+    weight vector to recover.
+    """
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, p, size=(n, nnz_per_row), dtype=np.int64)
+    # rows may contain duplicate column ids (they merge additively downstream);
+    # consumers must not assume unique columns per row
+    vals = (
+        np.ones((n, nnz_per_row), dtype=np.float32)
+        if binary
+        else rng.normal(size=(n, nnz_per_row)).astype(np.float32)
+    )
+    if w_true is None:
+        w_true = (rng.normal(size=p) * (rng.random(p) < 0.1)).astype(np.float32)
+    logits = (vals * w_true[idx]).sum(axis=1)
+    yprob = 1.0 / (1.0 + np.exp(-logits))
+    y = np.where(rng.random(n) < yprob, 1.0, -1.0).astype(np.float32)
+    indptr = np.arange(0, (n + 1) * nnz_per_row, nnz_per_row, dtype=np.int64)
+    return SparseBatch(
+        y=y,
+        indptr=indptr,
+        indices=idx.reshape(-1),
+        values=None if binary else vals.reshape(-1),
+        num_cols=p,
+    )

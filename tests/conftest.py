@@ -1,0 +1,35 @@
+"""Test harness: force a virtual 8-device CPU mesh before jax initializes.
+
+Mirrors the reference's ``local.sh`` multi-process test launcher
+(src/test/*.cc run with N servers + M workers): here the "nodes" are 8
+virtual XLA CPU devices, so every sharding/collective path is exercised
+without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from parameter_server_tpu.parallel import mesh as meshlib
+
+    assert len(jax.devices()) == 8, jax.devices()
+    return meshlib.make_mesh(num_data=4, num_server=2)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,216 @@
+"""Unit tests for utils — mirrors reference gtest coverage in src/test/
+(common_test, bloom_filter_test, countmin_test, localizer_test,
+parallel_ordered_match_test, sparse_matrix_test, assign_op_test)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.utils import crc32c, evaluation, recordio
+from parameter_server_tpu.utils.assign_op import AssignOp, apply_op
+from parameter_server_tpu.utils.bitmap import Bitmap
+from parameter_server_tpu.utils.localizer import Localizer, count_uniq_keys, remap
+from parameter_server_tpu.utils.ordered_match import ordered_match
+from parameter_server_tpu.utils.range import Range
+from parameter_server_tpu.utils.sketch import BloomFilter, CountMin
+from parameter_server_tpu.utils.sparse import SparseBatch, from_dense, random_sparse
+
+
+class TestRange:
+    def test_even_divide(self):
+        r = Range(0, 10)
+        parts = r.divide(3)
+        assert parts[0] == Range(0, 3)
+        assert parts[1] == Range(3, 6)
+        assert parts[2] == Range(6, 10)
+        assert sum(p.size() for p in parts) == 10
+
+    def test_intersection(self):
+        assert Range(0, 5).intersection(Range(3, 9)) == Range(3, 5)
+        assert Range(0, 2).intersection(Range(3, 9)).empty()
+
+    def test_contains(self):
+        assert 3 in Range(0, 5)
+        assert 5 not in Range(0, 5)
+
+
+class TestSparse:
+    def test_from_dense_roundtrip(self, rng):
+        x = (rng.random((7, 11)) < 0.3) * rng.normal(size=(7, 11))
+        y = np.sign(rng.normal(size=7))
+        b = from_dense(x.astype(np.float32), y)
+        np.testing.assert_allclose(b.to_dense(), x, rtol=1e-6)
+
+    def test_csc_matches_dense(self, rng):
+        b = random_sparse(50, 31, 4, seed=1)
+        dense = b.to_dense()
+        csc = b.to_csc()
+        for j in range(b.cols):
+            rows, vals = csc.col(j)
+            col = np.zeros(b.n, dtype=np.float32)
+            if vals is None:
+                col[rows] = 1.0
+            else:
+                np.add.at(col, rows, vals)
+            np.testing.assert_allclose(col, dense[:, j], rtol=1e-5)
+
+    def test_pad_device(self):
+        b = random_sparse(10, 20, 3, seed=2)
+        pb = b.pad_device(nnz_pad=64, rows_pad=16)
+        assert pb.rows_pad == 16 and pb.nnz_pad == 64
+        assert pb.row_mask.sum() == 10
+        # padded entries point at sentinel col with zero value
+        assert (pb.cols[b.nnz :] == b.cols).all()
+        assert (pb.vals[b.nnz :] == 0).all()
+        # matvec through padding equals dense matvec
+        w = np.random.default_rng(0).normal(size=b.cols + 1).astype(np.float32)
+        w[-1] = 123.0  # sentinel weight must not matter (value=0)
+        xw_pad = np.zeros(16, dtype=np.float32)
+        np.add.at(xw_pad, pb.rows, pb.vals * w[pb.cols])
+        np.testing.assert_allclose(xw_pad[:10], b.to_dense() @ w[:-1], rtol=1e-4)
+
+    def test_slice_rows(self):
+        b = random_sparse(10, 20, 3, seed=3)
+        s = b.slice_rows(2, 5)
+        np.testing.assert_allclose(s.to_dense(), b.to_dense()[2:5], rtol=1e-6)
+
+
+class TestLocalizer:
+    def test_count_uniq(self):
+        b = SparseBatch(
+            y=np.ones(2, np.float32),
+            indptr=np.array([0, 3, 5]),
+            indices=np.array([9, 4, 9, 4, 1]),
+            values=np.arange(5, dtype=np.float32),
+        )
+        keys, cnt = count_uniq_keys(b)
+        np.testing.assert_array_equal(keys, [1, 4, 9])
+        np.testing.assert_array_equal(cnt, [1, 2, 2])
+
+    def test_remap_keeps_subset(self):
+        b = SparseBatch(
+            y=np.ones(2, np.float32),
+            indptr=np.array([0, 3, 5]),
+            indices=np.array([9, 4, 9, 4, 1]),
+            values=np.arange(5, dtype=np.float32),
+        )
+        out = remap(b, np.array([4, 9]))
+        assert out.cols == 2
+        np.testing.assert_array_equal(out.indices, [1, 0, 1, 0])  # key9->1, key4->0
+        np.testing.assert_array_equal(out.indptr, [0, 3, 4])
+        np.testing.assert_array_equal(out.values, [0, 1, 2, 3])  # key1 dropped
+
+    def test_localizer_protocol(self):
+        b = random_sparse(20, 50, 5, seed=4)
+        loc = Localizer()
+        keys, cnt = loc.count_uniq_index(b)
+        out = loc.remap_index(keys)
+        # full keep: dense reconstruction must match with remapped columns
+        np.testing.assert_allclose(
+            out.to_dense(), b.to_dense()[:, keys.astype(int)], rtol=1e-6
+        )
+
+
+class TestOrderedMatch:
+    def test_assign_and_plus(self):
+        dst_k = np.array([1, 3, 5, 7])
+        dst_v = np.zeros(4, dtype=np.float32)
+        src_k = np.array([3, 5, 9])
+        src_v = np.array([30.0, 50.0, 90.0], dtype=np.float32)
+        n = ordered_match(dst_k, dst_v, src_k, src_v)
+        assert n == 2
+        np.testing.assert_array_equal(dst_v, [0, 30, 50, 0])
+        n = ordered_match(dst_k, dst_v, src_k, src_v, op=AssignOp.PLUS)
+        np.testing.assert_array_equal(dst_v, [0, 60, 100, 0])
+
+    def test_width_k(self):
+        dst_k = np.array([2, 4])
+        dst_v = np.zeros((2, 3), dtype=np.float32)
+        src_k = np.array([4])
+        src_v = np.array([[1.0, 2.0, 3.0]], dtype=np.float32)
+        ordered_match(dst_k, dst_v, src_k, src_v, k=3)
+        np.testing.assert_array_equal(dst_v[1], [1, 2, 3])
+
+
+class TestSketches:
+    def test_bloom_no_false_negatives(self, rng):
+        bf = BloomFilter(1 << 16, 3)
+        keys = rng.integers(0, 1 << 60, size=1000).astype(np.uint64)
+        bf.insert(keys)
+        assert bf.query(keys).all()
+
+    def test_bloom_low_false_positive(self, rng):
+        bf = BloomFilter(1 << 18, 3)
+        keys = rng.integers(0, 1 << 60, size=1000).astype(np.uint64)
+        bf.insert(keys)
+        other = rng.integers(1 << 61, 1 << 62, size=10000).astype(np.uint64)
+        assert bf.query(other).mean() < 0.01
+
+    def test_countmin_upper_bound(self, rng):
+        cm = CountMin(1 << 16, 3)
+        keys = rng.integers(0, 1 << 40, size=500).astype(np.uint64)
+        cm.insert(keys, 5)
+        est = cm.query(keys)
+        assert (est >= 5).all()  # never underestimates
+        fresh = rng.integers(1 << 41, 1 << 42, size=500).astype(np.uint64)
+        assert cm.query(fresh).mean() < 1.0
+
+
+class TestEvaluation:
+    def test_auc_perfect_and_random(self):
+        y = np.array([1, 1, -1, -1], dtype=np.float32)
+        assert evaluation.auc(y, np.array([2.0, 1.5, -1.0, -2.0])) == 1.0
+        assert evaluation.auc(y, np.array([-2.0, -1.5, 1.0, 2.0])) == 0.0
+        assert abs(evaluation.auc(y, np.zeros(4)) - 0.5) < 1e-9
+
+    def test_accuracy(self):
+        y = np.array([1, -1, 1, -1], dtype=np.float32)
+        assert evaluation.accuracy(y, np.array([1.0, -1.0, -1.0, 1.0])) == 0.5
+
+    def test_logloss(self):
+        y = np.array([1.0, -1.0])
+        xw = np.array([100.0, -100.0])
+        assert evaluation.logloss(y, xw) < 1e-6
+
+
+class TestCrcRecordio:
+    def test_crc_known_value(self):
+        # crc32c("123456789") = 0xE3069283 (Castagnoli standard test vector)
+        assert crc32c.value(b"123456789") == 0xE3069283
+
+    def test_mask_roundtrip(self):
+        c = crc32c.value(b"hello")
+        assert crc32c.unmask(crc32c.masked(c)) == c
+
+    def test_recordio_roundtrip(self):
+        buf = io.BytesIO()
+        w = recordio.RecordWriter(buf)
+        recs = [b"alpha", b"", b"x" * 1000]
+        for r in recs:
+            w.write_record(r)
+        buf.seek(0)
+        assert list(recordio.RecordReader(buf)) == recs
+
+    def test_recordio_detects_corruption(self):
+        buf = io.BytesIO()
+        recordio.RecordWriter(buf).write_record(b"payload")
+        data = bytearray(buf.getvalue())
+        data[-1] ^= 0xFF
+        with pytest.raises(IOError):
+            recordio.RecordReader(io.BytesIO(bytes(data))).read_record()
+
+
+class TestBitmapAssign:
+    def test_bitmap(self):
+        bm = Bitmap(10, True)
+        assert bm.nnz() == 10
+        bm.clear(3)
+        assert not bm.test(3) and bm.nnz() == 9
+        bm.fill(False)
+        assert bm.nnz() == 0
+
+    def test_assign_ops(self):
+        assert apply_op(AssignOp.PLUS, 2.0, 3.0) == 5.0
+        assert apply_op(AssignOp.ASSIGN, 2.0, 3.0) == 3.0
+        assert apply_op(AssignOp.TIMES, 2.0, 3.0) == 6.0
