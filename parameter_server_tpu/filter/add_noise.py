@@ -1,0 +1,32 @@
+"""Gaussian noise injection (ref ``src/filter/add_noise.h``).
+
+Adds N(mean, std) noise to float value arrays on encode (used for
+differential-privacy-flavoured experiments in the reference). Decode is a
+no-op — noise is not removed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..system.message import FilterSpec, Message
+from .base import Filter, register
+
+
+@register
+class AddNoiseFilter(Filter):
+    TYPE = "add_noise"
+
+    def __init__(self) -> None:
+        self._rng = np.random.default_rng(0)
+
+    def encode(self, msg: Message, spec: FilterSpec) -> Message:
+        if spec.std <= 0:
+            return msg
+        msg.values = [
+            (v + self._rng.normal(spec.mean, spec.std, v.shape).astype(v.dtype))
+            if v.dtype.kind == "f"
+            else v
+            for v in msg.values
+        ]
+        return msg

@@ -1,0 +1,46 @@
+"""Payload compression (ref ``src/filter/compressing.h``).
+
+The reference LZ4-compresses each value array on the wire. LZ4 isn't in
+this environment, so the host codec is zlib (level 1 — closest speed
+profile); arrays are restored to their original dtype/shape on decode. The
+device-path analog is dtype narrowing (bf16 pulls / int8 pushes) which the
+learners apply directly — compression of ICI traffic is a precision choice,
+not a byte codec.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from ..system.message import FilterSpec, Message
+from .base import Filter, register
+
+
+@register
+class CompressingFilter(Filter):
+    TYPE = "compressing"
+
+    def encode(self, msg: Message, spec: FilterSpec) -> Message:
+        meta = []
+        out = []
+        for v in msg.values:
+            raw = np.ascontiguousarray(v)
+            blob = zlib.compress(raw.tobytes(), level=1)
+            meta.append((str(raw.dtype), raw.shape))
+            out.append(np.frombuffer(blob, dtype=np.uint8))
+        spec.extra["meta"] = meta
+        msg.values = out
+        return msg
+
+    def decode(self, msg: Message, spec: FilterSpec) -> Message:
+        meta = spec.extra.get("meta")
+        if meta is None:
+            return msg
+        out = []
+        for v, (dtype, shape) in zip(msg.values, meta):
+            raw = zlib.decompress(v.tobytes())
+            out.append(np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy())
+        msg.values = out
+        return msg

@@ -1,0 +1,94 @@
+"""Randomized fixed-point quantization (ref ``src/filter/fixing_float.h``).
+
+The reference packs each float into ``num_bytes`` as
+``round((v - min) / (max - min) * 2^(8b) + bernoulli)`` with a shared
+[min,max] per array — lossy, unbiased via stochastic rounding. Same scheme
+here, host (NumPy) for messages and a jit variant (``quantize_jax`` /
+``dequantize_jax``) for compressing device pushes before cross-chip
+reduction — the TPU analog of shrinking wire bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..system.message import FilterSpec, Message
+from .base import Filter, register
+
+
+def quantize(
+    arr: np.ndarray, num_bytes: int, rng: np.random.Generator
+) -> Tuple[np.ndarray, float, float]:
+    assert num_bytes in (1, 2), "fixed-point width must be 1 or 2 bytes"
+    lo, hi = float(arr.min()), float(arr.max())
+    if hi <= lo:
+        hi = lo + 1.0
+    levels = float((1 << (8 * num_bytes)) - 1)
+    scaled = (arr.astype(np.float64) - lo) / (hi - lo) * levels
+    noise = rng.random(arr.shape)
+    q = np.floor(scaled + noise)  # stochastic rounding (ref boolrand)
+    dt = np.uint8 if num_bytes == 1 else np.uint16
+    return np.clip(q, 0, levels).astype(dt), lo, hi
+
+
+def dequantize(q: np.ndarray, lo: float, hi: float, num_bytes: int) -> np.ndarray:
+    levels = float((1 << (8 * num_bytes)) - 1)
+    return (q.astype(np.float64) / levels * (hi - lo) + lo).astype(np.float32)
+
+
+def quantize_jax(arr: jax.Array, num_bytes: int, key: jax.Array):
+    """Device-side stochastic quantization for push compression."""
+    levels = float((1 << (8 * num_bytes)) - 1)
+    lo = jnp.min(arr)
+    hi = jnp.maximum(jnp.max(arr), lo + 1e-12)
+    scaled = (arr - lo) / (hi - lo) * levels
+    noise = jax.random.uniform(key, arr.shape)
+    q = jnp.clip(jnp.floor(scaled + noise), 0, levels)
+    dt = jnp.uint8 if num_bytes == 1 else jnp.uint16
+    return q.astype(dt), lo, hi
+
+
+def dequantize_jax(q: jax.Array, lo, hi, num_bytes: int) -> jax.Array:
+    levels = float((1 << (8 * num_bytes)) - 1)
+    return (q.astype(jnp.float32) / levels * (hi - lo) + lo).astype(jnp.float32)
+
+
+@register
+class FixingFloatFilter(Filter):
+    TYPE = "fixing_float"
+
+    def __init__(self) -> None:
+        self._rng = np.random.default_rng(0)
+
+    def encode(self, msg: Message, spec: FilterSpec) -> Message:
+        if spec.num_bytes == 0:
+            return msg
+        ranges = []
+        out = []
+        for v in msg.values:
+            if v.dtype.kind != "f" or v.size == 0:
+                out.append(v)
+                ranges.append(None)
+                continue
+            q, lo, hi = quantize(v, spec.num_bytes, self._rng)
+            out.append(q)
+            ranges.append((lo, hi))
+        msg.values = out
+        spec.extra["ranges"] = ranges
+        return msg
+
+    def decode(self, msg: Message, spec: FilterSpec) -> Message:
+        if spec.num_bytes == 0 or "ranges" not in spec.extra:
+            return msg
+        out = []
+        for v, r in zip(msg.values, spec.extra["ranges"]):
+            if r is None:
+                out.append(v)
+            else:
+                out.append(dequantize(v, r[0], r[1], spec.num_bytes))
+        msg.values = out
+        return msg

@@ -1,0 +1,38 @@
+"""Tail-feature frequency filter (ref ``src/filter/frequency_filter.h``).
+
+``FreqencyFilter`` [sic] in the reference wraps a count-min sketch:
+``InsertKeys(keys, counts)`` accumulates, ``QueryKeys(keys, freq)`` returns
+the subset with estimated count ≥ freq. Used by MinibatchReader to drop
+ultra-rare features before pulling weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.sketch import CountMin
+
+
+class FrequencyFilter:
+    def __init__(self, n: int = 1 << 20, k: int = 2):
+        self._sketch = CountMin(n, k)
+
+    def resize(self, n: int, k: int) -> None:
+        self._sketch = CountMin(n, k)
+
+    def insert_keys(self, keys: np.ndarray, counts: np.ndarray | int = 1) -> None:
+        self._sketch.insert(keys, counts)
+
+    def query_keys(self, keys: np.ndarray, freq: int) -> np.ndarray:
+        """Keys whose estimated frequency ≥ freq (kept sorted if input is)."""
+        if freq <= 0:
+            return np.asarray(keys)
+        est = self._sketch.query(keys)
+        return np.asarray(keys)[est >= freq]
+
+    def clear(self) -> None:
+        self._sketch.clear()
+
+    @property
+    def empty(self) -> bool:
+        return not self._sketch.data.any()

@@ -1,0 +1,62 @@
+"""Sparse value filter (ref ``src/filter/sparse_filter.h``).
+
+The reference marks entries to skip with a NaN bitpattern (kkt filter marks)
+and drops zero runs from the wire. Here: encode replaces each float array
+with (nonzero positions, nonzero values); decode restores the dense array.
+Marked (NaN) entries survive the roundtrip — they encode "skip this
+coordinate", which darlin's KKT filter relies on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..system.message import FilterSpec, Message
+from .base import Filter, register
+
+# the reference uses a fixed NaN payload as the mark (sparse_filter.h kMark)
+MARK = np.float32(np.nan)
+
+
+def mark(arr: np.ndarray, idx) -> None:
+    arr[idx] = MARK
+
+
+def marked(arr: np.ndarray) -> np.ndarray:
+    return np.isnan(arr)
+
+
+@register
+class SparseFilter(Filter):
+    TYPE = "sparse"
+
+    def encode(self, msg: Message, spec: FilterSpec) -> Message:
+        meta = []
+        out = []
+        for v in msg.values:
+            if v.dtype.kind != "f":
+                out.append(v)
+                meta.append(None)
+                continue
+            nz = np.flatnonzero((v != 0) | np.isnan(v))
+            meta.append((len(v), nz.astype(np.int32)))
+            out.append(v[nz])
+        spec.extra["meta"] = meta
+        msg.values = out
+        return msg
+
+    def decode(self, msg: Message, spec: FilterSpec) -> Message:
+        meta = spec.extra.get("meta")
+        if meta is None:
+            return msg
+        out = []
+        for v, m in zip(msg.values, meta):
+            if m is None:
+                out.append(v)
+                continue
+            size, nz = m
+            dense = np.zeros(size, dtype=v.dtype)
+            dense[nz] = v
+            out.append(dense)
+        msg.values = out
+        return msg

@@ -1,0 +1,125 @@
+"""Sharded key-value pull/push — the device data plane.
+
+This is where the reference's ``KVVector::Push/Pull`` message traffic
+(kv_vector.h + van.cc sends) becomes XLA collectives over the mesh:
+
+- **pull**: every (data, server) device gathers the slots it owns for the
+  requested indices, then a ``psum`` over the *server* axis assembles full
+  rows (each slot is owned by exactly one server shard, so summation is
+  assembly). Cross-chip traffic rides ICI, sized ``n_idx × k`` — the same
+  payload the reference puts on the wire, minus serialization.
+- **push**: per-worker values are first combined across the *data* axis
+  (``psum`` — gradient aggregation, the reference's server-side merge of
+  worker messages), then every server shard scatter-adds the entries whose
+  slot falls in its key range. Duplicate indices within a request
+  scatter-add correctly (segment aggregation).
+
+All shapes are static: indices are int32 slot ids produced by the host-side
+localizer/directory; out-of-range or padding entries use slot id ``P``
+(one-past-the-end sentinel) and are dropped by range masking.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
+
+
+def _owned(idx: jnp.ndarray, lo: jnp.ndarray, shard: int):
+    """relative index + ownership mask for a server shard [lo, lo+shard)."""
+    rel = idx - lo
+    ok = (rel >= 0) & (rel < shard)
+    return jnp.clip(rel, 0, shard - 1), ok
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))
+def pull(table: jax.Array, idx: jax.Array, *, mesh: Mesh, batch_sharded: bool = True):
+    """Gather rows ``table[idx]`` from a server-sharded table.
+
+    table: [P, k] sharded P(SERVER, None); idx: [n] int32, sharded over DATA
+    if batch_sharded (each worker pulls its own key set — the common case)
+    else replicated. Returns [n, k] with the same batch sharding.
+    """
+    p_total, _ = table.shape
+    n_server = mesh.shape[SERVER_AXIS]
+    shard = p_total // n_server
+    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+
+    def local(tbl, ix):
+        lo = jax.lax.axis_index(SERVER_AXIS) * shard
+        rel, ok = _owned(ix, lo, shard)
+        vals = jnp.where(ok[:, None], tbl[rel], 0)
+        return jax.lax.psum(vals, SERVER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SERVER_AXIS, None), idx_spec),
+        out_specs=idx_spec,
+    )(table, idx)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "batch_sharded", "average", "combine_data")
+)
+def push(
+    table: jax.Array,
+    idx: jax.Array,
+    vals: jax.Array,
+    *,
+    mesh: Mesh,
+    batch_sharded: bool = True,
+    average: bool = False,
+    combine_data: bool = True,
+):
+    """Scatter-add ``vals`` at ``idx`` into the server-sharded table.
+
+    table: [P, k] sharded P(SERVER, None); idx: [n] int32; vals: [n, k].
+    With batch_sharded, each worker contributes its own (idx, vals): entries
+    are all-gathered over the DATA axis so every server shard sees every
+    contribution (the reference's sliced push messages to each server).
+    ``average`` divides by the worker count (scaled gradient aggregation).
+    """
+    p_total, k = table.shape
+    n_server = mesh.shape[SERVER_AXIS]
+    n_data = mesh.shape[DATA_AXIS]
+    shard = p_total // n_server
+    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+
+    combined = batch_sharded and combine_data and n_data > 1
+
+    def local(tbl, ix, v):
+        if combined:
+            ix = jax.lax.all_gather(ix, DATA_AXIS, tiled=True)
+            v = jax.lax.all_gather(v, DATA_AXIS, tiled=True)
+        if average and combined:
+            # average only when contributions were actually combined
+            v = v / n_data
+        lo = jax.lax.axis_index(SERVER_AXIS) * shard
+        rel, ok = _owned(ix, lo, shard)
+        v = jnp.where(ok[:, None], v, 0)
+        return tbl.at[rel].add(v, mode="drop")
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SERVER_AXIS, None), idx_spec, idx_spec),
+        out_specs=P(SERVER_AXIS, None),
+    )(table, idx, vals)
+
+
+def scatter_grad_dense(
+    idx: jax.Array, vals: jax.Array, p_total: int, k: int
+) -> jax.Array:
+    """Densify a sparse push into a [P, k] gradient table (single-shard
+    helper used by fused learner steps; padding slot P drops)."""
+    g = jnp.zeros((p_total, k), vals.dtype)
+    return g.at[idx].add(vals, mode="drop")

@@ -1,0 +1,130 @@
+"""KVLayer: named dense parameter blobs for neural-net workers.
+
+Counterpart of ``src/parameter/kv_layer.h``: layers are keyed by an int/name,
+pushed and pulled whole. The reference slices a layer across servers when its
+size exceeds ``partition_thr`` and runs a user ``Updater`` on the server
+side; small layers live on one server.
+
+TPU-native: each layer is a jax array; layers ≥ ``partition_thr`` elements
+are sharded over the server axis (first divisible dim), small ones are
+replicated. Push = cross-worker psum of gradients + Updater application
+(one fused jitted step); pull = return the (already resident) array.
+``zero_copy`` parity: device buffers are donated through the updater so no
+copy is made.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel import mesh as meshlib
+from ..parallel.mesh import SERVER_AXIS
+from ..system.message import Task
+from .parameter import Parameter
+
+
+class SGDUpdater:
+    """Default updater: w -= lr * grad (ref KVLayerUpdater is a no-op shell;
+    CXXNET plugs its optimizer — this is the minimal real one)."""
+
+    def __init__(self, lr: float = 0.01):
+        self.lr = lr
+
+    def init(self, name: str, shape, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros(shape, dtype)
+
+    def update(self, name: str, weight: jax.Array, recv: jax.Array) -> jax.Array:
+        return weight - self.lr * recv
+
+
+class KVLayer(Parameter):
+    def __init__(
+        self,
+        partition_thr: int = 1000,
+        updater=None,
+        mesh=None,
+        id: Optional[int] = None,
+        name: str = "",
+    ):
+        super().__init__(id=id, name=name)
+        if mesh is None:
+            assert self.po.mesh is not None, "Postoffice.start() first"
+            mesh = self.po.mesh
+        self.mesh = mesh
+        self.partition_thr = int(partition_thr)
+        self.updater = updater or SGDUpdater()
+        self.layers: Dict[object, jax.Array] = {}
+        self._update_fns: Dict[object, Callable] = {}
+
+    def _sharding(self, shape) -> NamedSharding:
+        size = int(np.prod(shape))
+        n_server = meshlib.num_servers(self.mesh)
+        if size >= self.partition_thr:
+            for dim, d in enumerate(shape):
+                if d % n_server == 0:
+                    spec = [None] * len(shape)
+                    spec[dim] = SERVER_AXIS
+                    return NamedSharding(self.mesh, P(*spec))
+        return meshlib.replicated(self.mesh)
+
+    def init_layer(self, key, shape, dtype=jnp.float32) -> jax.Array:
+        arr = self.updater.init(key, shape, dtype)
+        self.layers[key] = jax.device_put(arr, self._sharding(shape))
+        return self.layers[key]
+
+    def __getitem__(self, key) -> jax.Array:
+        return self.layers[key]
+
+    def layer(self, key) -> jax.Array:
+        return self.layers[key]
+
+    def _update_fn(self, key):
+        if key not in self._update_fns:
+            updater = self.updater
+
+            def fn(weight, recv):
+                return updater.update(key, weight, recv)
+
+            # no buffer donation here: a pending pull future may still alias
+            # the current weight array; donating it would poison that future
+            self._update_fns[key] = jax.jit(fn)
+        return self._update_fns[key]
+
+    def push(self, task: Task, key, data: jax.Array, zero_copy: bool = False, callback=None) -> int:
+        """Push a gradient/update for a layer; the updater runs server-side
+        (ref KVLayer::Push → SetValue → updater_->Update)."""
+        if key not in self.layers:
+            self.init_layer(key, data.shape, data.dtype)
+
+        def step():
+            recv = jnp.asarray(data)
+            self.layers[key] = self._update_fn(key)(self.layers[key], recv)
+            return self.layers[key]
+
+        return self.submit(step, task, callback)
+
+    def pull(self, task: Task, key, callback=None) -> int:
+        """Pull the layer (ref KVLayer::Pull; data lands in layer_ / user buf)."""
+
+        def step():
+            return self.layers[key]
+
+        return self.submit(step, task, callback)
+
+    def wait_pull(self, ts: int):
+        return self.executor.pop_result(ts)
+
+    def get_replica(self) -> dict:
+        return {k: np.asarray(v) for k, v in self.layers.items()}
+
+    def set_replica(self, snapshot: dict) -> None:
+        for k, arr in snapshot.items():
+            self.layers[k] = jax.device_put(
+                jnp.asarray(arr), self._sharding(arr.shape)
+            )

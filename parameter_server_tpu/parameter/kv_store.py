@@ -1,0 +1,25 @@
+"""KVStore: unified façade over the KV containers.
+
+The reference's ``src/parameter/kv_store.h`` is an unfinished placeholder
+(its include line even has typos). For capability parity we provide the
+obvious unification: a factory returning the right container, so user code
+can say ``kv_store(kind=...)`` — and re-export the concrete classes.
+"""
+
+from __future__ import annotations
+
+from .kv_layer import KVLayer
+from .kv_map import AddEntry, AssignEntry, KVMap
+from .kv_vector import KVVector
+
+__all__ = ["KVVector", "KVMap", "KVLayer", "AssignEntry", "AddEntry", "kv_store"]
+
+
+def kv_store(kind: str = "vector", **kwargs):
+    if kind == "vector":
+        return KVVector(**kwargs)
+    if kind == "map":
+        return KVMap(**kwargs)
+    if kind == "layer":
+        return KVLayer(**kwargs)
+    raise ValueError(f"unknown kv store kind: {kind}")

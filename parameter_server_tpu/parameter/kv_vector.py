@@ -1,0 +1,202 @@
+"""KVVector: sharded key-value vectors.
+
+Counterpart of ``src/parameter/kv_vector.h`` (KVVector<K,V>): values are
+fixed-length-k arrays per key, multiple isolated channels, push merges by
+addition, pull returns the current values. The reference stores ordered
+(key, value) arrays per node and matches messages with
+parallel_ordered_match; here each channel owns
+
+- a host ``KeyDirectory`` (ordered global keys or hash mapping), and
+- a device table ``[P, k]`` sharded over the server mesh axis,
+
+and push/pull are the collective kernels in ``ops/kv_ops.py``. The
+``buffer_value`` mode of the reference (stash received data per timestamp
+for later merge — used by BCD servers to aggregate worker gradients before
+an update) maps to ``pull_buffered``/``buffer``: pushes land in a staging
+table instead of the live one.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import kv_ops
+from ..parallel import mesh as meshlib
+from ..system.message import Task
+from ..utils.range import Range
+from .parameter import KeyDirectory, Parameter, pad_slots
+
+
+class _Channel:
+    def __init__(self, directory: KeyDirectory, table: jax.Array):
+        self.directory = directory
+        self.table = table
+        self.key: Optional[np.ndarray] = None  # last key set (ref data_[chl].key)
+        self.buffers: Dict[int, jax.Array] = {}  # ts -> staged pushes
+
+
+class KVVector(Parameter):
+    def __init__(
+        self,
+        mesh=None,
+        k: int = 1,
+        num_slots: int = 1 << 20,
+        hashed: bool = True,
+        dtype=jnp.float32,
+        buffer_value: bool = False,
+        id: Optional[int] = None,
+        name: str = "",
+    ):
+        super().__init__(id=id, name=name)
+        if mesh is None:
+            assert self.po.mesh is not None, "Postoffice.start() first"
+            mesh = self.po.mesh
+        self.mesh = mesh
+        self.k = int(k)
+        self.dtype = dtype
+        self.buffer_value = buffer_value
+        self.num_slots = pad_slots(num_slots, meshlib.num_servers(mesh))
+        self.hashed = hashed
+        self._channels: Dict[int, _Channel] = {}
+
+    # -- channel management (ref operator[]/Clear) --
+
+    def channel(self, ch: int = 0) -> _Channel:
+        if ch not in self._channels:
+            directory = KeyDirectory(self.num_slots, hashed=self.hashed)
+            table = self._zeros()
+            self._channels[ch] = _Channel(directory, table)
+        return self._channels[ch]
+
+    def __getitem__(self, ch: int) -> _Channel:
+        return self.channel(ch)
+
+    def clear(self, ch: int) -> None:
+        self._channels.pop(ch, None)
+
+    def _zeros(self) -> jax.Array:
+        arr = jnp.zeros((self.num_slots, self.k), self.dtype)
+        return jax.device_put(arr, meshlib.table_sharding(self.mesh))
+
+    def set_keys(self, ch: int, keys: np.ndarray) -> None:
+        """Install an exact ordered key set for a channel (ref: the worker
+        assigns ``model_[ch].key = key`` before pulling)."""
+        c = self.channel(ch)
+        keys = np.asarray(keys, dtype=np.int64)
+        c.directory = KeyDirectory(self.num_slots, keys=keys, hashed=False)
+        c.key = keys
+
+    # -- push/pull --
+
+    def slots(self, ch: int, keys: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(self.channel(ch).directory.slots(keys))
+
+    def pull(
+        self,
+        task: Task,
+        keys: Optional[np.ndarray] = None,
+        slots: Optional[jax.Array] = None,
+        callback=None,
+    ) -> int:
+        """Async pull; returns the timestamp. Result via ``wait_pull``."""
+        ch = task.key_channel
+        c = self.channel(ch)
+        if slots is None:
+            assert keys is not None
+            c.key = np.asarray(keys, dtype=np.int64)
+            slots = self.slots(ch, keys)
+
+        def step():
+            return kv_ops.pull(c.table, slots, mesh=self.mesh, batch_sharded=False)
+
+        return self.submit(step, task, callback)
+
+    def wait_pull(self, ts: int) -> jax.Array:
+        return self.executor.pop_result(ts)
+
+    def push(
+        self,
+        task: Task,
+        keys: Optional[np.ndarray] = None,
+        values: Optional[jax.Array] = None,
+        slots: Optional[jax.Array] = None,
+        callback=None,
+    ) -> int:
+        """Async additive push (gradient aggregation); returns timestamp."""
+        ch = task.key_channel
+        c = self.channel(ch)
+        if slots is None:
+            assert keys is not None
+            slots = self.slots(ch, keys)
+        vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
+
+        if self.buffer_value and task.time >= 0:
+            # stage into a per-timestamp buffer (ref buffer_[timestamp])
+            def step():
+                buf = c.buffers.get(task.time)
+                if buf is None:
+                    buf = self._zeros()
+                c.buffers[task.time] = kv_ops.push(
+                    buf, slots, vals, mesh=self.mesh, batch_sharded=False
+                )
+                return c.buffers[task.time]
+
+        else:
+
+            def step():
+                c.table = kv_ops.push(
+                    c.table, slots, vals, mesh=self.mesh, batch_sharded=False
+                )
+                return c.table
+
+        return self.submit(step, task, callback)
+
+    def buffer(self, ch: int, ts: int) -> Optional[jax.Array]:
+        """Staged pushes for a timestamp (ref KVVector::buffer)."""
+        return self.channel(ch).buffers.get(ts)
+
+    def clear_buffer(self, ch: int, ts: int) -> None:
+        self.channel(ch).buffers.pop(ts, None)
+
+    # -- direct (synchronous) access used by learners/tests --
+
+    def values(self, ch: int, keys: np.ndarray) -> np.ndarray:
+        ts = self.pull(self.request(channel=ch), keys=keys)
+        return np.asarray(self.wait_pull(ts))
+
+    def table(self, ch: int = 0) -> jax.Array:
+        return self.channel(ch).table
+
+    def set_table(self, ch: int, table: jax.Array) -> None:
+        self.channel(ch).table = table
+
+    # -- replica hooks --
+
+    def get_replica(self) -> dict:
+        return {ch: np.asarray(c.table) for ch, c in self._channels.items()}
+
+    def set_replica(self, snapshot: dict) -> None:
+        for ch, arr in snapshot.items():
+            c = self.channel(ch)
+            c.table = jax.device_put(
+                jnp.asarray(arr), meshlib.table_sharding(self.mesh)
+            )
+
+    def write_to_file(self, path: str, ch: int = 0) -> None:
+        """Dump nonzero (key, value) pairs as text (ref WriteToFile)."""
+        c = self.channel(ch)
+        tbl = np.asarray(c.table)
+        if c.directory.keys is not None:
+            keys = c.directory.keys
+            vals = tbl[: len(keys)]
+        else:
+            keys = np.arange(self.num_slots, dtype=np.int64)
+            vals = tbl
+        nz = np.any(vals != 0, axis=1)
+        with open(path, "w") as f:
+            for key, val in zip(keys[nz], vals[nz]):
+                f.write(f"{key}\t" + "\t".join(str(x) for x in val) + "\n")

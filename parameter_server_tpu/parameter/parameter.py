@@ -1,0 +1,102 @@
+"""Parameter: base class of shared parameters.
+
+Counterpart of ``src/parameter/parameter.{h,cc}``. The reference routes
+push/pull messages through Customer/Executor and slices them by server key
+range; here the slicing is implicit in the sharded table layout, and the
+base class provides: request construction (channel/timestamp/filters/key
+range — same fields as ``Parameter::Request`` in parameter.h), the
+key directory (global uint64 keys → dense slot ids), and replica hooks.
+
+Key directories come in two modes, both host-side:
+
+- **exact**: a sorted global key array per channel; slot = searchsorted(key)
+  (the reference's ordered unique key arrays in kv_vector.h).
+- **hashed**: slot = mix64(key) % num_slots — the streaming mode where the
+  key universe is unbounded (CTR hashing trick); collisions merge, as in any
+  TPU embedding-hash design.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..system.customer import Customer
+from ..system.message import INVALID_TIME, FilterSpec, Task
+from ..utils.murmur import murmur64_np
+from ..utils.range import Range
+
+
+class Parameter(Customer):
+    def __init__(self, id: Optional[int] = None, name: str = ""):
+        super().__init__(id=id, name=name)
+
+    @staticmethod
+    def request(
+        channel: int = 0,
+        ts: int = INVALID_TIME,
+        wait: Sequence[int] = (),
+        filters: Sequence[FilterSpec] = (),
+        key_range: Optional[Range] = None,
+    ) -> Task:
+        """Build a request task (ref Parameter::Request, parameter.h:24)."""
+        return Task(
+            request=True,
+            time=ts,
+            wait_time=list(wait),
+            key_channel=channel,
+            key_range=key_range if key_range is not None else Range.all(),
+            filters=list(filters),
+        )
+
+    # -- replica hooks (ref parameter.h SetReplica/GetReplica/Recover) --
+
+    def get_replica(self) -> dict:
+        """Snapshot of server-shard state for backup (overridden)."""
+        return {}
+
+    def set_replica(self, snapshot: dict) -> None:
+        pass
+
+    def recover(self, snapshot: dict) -> None:
+        self.set_replica(snapshot)
+
+
+class KeyDirectory:
+    """Host-side key → slot mapping for one channel."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        keys: Optional[np.ndarray] = None,
+        hashed: bool = False,
+    ):
+        self.num_slots = int(num_slots)
+        self.hashed = hashed
+        self.keys = None if keys is None else np.asarray(keys, dtype=np.int64)
+        if self.keys is not None and len(self.keys) > num_slots:
+            raise ValueError(f"{len(self.keys)} keys exceed {num_slots} slots")
+
+    def slots(self, keys: np.ndarray) -> np.ndarray:
+        """Map global keys to dense int32 slot ids; misses map to the
+        sentinel slot ``num_slots`` (dropped by device range masks)."""
+        keys = np.asarray(keys)
+        if self.hashed:
+            h = murmur64_np(keys.astype(np.uint64))
+            return (h % np.uint64(self.num_slots)).astype(np.int32)
+        assert self.keys is not None, "exact directory requires keys"
+        pos = np.searchsorted(self.keys, keys)
+        posc = np.minimum(pos, len(self.keys) - 1) if len(self.keys) else pos
+        hit = (
+            (pos < len(self.keys)) & (self.keys[posc] == keys)
+            if len(self.keys)
+            else np.zeros(len(keys), dtype=bool)
+        )
+        return np.where(hit, pos, self.num_slots).astype(np.int32)
+
+
+def pad_slots(num_slots: int, num_shards: int) -> int:
+    """Round slots up so every server shard is equal-sized (static shapes)."""
+    per = -(-num_slots // num_shards)
+    return per * num_shards

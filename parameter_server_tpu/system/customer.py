@@ -1,0 +1,80 @@
+"""Customer: base class of every shared object (apps, parameters).
+
+Counterpart of ``src/system/customer.h``. A customer owns an executor
+(timestamps + dependency tracking) and registers with the postoffice under a
+unique id, exactly like the reference's ``Customer(id)`` +
+``Postoffice::instance().manager().AddCustomer(this)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .executor import Executor
+from .message import Message, Task
+
+
+class Customer:
+    def __init__(self, id: Optional[int] = None, name: str = ""):
+        from .postoffice import Postoffice
+
+        self.po = Postoffice.instance()
+        self.id = self.po.manager.next_customer_id() if id is None else id
+        self.name = name or f"customer_{self.id}"
+        self.executor = Executor(name=self.name)
+        self.po.manager.add_customer(self)
+
+    # -- communication (ref customer.h Submit/Wait/Reply) --
+
+    def submit(
+        self,
+        step: Callable[[], Any],
+        task: Optional[Task] = None,
+        callback: Optional[Callable[[], None]] = None,
+    ) -> int:
+        return self.executor.submit(step, task, callback)
+
+    def wait(self, timestamp: int) -> Any:
+        return self.executor.wait(timestamp)
+
+    def reply(self, request: Message, response: Optional[Message] = None) -> None:
+        """Mark a request processed and deliver the response to its sender
+        (host-side: invoke the paired customer's ProcessResponse)."""
+        if response is None:
+            response = Message()
+        response.task.request = False
+        response.task.time = request.task.time
+        response.sender, response.recver = request.recver, request.sender
+        self.executor.tracker.finish(request.task.time)
+        target = self.po.manager.find_customer_by_name(request.sender)
+        if target is not None:
+            target.process_response(response)
+        if request.callback is not None:
+            request.callback()
+
+    # -- user hooks (ref ProcessRequest/ProcessResponse) --
+
+    def process_request(self, request: Message) -> None:
+        pass
+
+    def process_response(self, response: Message) -> None:
+        pass
+
+    def remove(self) -> None:
+        self.po.manager.remove_customer(self.id)
+
+
+class App(Customer):
+    """Base application (ref customer.h App): ``run`` is executed by the
+    main thread after construction."""
+
+    def run(self) -> None:
+        pass
+
+    @staticmethod
+    def create(conf: Any) -> "App":
+        """Factory from a config object (ref App::Create in main.cc dispatch);
+        apps register via apps/registry."""
+        from ..apps.registry import create_app
+
+        return create_app(conf)

@@ -1,0 +1,168 @@
+"""Executor: logical clocks and dependency tracking over XLA async dispatch.
+
+Counterpart of ``src/system/executor.{h,cc}`` + ``task_tracker.h``. The
+reference runs a per-customer DAG engine thread that picks received messages
+whose ``wait_time`` dependencies are finished. On TPU the same pipelining
+falls out of XLA's async dispatch: submitting a jitted step returns
+immediately with future arrays; ordering *within* a device queue is program
+order, and cross-step constraints are enforced by blocking on tracked
+futures before dispatch.
+
+``Submit`` assigns a timestamp, runs the step's host closure (which
+dispatches device work), and records returned jax arrays as the step's
+future. ``Wait(ts)`` blocks until that step's arrays are materialized —
+``Customer::Wait`` semantics. Bounded-delay consistency = submit without
+waiting, with a sliding window: ``Submit`` itself blocks when more than
+``max_in_flight`` steps are unfinished (the reference throttles identically
+through its message clocks).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from .message import INVALID_TIME, Message, Task
+
+
+class TaskTracker:
+    """Finished/started timestamp bookkeeping (ref task_tracker.h)."""
+
+    def __init__(self) -> None:
+        self._finished: set[int] = set()
+        self._started: set[int] = set()
+        self._lock = threading.Lock()
+
+    def start(self, ts: int) -> None:
+        with self._lock:
+            self._started.add(ts)
+
+    def finish(self, ts: int) -> None:
+        with self._lock:
+            self._finished.add(ts)
+
+    def is_finished(self, ts: int) -> bool:
+        with self._lock:
+            return ts in self._finished
+
+    def was_started(self, ts: int) -> bool:
+        with self._lock:
+            return ts in self._started
+
+
+class Executor:
+    def __init__(self, name: str = "", max_in_flight: int = 0):
+        self.name = name
+        self._time = 0
+        self._futures: Dict[int, Any] = {}  # ts -> pytree of jax arrays
+        self._callbacks: Dict[int, Callable[[], None]] = {}
+        self.tracker = TaskTracker()
+        self._lock = threading.Lock()
+        self.max_in_flight = max_in_flight  # 0 = unbounded (eventual consistency)
+
+    def time(self) -> int:
+        with self._lock:
+            return self._time
+
+    def _next_time(self) -> int:
+        with self._lock:
+            ts = self._time
+            self._time += 1
+            return ts
+
+    def submit(
+        self,
+        step: Callable[[], Any],
+        task: Optional[Task] = None,
+        callback: Optional[Callable[[], None]] = None,
+    ) -> int:
+        """Dispatch ``step`` with dependency waits; returns its timestamp.
+
+        ``task.wait_time`` lists timestamps that must be *finished* before
+        this step runs (ref executor.cc PickActiveMsg dependency check).
+        Dependencies must reference already-submitted steps — the reference
+        allocates timestamps at Submit, so a dep can never be in the future.
+        """
+        task = task or Task()
+        if task.time != INVALID_TIME:
+            ts = task.time
+            with self._lock:
+                if ts in self._futures or (
+                    ts < self._time and self.tracker.was_started(ts)
+                ):
+                    raise ValueError(f"timestamp {ts} already used")
+                # keep the auto counter ahead of explicit timestamps so they
+                # can never collide with a later auto-assigned one
+                self._time = max(self._time, ts + 1)
+        else:
+            ts = self._next_time()
+        for dep in task.wait_time:
+            if dep == INVALID_TIME:
+                continue
+            if dep >= ts:
+                raise ValueError(f"dependency {dep} is not before step {ts}")
+            self.wait(dep)
+        if self.max_in_flight > 0:
+            self._throttle(ts)
+        self.tracker.start(ts)
+        result = step()
+        with self._lock:
+            self._futures[ts] = result
+            if callback is not None:
+                self._callbacks[ts] = callback
+        return ts
+
+    def _throttle(self, ts: int) -> None:
+        """Bounded-delay window: block until step ts - max_in_flight is done."""
+        horizon = ts - self.max_in_flight
+        if horizon >= 0:
+            self.wait(horizon)
+
+    def wait(self, ts: int) -> Any:
+        """Block until step ``ts`` has materialized (Customer::Wait).
+
+        Evicts the step's future so device buffers are released — without
+        this, every intermediate table version would stay pinned in HBM.
+        Returns the step's value (None if ts is unknown or already waited).
+        """
+        with self._lock:
+            fut = self._futures.pop(ts, None)
+            cb = self._callbacks.pop(ts, None)
+        if fut is not None:
+            jax.block_until_ready(fut)
+        if self.tracker.was_started(ts):
+            self.tracker.finish(ts)
+        if cb is not None:
+            cb()
+        return fut
+
+    def wait_all(self) -> None:
+        with self._lock:
+            pending = list(self._futures.keys())
+        for ts in pending:
+            self.wait(ts)
+
+    def result(self, ts: int) -> Any:
+        """The (possibly still-async) value of step ts (None once waited)."""
+        with self._lock:
+            return self._futures.get(ts)
+
+    def pop_result(self, ts: int) -> Any:
+        return self.wait(ts)
+
+
+class NodeGroups:
+    """Symbolic node group ids (ref executor.h kServerGroup et al.).
+
+    On TPU these resolve to mesh axes rather than socket lists; kept for API
+    parity so app code reads like the reference.
+    """
+
+    SERVER_GROUP = "all_servers"
+    WORKER_GROUP = "all_workers"
+    COMP_GROUP = "all_comp_nodes"
+    REPLICA_GROUP = "all_replicas"
+    OWNER_GROUP = "all_owners"
+    LIVE_GROUP = "all_lives"

@@ -1,0 +1,80 @@
+"""Manager: customer registry and node lifecycle.
+
+Counterpart of ``src/system/manager.{h,cc}``: tracks customers by id,
+assigns fresh customer ids (ref ``NextCustomerID``), records node roles and
+key ranges, and coordinates orderly shutdown. Node join/leave on TPU is mesh
+(re)construction — elastic resize hooks re-shard tables via
+``parameter.replica`` checkpoints rather than live key-range migration.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..utils.range import Range
+
+
+class Node:
+    """A logical node (ref proto/node.proto): role + key range."""
+
+    SCHEDULER, SERVER, WORKER = "scheduler", "server", "worker"
+
+    def __init__(self, role: str, rank: int, key_range: Optional[Range] = None):
+        self.role = role
+        self.rank = rank
+        self.key_range = key_range if key_range is not None else Range.all()
+        # H=scheduler(head), S=server, W=worker — distinct prefixes (the
+        # reference's van.cc uses "H" for the scheduler node id too)
+        prefix = {"scheduler": "H", "server": "S", "worker": "W"}[role]
+        self.id = f"{prefix}{rank}"
+
+    def __repr__(self) -> str:
+        return f"Node({self.id}, keys={self.key_range})"
+
+
+class Manager:
+    def __init__(self) -> None:
+        self._customers: Dict[int, object] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self.nodes: List[Node] = []
+
+    def next_customer_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def add_customer(self, customer) -> None:
+        with self._lock:
+            if customer.id in self._customers:
+                raise ValueError(f"customer id {customer.id} already exists")
+            self._customers[customer.id] = customer
+
+    def remove_customer(self, cid: int) -> None:
+        with self._lock:
+            self._customers.pop(cid, None)
+
+    def get_customer(self, cid: int):
+        with self._lock:
+            return self._customers.get(cid)
+
+    def find_customer_by_name(self, name: str):
+        with self._lock:
+            for c in self._customers.values():
+                if getattr(c, "name", None) == name:
+                    return c
+        return None
+
+    def init_nodes(self, num_servers: int, num_workers: int, key_space: Range) -> None:
+        """Assign server key ranges by even division (ref manager.cc
+        NodeIDGenerator / Range::EvenDivide over servers)."""
+        self.nodes = [Node(Node.SCHEDULER, 0)]
+        for i in range(num_servers):
+            self.nodes.append(Node(Node.SERVER, i, key_space.even_divide(num_servers, i)))
+        for i in range(num_workers):
+            self.nodes.append(Node(Node.WORKER, i))
+
+    def stop(self) -> None:
+        with self._lock:
+            self._customers.clear()

@@ -1,0 +1,113 @@
+"""Tasks and messages — the host control plane.
+
+Counterpart of ``src/system/message.{h,cc}`` + ``proto/task.proto``. In the
+reference every RPC is a ``Task`` protobuf (request flag, logical time,
+wait_time dependencies, key_range, filters, typed payloads) carried in a
+``Message`` with key/value byte arrays over ZMQ. Here the data plane is XLA
+collectives, so Message carries host array references and Task keeps the
+same scheduling metadata (time/wait_time/key_range/channel/filters) used by
+the executor to order jitted steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.range import Range
+
+INVALID_TIME = -1
+
+
+class Command(enum.Enum):
+    """Control commands (ref task.proto Control/ManageNode + sgd.proto
+    SGDCall + bcd.proto BCDCall command enums, collapsed)."""
+
+    TERMINATE = "terminate"
+    REQUEST_WORKLOAD = "request_workload"
+    UPDATE_MODEL = "update_model"
+    PREPROCESS_DATA = "preprocess_data"
+    EVALUATE_PROGRESS = "evaluate_progress"
+    SAVE_MODEL = "save_model"
+    RECOVER = "recover"
+    HEARTBEAT = "heartbeat"
+
+
+@dataclasses.dataclass
+class FilterSpec:
+    """A filter application (ref proto/filter.proto FilterConfig)."""
+
+    type: str  # 'key_caching' | 'compressing' | 'fixing_float' | 'add_noise' | 'sparse'
+    num_bytes: int = 0  # fixing_float width
+    clear_cache_if_done: bool = False
+    mean: float = 0.0
+    std: float = 0.0
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Task:
+    """Scheduling metadata for one logical step (ref task.proto Task)."""
+
+    request: bool = True
+    time: int = INVALID_TIME
+    wait_time: List[int] = dataclasses.field(default_factory=list)
+    key_channel: int = 0
+    key_range: Range = dataclasses.field(default_factory=Range.all)
+    filters: List[FilterSpec] = dataclasses.field(default_factory=list)
+    cmd: Optional[Command] = None
+    push: bool = False  # push vs pull for parameter tasks
+    more: bool = False  # scheduler hint: more blocks coming (ref darlin)
+    payload: Any = None  # app-specific (workload descriptors, progress, ...)
+
+
+@dataclasses.dataclass
+class Message:
+    """One unit of work/communication (ref message.h Message).
+
+    ``key``/``values`` are host numpy arrays (the localized view of device
+    buffers); the device arrays themselves flow through the jitted step the
+    executor dispatches.
+    """
+
+    task: Task = dataclasses.field(default_factory=Task)
+    sender: str = ""
+    recver: str = ""
+    key: Optional[np.ndarray] = None
+    values: List[np.ndarray] = dataclasses.field(default_factory=list)
+    callback: Optional[Callable[[], None]] = None
+
+    def __repr__(self) -> str:
+        nk = 0 if self.key is None else len(self.key)
+        return (
+            f"Message({'req' if self.task.request else 'res'} t={self.task.time} "
+            f"{self.sender}->{self.recver} keys={nk} vals={len(self.values)})"
+        )
+
+
+def slice_message(msg: Message, key_ranges: Sequence[Range]) -> List[Message]:
+    """Partition an ordered-key message by server key ranges.
+
+    Counterpart of ``Parameter::SliceKOFVMessage`` (parameter.h): for each
+    server range, binary-search the key array and emit a sub-message with the
+    matching key/value segments.
+    """
+    out: List[Message] = []
+    keys = msg.key if msg.key is not None else np.zeros(0, dtype=np.int64)
+    for r in key_ranges:
+        lo = int(np.searchsorted(keys, r.begin, side="left"))
+        hi = int(np.searchsorted(keys, r.end, side="left"))
+        sub = Message(
+            task=dataclasses.replace(msg.task, key_range=r),
+            sender=msg.sender,
+            recver=msg.recver,
+            key=keys[lo:hi],
+            values=[v.reshape(len(keys), -1)[lo:hi].reshape(-1) for v in msg.values]
+            if len(keys)
+            else [],
+        )
+        out.append(sub)
+    return out

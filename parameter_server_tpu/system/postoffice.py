@@ -1,0 +1,71 @@
+"""Postoffice: the process-wide system singleton.
+
+Counterpart of ``src/system/postoffice.{h,cc}``: owns the manager (node and
+customer registry) and the van (transport). ``start`` boots the system —
+in the reference that spawns send/recv threads and connects ZMQ; here it
+builds the device mesh (and, multi-host, joins the jax.distributed
+rendezvous), which *is* the connected network on TPU.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from ..parallel import mesh as meshlib
+from ..utils.range import Range
+from .manager import Manager
+from .van import Van, init_distributed
+
+
+class Postoffice:
+    _instance: Optional["Postoffice"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.manager = Manager()
+        self.mesh: Optional[Mesh] = None
+        self.van: Optional[Van] = None
+        self._started = False
+
+    @classmethod
+    def instance(cls) -> "Postoffice":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Test helper — tear down the singleton (ref Postoffice::Stop)."""
+        with cls._lock:
+            cls._instance = None
+
+    def start(
+        self,
+        num_data: Optional[int] = None,
+        num_server: int = 1,
+        key_space: Optional[Range] = None,
+    ) -> "Postoffice":
+        if self._started:
+            return self
+        init_distributed()
+        self.mesh = meshlib.make_mesh(num_data=num_data, num_server=num_server)
+        self.van = Van(self.mesh)
+        self.manager.init_nodes(
+            num_servers=meshlib.num_servers(self.mesh),
+            num_workers=meshlib.num_workers(self.mesh),
+            key_space=key_space or Range.all(),
+        )
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self.manager.stop()
+        self._started = False
+
+    @property
+    def started(self) -> bool:
+        return self._started

@@ -26,8 +26,8 @@ class Range:
     def size(self) -> int:
         return max(0, self.end - self.begin)
 
-    def __len__(self) -> int:
-        return self.size()
+    # NOTE: deliberately no __len__ — bool(Range.all()) would overflow
+    # CPython's index-sized __len__ with a 2^64 key space.
 
     def empty(self) -> bool:
         return self.end <= self.begin

@@ -1,0 +1,149 @@
+"""Filter tests — mirrors src/test/fixing_float_test.cc plus roundtrip
+coverage for each filter in the chain."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.filter import sparse as sparse_filter
+from parameter_server_tpu.filter.base import FilterChain, create
+from parameter_server_tpu.filter.fixing_float import dequantize, quantize
+from parameter_server_tpu.filter.frequency import FrequencyFilter
+from parameter_server_tpu.system.message import FilterSpec, Message, Task
+from parameter_server_tpu.utils.range import Range
+
+
+def msg_with(values, key=None, channel=0):
+    m = Message(task=Task(key_channel=channel, key_range=Range(0, 100)))
+    m.values = values
+    m.key = key
+    return m
+
+
+class TestFixingFloat:
+    def test_quantize_error_bound(self, rng):
+        # ref fixing_float_test.cc: error within one quantization step
+        v = rng.normal(size=10000).astype(np.float32)
+        for nbytes in (1, 2):
+            q, lo, hi = quantize(v, nbytes, rng)
+            back = dequantize(q, lo, hi, nbytes)
+            step = (hi - lo) / ((1 << (8 * nbytes)) - 1)
+            assert np.abs(back - v).max() <= step + 1e-6
+
+    def test_stochastic_rounding_unbiased(self, rng):
+        v = np.full(20000, 0.3, dtype=np.float32)
+        v[0], v[1] = 0.0, 1.0  # pin the range
+        q, lo, hi = quantize(v, 1, rng)
+        back = dequantize(q, lo, hi, 1)
+        assert abs(back[2:].mean() - 0.3) < 1e-3
+
+    def test_chain_roundtrip(self, rng):
+        chain = FilterChain()
+        spec = FilterSpec(type="fixing_float", num_bytes=2)
+        v = rng.normal(size=100).astype(np.float32)
+        m = msg_with([v.copy()])
+        m.task.filters = [spec]
+        enc = chain.encode(m)
+        assert enc.values[0].dtype == np.uint16
+        dec = chain.decode(enc)
+        assert np.abs(dec.values[0] - v).max() < 1e-3
+
+
+class TestKeyCaching:
+    def test_second_send_drops_keys(self):
+        chain_s, chain_r = FilterChain(), FilterChain()
+        keys = np.arange(50, dtype=np.int64)
+        for i in range(2):
+            spec = FilterSpec(type="key_caching")
+            m = msg_with([np.ones(50, np.float32)], key=keys.copy())
+            m.task.filters = [spec]
+            enc = chain_s.encode(m)
+            if i == 0:
+                assert enc.key is not None
+            else:
+                assert enc.key is None  # cache hit: keys omitted
+            dec = chain_r.decode(enc)
+            np.testing.assert_array_equal(dec.key, keys)
+
+    def test_miss_raises(self):
+        chain_r = FilterChain()
+        spec = FilterSpec(type="key_caching")
+        spec.extra["signature"] = 12345
+        m = msg_with([np.ones(3, np.float32)])
+        m.task.filters = [spec]
+        with pytest.raises(KeyError):
+            chain_r.decode(m)
+
+
+class TestCompressing:
+    def test_roundtrip(self, rng):
+        chain = FilterChain()
+        spec = FilterSpec(type="compressing")
+        v = (rng.random(1000) < 0.05).astype(np.float32)  # compressible
+        m = msg_with([v.copy()])
+        m.task.filters = [spec]
+        enc = chain.encode(m)
+        assert enc.values[0].nbytes < v.nbytes  # actually smaller
+        dec = chain.decode(enc)
+        np.testing.assert_array_equal(dec.values[0], v)
+
+
+class TestSparse:
+    def test_zeros_dropped_nans_survive(self):
+        chain = FilterChain()
+        spec = FilterSpec(type="sparse")
+        v = np.array([0, 1.5, 0, 0, 2.5, 0], dtype=np.float32)
+        sparse_filter.mark(v, 2)  # kkt-style mark
+        m = msg_with([v.copy()])
+        m.task.filters = [spec]
+        enc = chain.encode(m)
+        assert len(enc.values[0]) == 3  # 1.5, nan, 2.5
+        dec = chain.decode(enc)
+        assert sparse_filter.marked(dec.values[0])[2]
+        np.testing.assert_array_equal(np.nan_to_num(dec.values[0]), np.nan_to_num(v))
+
+
+class TestAddNoise:
+    def test_noise_added(self, rng):
+        chain = FilterChain()
+        spec = FilterSpec(type="add_noise", std=0.1)
+        v = np.zeros(1000, dtype=np.float32)
+        m = msg_with([v.copy()])
+        m.task.filters = [spec]
+        enc = chain.encode(m)
+        assert 0.05 < enc.values[0].std() < 0.2
+
+
+class TestFrequency:
+    def test_tail_keys_dropped(self, rng):
+        f = FrequencyFilter(1 << 16, 2)
+        hot = rng.integers(0, 1 << 40, 100).astype(np.uint64)
+        cold = rng.integers(1 << 41, 1 << 42, 100).astype(np.uint64)
+        f.insert_keys(hot, 10)
+        f.insert_keys(cold, 1)
+        kept = f.query_keys(np.concatenate([hot, cold]), 5)
+        assert set(hot.tolist()) <= set(kept.tolist())
+        assert len(kept) < 150  # most cold keys dropped
+
+    def test_freq_zero_keeps_all(self):
+        f = FrequencyFilter()
+        keys = np.arange(10, dtype=np.uint64)
+        np.testing.assert_array_equal(f.query_keys(keys, 0), keys)
+
+
+class TestChainOrder:
+    def test_stacked_filters_reverse_decode(self, rng):
+        chain = FilterChain()
+        specs = [
+            FilterSpec(type="sparse"),
+            FilterSpec(type="compressing"),
+        ]
+        v = np.zeros(500, dtype=np.float32)
+        v[::50] = rng.normal(size=10)
+        m = msg_with([v.copy()])
+        m.task.filters = specs
+        dec = chain.decode(chain.encode(m))
+        np.testing.assert_allclose(dec.values[0], v)
+
+    def test_unknown_filter_raises(self):
+        with pytest.raises(ValueError):
+            create("nope")

@@ -1,0 +1,188 @@
+"""Parameter-layer integration tests on the virtual 8-device mesh.
+
+Mirrors the reference's multi-node binaries: kv_vector_ps.cc (push/pull with
+channels), kv_vector_buffer_ps.cc (buffered merges), kv_map_ps.cc (entry
+updaters), kv_layer_ps.cc (layer push/pull + updater), aggregation_ps.cc
+(additive aggregation across pushes).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parameter_server_tpu.ops import kv_ops
+from parameter_server_tpu.parameter.kv_layer import KVLayer, SGDUpdater
+from parameter_server_tpu.parameter.kv_map import AddEntry, AssignEntry, KVMap
+from parameter_server_tpu.parameter.kv_vector import KVVector
+from parameter_server_tpu.parameter.parameter import KeyDirectory, pad_slots
+from parameter_server_tpu.system.postoffice import Postoffice
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+class TestKeyDirectory:
+    def test_exact_hits_and_misses(self):
+        d = KeyDirectory(8, keys=np.array([2, 5, 9, 100]))
+        slots = d.slots(np.array([5, 2, 7, 100]))
+        np.testing.assert_array_equal(slots, [1, 0, 8, 3])  # 7 -> sentinel 8
+
+    def test_hashed_stable_in_range(self):
+        d = KeyDirectory(16, hashed=True)
+        keys = np.arange(1000, dtype=np.int64)
+        s1, s2 = d.slots(keys), d.slots(keys)
+        np.testing.assert_array_equal(s1, s2)
+        assert s1.min() >= 0 and s1.max() < 16
+
+    def test_pad_slots(self):
+        assert pad_slots(10, 4) == 12
+        assert pad_slots(8, 4) == 8
+
+
+class TestKvOps:
+    def test_pull_matches_numpy(self, mesh8):
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        p, k = 32, 3
+        table = jnp.arange(p * k, dtype=jnp.float32).reshape(p, k)
+        table = kv_ops.jax.device_put(table, meshlib.table_sharding(mesh8))
+        idx = jnp.array([0, 5, 31, 16, 5], dtype=jnp.int32)
+        out = kv_ops.pull(table, idx, mesh=mesh8, batch_sharded=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.arange(p * k).reshape(p, k)[np.asarray(idx)]
+        )
+
+    def test_pull_sentinel_is_zero(self, mesh8):
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        table = kv_ops.jax.device_put(
+            jnp.ones((16, 2), jnp.float32), meshlib.table_sharding(mesh8)
+        )
+        out = kv_ops.pull(
+            table, jnp.array([16, 3], dtype=jnp.int32), mesh=mesh8, batch_sharded=False
+        )
+        np.testing.assert_allclose(np.asarray(out), [[0, 0], [1, 1]])
+
+    def test_push_scatter_add_with_duplicates(self, mesh8):
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        table = kv_ops.jax.device_put(
+            jnp.zeros((16, 1), jnp.float32), meshlib.table_sharding(mesh8)
+        )
+        idx = jnp.array([2, 2, 9, 15], dtype=jnp.int32)
+        vals = jnp.array([[1.0], [2.0], [3.0], [4.0]])
+        out = kv_ops.push(table, idx, vals, mesh=mesh8, batch_sharded=False)
+        expect = np.zeros((16, 1))
+        expect[2] = 3.0
+        expect[9] = 3.0
+        expect[15] = 4.0
+        np.testing.assert_allclose(np.asarray(out), expect)
+
+
+class TestKVVector:
+    def test_push_pull_roundtrip(self, mesh8):
+        kv = KVVector(mesh=mesh8, k=2, num_slots=64, hashed=False)
+        keys = np.array([3, 17, 40, 99], dtype=np.int64)
+        kv.set_keys(0, keys)
+        vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+        ts = kv.push(kv.request(channel=0), keys=keys, values=vals)
+        kv.wait(ts)
+        out = kv.values(0, keys)
+        np.testing.assert_allclose(out, vals)
+
+    def test_push_aggregates(self, mesh8):
+        # aggregation_ps.cc: repeated pushes sum
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([1, 5, 9], dtype=np.int64)
+        kv.set_keys(0, keys)
+        for _ in range(3):
+            ts = kv.push(kv.request(channel=0), keys=keys, values=np.ones((3, 1), np.float32))
+            kv.wait(ts)
+        np.testing.assert_allclose(kv.values(0, keys), 3 * np.ones((3, 1)))
+
+    def test_channels_isolated(self, mesh8):
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        k0 = np.array([1, 2], dtype=np.int64)
+        k1 = np.array([1, 2], dtype=np.int64)
+        kv.set_keys(0, k0)
+        kv.set_keys(1, k1)
+        kv.wait(kv.push(kv.request(channel=0), keys=k0, values=np.full((2, 1), 7.0, np.float32)))
+        np.testing.assert_allclose(kv.values(1, k1), np.zeros((2, 1)))
+        np.testing.assert_allclose(kv.values(0, k0), np.full((2, 1), 7.0))
+
+    def test_buffered_push(self, mesh8):
+        # kv_vector_buffer_ps.cc: buffer_value stages instead of merging
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False, buffer_value=True)
+        keys = np.array([4, 8], dtype=np.int64)
+        kv.set_keys(0, keys)
+        task = kv.request(channel=0, ts=5)
+        ts = kv.push(task, keys=keys, values=np.ones((2, 1), np.float32))
+        kv.wait(ts)
+        # live table untouched, buffer holds the push
+        np.testing.assert_allclose(kv.values(0, keys), np.zeros((2, 1)))
+        buf = np.asarray(kv.buffer(0, 5))
+        assert buf[kv.channel(0).directory.slots(keys)].sum() == 2.0
+        kv.clear_buffer(0, 5)
+        assert kv.buffer(0, 5) is None
+
+    def test_write_to_file(self, mesh8, tmp_path):
+        kv = KVVector(mesh=mesh8, k=1, num_slots=16, hashed=False)
+        keys = np.array([2, 11], dtype=np.int64)
+        kv.set_keys(0, keys)
+        kv.wait(kv.push(kv.request(0), keys=keys, values=np.array([[1.5], [0.0]], np.float32)))
+        path = tmp_path / "model.txt"
+        kv.write_to_file(str(path), ch=0)
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 1 and lines[0].startswith("2\t")
+
+
+class TestKVMap:
+    def test_assign_entry(self, mesh8):
+        m = KVMap(AssignEntry(), mesh=mesh8, k=1, num_slots=32, keys=np.array([5, 10, 20]))
+        ts = m.push(m.request(), np.array([5, 20]), np.array([[1.0], [2.0]]))
+        m.wait(ts)
+        np.testing.assert_allclose(m.values(np.array([5, 10, 20])), [[1.0], [0.0], [2.0]])
+
+    def test_add_entry_accumulates(self, mesh8):
+        m = KVMap(AddEntry(), mesh=mesh8, k=2, num_slots=32, keys=np.array([1, 2]))
+        for _ in range(2):
+            m.wait(m.push(m.request(), np.array([1, 2]), np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(m.values(np.array([1, 2])), 2 * np.ones((2, 2)))
+
+    def test_replica_roundtrip(self, mesh8):
+        m = KVMap(AssignEntry(), mesh=mesh8, k=1, num_slots=16, keys=np.array([3]))
+        m.wait(m.push(m.request(), np.array([3]), np.array([[9.0]])))
+        snap = m.get_replica()
+        m2 = KVMap(AssignEntry(), mesh=mesh8, k=1, num_slots=16, keys=np.array([3]))
+        m2.set_replica(snap)
+        np.testing.assert_allclose(m2.values(np.array([3])), [[9.0]])
+
+
+class TestKVLayer:
+    def test_sgd_updater_push_pull(self, mesh8):
+        layer = KVLayer(partition_thr=4, updater=SGDUpdater(lr=0.5), mesh=mesh8)
+        layer.init_layer("w1", (8, 2))
+        grad = jnp.ones((8, 2))
+        layer.wait(layer.push(layer.request(), "w1", grad))
+        out = np.asarray(layer.wait_pull(layer.pull(layer.request(), "w1")))
+        np.testing.assert_allclose(out, -0.5 * np.ones((8, 2)))
+
+    def test_small_layer_replicated_large_sharded(self, mesh8):
+        layer = KVLayer(partition_thr=100, mesh=mesh8)
+        small = layer.init_layer("b", (3,))
+        big = layer.init_layer("w", (128, 4))
+        assert small.sharding.is_fully_replicated
+        assert not big.sharding.is_fully_replicated
+
+    def test_replica(self, mesh8):
+        layer = KVLayer(mesh=mesh8)
+        layer.init_layer("w", (4,))
+        layer.wait(layer.push(layer.request(), "w", jnp.ones(4)))
+        snap = layer.get_replica()
+        l2 = KVLayer(mesh=mesh8)
+        l2.set_replica(snap)
+        np.testing.assert_allclose(np.asarray(l2["w"]), -0.01 * np.ones(4))
