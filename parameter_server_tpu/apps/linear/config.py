@@ -1,0 +1,243 @@
+"""Linear-method configuration.
+
+Dataclass mirror of ``src/app/linear_method/proto/linear.proto`` (Config,
+SGDConfig, LossConfig, PenaltyConfig, LearningRateConfig) plus the BCD
+extension fields used by darlin (``delta_init_value``, ``delta_max_value``,
+``kkt_filter_threshold_ratio``) and ``src/learner/proto/bcd.proto``'s
+BCDConfig. Parsed from the reference's protobuf-text ``.conf`` files by
+``parse_conf`` so the example configs keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """ref data/proto/data.proto DataConfig."""
+
+    format: str = "text"  # text | record | bin
+    text: str = "libsvm"  # libsvm | criteo | adfea | terafea | ps (TextFormat)
+    file: List[str] = dataclasses.field(default_factory=list)
+    ignore_feature_group: bool = False
+    range_begin: int = 0  # example range restriction (ref DataConfig.range)
+    range_end: int = 0
+
+
+@dataclasses.dataclass
+class LossConfig:
+    type: str = "logit"  # square | logit | hinge | square_hinge
+
+
+@dataclasses.dataclass
+class PenaltyConfig:
+    type: str = "l1"  # l1 | l2
+    lambda_: List[float] = dataclasses.field(default_factory=lambda: [0.1])
+
+
+@dataclasses.dataclass
+class LearningRateConfig:
+    type: str = "decay"  # constant | decay
+    alpha: float = 0.1
+    beta: float = 1.0
+
+
+@dataclasses.dataclass
+class SGDConfig:
+    """ref learner/proto/sgd.proto SGDConfig."""
+
+    algo: str = "ftrl"  # standard | ftrl
+    minibatch: int = 1000
+    data_buf: int = 1000  # prefetch budget, MB
+    ada_grad: bool = True  # for algo=standard
+    max_delay: int = 0  # bounded-delay window (in-flight steps)
+    num_data_pass: int = 1
+    report_interval: float = 1.0
+    tail_feature_freq: int = 0
+    countmin_n: int = 100_000_000
+    countmin_k: int = 2
+    push_filter: list = dataclasses.field(default_factory=list)
+    pull_filter: list = dataclasses.field(default_factory=list)
+    # TPU extensions
+    num_slots: int = 1 << 22  # hashed weight table size
+    rows_pad: int = 0  # 0 = minibatch size
+    nnz_pad: int = 0  # 0 = auto from first batch
+    ell_lanes: int = 0  # >0: ELL row-block packing with K feature lanes
+
+
+@dataclasses.dataclass
+class BCDConfig:
+    """ref learner/proto/bcd.proto + darlin extensions in linear.proto."""
+
+    num_data_pass: int = 10  # max_pass_of_data
+    feature_block_ratio: float = 4.0
+    random_feature_block_order: bool = True
+    max_block_delay: int = 0
+    epsilon: float = 1e-4
+    save_model_every_n_iter: int = 0
+    load_local_data: bool = False
+    comm_filter: list = dataclasses.field(default_factory=list)
+    # darlin trust-region extension fields
+    delta_init_value: float = 1.0
+    delta_max_value: float = 5.0
+    kkt_filter_threshold_ratio: float = 10.0
+
+
+@dataclasses.dataclass
+class Config:
+    training_data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    validation_data: Optional[DataConfig] = None
+    model_output: Optional[DataConfig] = None
+    model_input: Optional[DataConfig] = None
+    loss: LossConfig = dataclasses.field(default_factory=LossConfig)
+    penalty: PenaltyConfig = dataclasses.field(default_factory=PenaltyConfig)
+    learning_rate: LearningRateConfig = dataclasses.field(
+        default_factory=LearningRateConfig
+    )
+    async_sgd: Optional[SGDConfig] = None
+    darlin: Optional[BCDConfig] = None
+
+
+_ENUMS = {
+    "LOGIT": "logit", "SQUARE": "square", "HINGE": "hinge",
+    "SQUARE_HINGE": "square_hinge", "L1": "l1", "L2": "l2",
+    "CONSTANT": "constant", "DECAY": "decay", "FTRL": "ftrl",
+    "STANDARD": "standard", "TEXT": "text", "LIBSVM": "libsvm",
+    "CRITEO": "criteo", "ADFEA": "adfea", "TERAFEA": "terafea",
+    "BIN": "bin", "PROTO": "record",
+}
+
+
+def parse_conf_dict(text: str) -> dict:
+    """Parse protobuf text format into nested dicts (repeated -> lists)."""
+    text = re.sub(r"#[^\n]*", "", text)
+
+    def parse_block(pos: int):
+        out: dict = {}
+        while pos < len(text):
+            while pos < len(text) and text[pos] in " \t\r\n;":
+                pos += 1
+            if pos >= len(text) or text[pos] == "}":
+                return out, pos + 1
+            m = re.match(r"([A-Za-z_][A-Za-z0-9_]*)\s*", text[pos:])
+            if not m:
+                raise ValueError(f"parse error at {text[pos:pos+40]!r}")
+            key = m.group(1)
+            pos += m.end()
+            if pos < len(text) and text[pos] == "{":
+                val, pos = parse_block(pos + 1)
+            else:
+                if text[pos] == ":":
+                    pos += 1
+                while pos < len(text) and text[pos] in " \t":
+                    pos += 1
+                if text[pos] == "{":
+                    val, pos = parse_block(pos + 1)
+                elif text[pos] == '"':
+                    end = text.index('"', pos + 1)
+                    val = text[pos + 1 : end]
+                    pos = end + 1
+                else:
+                    m2 = re.match(r"[^\s{}]+", text[pos:])
+                    raw = m2.group(0)
+                    pos += m2.end()
+                    val = _coerce(raw)
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(val)
+            else:
+                out[key] = val
+        return out, pos
+
+    d, _ = parse_block(0)
+    return d
+
+
+def _coerce(raw: str):
+    if raw in _ENUMS:
+        return _ENUMS[raw]
+    if raw.lower() in ("true", "false"):
+        return raw.lower() == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+def _data_config(d: dict) -> DataConfig:
+    files = d.get("file", [])
+    if not isinstance(files, list):
+        files = [files]
+    return DataConfig(
+        format=str(d.get("format", "text")).lower(),
+        text=str(d.get("text", "libsvm")).lower(),
+        file=[str(f) for f in files],
+        ignore_feature_group=bool(d.get("ignore_feature_group", False)),
+    )
+
+
+def parse_conf(text: str) -> Config:
+    """Parse a reference-style .conf (protobuf text) into Config."""
+    d = parse_conf_dict(text)
+    cfg = Config()
+    if "training_data" in d:
+        cfg.training_data = _data_config(d["training_data"])
+    if "validation_data" in d:
+        cfg.validation_data = _data_config(d["validation_data"])
+    if "model_output" in d:
+        cfg.model_output = _data_config(d["model_output"])
+    if "model_input" in d:
+        cfg.model_input = _data_config(d["model_input"])
+    if "loss" in d:
+        cfg.loss = LossConfig(type=str(d["loss"].get("type", "logit")))
+    if "penalty" in d:
+        lam = d["penalty"].get("lambda", [0.1])
+        if not isinstance(lam, list):
+            lam = [lam]
+        cfg.penalty = PenaltyConfig(
+            type=str(d["penalty"].get("type", "l1")), lambda_=[float(x) for x in lam]
+        )
+    if "learning_rate" in d:
+        lr = d["learning_rate"]
+        cfg.learning_rate = LearningRateConfig(
+            type=str(lr.get("type", "decay")),
+            alpha=float(lr.get("alpha", 0.1)),
+            beta=float(lr.get("beta", 1.0)),
+        )
+    if "async_sgd" in d:
+        s = d["async_sgd"]
+        cfg.async_sgd = SGDConfig(
+            algo=str(s.get("algo", "ftrl")),
+            minibatch=int(s.get("minibatch", 1000)),
+            data_buf=int(s.get("data_buf", 1000)),
+            ada_grad=bool(s.get("ada_grad", True)),
+            max_delay=int(s.get("max_delay", 0)),
+            num_data_pass=int(s.get("num_data_pass", 1)),
+            report_interval=float(s.get("report_interval", 1.0)),
+            tail_feature_freq=int(s.get("tail_feature_freq", 0)),
+            countmin_n=int(float(s.get("countmin_n", 1e8))),
+            countmin_k=int(s.get("countmin_k", 2)),
+        )
+    if "darlin" in d:
+        b = d["darlin"]
+        cfg.darlin = BCDConfig(
+            num_data_pass=int(b.get("max_pass_of_data", b.get("num_data_pass", 10))),
+            feature_block_ratio=float(b.get("feature_block_ratio", 4.0)),
+            random_feature_block_order=bool(b.get("random_feature_block_order", True)),
+            max_block_delay=int(b.get("max_block_delay", 0)),
+            epsilon=float(b.get("epsilon", 1e-4)),
+            save_model_every_n_iter=int(b.get("save_model_every_n_iter", 0)),
+            load_local_data=bool(b.get("load_local_data", False)),
+            delta_init_value=float(b.get("delta_init_value", 1.0)),
+            delta_max_value=float(b.get("delta_max_value", 5.0)),
+            kkt_filter_threshold_ratio=float(b.get("kkt_filter_threshold_ratio", 10.0)),
+        )
+    return cfg

@@ -1,0 +1,25 @@
+"""Learning-rate schedules (ref ``src/app/linear_method/learning_rate.h``):
+
+CONSTANT: η = α;  DECAY: η(x) = α / (x + β), where x is the per-coordinate
+scale (√n in FTRL/AdaGrad). jnp-traceable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LearningRate:
+    CONSTANT = "constant"
+    DECAY = "decay"
+
+    def __init__(self, type_: str = DECAY, alpha: float = 0.1, beta: float = 1.0):
+        assert alpha > 0 and beta >= 0
+        self.type = type_.lower()
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def eval(self, x=0.0):
+        if self.type == self.CONSTANT:
+            return jnp.asarray(self.alpha)
+        return self.alpha / (x + self.beta)

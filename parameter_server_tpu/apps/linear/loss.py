@@ -1,0 +1,69 @@
+"""Scalar losses for linear methods.
+
+Counterpart of ``src/app/linear_method/loss.h``: logit and square hinge
+(plus square for regression), each exposing objective value, per-row
+gradient dL/d(Xw), and a per-row diagonal-Hessian (curvature) weight — the
+pieces the reference's ``ScalarLoss::compute`` assembles into X^T(...)
+products, which here happen in ops/spmv.
+
+Note: the reference's SquareHingeLoss gradient uses the indicator
+``y*Xw > 1`` (active side inverted, src loss.h:110); we implement the
+standard subgradient ``-2 y max(0, 1 - y·Xw)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LogitLoss:
+    """L(y, Xw) = sum log(1 + exp(-y Xw)), y ∈ {-1, +1}."""
+
+    def evaluate(self, y, xw):
+        return jnp.sum(jnp.logaddexp(0.0, -y * xw))
+
+    def row_grad(self, y, xw):
+        tau = 1.0 / (1.0 + jnp.exp(y * xw))
+        return -y * tau
+
+    def row_hess(self, y, xw):
+        tau = 1.0 / (1.0 + jnp.exp(y * xw))
+        return tau * (1.0 - tau)
+
+
+class SquareHingeLoss:
+    """L = sum max(0, 1 - y Xw)^2."""
+
+    def evaluate(self, y, xw):
+        return jnp.sum(jnp.maximum(0.0, 1.0 - y * xw) ** 2)
+
+    def row_grad(self, y, xw):
+        return -2.0 * y * jnp.maximum(0.0, 1.0 - y * xw)
+
+    def row_hess(self, y, xw):
+        return jnp.where(y * xw < 1.0, 2.0, 0.0)
+
+
+class SquareLoss:
+    """L = 0.5 sum (Xw - y)^2 (regression)."""
+
+    def evaluate(self, y, xw):
+        return 0.5 * jnp.sum((xw - y) ** 2)
+
+    def row_grad(self, y, xw):
+        return xw - y
+
+    def row_hess(self, y, xw):
+        return jnp.ones_like(y)
+
+
+def create_loss(type_: str):
+    """Factory (ref loss.h createLoss)."""
+    t = type_.lower()
+    if t == "logit":
+        return LogitLoss()
+    if t in ("square_hinge", "squarehinge"):
+        return SquareHingeLoss()
+    if t == "square":
+        return SquareLoss()
+    raise ValueError(f"unknown loss type: {type_}")

@@ -1,0 +1,41 @@
+"""Penalties and proximal operators.
+
+Counterpart of ``src/app/linear_method/penalty.h``: elastic net
+``λ1 |x| + λ2 x²`` with the proximal step
+``prox(z, η) = soft(z, λ1 η) / (1 + λ2 η)`` — exactly the reference's
+``ElasticNet::proximal``. jnp-traced; used inside FTRL/AdaGrad updaters and
+darlin's shrink step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ElasticNet:
+    def __init__(self, lambda1: float = 0.0, lambda2: float = 0.0):
+        assert lambda1 >= 0 and lambda2 >= 0
+        self.lambda1 = float(lambda1)
+        self.lambda2 = float(lambda2)
+
+    def eval(self, w) -> jnp.ndarray:
+        return self.lambda1 * jnp.sum(jnp.abs(w)) + self.lambda2 * jnp.sum(w * w)
+
+    def proximal(self, z, eta):
+        """argmin_x 0.5/η (x-z)² + h(x) (ref penalty.h:proximal)."""
+        leta = self.lambda1 * eta
+        shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - leta, 0.0)
+        return shrunk / (1.0 + self.lambda2 * eta)
+
+
+def create_penalty(type_: str, lambdas) -> ElasticNet:
+    """Factory (ref penalty.h createPenalty): L1 -> (λ1[, λ2]), L2 -> (0, λ)."""
+    t = type_.lower()
+    lambdas = list(lambdas)
+    if t == "l1":
+        l1 = lambdas[0]
+        l2 = lambdas[1] if len(lambdas) > 1 else 0.0
+        return ElasticNet(l1, l2)
+    if t == "l2":
+        return ElasticNet(0.0, lambdas[0])
+    raise ValueError(f"unknown penalty type: {type_}")

@@ -1,0 +1,118 @@
+"""Server-side updaters: FTRL, AdaGrad, SGD.
+
+Counterparts of the per-key entry structs in
+``src/app/linear_method/async_sgd.h`` (FTRLEntry, AdaGradEntry, SGDEntry)
+— vectorized over slots. Each updater defines:
+
+- ``init(num_slots)``: struct-of-arrays state,
+- ``weights(state_u)``: model weights from (gathered) state — FTRL derives
+  w from (z, √n) exactly like FTRLEntry which "not necessary to store w",
+- ``apply(state, grad, touched)``: the entry ``Set`` step, fused dense over
+  a server shard with a touched mask (untouched slots pass through).
+
+The same objects plug into KVMap as entries (parameter/kv_map.py protocol)
+and into the fused SPMD train step (async_sgd.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from .learning_rate import LearningRate
+from .penalty import ElasticNet
+
+
+class FTRLUpdater:
+    """FTRL-proximal (ref FTRLEntry::Set, async_sgd.h:131-151):
+
+        n' = sqrt(n² + g²); σ = (n' − n)/α; z += g − σ w; n = n'
+        w = prox(−z·η, η),  η = lr.eval(n') = α/(n' + β)
+    """
+
+    def __init__(self, lr: LearningRate, penalty: ElasticNet):
+        self.lr = lr
+        self.penalty = penalty
+
+    def init(self, num_slots: int) -> Dict[str, jnp.ndarray]:
+        return {
+            "z": jnp.zeros(num_slots, jnp.float32),
+            "sqrt_n": jnp.zeros(num_slots, jnp.float32),
+        }
+
+    def weights(self, state):
+        eta = self.lr.eval(state["sqrt_n"])
+        return self.penalty.proximal(-state["z"] * eta, eta)
+
+    def apply(self, state, grad, touched):
+        z, sqrt_n = state["z"], state["sqrt_n"]
+        w = self.weights(state)
+        sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
+        sigma = (sqrt_n_new - sqrt_n) / self.lr.alpha
+        z_new = z + grad - sigma * w
+        return {
+            "z": jnp.where(touched, z_new, z),
+            "sqrt_n": jnp.where(touched, sqrt_n_new, sqrt_n),
+        }
+
+
+class AdaGradUpdater:
+    """AdaGrad (ref AdaGradEntry::Set): sum_sq += g²;
+    w = prox(w − η g, η), η = lr.eval(√sum_sq)."""
+
+    def __init__(self, lr: LearningRate, penalty: ElasticNet):
+        self.lr = lr
+        self.penalty = penalty
+
+    def init(self, num_slots: int) -> Dict[str, jnp.ndarray]:
+        return {
+            "w": jnp.zeros(num_slots, jnp.float32),
+            "sum_sq": jnp.zeros(num_slots, jnp.float32),
+        }
+
+    def weights(self, state):
+        return state["w"]
+
+    def apply(self, state, grad, touched):
+        sum_sq = state["sum_sq"] + grad * grad
+        eta = self.lr.eval(jnp.sqrt(sum_sq))
+        w = self.penalty.proximal(state["w"] - eta * grad, eta)
+        return {
+            "w": jnp.where(touched, w, state["w"]),
+            "sum_sq": jnp.where(touched, sum_sq, state["sum_sq"]),
+        }
+
+
+class SGDUpdater:
+    """Plain (proximal) SGD with a global step count — the reference's
+    commented-out SGDEntry, completed: w = prox(w − η g, η), η = lr.eval(√t)."""
+
+    def __init__(self, lr: LearningRate, penalty: ElasticNet):
+        self.lr = lr
+        self.penalty = penalty
+
+    def init(self, num_slots: int) -> Dict[str, jnp.ndarray]:
+        return {
+            "w": jnp.zeros(num_slots, jnp.float32),
+            "t": jnp.zeros((), jnp.float32),
+        }
+
+    def weights(self, state):
+        return state["w"]
+
+    def apply(self, state, grad, touched):
+        t = state["t"] + 1.0
+        eta = self.lr.eval(jnp.sqrt(t))
+        w = self.penalty.proximal(state["w"] - eta * grad, eta)
+        return {"w": jnp.where(touched, w, state["w"]), "t": t}
+
+
+def create_updater(algo: str, ada_grad: bool, lr: LearningRate, penalty: ElasticNet):
+    """ref AsyncSGDServer ctor dispatch (async_sgd.h:46-58)."""
+    a = algo.lower()
+    if a == "ftrl":
+        return FTRLUpdater(lr, penalty)
+    if a == "standard":
+        return AdaGradUpdater(lr, penalty) if ada_grad else SGDUpdater(lr, penalty)
+    raise ValueError(f"unknown sgd algo: {algo}")

@@ -115,7 +115,7 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
       char* e2;
       double val = strtod(vp, &e2);
       if (e2 == vp) break;
-      if (nnz >= max_nnz) return row;  // capacity hit: report rows done
+      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return row; }  // capacity hit
       indices[nnz] = idx;
       values[nnz] = (float)val;
       ++nnz;
@@ -156,7 +156,7 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
       ++p;  // consume tab
       ++slot;
       if (p >= line_end || *p == '\t') continue;  // missing field
-      if (nnz >= max_nnz) return row;
+      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return row; }  // capacity hit
       if (slot <= 13) {  // integer feature: value = log-ish raw, key = slot
         char* e;
         double v = strtod(p, &e);

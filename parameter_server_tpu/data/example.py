@@ -1,0 +1,86 @@
+"""Example/slot containers + binary serialization.
+
+Counterpart of ``src/data/proto/example.proto`` (Example/Slot/SlotInfo/
+ExampleInfo) and ``src/data/common.h`` conversions — without protobuf: a
+compact numpy framing (`batch_to_bytes`/`batch_from_bytes`) stored inside
+recordio files, and slot/statistics dataclasses used by info_parser and the
+slot reader.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.sparse import SparseBatch
+
+_MAGIC = b"PSB1"
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    """ref example.proto SlotInfo."""
+
+    id: int = 0
+    format: str = "sparse"  # dense | sparse | sparse_binary
+    min_key: int = (1 << 64) - 1
+    max_key: int = 0
+    nnz_ele: int = 0
+    nnz_ex: int = 0
+
+
+@dataclasses.dataclass
+class ExampleInfo:
+    """ref example.proto ExampleInfo."""
+
+    slot: List[SlotInfo] = dataclasses.field(default_factory=list)
+    num_ex: int = 0
+
+    def merge(self, other: "ExampleInfo") -> None:
+        self.num_ex += other.num_ex
+        by_id: Dict[int, SlotInfo] = {s.id: s for s in self.slot}
+        for s in other.slot:
+            if s.id in by_id:
+                d = by_id[s.id]
+                d.min_key = min(d.min_key, s.min_key)
+                d.max_key = max(d.max_key, s.max_key)
+                d.nnz_ele += s.nnz_ele
+                d.nnz_ex += s.nnz_ex
+            else:
+                self.slot.append(dataclasses.replace(s))
+        self.slot.sort(key=lambda s: s.id)
+
+
+def batch_to_bytes(batch: SparseBatch) -> bytes:
+    """Serialize a SparseBatch (the Example-records payload)."""
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    binary = 1 if batch.binary else 0
+    buf.write(struct.pack("<qqq", batch.n, batch.nnz, binary))
+    buf.write(batch.y.astype(np.float32).tobytes())
+    buf.write(batch.indptr.astype(np.int64).tobytes())
+    buf.write(batch.indices.astype(np.int64).tobytes())
+    if not binary:
+        buf.write(batch.values.astype(np.float32).tobytes())
+    return buf.getvalue()
+
+
+def batch_from_bytes(data: bytes) -> SparseBatch:
+    if data[:4] != _MAGIC:
+        raise IOError("bad batch magic")
+    n, nnz, binary = struct.unpack_from("<qqq", data, 4)
+    off = 4 + 24
+    y = np.frombuffer(data, np.float32, n, off).copy()
+    off += 4 * n
+    indptr = np.frombuffer(data, np.int64, n + 1, off).copy()
+    off += 8 * (n + 1)
+    indices = np.frombuffer(data, np.int64, nnz, off).copy()
+    off += 8 * nnz
+    values = None
+    if not binary:
+        values = np.frombuffer(data, np.float32, nnz, off).copy()
+    return SparseBatch(y=y, indptr=indptr, indices=indices, values=values)

@@ -1,0 +1,90 @@
+"""Streaming minibatch reader (ref ``src/data/stream_reader.h``).
+
+``StreamReader<V>::readMatrices(size, &out)`` pulls the next N examples
+from a list of (possibly gzipped) text/record files. Here:
+``StreamReader.minibatches(n)`` yields SparseBatch chunks of n examples,
+crossing file boundaries, using ExampleParser for the configured format.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..utils import file as psfile
+from ..utils import recordio
+from ..utils.sparse import SparseBatch
+from .text_parser import ExampleParser
+
+
+def _concat_batches(parts: List[SparseBatch]) -> SparseBatch:
+    if len(parts) == 1:
+        return parts[0]
+    y = np.concatenate([p.y for p in parts])
+    indptr = [np.zeros(1, np.int64)]
+    offset = 0
+    for p in parts:
+        indptr.append(p.indptr[1:] + offset)
+        offset += p.indptr[-1]
+    binary = all(p.binary for p in parts)
+    return SparseBatch(
+        y=y,
+        indptr=np.concatenate(indptr),
+        indices=np.concatenate([p.indices for p in parts]),
+        values=None
+        if binary
+        else np.concatenate([p.value_array() for p in parts]),
+    )
+
+
+class StreamReader:
+    def __init__(self, files: List[str], data_format: str = "libsvm"):
+        self.files = psfile.expand_globs(files)
+        self.format = data_format
+        self.parser = ExampleParser(data_format) if data_format != "record" else None
+
+    def _lines(self) -> Iterator[str]:
+        for path in self.files:
+            yield from psfile.read_lines(path)
+
+    def _record_batches(self) -> Iterator[SparseBatch]:
+        from .example import batch_from_bytes
+
+        for path in self.files:
+            with open(path, "rb") as f:
+                for payload in recordio.RecordReader(f):
+                    yield batch_from_bytes(payload)
+
+    def minibatches(self, size: int) -> Iterator[SparseBatch]:
+        """Yield batches of ``size`` examples (last may be smaller)."""
+        if self.format == "record":
+            pending: List[SparseBatch] = []
+            count = 0
+            for b in self._record_batches():
+                pending.append(b)
+                count += b.n
+                while count >= size:
+                    merged = _concat_batches(pending)
+                    yield merged.slice_rows(0, size)
+                    rest = merged.slice_rows(size, merged.n)
+                    pending = [rest] if rest.n else []
+                    count = rest.n
+            if count:
+                yield _concat_batches(pending)
+            return
+        lines: List[str] = []
+        for line in self._lines():
+            lines.append(line)
+            if len(lines) >= size:
+                yield self.parser.parse_lines(lines)
+                lines = []
+        if lines:
+            yield self.parser.parse_lines(lines)
+
+    def read_all(self) -> Optional[SparseBatch]:
+        """Whole-dataset read (BCD preprocessing path)."""
+        parts = list(self.minibatches(1 << 16))
+        if not parts:
+            return None
+        return _concat_batches(parts)

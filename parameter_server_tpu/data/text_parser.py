@@ -1,0 +1,255 @@
+"""Text format parsers (ref ``src/data/text_parser.{h,cc}`` ExampleParser).
+
+Formats, as in the reference: libsvm ("label idx:val ..."), criteo
+(label \\t 13 numeric \\t 26 hex categorical), adfea ("line_id key:groupid ..."
+with label first), terafea, and ps_sparse/ps_dense. Output is a SparseBatch
+(CSR over uint64 feature keys). The C++ fast path (cpp/psnative.cc
+ps_parse_*) handles the two hot formats; NumPy/Python fallbacks cover all.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from ..cpp import native
+from ..utils.sparse import SparseBatch
+
+# per-slot key striping for multi-slot formats (matches cpp/psnative.cc)
+SLOT_SPACE = 1 << 52
+
+
+def _batch_from_rows(
+    labels: List[float], row_keys: List[np.ndarray], row_vals: Optional[List[np.ndarray]]
+) -> SparseBatch:
+    n = len(labels)
+    counts = np.array([len(k) for k in row_keys], dtype=np.int64)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    indices = (
+        np.concatenate(row_keys).astype(np.int64) if n and indptr[-1] else np.zeros(0, np.int64)
+    )
+    values = None
+    if row_vals is not None:
+        values = (
+            np.concatenate(row_vals).astype(np.float32)
+            if n and indptr[-1]
+            else np.zeros(0, np.float32)
+        )
+    return SparseBatch(
+        y=np.asarray(labels, dtype=np.float32), indptr=indptr, indices=indices, values=values
+    )
+
+
+def parse_libsvm(lines: List[str]) -> SparseBatch:
+    labels, keys, vals = [], [], []
+    for line in lines:
+        parts = line.split()
+        if not parts:
+            continue
+        try:
+            label = float(parts[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k, v = [], []
+        for tok in parts[1:]:
+            i, _, x = tok.partition(":")
+            try:
+                k.append(int(i))
+                v.append(float(x) if x else 1.0)
+            except ValueError:
+                continue
+        keys.append(np.asarray(k, dtype=np.int64))
+        vals.append(np.asarray(v, dtype=np.float32))
+    return _batch_from_rows(labels, keys, vals)
+
+
+def parse_criteo(lines: List[str]) -> SparseBatch:
+    """label\\t13 ints\\t26 hex cats; numeric slots 1-13 keyed by slot id,
+    categorical slots 14-39 hashed into per-slot stripes (ref ParseCriteo)."""
+    labels, keys, vals = [], [], []
+    for line in lines:
+        f = line.rstrip("\n").split("\t")
+        if len(f) < 2:
+            continue
+        try:
+            label = int(f[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k, v = [], []
+        for slot, tok in enumerate(f[1:40], start=1):
+            if not tok:
+                continue
+            if slot <= 13:
+                try:
+                    x = float(tok)
+                except ValueError:
+                    continue
+                k.append(slot * SLOT_SPACE)
+                v.append(x)
+            else:
+                try:
+                    h = int(tok, 16)
+                except ValueError:
+                    continue
+                k.append(slot * SLOT_SPACE + h % (SLOT_SPACE - 1) + 1)
+                v.append(1.0)
+        keys.append(np.asarray(k, dtype=np.int64))
+        vals.append(np.asarray(v, dtype=np.float32))
+    return _batch_from_rows(labels, keys, vals)
+
+
+def parse_adfea(lines: List[str]) -> SparseBatch:
+    """ref ParseAdfea: "line_id; clicked; key:group_id key:group_id ...";
+    binary features, keys striped by group id."""
+    labels, keys = [], []
+    for line in lines:
+        toks = line.replace(";", " ").split()
+        if len(toks) < 2:
+            continue
+        try:
+            label = float(toks[1])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k = []
+        for tok in toks[2:]:
+            i, _, grp = tok.partition(":")
+            try:
+                key = int(i)
+                g = int(grp) if grp else 0
+            except ValueError:
+                continue
+            k.append(g * SLOT_SPACE + key % (SLOT_SPACE - 1))
+        keys.append(np.asarray(k, dtype=np.int64))
+    return _batch_from_rows(labels, keys, None)
+
+
+def parse_terafea(lines: List[str]) -> SparseBatch:
+    """ref ParseTerafea: "label |ns feature ..." VW-flavoured namespaces."""
+    labels, keys = [], []
+    for line in lines:
+        parts = line.split("|")
+        head = parts[0].split()
+        if not head:
+            continue
+        try:
+            label = float(head[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k = []
+        for ns_block in parts[1:]:
+            toks = ns_block.split()
+            if not toks:
+                continue
+            ns = hash(toks[0]) & 0x3FF
+            for feat in toks[1:]:
+                k.append(ns * SLOT_SPACE + (hash(feat) & (SLOT_SPACE - 2)))
+        keys.append(np.asarray(k, dtype=np.int64))
+    return _batch_from_rows(labels, keys, None)
+
+
+def parse_ps_sparse(lines: List[str]) -> SparseBatch:
+    """ref ParsePS sparse: "label;grp_id idx:val ...;grp_id ...;" — we fold
+    groups into key stripes like criteo."""
+    labels, keys, vals = [], [], []
+    for line in lines:
+        groups = [g for g in line.strip().split(";") if g]
+        if not groups:
+            continue
+        try:
+            label = float(groups[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k, v = [], []
+        for grp in groups[1:]:
+            toks = grp.split()
+            if not toks:
+                continue
+            try:
+                gid = int(toks[0])
+            except ValueError:
+                continue
+            for tok in toks[1:]:
+                i, _, x = tok.partition(":")
+                try:
+                    k.append(gid * SLOT_SPACE + int(i))
+                    v.append(float(x) if x else 1.0)
+                except ValueError:
+                    continue
+        keys.append(np.asarray(k, dtype=np.int64))
+        vals.append(np.asarray(v, dtype=np.float32))
+    return _batch_from_rows(labels, keys, vals)
+
+
+def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBatch]:
+    lib = native()
+    if lib is None:
+        return None
+    fn = getattr(lib, fn_name)
+    max_nnz = max(1024, len(text) // 2)
+    while True:
+        y = np.zeros(max_rows, np.float32)
+        indptr = np.zeros(max_rows + 1, np.int64)
+        indices = np.zeros(max_nnz, np.uint64)
+        values = np.zeros(max_nnz, np.float32)
+        out_nnz = ctypes.c_int64(0)
+        rows = fn(
+            text,
+            len(text),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_rows,
+            max_nnz,
+            ctypes.byref(out_nnz),
+        )
+        nnz = out_nnz.value
+        if nnz >= max_nnz:
+            # buffer exactly full ⇒ possible mid-stream capacity stop
+            # (psnative.cc early-return contract): retry bigger
+            max_nnz *= 2
+            continue
+        return SparseBatch(
+            y=y[:rows].copy(),
+            indptr=indptr[: rows + 1].copy(),
+            indices=indices[:nnz].astype(np.int64),
+            values=values[:nnz].copy(),
+        )
+
+
+_PY_PARSERS = {
+    "libsvm": parse_libsvm,
+    "criteo": parse_criteo,
+    "adfea": parse_adfea,
+    "terafea": parse_terafea,
+    "ps": parse_ps_sparse,
+    "ps_sparse": parse_ps_sparse,
+}
+_NATIVE = {"libsvm": "ps_parse_libsvm", "criteo": "ps_parse_criteo"}
+
+
+class ExampleParser:
+    """Format-dispatching parser (ref ExampleParser::Init/ToProto)."""
+
+    def __init__(self, format_: str = "libsvm", use_native: bool = True):
+        f = format_.lower()
+        if f not in _PY_PARSERS:
+            raise ValueError(f"unknown text format: {format_}")
+        self.format = f
+        self.use_native = use_native and f in _NATIVE
+
+    def parse_lines(self, lines: List[str]) -> SparseBatch:
+        if self.use_native and lines:
+            blob = ("\n".join(lines) + "\n").encode()
+            out = _parse_native(blob, _NATIVE[self.format], len(lines) + 1)
+            if out is not None:
+                return out
+        return _PY_PARSERS[self.format](lines)

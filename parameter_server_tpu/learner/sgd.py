@@ -1,0 +1,167 @@
+"""SGD learner framework (ref ``src/learner/sgd.{h,cc}``).
+
+- ``SGDProgress``: the progress record (ref learner/proto/sgd.proto).
+- ``ISGDScheduler``: workload pool + monitor + progress table printing
+  (ref ISGDScheduler::Run / ShowProgress / MergeProgress).
+- ``ISGDCompNode``: computation node base with a reporter slaver.
+- ``MinibatchReader``: prefetching minibatch source with countmin
+  tail-feature filtering and key localization (ref MinibatchReader<V>).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..data.stream_reader import StreamReader
+from ..filter.frequency import FrequencyFilter
+from ..system.customer import App
+from ..system.monitor import MonitorMaster, MonitorSlaver
+from ..utils.concurrent import ProducerConsumer
+from ..utils.localizer import Localizer, count_uniq_keys
+from ..utils.sparse import SparseBatch
+from .workload_pool import WorkloadPool
+
+
+@dataclasses.dataclass
+class SGDProgress:
+    """ref sgd.proto SGDProgress."""
+
+    objective: List[float] = dataclasses.field(default_factory=list)
+    num_examples_processed: int = 0
+    accuracy: List[float] = dataclasses.field(default_factory=list)
+    auc: List[float] = dataclasses.field(default_factory=list)
+    nnz: int = 0
+    weight_sum: float = 0.0
+    delta_sum: float = 0.0
+
+    def merge(self, other: "SGDProgress") -> None:
+        """ref ISGDScheduler::MergeProgress."""
+        self.objective.extend(other.objective)
+        self.accuracy.extend(other.accuracy)
+        self.auc.extend(other.auc)
+        self.num_examples_processed += other.num_examples_processed
+        self.nnz = other.nnz or self.nnz
+        self.weight_sum += other.weight_sum
+        self.delta_sum += other.delta_sum
+
+
+class ISGDScheduler(App):
+    """Scheduler: hands workloads to comp nodes, merges progress, prints the
+    live table (ref ISGDScheduler::Run + ShowProgress)."""
+
+    def __init__(self, workload_pool: Optional[WorkloadPool] = None, name: str = "sgd_scheduler"):
+        super().__init__(name=name)
+        self.workload_pool = workload_pool or WorkloadPool()
+        self.monitor: MonitorMaster[SGDProgress] = MonitorMaster()
+        self.monitor.set_data_merger(lambda src, dst: dst.merge(src))
+        self._show_prog_head = True
+        self.num_ex_processed = 0
+
+    def show_progress(self, elapsed: float, progress: Dict[str, SGDProgress]) -> None:
+        """ref ISGDScheduler::ShowProgress — one merged line per interval."""
+        total = SGDProgress()
+        for p in progress.values():
+            total.merge(p)
+        if not total.objective:
+            return
+        if self._show_prog_head:
+            print(" sec  examples    loss      auc   accuracy")
+            self._show_prog_head = False
+        self.num_ex_processed += total.num_examples_processed
+        # objective entries are per-minibatch sums; display per-example loss
+        per_ex = sum(total.objective) / max(1, total.num_examples_processed)
+        print(
+            f"{elapsed:4.0f}  {self.num_ex_processed:.2e}  "
+            f"{per_ex:.5f}  {np.mean(total.auc or [0]):.4f}  "
+            f"{np.mean(total.accuracy or [0]):.4f}"
+        )
+        for p in progress.values():  # reset accumulation window
+            p.objective.clear()
+            p.auc.clear()
+            p.accuracy.clear()
+            p.num_examples_processed = 0
+
+    def run(self) -> None:
+        self.monitor.set_printer(self.show_progress, interval=1.0)
+
+
+class ISGDCompNode(App):
+    """ref ISGDCompNode: has a reporter to the scheduler's monitor."""
+
+    def __init__(self, name: str = "sgd_comp", monitor: Optional[MonitorMaster] = None):
+        super().__init__(name=name)
+        self.reporter: MonitorSlaver[SGDProgress] = MonitorSlaver(monitor, name)
+
+    def attach_monitor(self, scheduler: ISGDScheduler) -> None:
+        self.reporter = MonitorSlaver(scheduler.monitor, self.name)
+
+
+class MinibatchReader:
+    """Prefetching minibatch reader (ref MinibatchReader<V>, sgd.h:60-143).
+
+    Streams SparseBatches from files, filters tail features with a countmin
+    sketch, and yields (batch, uniq_keys) with keys still global — the
+    worker's ``prep_batch`` does the final remap to table slots.
+    """
+
+    def __init__(
+        self,
+        files: Optional[List[str]] = None,
+        minibatch_size: int = 1000,
+        data_format: str = "libsvm",
+        capacity: int = 16,
+        batches: Optional[Iterator[SparseBatch]] = None,
+    ):
+        self._source: Optional[Iterator[SparseBatch]] = batches
+        if self._source is None:
+            reader = StreamReader(files or [], data_format)
+            self._source = reader.minibatches(minibatch_size)
+        self._filter: Optional[FrequencyFilter] = None
+        self._freq = 0
+        self._pc: ProducerConsumer[SparseBatch] = ProducerConsumer(capacity)
+        self._started = False
+
+    def init_filter(self, n: int, k: int, freq: int) -> None:
+        """Countmin tail-feature filter (ref InitFilter)."""
+        self._filter = FrequencyFilter(n, k)
+        self._freq = freq
+
+    def start(self) -> None:
+        src = self._source
+
+        def produce() -> Optional[SparseBatch]:
+            return next(src, None)
+
+        self._pc.start_producer(produce)
+        self._started = True
+
+    def read(self) -> Optional[SparseBatch]:
+        """Next minibatch with tail features dropped (ref Read)."""
+        if not self._started:
+            self.start()
+        batch = self._pc.pop()
+        if batch is None:
+            return None
+        if self._filter is not None and self._freq > 0:
+            keys, cnt = count_uniq_keys(batch)
+            self._filter.insert_keys(keys, cnt)
+            keep = self._filter.query_keys(keys, self._freq)
+            loc = Localizer()
+            loc.count_uniq_index(batch)
+            local = loc.remap_index(keep)
+            # restore global key ids so downstream sees a normal batch
+            local.indices = keep[local.indices]
+            local.num_cols = batch.num_cols
+            return local
+        return batch
+
+    def __iter__(self) -> Iterator[SparseBatch]:
+        while True:
+            b = self.read()
+            if b is None:
+                return
+            yield b

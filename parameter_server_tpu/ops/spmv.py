@@ -1,0 +1,55 @@
+"""Sparse matrix-vector ops for example batches.
+
+The worker-side compute of the reference (Eigen CSR matvec in
+``loss.h::compute`` / the hand loops in ``darlin.h::ComputeGradient``)
+becomes segment-sum/gather kernels over the padded-COO device encoding
+(utils/sparse.py PaddedBatch): all shapes static, padding entries point at a
+sentinel column with value 0 so they vanish from every reduction.
+
+A batch arrives *localized*: ``ucols`` indexes into the batch's unique-slot
+array, so weight gathers touch each unique feature once (the reference pulls
+per unique key for the same reason — kv_vector.h ordered unique keys).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def row_segment_sum(values: jnp.ndarray, rows: jnp.ndarray, num_rows: int) -> jnp.ndarray:
+    """sum_{e: rows[e]=i} values[e] → [num_rows]. Xw when values = x_e * w_e."""
+    return jax.ops.segment_sum(values, rows, num_segments=num_rows)
+
+
+def spmv(
+    vals: jnp.ndarray,  # [nnz] feature values (0 for padding)
+    ucols: jnp.ndarray,  # [nnz] index into w_uniq
+    rows: jnp.ndarray,  # [nnz] example ids
+    w_uniq: jnp.ndarray,  # [U] weights for the batch's unique features
+    num_rows: int,
+) -> jnp.ndarray:
+    """Xw for a localized padded batch: [num_rows]."""
+    return row_segment_sum(vals * w_uniq[ucols], rows, num_rows)
+
+
+def spmv_t(
+    vals: jnp.ndarray,
+    ucols: jnp.ndarray,
+    rows: jnp.ndarray,
+    row_grad: jnp.ndarray,  # [num_rows] d loss / d (Xw)_i
+    num_uniq: int,
+) -> jnp.ndarray:
+    """X^T g: per-unique-feature gradient, [U] (loss.h transTimes)."""
+    return jax.ops.segment_sum(vals * row_grad[rows], ucols, num_segments=num_uniq)
+
+
+def spmv_t_sq(
+    vals: jnp.ndarray,
+    ucols: jnp.ndarray,
+    rows: jnp.ndarray,
+    row_h: jnp.ndarray,  # [num_rows] per-row curvature weight
+    num_uniq: int,
+) -> jnp.ndarray:
+    """(X.^2)^T h: diagonal-Hessian accumulation, [U] (loss.h dotTimes path)."""
+    return jax.ops.segment_sum(vals * vals * row_h[rows], ucols, num_segments=num_uniq)

@@ -84,6 +84,9 @@ class KeyDirectory:
         keys = np.asarray(keys)
         if self.hashed:
             h = murmur64_np(keys.astype(np.uint64))
+            if self.num_slots & (self.num_slots - 1) == 0:
+                # pow2 table: bitmask beats uint64 modulo by ~5x on host
+                return (h & np.uint64(self.num_slots - 1)).astype(np.int32)
             return (h % np.uint64(self.num_slots)).astype(np.int32)
         assert self.keys is not None, "exact directory requires keys"
         pos = np.searchsorted(self.keys, keys)

@@ -1,0 +1,67 @@
+"""Progress monitoring (ref ``src/system/monitor.h``).
+
+MonitorMaster collects typed progress reports from slavers and merges them
+per node; MonitorSlaver pushes reports. The reference moves these over
+messages on a timer; here slavers call the master directly (same process —
+the scheduler is host-side), preserving the merge semantics and the
+periodic display hook.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Generic, Optional, TypeVar
+
+P = TypeVar("P")
+
+
+class MonitorMaster(Generic[P]):
+    def __init__(self, merger: Optional[Callable[[P, P], None]] = None):
+        self._progress: Dict[str, P] = {}
+        self._merger = merger
+        self._printer: Optional[Callable[[float, Dict[str, P]], None]] = None
+        self._interval = 1.0
+        self._lock = threading.Lock()
+        self._start = time.time()
+        self._last_print = 0.0
+
+    def set_data_merger(self, fn: Callable[[P, P], None]) -> None:
+        self._merger = fn
+
+    def set_printer(self, fn: Callable[[float, Dict[str, P]], None], interval: float = 1.0) -> None:
+        self._printer = fn
+        self._interval = interval
+
+    def report(self, node_id: str, progress: P) -> None:
+        with self._lock:
+            cur = self._progress.get(node_id)
+            if cur is None or self._merger is None:
+                self._progress[node_id] = progress
+            else:
+                self._merger(progress, cur)
+        self.maybe_print()
+
+    def maybe_print(self, force: bool = False) -> None:
+        if self._printer is None:
+            return
+        now = time.time()
+        if force or now - self._last_print >= self._interval:
+            self._last_print = now
+            with self._lock:
+                snapshot = dict(self._progress)
+            self._printer(now - self._start, snapshot)
+
+    def progress(self) -> Dict[str, P]:
+        with self._lock:
+            return dict(self._progress)
+
+
+class MonitorSlaver(Generic[P]):
+    def __init__(self, master: Optional[MonitorMaster[P]], node_id: str):
+        self.master = master
+        self.node_id = node_id
+
+    def report(self, progress: P) -> None:
+        if self.master is not None:
+            self.master.report(self.node_id, progress)

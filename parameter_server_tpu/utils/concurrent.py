@@ -47,21 +47,37 @@ class ProducerConsumer(Generic[T]):
 
     def __init__(self, capacity: int = 16):
         self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
-        self._thread: Optional[threading.Thread] = None
+        self._threads: list[threading.Thread] = []
+        self._live = 0
+        self._live_lock = threading.Lock()
 
-    def start_producer(self, produce: Callable[[], Optional[T]]) -> None:
-        """``produce`` returns the next item or None at end of stream."""
+    def start_producer(
+        self, produce: Callable[[], Optional[T]], num_threads: int = 1
+    ) -> None:
+        """``produce`` returns the next item or None at end of stream.
+
+        With num_threads > 1, several producers drain the same source
+        concurrently (``produce`` must be thread-safe); order of items is
+        then unspecified — fine for SGD minibatches, which the reference
+        shuffles anyway.
+        """
+        self._live = num_threads
 
         def run():
             while True:
                 item = produce()
                 if item is None:
-                    self._q.put(self._END)
+                    with self._live_lock:
+                        self._live -= 1
+                        if self._live == 0:
+                            self._q.put(self._END)
                     return
                 self._q.put(item)
 
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+        for _ in range(num_threads):
+            t = threading.Thread(target=run, daemon=True)
+            self._threads.append(t)
+            t.start()
 
     def pop(self) -> Optional[T]:
         item = self._q.get()

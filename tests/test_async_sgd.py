@@ -1,0 +1,198 @@
+"""End-to-end async SGD tests: FTRL parity vs a NumPy oracle of the
+reference's FTRLEntry math, AdaGrad, bounded delay, reader pipeline, config
+parsing. Mirrors the role of the reference's example/linear rcv1 runs."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    PenaltyConfig,
+    SGDConfig,
+    parse_conf,
+)
+from parameter_server_tpu.learner.sgd import MinibatchReader
+from parameter_server_tpu.parameter.parameter import KeyDirectory
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def make_conf(algo="ftrl", ada_grad=True, num_slots=512, max_delay=0, alpha=0.5):
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=alpha, beta=1.0)
+    conf.async_sgd = SGDConfig(
+        algo=algo, ada_grad=ada_grad, minibatch=256, num_slots=num_slots,
+        max_delay=max_delay,
+    )
+    return conf
+
+
+def synth(n_batches, w_true, seed0=0):
+    for i in range(n_batches):
+        yield random_sparse(256, 512, 8, seed=seed0 + i, w_true=w_true)
+
+
+@pytest.fixture(scope="module")
+def w_true():
+    rng = np.random.default_rng(0)
+    return (rng.normal(size=512) * (rng.random(512) < 0.2)).astype(np.float32)
+
+
+def ftrl_oracle(n_batches, w_true, alpha=0.5, beta=1.0, l1=0.01, l2=0.0):
+    """The reference FTRLEntry::Set math (async_sgd.h:131-151), dense numpy."""
+    z = np.zeros(512)
+    n = np.zeros(512)
+
+    def w_from():
+        eta = alpha / (n + beta)
+        zt = -z * eta
+        return np.sign(zt) * np.maximum(np.abs(zt) - l1 * eta, 0) / (1 + l2 * eta)
+
+    for i in range(n_batches):
+        b = random_sparse(256, 512, 8, seed=i, w_true=w_true)
+        w = w_from()
+        X = b.to_dense()
+        xw = X @ w
+        tau = 1 / (1 + np.exp(b.y * xw))
+        g = X.T @ (-b.y * tau)
+        n_new = np.sqrt(n * n + g * g)
+        z += g - (n_new - n) / alpha * w
+        n = n_new
+    return w_from()
+
+
+class TestFTRLParity:
+    def test_matches_reference_math(self, mesh8, w_true):
+        worker = AsyncSGDWorker(make_conf(), mesh=mesh8)
+        worker.directory = KeyDirectory(worker.num_slots, keys=np.arange(512))
+        for batch in synth(10, w_true):
+            worker.collect(worker.process_minibatch(batch))
+        w_oracle = ftrl_oracle(10, w_true)
+        np.testing.assert_allclose(
+            worker.weights_dense()[:512], w_oracle, atol=2e-5
+        )
+
+    def test_l1_induces_sparsity(self, mesh8, w_true):
+        def nnz_with(lambda1):
+            conf = make_conf()
+            conf.penalty = PenaltyConfig(type="l1", lambda_=[lambda1])
+            worker = AsyncSGDWorker(conf, mesh=mesh8)
+            worker.directory = KeyDirectory(worker.num_slots, keys=np.arange(512))
+            for batch in synth(10, w_true):
+                worker.collect(worker.process_minibatch(batch))
+            return (worker.weights_dense() != 0).mean()
+
+        sparse_frac, dense_frac = nnz_with(5.0), nnz_with(0.001)
+        assert sparse_frac < 0.6 * dense_frac  # heavier l1 -> markedly sparser
+
+
+class TestConvergence:
+    def test_ftrl_converges(self, mesh8, w_true):
+        worker = AsyncSGDWorker(make_conf(num_slots=4096), mesh=mesh8)
+        prog = worker.train(synth(40, w_true))
+        ev = worker.evaluate(random_sparse(2000, 512, 8, seed=999, w_true=w_true))
+        assert ev["auc"] > 0.65
+        assert ev["logloss"] < 0.68  # below chance log(2)
+        assert prog.num_examples_processed == 40 * 256
+
+    def test_adagrad_converges(self, mesh8, w_true):
+        worker = AsyncSGDWorker(
+            make_conf(algo="standard", ada_grad=True, num_slots=4096), mesh=mesh8
+        )
+        worker.train(synth(40, w_true))
+        ev = worker.evaluate(random_sparse(2000, 512, 8, seed=999, w_true=w_true))
+        assert ev["auc"] > 0.65
+
+    def test_bounded_delay_still_converges(self, mesh8, w_true):
+        worker = AsyncSGDWorker(make_conf(num_slots=4096, max_delay=3), mesh=mesh8)
+        worker.train(synth(40, w_true))
+        ev = worker.evaluate(random_sparse(2000, 512, 8, seed=999, w_true=w_true))
+        assert ev["auc"] > 0.6  # staleness costs a little, must still learn
+
+    def test_save_model(self, mesh8, w_true, tmp_path):
+        worker = AsyncSGDWorker(make_conf(num_slots=4096), mesh=mesh8)
+        worker.train(synth(5, w_true))
+        path = tmp_path / "model.txt"
+        worker.save_model(str(path))
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) > 10
+        key, val = lines[0].split("\t")
+        assert float(val) != 0
+
+
+class TestReaderPipeline:
+    def test_libsvm_file_to_training(self, mesh8, w_true, tmp_path):
+        # write libsvm, read through MinibatchReader, train
+        path = tmp_path / "train.libsvm"
+        with open(path, "w") as f:
+            for b in synth(4, w_true):
+                dense = b.to_dense()
+                for i in range(b.n):
+                    lo, hi = b.indptr[i], b.indptr[i + 1]
+                    feats = " ".join(
+                        f"{int(k)}:{v:.4f}"
+                        for k, v in zip(b.indices[lo:hi], b.values[lo:hi])
+                    )
+                    f.write(f"{int(b.y[i])} {feats}\n")
+        reader = MinibatchReader(files=[str(path)], minibatch_size=256)
+        worker = AsyncSGDWorker(make_conf(num_slots=4096), mesh=mesh8)
+        prog = worker.train(iter(reader))
+        assert prog.num_examples_processed == 4 * 256
+
+    def test_tail_filter_reduces_features(self, mesh8, w_true):
+        batches = list(synth(3, w_true))
+        reader = MinibatchReader(batches=iter(batches))
+        reader.init_filter(1 << 14, 2, freq=100)  # absurd threshold drops all
+        out = reader.read()
+        assert out.nnz < batches[0].nnz
+
+
+class TestConfParsing:
+    def test_reference_style_conf(self):
+        text = """
+        # L1 logistic regression
+        training_data {
+          format: TEXT
+          text: LIBSVM
+          file: "data/rcv1_train"
+        }
+        loss { type: LOGIT }
+        penalty { type: L1 lambda: 1 lambda: 0.1 }
+        learning_rate { type: DECAY alpha: 1 beta: 1 }
+        async_sgd {
+          algo: FTRL
+          minibatch: 10000
+          max_delay: 4
+          tail_feature_freq: 4
+        }
+        """
+        cfg = parse_conf(text)
+        assert cfg.training_data.file == ["data/rcv1_train"]
+        assert cfg.loss.type == "logit"
+        assert cfg.penalty.lambda_ == [1.0, 0.1]
+        assert cfg.async_sgd.minibatch == 10000
+        assert cfg.async_sgd.max_delay == 4
+
+    def test_darlin_conf(self):
+        text = """
+        darlin {
+          feature_block_ratio: 4
+          max_block_delay: 2
+          max_pass_of_data: 20
+          epsilon: 2e-5
+        }
+        """
+        cfg = parse_conf(text)
+        assert cfg.darlin.max_block_delay == 2
+        assert cfg.darlin.num_data_pass == 20
+        assert cfg.darlin.epsilon == 2e-5
