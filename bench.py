@@ -67,7 +67,7 @@ def main() -> int:
         algo="ftrl",
         minibatch=args.minibatch,
         num_slots=args.num_slots,
-        max_delay=1,
+        max_delay=4,  # the reference criteo conf's bounded delay
         ell_lanes=args.nnz_per_row,
     )
     worker = AsyncSGDWorker(conf, mesh=po.mesh)
@@ -111,7 +111,8 @@ def main() -> int:
     pc.start_producer(produce, num_threads=3)
 
     def upload_and_submit(prepped):
-        return worker._submit_prepped(jax.device_put(prepped))
+        # with_aux=False: skip the per-example AUC outputs in the hot loop
+        return worker._submit_prepped(jax.device_put(prepped), with_aux=False)
 
     # warmup (compile)
     pending = []

@@ -256,7 +256,25 @@ def _lane_positions(counts: np.ndarray, lanes: int) -> np.ndarray:
     return np.where(pos < lanes, pos, -1)
 
 
-def make_train_step_ell(updater, loss, mesh, num_slots: int, binary: bool):
+def _progress_metrics(loss, y, xw, mask, with_aux: bool):
+    """SGDProgress scalars (padding rows masked out of the objective); the
+    per-example xw/y/mask aux — needed only for host-side AUC — costs three
+    all_gathers + a device→host minibatch transfer, so it's optional."""
+    metrics = {
+        "objective": jax.lax.psum(jnp.sum(loss.row_loss(y, xw) * mask), DATA_AXIS),
+        "num_ex": jax.lax.psum(jnp.sum(mask), DATA_AXIS),
+        "correct": jax.lax.psum(jnp.sum(((xw > 0) == (y > 0)) * mask), DATA_AXIS),
+    }
+    if with_aux:
+        metrics["xw"] = jax.lax.all_gather(xw, DATA_AXIS)
+        metrics["y"] = jax.lax.all_gather(y, DATA_AXIS)
+        metrics["mask"] = jax.lax.all_gather(mask, DATA_AXIS)
+    return metrics
+
+
+def make_train_step_ell(
+    updater, loss, mesh, num_slots: int, binary: bool, with_aux: bool = True
+):
     """Fused SPMD step over ELL batches: Xw is a lane reduction (no row
     scatter); only the push keeps a scatter-add."""
     n_server = meshlib.num_servers(mesh)
@@ -297,17 +315,7 @@ def make_train_step_ell(updater, loss, mesh, num_slots: int, binary: bool):
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
         new_state = updater.apply(live, g_shard, touched)
 
-        objective = jax.lax.psum(loss.evaluate(y, xw * mask), DATA_AXIS)
-        num_ex = jax.lax.psum(jnp.sum(mask), DATA_AXIS)
-        correct = jax.lax.psum(jnp.sum(((xw > 0) == (y > 0)) * mask), DATA_AXIS)
-        metrics = {
-            "objective": objective,
-            "num_ex": num_ex,
-            "correct": correct,
-            "xw": jax.lax.all_gather(xw, DATA_AXIS),
-            "y": jax.lax.all_gather(y, DATA_AXIS),
-            "mask": jax.lax.all_gather(mask, DATA_AXIS),
-        }
+        metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
 
     def state_spec(state):
@@ -332,7 +340,9 @@ def make_train_step_ell(updater, loss, mesh, num_slots: int, binary: bool):
     return step
 
 
-def make_train_step_hashed(updater, loss, mesh, num_slots: int):
+def make_train_step_hashed(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True
+):
     """Per-entry fused SPMD step (hashed fast path): gather state at each
     nnz slot, segment-sum Xw by row, scatter per-entry gradients densely —
     duplicates fold in the scatter, so no uniquification anywhere."""
@@ -367,17 +377,7 @@ def make_train_step_hashed(updater, loss, mesh, num_slots: int):
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
         new_state = updater.apply(live, g_shard, touched)
 
-        objective = jax.lax.psum(loss.evaluate(y, xw * mask), DATA_AXIS)
-        num_ex = jax.lax.psum(jnp.sum(mask), DATA_AXIS)
-        correct = jax.lax.psum(jnp.sum(((xw > 0) == (y > 0)) * mask), DATA_AXIS)
-        metrics = {
-            "objective": objective,
-            "num_ex": num_ex,
-            "correct": correct,
-            "xw": jax.lax.all_gather(xw, DATA_AXIS),
-            "y": jax.lax.all_gather(y, DATA_AXIS),
-            "mask": jax.lax.all_gather(mask, DATA_AXIS),
-        }
+        metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
 
     def state_spec(state):
@@ -408,7 +408,7 @@ def make_train_step_hashed(updater, loss, mesh, num_slots: int):
     return step
 
 
-def make_train_step(updater, loss, mesh, num_slots: int):
+def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
     """Build the fused SPMD train step. Returns jitted
     ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
     """
@@ -452,22 +452,7 @@ def make_train_step(updater, loss, mesh, num_slots: int):
         new_state = apply_leafwise(live)
 
         # -- progress (ref SGDProgress fields) --
-        objective = jax.lax.psum(loss.evaluate(y, xw * mask), DATA_AXIS)
-        num_ex = jax.lax.psum(jnp.sum(mask), DATA_AXIS)
-        correct = jax.lax.psum(
-            jnp.sum(((xw > 0) == (y > 0)) * mask), DATA_AXIS
-        )
-        xw_all = jax.lax.all_gather(xw, DATA_AXIS)
-        y_all = jax.lax.all_gather(y, DATA_AXIS)
-        mask_all = jax.lax.all_gather(mask, DATA_AXIS)
-        metrics = {
-            "objective": objective,
-            "num_ex": num_ex,
-            "correct": correct,
-            "xw": xw_all,
-            "y": y_all,
-            "mask": mask_all,
-        }
+        metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
 
     def state_spec(state):
@@ -498,16 +483,6 @@ def make_train_step(updater, loss, mesh, num_slots: int):
         )
 
     return step
-
-
-def make_weights_fn(updater, mesh):
-    """Full dense weight vector from state (for eval / model export)."""
-
-    @jax.jit
-    def weights(state):
-        return updater.weights(state)
-
-    return weights
 
 
 class AsyncSGDWorker(ISGDCompNode):
@@ -545,12 +520,13 @@ class AsyncSGDWorker(ISGDCompNode):
             ),
             self.updater.init(self.num_slots),
         )
-        self._step = make_train_step(self.updater, self.loss, mesh, self.num_slots)
-        self._step_hashed = make_train_step_hashed(
-            self.updater, self.loss, mesh, self.num_slots
-        )
-        self._ell_steps: Dict[bool, object] = {}
-        self.executor.max_in_flight = max(0, sgd.max_delay) + 1 if sgd.max_delay else 0
+        # step functions cached per (encoding, binary, with_aux)
+        self._steps: Dict[Tuple[str, bool, bool], object] = {}
+        self._weights_fn = jax.jit(self.updater.weights)
+        # max_delay=0 still bounds in-flight work to one step ahead — 0 here
+        # would mean *unbounded* (executor semantics), pinning every metrics
+        # future in memory
+        self.executor.max_in_flight = max(0, sgd.max_delay) + 1
         self._pull_state = self.state
         self._steps_since_snapshot = 0
         self._pads: Optional[Tuple[int, int, int]] = None
@@ -571,13 +547,6 @@ class AsyncSGDWorker(ISGDCompNode):
         """Pull → gradient → push, one async step (ref UpdateModel inner loop
         + ComputeGradient)."""
         return self._submit_prepped(self.prep(batch, device_put=False))
-
-    def _get_step_ell(self, binary: bool):
-        if binary not in self._ell_steps:
-            self._ell_steps[binary] = make_train_step_ell(
-                self.updater, self.loss, self.mesh, self.num_slots, binary
-            )
-        return self._ell_steps[binary]
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
@@ -612,19 +581,38 @@ class AsyncSGDWorker(ISGDCompNode):
             self.num_slots,
         )
 
-    def _submit_prepped(self, prepped) -> int:
-        """Dispatch one SPMD step on an already-localized batch."""
+    def _get_step(self, prepped, with_aux: bool):
+        if isinstance(prepped, ELLBatch):
+            key = ("ell", prepped.vals is None, with_aux)
+            builder = lambda: make_train_step_ell(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                binary=prepped.vals is None, with_aux=with_aux,
+            )
+        elif isinstance(prepped, HashedBatch):
+            key = ("hashed", False, with_aux)
+            builder = lambda: make_train_step_hashed(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots, with_aux=with_aux
+            )
+        else:
+            key = ("exact", False, with_aux)
+            builder = lambda: make_train_step(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots, with_aux=with_aux
+            )
+        if key not in self._steps:
+            self._steps[key] = builder()
+        return self._steps[key]
+
+    def _submit_prepped(self, prepped, with_aux: bool = True) -> int:
+        """Dispatch one SPMD step on an already-localized batch.
+
+        ``with_aux=False`` skips the per-example xw/y/mask outputs (host AUC)
+        — the cheap mode for throughput-critical loops.
+        """
         tau = self.sgd.max_delay
         if tau <= 0 or self._steps_since_snapshot >= tau:
             self._pull_state = self.state
             self._steps_since_snapshot = 0
-
-        if isinstance(prepped, ELLBatch):
-            step_fn = self._get_step_ell(prepped.vals is None)
-        elif isinstance(prepped, HashedBatch):
-            step_fn = self._step_hashed
-        else:
-            step_fn = self._step
+        step_fn = self._get_step(prepped, with_aux)
 
         def step():
             new_state, metrics = step_fn(self.state, self._pull_state, prepped)
@@ -640,15 +628,16 @@ class AsyncSGDWorker(ISGDCompNode):
         metrics = self.executor.wait(ts)
         if metrics is None:
             return self.progress
-        y = np.asarray(metrics["y"]).ravel()
-        xw = np.asarray(metrics["xw"]).ravel()
-        mask = np.asarray(metrics["mask"]).ravel() > 0
         prog = SGDProgress(
             objective=[float(metrics["objective"])],
             num_examples_processed=int(metrics["num_ex"]),
             accuracy=[float(metrics["correct"]) / max(1.0, float(metrics["num_ex"]))],
-            auc=[evaluation.auc(y[mask], xw[mask])],
         )
+        if "xw" in metrics:  # aux present: per-minibatch AUC (ref prog.add_auc)
+            y = np.asarray(metrics["y"]).ravel()
+            xw = np.asarray(metrics["xw"]).ravel()
+            mask = np.asarray(metrics["mask"]).ravel() > 0
+            prog.auc = [evaluation.auc(y[mask], xw[mask])]
         self.progress.merge(prog)
         self.reporter.report(prog)
         return prog
@@ -667,7 +656,7 @@ class AsyncSGDWorker(ISGDCompNode):
         return self.progress
 
     def weights_dense(self) -> np.ndarray:
-        return np.asarray(self.updater.weights(self.state))
+        return np.asarray(self._weights_fn(self.state))
 
     def evaluate(self, batch: SparseBatch) -> Dict[str, float]:
         """Validation metrics on a batch (ref COMPUTE_VALIDATION_AUC)."""
@@ -684,12 +673,25 @@ class AsyncSGDWorker(ISGDCompNode):
         }
 
     def save_model(self, path: str) -> None:
-        """Nonzero weights as key\\tvalue text (ref SaveModel/WriteToFile)."""
+        """Nonzero weights as key\\tvalue text (ref SaveModel/WriteToFile).
+
+        With a hashed directory the original keys are unrecoverable, so the
+        keys written are table slots and a ``#hashed <num_slots>`` header
+        tells consumers (ModelEvaluation) to route lookups through the same
+        hash. Exact directories write true global keys.
+        """
         w = self.weights_dense()
         nz = np.flatnonzero(w)
+        keys = self.directory.keys
         with open(path, "w") as f:
-            for i in nz:
-                f.write(f"{i}\t{float(w[i])!r}\n")
+            if self.directory.hashed:
+                f.write(f"#hashed\t{self.num_slots}\n")
+                for i in nz:
+                    f.write(f"{i}\t{float(w[i])!r}\n")
+            else:
+                for i in nz:
+                    if i < len(keys):
+                        f.write(f"{keys[i]}\t{float(w[i])!r}\n")
 
 
 class AsyncSGDScheduler(ISGDScheduler):

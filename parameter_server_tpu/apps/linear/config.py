@@ -225,6 +225,10 @@ def parse_conf(text: str) -> Config:
             tail_feature_freq=int(s.get("tail_feature_freq", 0)),
             countmin_n=int(float(s.get("countmin_n", 1e8))),
             countmin_k=int(s.get("countmin_k", 2)),
+            num_slots=int(s.get("num_slots", 1 << 22)),
+            rows_pad=int(s.get("rows_pad", 0)),
+            nnz_pad=int(s.get("nnz_pad", 0)),
+            ell_lanes=int(s.get("ell_lanes", 0)),
         )
     if "darlin" in d:
         b = d["darlin"]

@@ -19,8 +19,11 @@ import jax.numpy as jnp
 class LogitLoss:
     """L(y, Xw) = sum log(1 + exp(-y Xw)), y ∈ {-1, +1}."""
 
+    def row_loss(self, y, xw):
+        return jnp.logaddexp(0.0, -y * xw)
+
     def evaluate(self, y, xw):
-        return jnp.sum(jnp.logaddexp(0.0, -y * xw))
+        return jnp.sum(self.row_loss(y, xw))
 
     def row_grad(self, y, xw):
         tau = 1.0 / (1.0 + jnp.exp(y * xw))
@@ -34,8 +37,11 @@ class LogitLoss:
 class SquareHingeLoss:
     """L = sum max(0, 1 - y Xw)^2."""
 
+    def row_loss(self, y, xw):
+        return jnp.maximum(0.0, 1.0 - y * xw) ** 2
+
     def evaluate(self, y, xw):
-        return jnp.sum(jnp.maximum(0.0, 1.0 - y * xw) ** 2)
+        return jnp.sum(self.row_loss(y, xw))
 
     def row_grad(self, y, xw):
         return -2.0 * y * jnp.maximum(0.0, 1.0 - y * xw)
@@ -47,8 +53,11 @@ class SquareHingeLoss:
 class SquareLoss:
     """L = 0.5 sum (Xw - y)^2 (regression)."""
 
+    def row_loss(self, y, xw):
+        return 0.5 * (xw - y) ** 2
+
     def evaluate(self, y, xw):
-        return 0.5 * jnp.sum((xw - y) ** 2)
+        return jnp.sum(self.row_loss(y, xw))
 
     def row_grad(self, y, xw):
         return xw - y

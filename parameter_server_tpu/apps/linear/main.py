@@ -1,0 +1,100 @@
+"""Linear-method CLI (ref main.cc + script/ps.sh):
+
+    python -m parameter_server_tpu.apps.linear.main <config.conf> \\
+        [--num-servers N] [--num-workers M] [--verbose]
+
+Reads a reference-style protobuf-text config, boots the postoffice mesh and
+runs the selected app end to end (async SGD, darlin, or model evaluation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("conf", help="path to a protobuf-text .conf file")
+    ap.add_argument("--num-servers", type=int, default=1)
+    ap.add_argument("--num-workers", type=int, default=0, help="0 = rest of devices")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ...learner.sgd import MinibatchReader
+    from ...system.postoffice import Postoffice
+    from .config import parse_conf
+
+    with open(args.conf) as f:
+        conf = parse_conf(f.read())
+
+    po = Postoffice.instance().start(
+        num_data=args.num_workers or None, num_server=args.num_servers
+    )
+
+    if conf.darlin is not None:
+        from .darlin import DarlinScheduler
+
+        sched = DarlinScheduler(conf)
+        td = conf.training_data
+        sched.load_data(td.file, td.text if td.format == "text" else td.format)
+        sched.run_loaded(verbose=True)
+        if conf.model_output is not None and conf.model_output.file:
+            sched.save_model(conf.model_output.file[0])
+            print(f"model written to {conf.model_output.file[0]}")
+        print(sched.show_progress(max(sched.g_progress) if sched.g_progress else 0))
+    elif conf.async_sgd is not None:
+        from .async_sgd import AsyncSGDScheduler, AsyncSGDWorker
+
+        sched = AsyncSGDScheduler(conf)
+        sched.run()
+        worker = AsyncSGDWorker(conf)
+        worker.attach_monitor(sched)
+        sgd = conf.async_sgd
+        while True:
+            load = sched.workload_pool.assign(worker.name)
+            if load is None:
+                break
+            td = conf.training_data
+            reader = MinibatchReader(
+                files=load.files,
+                minibatch_size=sgd.minibatch,
+                data_format=td.text if td.format == "text" else td.format,
+            )
+            if sgd.tail_feature_freq > 0:
+                reader.init_filter(
+                    sgd.countmin_n, sgd.countmin_k, sgd.tail_feature_freq
+                )
+            worker.train(iter(reader))
+            sched.workload_pool.finish(load.id)
+        sched.monitor.maybe_print(force=True)
+        if conf.model_output is not None and conf.model_output.file:
+            worker.save_model(conf.model_output.file[0])
+            print(f"model written to {conf.model_output.file[0]}")
+        if conf.validation_data is not None and conf.validation_data.file:
+            from ...data.stream_reader import StreamReader
+
+            vd = conf.validation_data
+            r = StreamReader(vd.file, vd.text if vd.format == "text" else vd.format)
+            allb = r.read_all()
+            if allb is not None:
+                ev = worker.evaluate(allb)
+                print(
+                    f"validation auc: {ev['auc']:.6f}, accuracy: {ev['accuracy']:.6f}, "
+                    f"logloss: {ev['logloss']:.6f}"
+                )
+    elif conf.validation_data is not None:
+        from .model_evaluation import ModelEvaluation
+
+        ModelEvaluation(conf).run()
+    else:
+        print("config selects no app", file=sys.stderr)
+        return 2
+    po.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

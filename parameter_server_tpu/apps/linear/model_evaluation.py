@@ -1,0 +1,91 @@
+"""Model evaluation app (ref ``src/app/linear_method/model_evaluation.h``):
+load a saved text model (key\\tweight per line, possibly several shard
+files), stream validation data, compute AUC/accuracy/logloss."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ...data.stream_reader import StreamReader
+from ...system.customer import App
+from ...utils import evaluation
+from ...utils import file as psfile
+from .config import Config
+
+
+class ModelEvaluation(App):
+    def __init__(self, conf: Config, name: str = "model_evaluation"):
+        super().__init__(name=name)
+        self.conf = conf
+        self.metrics: Dict[str, float] = {}
+
+    def load_model(self) -> Dict[int, float]:
+        """Parse key\\tvalue model files (ref Run() model load loop).
+
+        A ``#hashed <num_slots>`` header (async SGD hashed-directory export)
+        sets ``self.hashed_slots`` so validation keys are routed through the
+        same hash before lookup.
+        """
+        assert self.conf.model_input is not None, "model_input required"
+        weight: Dict[int, float] = {}
+        self.hashed_slots = 0
+        for path in psfile.expand_globs(self.conf.model_input.file):
+            with psfile.open_read(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    if parts[0] == "#hashed":
+                        self.hashed_slots = int(parts[1])
+                        continue
+                    if len(parts) >= 2:
+                        weight[int(parts[0])] = float(parts[1])
+        return weight
+
+    def run(self) -> Dict[str, float]:
+        weight = self.load_model()
+        keys = np.fromiter(weight.keys(), dtype=np.int64, count=len(weight))
+        vals = np.fromiter(weight.values(), dtype=np.float32, count=len(weight))
+        order = np.argsort(keys)
+        keys, vals = keys[order], vals[order]
+
+        vd = self.conf.validation_data
+        assert vd is not None, "validation_data required"
+        reader = StreamReader(vd.file, vd.text if vd.format == "text" else vd.format)
+        ys, xws = [], []
+        hashed_dir = None
+        if getattr(self, "hashed_slots", 0):
+            from ...parameter.parameter import KeyDirectory
+
+            hashed_dir = KeyDirectory(self.hashed_slots, hashed=True)
+        for batch in reader.minibatches(1 << 14):
+            xw = np.zeros(batch.n, np.float32)
+            if len(keys):
+                lookup = (
+                    hashed_dir.slots(batch.indices).astype(np.int64)
+                    if hashed_dir is not None
+                    else batch.indices
+                )
+                pos = np.searchsorted(keys, lookup)
+                posc = np.minimum(pos, len(keys) - 1)
+                hit = (pos < len(keys)) & (keys[posc] == lookup)
+                w_e = np.where(hit, vals[posc], 0.0).astype(np.float32)
+                np.add.at(xw, batch.row_ids(), batch.value_array() * w_e)
+            ys.append(batch.y)
+            xws.append(xw)
+        y = np.concatenate(ys) if ys else np.zeros(0, np.float32)
+        xw = np.concatenate(xws) if xws else np.zeros(0, np.float32)
+        self.metrics = {
+            "num_examples": float(len(y)),
+            "auc": evaluation.auc(y, xw),
+            "accuracy": evaluation.accuracy(y, xw),
+            "logloss": evaluation.logloss(y, xw),
+        }
+        # ref prints "auc: %f, accuracy: %f"
+        print(
+            f"auc: {self.metrics['auc']:.6f}, accuracy: {self.metrics['accuracy']:.6f}, "
+            f"logloss: {self.metrics['logloss']:.6f} ({int(self.metrics['num_examples'])} examples)"
+        )
+        return self.metrics

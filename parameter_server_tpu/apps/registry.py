@@ -1,0 +1,24 @@
+"""App factory (ref ``src/app/linear_method/main.cc`` App::Create dispatch):
+pick the app from which config sections are present — darlin > async_sgd >
+validation-only (model evaluation)."""
+
+from __future__ import annotations
+
+from ..system.customer import App
+from .linear.config import Config
+
+
+def create_app(conf: Config) -> App:
+    if conf.darlin is not None:
+        from .linear.darlin import DarlinScheduler
+
+        return DarlinScheduler(conf)
+    if conf.async_sgd is not None:
+        from .linear.async_sgd import AsyncSGDScheduler
+
+        return AsyncSGDScheduler(conf)
+    if conf.validation_data is not None:
+        from .linear.model_evaluation import ModelEvaluation
+
+        return ModelEvaluation(conf)
+    raise ValueError("config selects no app (need darlin/async_sgd/validation_data)")

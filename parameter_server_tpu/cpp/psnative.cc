@@ -76,9 +76,10 @@ void ps_mix64_array(const uint64_t* keys, uint64_t n, uint64_t seed,
 // ---------------------------------------------------------------------------
 // Text parsers (libsvm / criteo). Parse a buffer of newline-separated
 // examples into CSR arrays. Caller supplies output buffers sized by
-// ps_parse_* return contract: returns #examples parsed, fills nnz via
-// out_nnz. On overflow of caller capacity, parsing stops early (the Python
-// wrapper re-calls with a bigger buffer).
+// ps_parse_* return contract: returns #examples parsed (NEGATED minus one,
+// i.e. -(rows+1), when the value-capacity budget was hit mid-stream so the
+// caller can retry with a bigger buffer), fills nnz via out_nnz (rolled
+// back to the last complete row on a capacity stop).
 // ---------------------------------------------------------------------------
 
 static inline const char* skip_ws(const char* p, const char* end) {
@@ -115,7 +116,7 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
       char* e2;
       double val = strtod(vp, &e2);
       if (e2 == vp) break;
-      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return row; }  // capacity hit
+      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
       indices[nnz] = idx;
       values[nnz] = (float)val;
       ++nnz;
@@ -156,7 +157,7 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
       ++p;  // consume tab
       ++slot;
       if (p >= line_end || *p == '\t') continue;  // missing field
-      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return row; }  // capacity hit
+      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
       if (slot <= 13) {  // integer feature: value = log-ish raw, key = slot
         char* e;
         double v = strtod(p, &e);

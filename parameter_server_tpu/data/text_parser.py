@@ -212,9 +212,9 @@ def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBa
             ctypes.byref(out_nnz),
         )
         nnz = out_nnz.value
-        if nnz >= max_nnz:
-            # buffer exactly full ⇒ possible mid-stream capacity stop
-            # (psnative.cc early-return contract): retry bigger
+        if rows < 0:
+            # explicit truncation signal (-(rows+1), psnative.cc contract):
+            # the value budget was hit mid-stream — retry with a bigger buffer
             max_nnz *= 2
             continue
         return SparseBatch(

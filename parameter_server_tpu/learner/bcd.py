@@ -1,0 +1,115 @@
+"""Block coordinate descent framework (ref ``src/learner/bcd.{h,cc}``).
+
+``BCDScheduler::Run`` = LoadData → PreprocessData → DivideFeatureBlocks,
+then apps drive per-block UPDATE_MODEL/EVALUATE_PROGRESS tasks. Here:
+
+- ``load_data``: stream all training files into one SparseBatch per worker
+  shard (the reference assigns file slices via DataAssigner).
+- ``preprocess``: global key localization — the reference's workers send
+  unique keys to servers to build the model key arrays (bcd.h
+  PreprocessData); we build the global sorted key union + remapped columns.
+- ``divide_feature_blocks``: partition features into ~ratio×groups blocks,
+  mirroring fea_blk_ pairs (group, key range).
+
+``BCDProgress`` mirrors learner/proto/bcd.proto.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..data.stream_reader import StreamReader
+from ..system.customer import App
+from ..utils.localizer import Localizer
+from ..utils.range import Range
+from ..utils.sparse import SparseBatch
+
+
+@dataclasses.dataclass
+class BCDProgress:
+    """ref learner/proto/bcd.proto BCDProgress."""
+
+    objective: float = 0.0
+    relative_obj: float = 0.0
+    violation: float = 0.0
+    nnz_w: int = 0
+    nnz_active_set: int = 0
+    busy_time: float = 0.0
+    total_time: float = 0.0
+
+    def merge(self, other: "BCDProgress") -> None:
+        self.objective += other.objective
+        self.violation = max(self.violation, other.violation)
+        self.nnz_w += other.nnz_w
+        self.nnz_active_set += other.nnz_active_set
+        self.busy_time += other.busy_time
+
+
+@dataclasses.dataclass
+class FeatureBlock:
+    """One update unit: (group id, local column range) — ref fea_blk_."""
+
+    group: int
+    col_range: Range
+
+
+class BCDScheduler(App):
+    def __init__(self, bcd_conf, name: str = "bcd_scheduler"):
+        super().__init__(name=name)
+        self.bcd_conf = bcd_conf
+        self.g_progress: Dict[int, BCDProgress] = {}
+        self.fea_blk: List[FeatureBlock] = []
+        self.blk_order: List[int] = []
+        self.global_keys: Optional[np.ndarray] = None
+        self.data: Optional[SparseBatch] = None  # localized, cols = len(global_keys)
+
+    # -- Run() stages (ref bcd.cc) --
+
+    def load_data(self, files: List[str], data_format: str = "libsvm") -> SparseBatch:
+        reader = StreamReader(files, data_format)
+        batch = reader.read_all()
+        if batch is None:
+            raise ValueError(f"no data in {files}")
+        return self.set_data(batch)
+
+    def set_data(self, batch: SparseBatch) -> SparseBatch:
+        """Preprocess: global localization (ref PreprocessData key union)."""
+        loc = Localizer()
+        keys, _ = loc.count_uniq_index(batch)
+        self.global_keys = keys
+        self.data = loc.remap_index(keys)
+        return self.data
+
+    def divide_feature_blocks(self, num_groups: int = 1) -> List[FeatureBlock]:
+        """ref BCDScheduler::DivideFeatureBlocks: ~ratio blocks per group."""
+        assert self.data is not None, "load data first"
+        f = self.data.cols
+        ratio = max(self.bcd_conf.feature_block_ratio, 0)
+        nblk = max(1, int(round(ratio * num_groups))) if ratio > 0 else 1
+        nblk = min(nblk, max(1, f))
+        full = Range(0, f)
+        self.fea_blk = [
+            FeatureBlock(group=0, col_range=full.even_divide(nblk, i))
+            for i in range(nblk)
+        ]
+        self.blk_order = list(range(nblk))
+        return self.fea_blk
+
+    def merge_progress(self, iteration: int, prog: BCDProgress) -> None:
+        cur = self.g_progress.get(iteration)
+        if cur is None:
+            self.g_progress[iteration] = prog
+        else:
+            cur.merge(prog)
+
+    def show_progress(self, iteration: int) -> str:
+        """ref ShowTime/ShowObjective line."""
+        p = self.g_progress.get(iteration, BCDProgress())
+        return (
+            f"iter {iteration:3d}: objv {p.objective:.6e} "
+            f"rel {p.relative_obj:.2e} |w|0 {p.nnz_w} "
+            f"active {p.nnz_active_set} vio {p.violation:.2e}"
+        )

@@ -115,20 +115,26 @@ class Executor:
         return ts
 
     def _throttle(self, ts: int) -> None:
-        """Bounded-delay window: block until step ts - max_in_flight is done."""
+        """Bounded-delay window: block until step ts - max_in_flight is done.
+
+        Completion only (pop=False): the step's result stays claimable by a
+        later wait()/pop_result() — throttling must not consume metrics the
+        caller still wants to collect.
+        """
         horizon = ts - self.max_in_flight
         if horizon >= 0:
-            self.wait(horizon)
+            self.wait(horizon, pop=False)
 
-    def wait(self, ts: int) -> Any:
+    def wait(self, ts: int, pop: bool = True) -> Any:
         """Block until step ``ts`` has materialized (Customer::Wait).
 
-        Evicts the step's future so device buffers are released — without
-        this, every intermediate table version would stay pinned in HBM.
-        Returns the step's value (None if ts is unknown or already waited).
+        By default evicts the step's future so device buffers are released —
+        without this, every intermediate result would stay pinned in HBM.
+        ``pop=False`` blocks without consuming (used by the throttle).
+        Returns the step's value (None if ts is unknown or already popped).
         """
         with self._lock:
-            fut = self._futures.pop(ts, None)
+            fut = self._futures.pop(ts, None) if pop else self._futures.get(ts)
             cb = self._callbacks.pop(ts, None)
         if fut is not None:
             jax.block_until_ready(fut)

@@ -1,0 +1,179 @@
+"""Darlin (delayed block proximal gradient) tests: block-update parity vs a
+NumPy transcription of the reference's ComputeGradient/UpdateWeight/
+UpdateDual math, plus convergence/KKT-filter behavior."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.config import (
+    BCDConfig,
+    Config,
+    LearningRateConfig,
+    LossConfig,
+    PenaltyConfig,
+)
+from parameter_server_tpu.apps.linear.darlin import DarlinScheduler, DarlinSolver
+from parameter_server_tpu.learner.bcd import BCDScheduler, FeatureBlock
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils import evaluation
+from parameter_server_tpu.utils.range import Range
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def make_conf(lam=1.0, passes=10, ratio=4.0):
+    conf = Config()
+    conf.loss = LossConfig(type="logit")
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[lam])
+    conf.learning_rate = LearningRateConfig(alpha=1.0)
+    conf.darlin = BCDConfig(
+        num_data_pass=passes, feature_block_ratio=ratio, epsilon=1e-6
+    )
+    return conf
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(0)
+    w_true = (rng.normal(size=200) * (rng.random(200) < 0.15) * 2).astype(np.float32)
+    return random_sparse(2000, 200, 10, seed=1, w_true=w_true), w_true
+
+
+def darlin_block_oracle(X, y, w, delta, active, dual, lam, eta, delta_max, thr):
+    """NumPy transcription of darlin.h ComputeGradient (417-462) +
+    UpdateWeight (261-306) + UpdateDual (558-588) for a whole-feature block."""
+    n, f = X.shape
+    tau = 1.0 / (1.0 + dual)
+    G = X.T @ (-y * tau)
+    U = np.zeros(f)
+    for j in range(f):
+        xj = X[:, j]
+        U[j] = np.sum(
+            np.minimum(tau * (1 - tau) * np.exp(np.abs(xj) * delta[j]), 0.25) * xj * xj
+        )
+    u = U / eta + 1e-10
+    g_pos, g_neg = G + lam, G - lam
+    new_w, new_delta, new_active = w.copy(), delta.copy(), active.copy()
+    violation = 0.0
+    d_w = np.zeros(f)
+    for j in range(f):
+        if not active[j]:
+            continue
+        if w[j] == 0:
+            vio = 0.0
+            if g_pos[j] < 0:
+                vio = -g_pos[j]
+            elif g_neg[j] > 0:
+                vio = g_neg[j]
+            elif g_pos[j] > thr and g_neg[j] < -thr:
+                new_active[j] = False
+                continue
+            violation = max(violation, vio)
+        d = -w[j]
+        if g_pos[j] <= u[j] * w[j]:
+            d = -g_pos[j] / u[j]
+        elif g_neg[j] >= u[j] * w[j]:
+            d = -g_neg[j] / u[j]
+        d = min(delta[j], max(-delta[j], d))
+        d_w[j] = d
+        new_delta[j] = min(delta_max, 2 * abs(d) + 0.1)
+        new_w[j] = w[j] + d
+    new_dual = dual * np.exp(y * (X @ d_w))
+    return new_w, new_delta, new_active, new_dual, violation
+
+
+class TestBlockParity:
+    def test_single_block_matches_oracle(self, mesh8):
+        # duplicate-free batch (the U term is nonlinear per entry, so dup
+        # (row, col) pairs would differ from the dense-merged oracle)
+        from parameter_server_tpu.utils.sparse import from_dense
+
+        rng = np.random.default_rng(3)
+        dense = (rng.random((400, 120)) < 0.08) * rng.normal(size=(400, 120))
+        w_true = (rng.normal(size=120) * (rng.random(120) < 0.2) * 2).astype(np.float32)
+        logits = dense @ w_true
+        y = np.where(rng.random(400) < 1 / (1 + np.exp(-logits)), 1.0, -1.0)
+        data = from_dense(dense.astype(np.float32), y.astype(np.float32))
+        conf = make_conf(lam=0.5, ratio=0)  # one block = all features
+        sched = BCDScheduler(conf.darlin)
+        localized = sched.set_data(data)
+        blocks = [FeatureBlock(0, Range(0, localized.cols))]
+        solver = DarlinSolver(conf, mesh=mesh8)
+        solver.init_data(localized, blocks)
+
+        X = localized.to_dense()
+        w0 = solver.w.copy()
+        delta0 = solver.delta.copy()
+        active0 = solver.active.copy()
+        dual0 = np.ones(localized.n)
+
+        vio = solver.update_block(0, blocks, thr=1e20, reset=False)
+        ew, edelta, eactive, edual, evio = darlin_block_oracle(
+            X, localized.y.astype(np.float64), w0, delta0, active0, dual0,
+            lam=0.5, eta=1.0, delta_max=conf.darlin.delta_max_value, thr=1e20,
+        )
+        np.testing.assert_allclose(solver.w, ew, atol=1e-4)
+        np.testing.assert_allclose(solver.delta, edelta, atol=1e-4)
+        np.testing.assert_array_equal(solver.active, eactive)
+        dual = np.asarray(solver.dual).ravel()[: localized.n]
+        np.testing.assert_allclose(dual, edual, rtol=1e-3)
+        assert abs(vio - evio) < 1e-3
+
+
+class TestConvergence:
+    def test_objective_decreases_and_learns(self, mesh8, dataset):
+        data, _ = dataset
+        sched = DarlinScheduler(make_conf(passes=10), mesh=mesh8)
+        prog = sched.run_on(data)
+        objs = [sched.g_progress[i].objective for i in sorted(sched.g_progress)]
+        assert all(b <= a + 1e-6 for a, b in zip(objs, objs[1:]))
+        auc = evaluation.auc(data.y, sched.solver.predict_margin())
+        assert auc > 0.8
+
+    def test_kkt_filter_prunes_active_set(self, mesh8, dataset):
+        data, _ = dataset
+        sched = DarlinScheduler(make_conf(passes=6), mesh=mesh8)
+        prog = sched.run_on(data)
+        assert prog.nnz_active_set < sched.data.cols  # some coords suspended
+
+    def test_heavier_l1_sparser(self, mesh8, dataset):
+        data, _ = dataset
+        nnz = []
+        for lam in (0.1, 10.0):
+            Postoffice.reset()
+            sched = DarlinScheduler(make_conf(lam=lam, passes=6), mesh=mesh8)
+            nnz.append(sched.run_on(data).nnz_w)
+        assert nnz[1] < nnz[0] * 0.7
+
+    def test_save_model(self, mesh8, dataset, tmp_path):
+        data, _ = dataset
+        sched = DarlinScheduler(make_conf(passes=4), mesh=mesh8)
+        prog = sched.run_on(data)
+        path = tmp_path / "darlin.txt"
+        sched.save_model(str(path))
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == prog.nnz_w
+
+
+class TestBCDFramework:
+    def test_divide_feature_blocks(self, mesh8, dataset):
+        data, _ = dataset
+        sched = BCDScheduler(BCDConfig(feature_block_ratio=3.0))
+        sched.set_data(data)
+        blocks = sched.divide_feature_blocks(num_groups=2)
+        assert len(blocks) == 6
+        total = sum(b.col_range.size() for b in blocks)
+        assert total == sched.data.cols
+
+    def test_progress_merge(self):
+        from parameter_server_tpu.learner.bcd import BCDProgress
+
+        a = BCDProgress(objective=1.0, violation=0.5, nnz_w=10)
+        a.merge(BCDProgress(objective=2.0, violation=0.3, nnz_w=5))
+        assert a.objective == 3.0 and a.violation == 0.5 and a.nnz_w == 15
