@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils import file as psfile
+
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -683,7 +685,7 @@ class AsyncSGDWorker(ISGDCompNode):
         w = self.weights_dense()
         nz = np.flatnonzero(w)
         keys = self.directory.keys
-        with open(path, "w") as f:
+        with psfile.open_write(path) as f:
             if self.directory.hashed:
                 f.write(f"#hashed\t{self.num_slots}\n")
                 for i in nz:

@@ -29,12 +29,13 @@ from __future__ import annotations
 
 import dataclasses
 import random
-import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ...utils import file as psfile
 
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -42,9 +43,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...learner.bcd import BCDProgress, BCDScheduler, FeatureBlock
 from ...parallel import mesh as meshlib
 from ...parallel.mesh import DATA_AXIS
-from ...system.message import Task
-from ...utils import evaluation
-from ...utils.range import Range
 from ...utils.sparse import SparseBatch
 from .config import BCDConfig, Config
 
@@ -311,13 +309,17 @@ class DarlinScheduler(BCDScheduler):
             order = list(self.blk_order)
             if self.bcd_conf.random_feature_block_order:
                 rng.shuffle(order)
+            if reset_kkt:
+                # reference resets the active set for ALL groups
+                # (darlin.h Update: reset_kkt_filter -> fill(true) per grp)
+                self.solver.active[:] = True
+                reset_kkt = False
             violation = 0.0
-            for i, blk_id in enumerate(order):
+            for blk_id in order:
                 vio = self.solver.update_block(
-                    blk_id, self.fea_blk, kkt_threshold, reset_kkt and i == 0
+                    blk_id, self.fea_blk, kkt_threshold, reset=False
                 )
                 violation = max(violation, vio)
-            reset_kkt = False
             prog = self.solver.evaluate()
             prog.violation = violation
             if prev_objv is not None and prev_objv > 0:
@@ -345,7 +347,7 @@ class DarlinScheduler(BCDScheduler):
         """key\\tweight text dump (ref BCDServer::SaveModel)."""
         keys = self.global_keys
         w = self.solver.w
-        with open(path, "w") as f:
+        with psfile.open_write(path) as f:
             for k, v in zip(keys, w):
                 if v != 0 and not np.isnan(v):
                     f.write(f"{k}\t{float(v)!r}\n")

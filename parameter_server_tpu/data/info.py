@@ -1,0 +1,37 @@
+"""Example-info statistics (ref ``src/data/info_parser.{h,cc}``): per-slot
+min/max key, nnz element/example counts, total example count — computed
+from parsed batches instead of per-proto accumulation."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.sparse import SparseBatch
+from .example import ExampleInfo, SlotInfo
+from .text_parser import SLOT_SPACE
+
+
+def info_from_batch(batch: SparseBatch, split_slots: bool = True) -> ExampleInfo:
+    info = ExampleInfo(num_ex=batch.n)
+    if batch.nnz == 0:
+        return info
+    if split_slots:
+        slot_of = (batch.indices // SLOT_SPACE).astype(np.int64)
+    else:
+        slot_of = np.zeros(batch.nnz, np.int64)
+    rows = batch.row_ids()
+    for sid in np.unique(slot_of):
+        sel = slot_of == sid
+        keys = batch.indices[sel]
+        ex = np.unique(rows[sel])
+        info.slot.append(
+            SlotInfo(
+                id=int(sid),
+                format="sparse_binary" if batch.binary else "sparse",
+                min_key=int(keys.min()),
+                max_key=int(keys.max()) + 1,
+                nnz_ele=int(sel.sum()),
+                nnz_ex=int(len(ex)),
+            )
+        )
+    return info

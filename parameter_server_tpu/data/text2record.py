@@ -1,0 +1,49 @@
+"""Text → record conversion tool (ref ``src/data/text2proto.h`` +
+``util/recordio``): parse any supported text format and write CRC-framed
+binary record files, which StreamReader reads back with format="record".
+
+    python -m parameter_server_tpu.data.text2record \\
+        --input data/part-* --format criteo --output data/part.rec \\
+        [--batch 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..utils import file as psfile
+from ..utils.recordio import RecordWriter
+from .example import batch_to_bytes
+from .stream_reader import StreamReader
+
+
+def convert(inputs, data_format: str, output: str, batch_size: int = 65536) -> int:
+    reader = StreamReader(list(inputs), data_format)
+    n = 0
+    with open(output, "wb") as f:
+        writer = RecordWriter(f)
+        for batch in reader.minibatches(batch_size):
+            writer.write_record(batch_to_bytes(batch))
+            n += batch.n
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", nargs="+", required=True)
+    ap.add_argument("--format", default="libsvm")
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--batch", type=int, default=65536)
+    args = ap.parse_args(argv)
+    files = psfile.expand_globs(args.input)
+    if not files:
+        print(f"no input files match {args.input}", file=sys.stderr)
+        return 2
+    n = convert(files, args.format, args.output, args.batch)
+    print(f"wrote {n} examples to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""Convolutional network (role of the CXXNET CIFAR-10 worker the reference
+trains through KVLayer dense push/pull — README.md points NN training at
+CXXNET/Minerva with the parameter server as the KVLayer backend).
+
+A compact flax CNN sized for CIFAR-shaped inputs; trained by
+``apps/nn/trainer.py`` with parameters stored in a KVLayer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvNet(nn.Module):
+    num_classes: int = 10
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, H, W, C]
+        x = nn.Conv(self.width, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.width * 2, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class MLP(nn.Module):
+    """Small dense net for quick KVLayer tests."""
+
+    num_classes: int = 10
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def cross_entropy(logits, labels):
+    import jax
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jnp.eye(logits.shape[-1])[labels]
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))

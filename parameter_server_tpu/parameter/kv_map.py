@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import file as psfile
+
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -176,7 +178,7 @@ class KVMap(Parameter):
         )
         vals = vals[: len(keys)]
         nz = np.any(vals != 0, axis=1)
-        with open(path, "w") as f:
+        with psfile.open_write(path) as f:
             for key, val in zip(np.asarray(keys)[nz], vals[nz]):
                 f.write(f"{key}\t" + "\t".join(repr(float(x)) for x in val) + "\n")
 

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import file as psfile
+
 from ..ops import kv_ops
 from ..parallel import mesh as meshlib
 from ..system.message import Task
@@ -197,6 +199,6 @@ class KVVector(Parameter):
             keys = np.arange(self.num_slots, dtype=np.int64)
             vals = tbl
         nz = np.any(vals != 0, axis=1)
-        with open(path, "w") as f:
+        with psfile.open_write(path) as f:
             for key, val in zip(keys[nz], vals[nz]):
                 f.write(f"{key}\t" + "\t".join(str(x) for x in val) + "\n")

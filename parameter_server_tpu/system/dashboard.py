@@ -1,0 +1,63 @@
+"""Dashboard: node metrics table (ref ``src/system/dashboard.{h,cc}``).
+
+Renders a fixed-width table of per-node heartbeat reports, ordered
+scheduler → workers → servers by rank (ref NodeIDCmp), same column spirit
+as the reference's dashboard output.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .heartbeat import HeartbeatReport
+
+_COLUMNS = [
+    ("node", 8),
+    ("total(s)", 9),
+    ("busy(s)", 8),
+    ("in(MB)", 8),
+    ("out(MB)", 8),
+    ("rss(MB)", 8),
+    ("cpu%", 6),
+    ("host", 10),
+]
+
+
+def _node_sort_key(node_id: str):
+    # H (scheduler) first, then W workers, then S servers, by numeric rank
+    order = {"H": 0, "W": 1, "S": 2}
+    return (order.get(node_id[:1], 3), int(node_id[1:]) if node_id[1:].isdigit() else 0)
+
+
+class Dashboard:
+    def __init__(self) -> None:
+        self._data: Dict[str, HeartbeatReport] = {}
+        self._tasks: Dict[str, int] = {}
+
+    def add_report(self, node_id: str, report: HeartbeatReport) -> None:
+        self._data[node_id] = report
+
+    def add_task(self, node_id: str, task_id: int) -> None:
+        self._tasks[node_id] = task_id
+
+    def title(self) -> str:
+        return "  ".join(name.ljust(width) for name, width in _COLUMNS)
+
+    def report(self) -> str:
+        lines = [self.title()]
+        for nid in sorted(self._data, key=_node_sort_key):
+            r = self._data[nid]
+            cells = [
+                nid,
+                f"{r.total_time_milli / 1e3:.1f}",
+                f"{r.busy_time_milli / 1e3:.1f}",
+                f"{r.net_in_mb:.1f}",
+                f"{r.net_out_mb:.1f}",
+                f"{r.process_rss_mb:.0f}",
+                f"{100 * r.process_cpu_usage:.0f}",
+                r.hostname[:10],
+            ]
+            lines.append(
+                "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
+            )
+        return "\n".join(lines)

@@ -1,0 +1,120 @@
+"""Heartbeats and failure detection.
+
+Counterpart of ``src/system/heartbeat_info.{h,cc}``: each node periodically
+reports host metrics (cpu, memory, traffic, busy time); the scheduler's
+collector marks nodes dead when reports stop arriving — that's the failure
+detection signal the manager uses to trigger workload restore
+(WorkloadPool.restore) and replica recovery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils import resource_usage
+
+
+@dataclasses.dataclass
+class HeartbeatReport:
+    """ref proto/heartbeat.proto HeartbeatReport fields we can source."""
+
+    hostname: str = ""
+    seconds_since_epoch: float = 0.0
+    total_time_milli: float = 0.0
+    busy_time_milli: float = 0.0
+    net_in_mb: float = 0.0
+    net_out_mb: float = 0.0
+    process_rss_mb: float = 0.0
+    process_virt_mb: float = 0.0
+    process_cpu_usage: float = 0.0
+    host_cpu_usage: float = 0.0
+
+
+class HeartbeatInfo:
+    """Per-node metrics sampler (busy timer + /proc counters)."""
+
+    def __init__(self, hostname: str = "localhost"):
+        self.hostname = hostname
+        self._busy_ms = 0.0
+        self._busy_start: Optional[float] = None
+        self._start = time.time()
+        self._in_bytes = 0
+        self._out_bytes = 0
+        self._last = resource_usage.sample()
+        self._lock = threading.Lock()
+
+    def start_timer(self) -> None:
+        with self._lock:
+            self._busy_start = time.perf_counter()
+
+    def stop_timer(self) -> None:
+        with self._lock:
+            if self._busy_start is not None:
+                self._busy_ms += (time.perf_counter() - self._busy_start) * 1e3
+                self._busy_start = None
+
+    def increase_in_bytes(self, delta: int) -> None:
+        with self._lock:
+            self._in_bytes += delta
+
+    def increase_out_bytes(self, delta: int) -> None:
+        with self._lock:
+            self._out_bytes += delta
+
+    def get(self) -> HeartbeatReport:
+        cur = resource_usage.sample()
+        with self._lock:
+            busy = self._busy_ms
+            self._busy_ms = 0.0
+            in_b, self._in_bytes = self._in_bytes, 0
+            out_b, self._out_bytes = self._out_bytes, 0
+        dt = max(1e-9, cur.timestamp - self._last.timestamp)
+        proc_cpu = (cur.cpu_seconds - self._last.cpu_seconds) / dt
+        host_cpu = (
+            (cur.host_total_cpu_seconds - self._last.host_total_cpu_seconds) / dt
+        )
+        self._last = cur
+        return HeartbeatReport(
+            hostname=self.hostname,
+            seconds_since_epoch=cur.timestamp,
+            total_time_milli=(cur.timestamp - self._start) * 1e3,
+            busy_time_milli=busy,
+            net_in_mb=in_b / 1e6,
+            net_out_mb=out_b / 1e6,
+            process_rss_mb=cur.rss_mb,
+            process_virt_mb=cur.vm_mb,
+            process_cpu_usage=proc_cpu,
+            host_cpu_usage=host_cpu,
+        )
+
+
+class HeartbeatCollector:
+    """Scheduler-side liveness tracking (manager.cc heartbeat handling)."""
+
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+        self._reports: Dict[str, HeartbeatReport] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def report(self, node_id: str, hb: HeartbeatReport) -> None:
+        with self._lock:
+            self._reports[node_id] = hb
+            self._last_seen[node_id] = time.time()
+
+    def dead_nodes(self, now: Optional[float] = None) -> List[str]:
+        """Nodes whose last report is older than the timeout."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            return [
+                nid
+                for nid, seen in self._last_seen.items()
+                if now - seen > self.timeout
+            ]
+
+    def reports(self) -> Dict[str, HeartbeatReport]:
+        with self._lock:
+            return dict(self._reports)

@@ -30,6 +30,16 @@ def open_read(path: str, mode: str = "rt") -> IO:
     return open(path, mode)
 
 
+def open_write(path: str, mode: str = "w") -> IO:
+    """Open for writing, creating parent directories (the reference's
+    SaveModel does createDir(getPath(file)) first, bcd.h:225)."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    if path.endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode)
+
+
 def expand_globs(patterns: Iterable[str]) -> List[str]:
     out: List[str] = []
     for p in patterns:

@@ -1,0 +1,242 @@
+"""Aux subsystem tests: assigner, heartbeat/failure detection, dashboard,
+remote-node filter state, workload pool, monitor, slot reader, example info,
+text2record roundtrip, checkpoint/restore + replica recovery."""
+
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.info import info_from_batch
+from parameter_server_tpu.data.slot_reader import SlotReader
+from parameter_server_tpu.data.text2record import convert
+from parameter_server_tpu.data.stream_reader import StreamReader
+from parameter_server_tpu.data.text_parser import SLOT_SPACE
+from parameter_server_tpu.learner.workload_pool import Workload, WorkloadPool
+from parameter_server_tpu.parameter.replica import CheckpointManager, ReplicaManager
+from parameter_server_tpu.system.assigner import DataAssigner, NodeAssigner
+from parameter_server_tpu.system.dashboard import Dashboard
+from parameter_server_tpu.system.heartbeat import HeartbeatCollector, HeartbeatInfo
+from parameter_server_tpu.system.manager import Node
+from parameter_server_tpu.system.message import FilterSpec, Message, Task
+from parameter_server_tpu.system.monitor import MonitorMaster, MonitorSlaver
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.system.remote_node import RemoteNodeTable
+from parameter_server_tpu.utils.range import Range
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+class TestAssigner:
+    def test_node_assigner_key_ranges(self):
+        na = NodeAssigner(num_servers=3, key_range=Range(0, 90))
+        servers = [na.assign(Node(Node.SERVER, 0)) for _ in range(3)]
+        assert [s.key_range for s in servers] == [
+            Range(0, 30), Range(30, 60), Range(60, 90),
+        ]
+        assert [s.rank for s in servers] == [0, 1, 2]
+        w = na.assign(Node(Node.WORKER, 0))
+        assert w.rank == 0
+
+    def test_data_assigner_more_files_than_workers(self, tmp_path):
+        files = []
+        for i in range(6):
+            p = tmp_path / f"part{i}"
+            p.write_text("x")
+            files.append(str(p))
+        da = DataAssigner(files, num=3)
+        parts = [da.next() for _ in range(3)]
+        assert da.next() is None
+        assert sum(len(p.files) for p in parts) == 6
+
+    def test_data_assigner_fewer_files(self, tmp_path):
+        p = tmp_path / "single"
+        p.write_text("x")
+        da = DataAssigner([str(p)], num=4)
+        parts = [da.next() for _ in range(4)]
+        assert all(pt.files == [str(p)] for pt in parts)
+        assert len({pt.range_begin for pt in parts}) == 4
+
+
+class TestHeartbeat:
+    def test_report_fields(self):
+        hb = HeartbeatInfo(hostname="testhost")
+        hb.start_timer()
+        time.sleep(0.01)
+        hb.stop_timer()
+        hb.increase_in_bytes(1_000_000)
+        rep = hb.get()
+        assert rep.hostname == "testhost"
+        assert rep.busy_time_milli >= 10
+        assert rep.net_in_mb == pytest.approx(1.0)
+        assert rep.process_rss_mb > 0
+
+    def test_failure_detection(self):
+        col = HeartbeatCollector(timeout=0.05)
+        col.report("W0", HeartbeatInfo().get())
+        col.report("W1", HeartbeatInfo().get())
+        assert col.dead_nodes() == []
+        time.sleep(0.06)
+        col.report("W1", HeartbeatInfo().get())  # W1 stays alive
+        assert col.dead_nodes() == ["W0"]
+
+
+class TestDashboard:
+    def test_table_render_and_order(self):
+        dash = Dashboard()
+        hb = HeartbeatInfo(hostname="h")
+        for nid in ("S1", "W0", "H0", "S0"):
+            dash.add_report(nid, hb.get())
+        out = dash.report().splitlines()
+        assert out[0].startswith("node")
+        order = [line.split()[0] for line in out[1:]]
+        assert order == ["H0", "W0", "S0", "S1"]
+
+
+class TestRemoteNode:
+    def test_per_peer_filter_state_isolated(self):
+        table = RemoteNodeTable()
+        keys = np.arange(10, dtype=np.int64)
+
+        def msg():
+            m = Message(task=Task(key_range=Range(0, 100)))
+            m.key = keys.copy()
+            m.values = [np.ones(10, np.float32)]
+            m.task.filters = [FilterSpec(type="key_caching")]
+            return m
+
+        a, b = table.get("S0"), table.get("S1")
+        m1 = a.encode(msg())
+        assert m1.key is not None  # first send to S0 carries keys
+        m2 = a.encode(msg())
+        assert m2.key is None  # cache hit on S0
+        m3 = b.encode(msg())
+        assert m3.key is not None  # S1 has its own cache
+        assert len(table) == 2
+
+
+class TestWorkloadPool:
+    def test_assign_finish_restore(self):
+        pool = WorkloadPool(Workload(files=["a", "b", "c"]))
+        l1 = pool.assign("W0")
+        l2 = pool.assign("W1")
+        pool.finish(l1.id)
+        pool.restore("W1")  # W1 died: its piece goes back
+        l2b = pool.assign("W2")
+        assert l2b.files == l2.files
+        pool.finish(l2b.id)
+        l3 = pool.assign("W2")
+        pool.finish(l3.id)
+        assert pool.wait_until_done(timeout=1)
+
+    def test_replica_and_shuffle(self):
+        pool = WorkloadPool(Workload(files=["a", "b"], replica=3, shuffle=True))
+        assert pool.num_pending() == 6
+
+
+class TestMonitor:
+    def test_merge_and_print(self):
+        master: MonitorMaster[list] = MonitorMaster()
+        master.set_data_merger(lambda src, dst: dst.extend(src))
+        s1 = MonitorSlaver(master, "W0")
+        s2 = MonitorSlaver(master, "W1")
+        s1.report([1])
+        s1.report([2])
+        s2.report([3])
+        prog = master.progress()
+        assert prog["W0"] == [1, 2] and prog["W1"] == [3]
+
+
+class TestSlotReaderInfo:
+    def _write_criteo(self, tmp_path, n=50):
+        path = tmp_path / "part.criteo"
+        rng = np.random.default_rng(0)
+        with open(path, "w") as f:
+            for i in range(n):
+                ints = "\t".join(str(rng.integers(0, 100)) for _ in range(13))
+                cats = "\t".join(f"{rng.integers(0, 1 << 32):08x}" for _ in range(26))
+                f.write(f"{i % 2}\t{ints}\t{cats}\n")
+        return str(path)
+
+    def test_slot_reader_splits_criteo_slots(self, tmp_path):
+        path = self._write_criteo(tmp_path)
+        sr = SlotReader([path], "criteo", cache_dir=str(tmp_path / "cache"))
+        info = sr.read()
+        assert info.num_ex == 50
+        assert len(info.slot) == 39  # 13 numeric + 26 categorical
+        s1 = sr.slot(1)
+        assert s1 is not None and s1.nnz == 50  # slot 1 present in every row
+        # cache round trip
+        sr.clear(1)
+        s1b = sr.slot(1)
+        np.testing.assert_array_equal(s1.indices, s1b.indices)
+
+    def test_info_from_batch(self):
+        b = random_sparse(20, 100, 5, seed=0)
+        info = info_from_batch(b, split_slots=False)
+        assert info.num_ex == 20
+        assert info.slot[0].nnz_ele == b.nnz
+
+    def test_info_merge(self):
+        b1 = info_from_batch(random_sparse(10, 50, 3, seed=1), split_slots=False)
+        b2 = info_from_batch(random_sparse(15, 50, 3, seed=2), split_slots=False)
+        b1.merge(b2)
+        assert b1.num_ex == 25
+        assert b1.slot[0].nnz_ele == 30 + 45
+
+
+class TestText2Record:
+    def test_roundtrip(self, tmp_path):
+        svm = tmp_path / "in.svm"
+        b = random_sparse(100, 50, 4, seed=5)
+        with open(svm, "w") as f:
+            for r in range(b.n):
+                lo, hi = b.indptr[r], b.indptr[r + 1]
+                feats = " ".join(
+                    f"{int(k)}:{v:.5f}" for k, v in zip(b.indices[lo:hi], b.values[lo:hi])
+                )
+                f.write(f"{int(b.y[r])} {feats}\n")
+        out = tmp_path / "out.rec"
+        n = convert([str(svm)], "libsvm", str(out), batch_size=32)
+        assert n == 100
+        back = StreamReader([str(out)], "record").read_all()
+        assert back.n == 100
+        np.testing.assert_array_equal(back.y, b.y)
+        np.testing.assert_array_equal(back.indices, b.indices)
+
+
+class TestCheckpointReplica:
+    def test_checkpoint_roundtrip(self, tmp_path, mesh8):
+        import jax
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        cm = CheckpointManager(str(tmp_path / "ckpt"))
+        tree = {
+            "z": jax.device_put(
+                jnp.arange(16.0).reshape(16, 1), meshlib.table_sharding(mesh8)
+            ),
+            "step": jnp.asarray(7),
+        }
+        cm.save(3, tree)
+        assert cm.latest_step() == 3
+        restored = cm.restore(3, like=tree)
+        np.testing.assert_allclose(np.asarray(restored["z"]), np.asarray(tree["z"]))
+        assert restored["z"].sharding == tree["z"].sharding
+
+    def test_replica_recovery(self, mesh8):
+        from parameter_server_tpu.parameter.kv_vector import KVVector
+
+        Postoffice.reset()
+        kv = KVVector(mesh=mesh8, k=1, num_slots=16, hashed=False, name="kv_rep")
+        keys = np.array([2, 9], dtype=np.int64)
+        kv.set_keys(0, keys)
+        kv.wait(kv.push(kv.request(0), keys=keys, values=np.ones((2, 1), np.float32)))
+        rm = ReplicaManager()
+        rm.backup(kv)
+        # "server dies": wipe state, then recover from replica
+        kv.set_replica({0: np.zeros((16, 1), np.float32)})
+        assert kv.values(0, keys).sum() == 0
+        assert rm.recover(kv)
+        np.testing.assert_allclose(kv.values(0, keys), np.ones((2, 1)))
+        Postoffice.reset()
