@@ -1,0 +1,49 @@
+"""parameter_server_tpu — a TPU-native parameter-server framework.
+
+A from-scratch rebuild of the DMLC parameter server (Mu Li et al., OSDI'14;
+reference C++/ZMQ tree mounted at /root/reference) designed for TPU: sharded
+parameter tables live in HBM over a ``jax.sharding.Mesh``, push/pull lower to
+XLA collectives, hot update rules run as Pallas kernels, and the host control
+plane (schedulers, readers, filters, recordio) mirrors the reference's C++
+runtime with a C++ fast path of its own (``cpp/``).
+
+Quick start::
+
+    import parameter_server_tpu as pst
+
+    po = pst.Postoffice.instance().start(num_server=1)
+    w = pst.KVVector(name="w", num_slots=1024, k=1)
+    ...
+
+The ``ps`` module is the ps.h-style convenience façade for writing
+role-dispatched apps; ``apps.linear.main`` is the conf-driven CLI.
+"""
+
+from . import ps
+from .parameter.kv_layer import KVLayer
+from .parameter.kv_map import KVMap
+from .parameter.kv_store import kv_store
+from .parameter.kv_vector import KVVector
+from .system.customer import App, Customer
+from .system.executor import NodeGroups
+from .system.message import Message, Task
+from .system.postoffice import Postoffice
+from .utils.range import Range
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "App",
+    "Customer",
+    "KVLayer",
+    "KVMap",
+    "KVVector",
+    "kv_store",
+    "Message",
+    "NodeGroups",
+    "Postoffice",
+    "Range",
+    "Task",
+    "ps",
+    "__version__",
+]

@@ -48,16 +48,14 @@ def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = Fal
     Returns (q, lo, hi); q is uint8/uint16. Padding to the TPU tile is
     handled internally.
     """
+    from ..filter.fixing_float import quantize_jax
+
+    if not (force_pallas or _use_pallas()):
+        return quantize_jax(x, num_bytes, jax.random.PRNGKey(seed))
     levels = float((1 << (8 * num_bytes)) - 1)
     lo = jnp.min(x)
     hi = jnp.maximum(jnp.max(x), lo + 1e-12)
     dt = jnp.uint8 if num_bytes == 1 else jnp.uint16
-    if not (force_pallas or _use_pallas()):
-        key = jax.random.PRNGKey(seed)
-        scaled = (x - lo) / (hi - lo) * levels
-        noise = jax.random.uniform(key, x.shape)
-        q = jnp.clip(jnp.floor(scaled + noise), 0, levels)
-        return q.astype(dt), lo, hi
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -89,5 +87,6 @@ def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = Fal
 
 
 def dequantize(q: jax.Array, lo, hi, num_bytes: int = 1) -> jax.Array:
-    levels = float((1 << (8 * num_bytes)) - 1)
-    return (q.astype(jnp.float32) / levels * (hi - lo) + lo).astype(jnp.float32)
+    from ..filter.fixing_float import dequantize_jax
+
+    return dequantize_jax(q, lo, hi, num_bytes)

@@ -17,6 +17,7 @@ reference where workers and servers are disjoint processes.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional, Sequence
 
@@ -41,10 +42,19 @@ def make_mesh(
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
     if num_data is None:
-        num_data = n // num_server
-    if num_data * num_server != n:
-        raise ValueError(f"mesh {num_data}x{num_server} != {n} devices")
-    arr = np.asarray(devs).reshape(num_data, num_server)
+        num_data = max(1, n // num_server)
+        if num_data * num_server < n:
+            logging.getLogger(__name__).warning(
+                "mesh %dx%d leaves %d of %d devices idle (num_server does "
+                "not divide the device count)",
+                num_data, num_server, n - num_data * num_server, n,
+            )
+    need = num_data * num_server
+    if need > n:
+        raise ValueError(f"mesh {num_data}x{num_server} needs {need} > {n} devices")
+    # fewer nodes than devices is fine (ref script/local.sh runs any N/M on
+    # one box): take a prefix of the device list
+    arr = np.asarray(devs[:need]).reshape(num_data, num_server)
     return Mesh(arr, (DATA_AXIS, SERVER_AXIS))
 
 

@@ -1,0 +1,281 @@
+"""Simple top-level interface for writing PS programs.
+
+Counterpart of ``src/ps.h`` (reference: ps.h:1-80): the convenience façade a
+user program imports to query its node identity (``my_node_id``, ``is_worker``,
+``my_rank``...), build apps, and boot/stop the system. The reference runs one
+OS process per node and reads the role from flags; the TPU-native runtime is
+a single SPMD process that drives every role over the device mesh, so
+``run_system`` plays the part of ``script/local.sh`` + ``RunSystem``: it
+instantiates the scheduler/server/worker apps from one factory and executes
+worker ``run()`` bodies (concurrently, like separate node processes), with a
+per-thread *current node* so the ps.h-style role helpers answer correctly
+inside each app body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, List, Optional
+
+from .system.customer import App
+from .system.executor import NodeGroups
+from .system.manager import Node
+from .system.message import Message, Task
+from .system.postoffice import Postoffice
+from .utils.range import Range
+
+__all__ = [
+    "App",
+    "NodeGroups",
+    "start_system",
+    "stop_system",
+    "run_system",
+    "submit",
+    "my_app",
+    "my_node",
+    "my_node_id",
+    "is_worker",
+    "is_server",
+    "is_scheduler",
+    "my_key_range",
+    "scheduler_id",
+    "next_customer_id",
+    "my_rank",
+    "rank_size",
+    "wait_servers_ready",
+    "wait_workers_ready",
+]
+
+_tls = threading.local()
+
+
+def _current_node() -> Node:
+    node = getattr(_tls, "node", None)
+    if node is None:
+        # Outside run_system the driving process acts as the scheduler,
+        # matching the reference where the root process is node "H".
+        nodes = Postoffice.instance().manager.nodes
+        return nodes[0] if nodes else Node(Node.SCHEDULER, 0)
+    return node
+
+
+def _set_current_node(node: Optional[Node]) -> None:
+    _tls.node = node
+
+
+# -- system lifecycle (ref ps.h StartSystem/StopSystem/RunSystem) --
+
+
+def start_system(
+    num_workers: Optional[int] = None,
+    num_servers: int = 1,
+    key_space: Optional[Range] = None,
+) -> Postoffice:
+    """Boot the postoffice: build the device mesh and the node table."""
+    return Postoffice.instance().start(
+        num_data=num_workers, num_server=num_servers, key_space=key_space
+    )
+
+
+def stop_system() -> None:
+    _app_registry.clear()
+    Postoffice.instance().stop()
+    Postoffice.reset()
+
+
+# Apps created by run_system, for group routing (ref: the manager's customer
+# registry keyed by (node, customer id); here one process hosts every node).
+_app_registry: List[App] = []
+
+_GROUP_ROLES = {
+    NodeGroups.SERVER_GROUP: {Node.SERVER},
+    NodeGroups.WORKER_GROUP: {Node.WORKER},
+    NodeGroups.COMP_GROUP: {Node.SERVER, Node.WORKER},
+    NodeGroups.LIVE_GROUP: {Node.SCHEDULER, Node.SERVER, Node.WORKER},
+}
+
+
+def _group_apps(recver: str, exclude: Optional[App] = None) -> List[App]:
+    roles = _GROUP_ROLES.get(recver)
+    out = []
+    for a in _app_registry:
+        if a is exclude:
+            continue
+        node = getattr(a, "node", None)
+        if node is None:
+            continue
+        if (roles is not None and node.role in roles) or node.id == recver:
+            out.append(a)
+    return out
+
+
+def submit(
+    app: App,
+    task: Optional[Task] = None,
+    recver: str = NodeGroups.SERVER_GROUP,
+    callback: Optional[Callable[[], None]] = None,
+) -> int:
+    """RPC-style Submit (ref customer.h ``Submit(task, NodeID)``): deliver a
+    request carrying ``task`` to every app in the ``recver`` group (a
+    NodeGroups constant or a node id like "S0"), invoking each receiver's
+    ``process_request``; receivers that do not reply themselves are acked by
+    the system (ref executor.cc). Returns the timestamp to ``app.wait`` on;
+    ``callback`` fires when the last reply lands (delivery is synchronous on
+    this runtime, so by the time submit returns the callback has run —
+    waiting on the timestamp is not required for it to fire).
+    """
+    task = dataclasses.replace(task) if task is not None else Task()
+    if task.time < 0:
+        task.time = app.executor.time()
+
+    def step() -> None:
+        me = _current_node()
+        for target in _group_apps(recver, exclude=app):
+            req = Message(
+                task=dataclasses.replace(task),
+                sender=app.name,
+                recver=target.node.id,
+            )
+            # each node's receive path is serialized (the reference runs one
+            # executor thread per customer), so hello-style apps may mutate
+            # unlocked state in process_request
+            recv_lock = getattr(target, "_ps_recv_lock", None) or threading.Lock()
+            with recv_lock:
+                # the receiver's hooks run under its node identity (in the
+                # reference they run in the receiver's process)...
+                _set_current_node(target.node)
+                try:
+                    target.process_request(req)
+                finally:
+                    _set_current_node(me)
+            # ...while the auto-ack delivers process_response inline to the
+            # sender, which must see its own identity
+            if not getattr(req, "replied", False):
+                target.reply(req)
+        if callback is not None:
+            callback()
+
+    return app.submit(step, task=task)
+
+
+def run_system(
+    create_app: Callable[[], App],
+    num_workers: Optional[int] = None,
+    num_servers: int = 1,
+    key_space: Optional[Range] = None,
+) -> List[App]:
+    """Run a ps.h-style program end to end (ref RunSystem + local.sh).
+
+    ``create_app`` is called once per node — with ``is_worker()`` /
+    ``is_server()`` / ``is_scheduler()`` answering for that node, exactly like
+    the reference's ``App::Create`` factory — then every worker app's
+    ``run()`` executes on its own thread (the reference's per-process main).
+    Returns the app instances (scheduler first, then servers, then workers).
+    """
+    po = start_system(num_workers, num_servers, key_space)
+    apps: List[App] = []
+    try:
+        for node in po.manager.nodes:
+            _set_current_node(node)
+            app = create_app()
+            app.node = node
+            app.name = node.id  # messages identify nodes by id (ref van.cc)
+            app._ps_recv_lock = threading.Lock()
+            apps.append(app)
+            _app_registry.append(app)
+        workers = [a for a in apps if a.node.role == Node.WORKER]
+        threads = []
+        for app in workers:
+
+            def body(app: App = app) -> None:
+                _set_current_node(app.node)
+                app.run()
+
+            t = threading.Thread(target=body, name=f"run_{app.node.id}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        for app in apps:
+            if app.node.role != Node.WORKER:
+                _set_current_node(app.node)
+                app.run()
+    finally:
+        _set_current_node(None)
+        stop_system()
+    return apps
+
+
+# -- node identity helpers (ref ps.h MyApp/MyNode/MyNodeID/IsWorker/...) --
+
+
+def my_app() -> Optional[App]:
+    """The app running on the current node (ref ps.h MyApp)."""
+    node = getattr(_tls, "node", None)
+    if node is not None:
+        for a in _app_registry:
+            if getattr(a, "node", None) is node:
+                return a
+    po = Postoffice.instance()
+    for c in list(po.manager._customers.values()):
+        if isinstance(c, App):
+            return c
+    return None
+
+
+def my_node() -> Node:
+    return _current_node()
+
+
+def my_node_id() -> str:
+    return _current_node().id
+
+
+def is_worker() -> bool:
+    return _current_node().role == Node.WORKER
+
+
+def is_server() -> bool:
+    return _current_node().role == Node.SERVER
+
+
+def is_scheduler() -> bool:
+    return _current_node().role == Node.SCHEDULER
+
+
+def my_key_range() -> Range:
+    return _current_node().key_range
+
+
+def scheduler_id() -> str:
+    return "H0"
+
+
+def next_customer_id() -> int:
+    return Postoffice.instance().manager.next_customer_id()
+
+
+def my_rank() -> int:
+    return _current_node().rank
+
+
+def rank_size() -> int:
+    """Nodes in my group (ref ps.h RankSize)."""
+    role = _current_node().role
+    nodes = Postoffice.instance().manager.nodes
+    return max(1, sum(1 for n in nodes if n.role == role))
+
+
+# -- readiness barriers (ref ps.h WaitServersReady/WaitWorkersReady). On the
+#    single-process SPMD runtime all nodes exist once start_system returns,
+#    so these only assert the system is up. --
+
+
+def wait_servers_ready() -> None:
+    if not Postoffice.instance().started:
+        raise RuntimeError("system not started (call start_system first)")
+
+
+def wait_workers_ready() -> None:
+    wait_servers_ready()

@@ -22,6 +22,7 @@ class Customer:
         self.id = self.po.manager.next_customer_id() if id is None else id
         self.name = name or f"customer_{self.id}"
         self.executor = Executor(name=self.name)
+        self._last_response: Optional[Message] = None
         self.po.manager.add_customer(self)
 
     # -- communication (ref customer.h Submit/Wait/Reply) --
@@ -45,12 +46,19 @@ class Customer:
         response.task.request = False
         response.task.time = request.task.time
         response.sender, response.recver = request.recver, request.sender
+        request.replied = True  # ref executor.cc: system acks once per request
         self.executor.tracker.finish(request.task.time)
         target = self.po.manager.find_customer_by_name(request.sender)
         if target is not None:
+            target._last_response = response  # ref customer.h LastResponse()
             target.process_response(response)
         if request.callback is not None:
             request.callback()
+
+    def last_response(self) -> Optional[Message]:
+        """The most recent response delivered to me (ref customer.h
+        LastResponse — valid inside a response callback)."""
+        return self._last_response
 
     # -- user hooks (ref ProcessRequest/ProcessResponse) --
 

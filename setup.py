@@ -3,8 +3,8 @@
 Builds the C++ host library (crc32c, hashing, text parsers) as part of the
 package; pure-stdlib build so no pip installs are needed.
 
-    python setup.py build_ext   # or: make
-    pip install -e .            # optional editable install
+    python setup.py build_native   # or: make -C parameter_server_tpu/cpp
+    pip install -e .               # optional editable install
 """
 
 import subprocess

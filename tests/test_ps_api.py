@@ -1,0 +1,123 @@
+"""ps.h-façade tests: hello-world app parity (ref src/test/hello_ps.cc) and
+the node-identity helpers from src/ps.h."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import parameter_server_tpu as pst
+from parameter_server_tpu import ps
+from parameter_server_tpu.system.message import Task
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.range import Range
+
+
+@pytest.fixture(autouse=True)
+def _fresh_system():
+    Postoffice.reset()
+    yield
+    ps.stop_system()
+
+
+def test_hello_world_roundtrip():
+    """Port of hello_ps.cc: workers Submit two tasks to the server group,
+    Wait on each, then a third with a completion callback reading
+    last_response."""
+    log = []
+    log_lock = threading.Lock()
+
+    def record(line):
+        with log_lock:
+            log.append(line)
+
+    class Server(ps.App):
+        def process_request(self, req):
+            record((ps.my_node_id(), "req", req.task.time, req.sender))
+
+    class Worker(ps.App):
+        def process_response(self, res):
+            record((ps.my_node_id(), "res", res.task.time, res.sender))
+
+        def run(self):
+            ts = ps.submit(self, Task(), ps.NodeGroups.SERVER_GROUP)
+            self.wait(ts)
+            ts = ps.submit(self, Task(), ps.NodeGroups.SERVER_GROUP)
+            self.wait(ts)
+
+            done = threading.Event()
+
+            def on_done():
+                assert self.last_response() is not None
+                record((ps.my_node_id(), "cb", self.last_response().task.time))
+                done.set()
+
+            self.wait(ps.submit(self, Task(), callback=on_done))
+            assert done.is_set()
+
+    def create_app():
+        if ps.is_worker():
+            return Worker()
+        if ps.is_server():
+            return Server()
+        return ps.App()
+
+    apps = ps.run_system(create_app, num_workers=2, num_servers=2)
+    assert len(apps) == 5  # H0 + 2 servers + 2 workers
+
+    reqs = [e for e in log if e[1] == "req"]
+    ress = [e for e in log if e[1] == "res"]
+    cbs = [e for e in log if e[1] == "cb"]
+    # each of 2 workers sent 3 requests, each fanned out to 2 servers
+    assert len(reqs) == 2 * 3 * 2
+    assert len(ress) == 2 * 3 * 2
+    assert len(cbs) == 2
+    assert {e[0] for e in reqs} == {"S0", "S1"}
+    assert {e[0] for e in ress} == {"W0", "W1"}
+
+
+def test_node_identity_helpers():
+    seen = {}
+
+    class Probe(ps.App):
+        def __init__(self):
+            super().__init__()
+            seen[ps.my_node_id()] = (
+                ps.is_scheduler(),
+                ps.is_server(),
+                ps.is_worker(),
+                ps.my_rank(),
+                ps.rank_size(),
+                ps.my_key_range(),
+            )
+
+    ps.run_system(Probe, num_workers=3, num_servers=2, key_space=Range(0, 100))
+
+    assert seen["H0"][:3] == (True, False, False)
+    assert seen["S0"][:3] == (False, True, False)
+    assert seen["W2"][:3] == (False, False, True)
+    assert seen["W1"][3:5] == (1, 3)
+    assert seen["S1"][3:5] == (1, 2)
+    # server key ranges evenly divide the key space (ref Range::EvenDivide)
+    assert seen["S0"][5] == Range(0, 50)
+    assert seen["S1"][5] == Range(50, 100)
+    # workers span the whole key space
+    assert seen["W0"][5] == Range.all()
+
+
+def test_ready_barriers_and_scheduler_id():
+    ps.start_system(num_workers=1, num_servers=1)
+    ps.wait_servers_ready()
+    ps.wait_workers_ready()
+    assert ps.scheduler_id() == "H0"
+    assert ps.next_customer_id() >= 1
+    ps.stop_system()
+    with pytest.raises(RuntimeError):
+        ps.wait_servers_ready()
+
+
+def test_package_exports():
+    assert pst.__version__
+    assert pst.KVVector is not None and pst.KVMap is not None
+    assert pst.ps.App is ps.App
