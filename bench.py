@@ -19,7 +19,6 @@ evaluation: ~65k examples/sec/node with sparse LR at ~100 nnz/example).
 import argparse
 import json
 import sys
-import threading
 import time
 
 import numpy as np
@@ -53,7 +52,6 @@ def main() -> int:
     )
     from parameter_server_tpu.parallel import mesh as meshlib
     from parameter_server_tpu.system.postoffice import Postoffice
-    from parameter_server_tpu.utils.concurrent import ProducerConsumer
     from parameter_server_tpu.utils.sparse import random_sparse
 
     Postoffice.reset()
@@ -88,36 +86,23 @@ def main() -> int:
 
     # pre-generate raw batches (parsing is benchmarked separately; the
     # reference criteo bench reads pre-tokenized minibatches similarly),
-    # but run LOCALIZATION (hash→slot) + device upload inside the timed loop
-    # via prefetch threads — that's the honest host-side cost.
+    # but run LOCALIZATION (hash→slot + u24 wire packing) + device upload
+    # inside the timed loop — that's the honest host-side cost. The loop is
+    # deliberately single-threaded: device_put is async, so transfers
+    # overlap the next batch's host prep without helper threads (which
+    # contend with the transfer engine for the GIL and *halve* throughput).
     raw = [gen(i) for i in range(min(args.steps + args.warmup, 16))]
     worker._padding(raw[0])
 
-    pc = ProducerConsumer(capacity=8)
-    total_steps = args.warmup + args.steps
-    counter = {"i": 0}
-    counter_lock = threading.Lock()
-
-    def produce():
-        with counter_lock:
-            i = counter["i"]
-            if i >= total_steps:
-                return None
-            counter["i"] = i + 1
-        # host prep only — uploads contend when threaded, so the main loop
-        # does a single async device_put per batch instead
-        return worker.prep(raw[i % len(raw)], device_put=False)
-
-    pc.start_producer(produce, num_threads=3)
-
-    def upload_and_submit(prepped):
+    def prep_upload_submit(i: int):
         # with_aux=False: skip the per-example AUC outputs in the hot loop
+        prepped = worker.prep(raw[i % len(raw)], device_put=False)
         return worker._submit_prepped(jax.device_put(prepped), with_aux=False)
 
     # warmup (compile)
     pending = []
-    for _ in range(args.warmup):
-        pending.append(upload_and_submit(pc.pop()))
+    for i in range(args.warmup):
+        pending.append(prep_upload_submit(i))
     for ts in pending:
         worker.executor.wait(ts)
 
@@ -125,10 +110,7 @@ def main() -> int:
     pending = []
     done = 0
     while done < args.steps:
-        prepped = pc.pop()
-        if prepped is None:
-            break
-        pending.append(upload_and_submit(prepped))
+        pending.append(prep_upload_submit(done))
         done += 1
         if len(pending) > 3:
             worker.executor.wait(pending.pop(0))

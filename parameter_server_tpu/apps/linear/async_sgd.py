@@ -195,6 +195,42 @@ class ELLBatch:
         return int(self.mask.sum())
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ELLPackedBatch:
+    """ELLBatch with slot ids packed to 3 bytes on the wire.
+
+    The host→device link (PCIe, or an RPC tunnel in disaggregated setups)
+    is the pipeline's scarce resource — the device step is ~100x faster
+    than the transfer. Slot ids address ``num_slots`` < 2^24 entries, so
+    int32 wastes a byte per feature; we ship little-endian u24 and
+    reassemble with three cheap VPU ops inside the jitted step. This is the
+    same byte-economy instinct as the reference's fixing_float filter
+    (filter/fixing_float.h) applied to the key stream instead of values.
+    """
+
+    y: np.ndarray  # [D, R] float32
+    mask: np.ndarray  # [D, R] uint8
+    slots_u24: np.ndarray  # [D, R, K, 3] uint8, little-endian
+    vals: Optional[np.ndarray]  # [D, R, K] float32 or None (binary)
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.mask.sum())
+
+
+def pack_u24(idx: np.ndarray) -> np.ndarray:
+    """int32 [..] → uint8 [.., 3] little-endian (values must be < 2^24)."""
+    flat = np.ascontiguousarray(idx, dtype="<u4")
+    return flat.view(np.uint8).reshape(*idx.shape, 4)[..., :3].copy()
+
+
+def unpack_u24(b: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [.., 3] → int32 [..] (jit-side inverse of pack_u24)."""
+    s = b.astype(jnp.int32)
+    return s[..., 0] | (s[..., 1] << 8) | (s[..., 2] << 16)
+
+
 def prep_batch_ell(
     batch: SparseBatch,
     directory,
@@ -203,6 +239,7 @@ def prep_batch_ell(
     lanes: int,
     num_slots: int,
     device_put: bool = False,
+    pack: bool = False,
 ) -> ELLBatch:
     """Pack a CSR batch into ELL lanes (rows with more than ``lanes``
     features are truncated — callers size lanes to the data's max row)."""
@@ -236,12 +273,21 @@ def prep_batch_ell(
                 vals[flat_rows, flat_lanes] = batch.values[seg][keep]
         shards.append((y, mask, slots, vals))
     ys, masks, slotss, valss = zip(*shards)
-    out = ELLBatch(
-        y=np.stack(ys),
-        mask=np.stack(masks),
-        slots=np.stack(slotss),
-        vals=None if binary else np.stack(valss),
-    )
+    if pack:
+        assert num_slots < (1 << 24), "u24 wire format needs num_slots < 2^24"
+        out = ELLPackedBatch(
+            y=np.stack(ys),
+            mask=np.stack(masks).astype(np.uint8),
+            slots_u24=pack_u24(np.stack(slotss)),
+            vals=None if binary else np.stack(valss),
+        )
+    else:
+        out = ELLBatch(
+            y=np.stack(ys),
+            mask=np.stack(masks),
+            slots=np.stack(slotss),
+            vals=None if binary else np.stack(valss),
+        )
     if device_put:
         out = jax.device_put(out)
     return out
@@ -275,16 +321,26 @@ def _progress_metrics(loss, y, xw, mask, with_aux: bool):
 
 
 def make_train_step_ell(
-    updater, loss, mesh, num_slots: int, binary: bool, with_aux: bool = True
+    updater,
+    loss,
+    mesh,
+    num_slots: int,
+    binary: bool,
+    with_aux: bool = True,
+    packed: bool = False,
 ):
     """Fused SPMD step over ELL batches: Xw is a lane reduction (no row
-    scatter); only the push keeps a scatter-add."""
+    scatter); only the push keeps a scatter-add. ``packed`` accepts the
+    u24-wire ELLPackedBatch and unpacks indices on device."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
 
     def local_step(live, pulled, y, mask, slots, vals):
         y, mask, slots = y[0], mask[0], slots[0]
         vals = None if binary else vals[0]
+        if packed:
+            mask = mask.astype(jnp.float32)
+            slots = unpack_u24(slots)
         flat = slots.reshape(-1)
         lo = jax.lax.axis_index(SERVER_AXIS) * shard
         rel = jnp.clip(flat - lo, 0, shard - 1)
@@ -328,8 +384,9 @@ def make_train_step_ell(
     @jax.jit
     def step(live_state, pull_state, batch):
         specs = state_spec(live_state)
+        slots = batch.slots_u24 if packed else batch.slots
         # binary batches carry no vals; pass slots as an unused placeholder
-        vals = batch.slots if binary else batch.vals
+        vals = slots if binary else batch.vals
         batch_specs = tuple(P(DATA_AXIS) for _ in range(4))
         return shard_map(
             local_step,
@@ -337,7 +394,7 @@ def make_train_step_ell(
             in_specs=(specs, specs, *batch_specs),
             out_specs=(specs, P()),
             check_vma=False,
-        )(live_state, pull_state, batch.y, batch.mask, batch.slots, vals)
+        )(live_state, pull_state, batch.y, batch.mask, slots, vals)
 
     return step
 
@@ -562,6 +619,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.sgd.ell_lanes,
                 self.num_slots,
                 device_put=device_put,
+                pack=self.sgd.wire_u24 and self.num_slots < (1 << 24),
             )
         if self.directory.hashed:
             return prep_batch_hashed(
@@ -584,11 +642,12 @@ class AsyncSGDWorker(ISGDCompNode):
         )
 
     def _get_step(self, prepped, with_aux: bool):
-        if isinstance(prepped, ELLBatch):
-            key = ("ell", prepped.vals is None, with_aux)
+        if isinstance(prepped, (ELLBatch, ELLPackedBatch)):
+            packed = isinstance(prepped, ELLPackedBatch)
+            key = ("ell_packed" if packed else "ell", prepped.vals is None, with_aux)
             builder = lambda: make_train_step_ell(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
-                binary=prepped.vals is None, with_aux=with_aux,
+                binary=prepped.vals is None, with_aux=with_aux, packed=packed,
             )
         elif isinstance(prepped, HashedBatch):
             key = ("hashed", False, with_aux)

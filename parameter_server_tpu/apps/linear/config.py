@@ -66,6 +66,10 @@ class SGDConfig:
     rows_pad: int = 0  # 0 = minibatch size
     nnz_pad: int = 0  # 0 = auto from first batch
     ell_lanes: int = 0  # >0: ELL row-block packing with K feature lanes
+    # pack ELL slot ids to 3 bytes on the wire. Off by default: the numpy
+    # byte-slice pack costs ~3.5ms/16k-batch on the critical path, which
+    # only pays off on links where raw bytes (not host cycles) dominate.
+    wire_u24: bool = False
 
 
 @dataclasses.dataclass

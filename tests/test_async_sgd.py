@@ -196,3 +196,28 @@ class TestConfParsing:
         assert cfg.darlin.max_block_delay == 2
         assert cfg.darlin.num_data_pass == 20
         assert cfg.darlin.epsilon == 2e-5
+
+
+class TestU24Wire:
+    def test_pack_unpack_roundtrip(self):
+        import jax
+        from parameter_server_tpu.apps.linear.async_sgd import pack_u24, unpack_u24
+
+        idx = np.random.default_rng(0).integers(0, 1 << 24, size=(64, 7)).astype(np.int32)
+        packed = pack_u24(idx)
+        assert packed.dtype == np.uint8 and packed.shape == (64, 7, 3)
+        out = np.asarray(jax.jit(unpack_u24)(packed))
+        np.testing.assert_array_equal(out, idx)
+
+    def test_packed_step_matches_unpacked(self, mesh8, w_true):
+        """u24 wire format is a pure encoding: same state evolution."""
+
+        def train(wire):
+            conf = make_conf(num_slots=4096)
+            conf.async_sgd.ell_lanes = 8
+            conf.async_sgd.wire_u24 = wire
+            worker = AsyncSGDWorker(conf, mesh=mesh8)
+            worker.train(synth(5, w_true))
+            return worker.weights_dense()
+
+        np.testing.assert_allclose(train(True), train(False), atol=1e-6)
