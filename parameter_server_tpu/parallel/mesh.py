@@ -43,15 +43,14 @@ def make_mesh(
     n = len(devs)
     if num_data is None:
         num_data = max(1, n // num_server)
-        if num_data * num_server < n:
-            logging.getLogger(__name__).warning(
-                "mesh %dx%d leaves %d of %d devices idle (num_server does "
-                "not divide the device count)",
-                num_data, num_server, n - num_data * num_server, n,
-            )
     need = num_data * num_server
     if need > n:
         raise ValueError(f"mesh {num_data}x{num_server} needs {need} > {n} devices")
+    if need < n:
+        logging.getLogger(__name__).warning(
+            "mesh %dx%d leaves %d of %d devices idle",
+            num_data, num_server, n - need, n,
+        )
     # fewer nodes than devices is fine (ref script/local.sh runs any N/M on
     # one box): take a prefix of the device list
     arr = np.asarray(devs[:need]).reshape(num_data, num_server)

@@ -96,12 +96,10 @@ _GROUP_ROLES = {
 }
 
 
-def _group_apps(recver: str, exclude: Optional[App] = None) -> List[App]:
+def _group_apps(recver: str) -> List[App]:
     roles = _GROUP_ROLES.get(recver)
     out = []
     for a in _app_registry:
-        if a is exclude:
-            continue
         node = getattr(a, "node", None)
         if node is None:
             continue
@@ -131,7 +129,10 @@ def submit(
 
     def step() -> None:
         me = _current_node()
-        for target in _group_apps(recver, exclude=app):
+        # groups include the sender's own node when its role matches (ref
+        # executor.cc AddNode: every node joins kLiveGroup and its role
+        # group), so a broadcast delivers to self via loopback too
+        for target in _group_apps(recver):
             req = Message(
                 task=dataclasses.replace(task),
                 sender=app.name,
@@ -140,8 +141,7 @@ def submit(
             # each node's receive path is serialized (the reference runs one
             # executor thread per customer), so hello-style apps may mutate
             # unlocked state in process_request
-            recv_lock = getattr(target, "_ps_recv_lock", None) or threading.Lock()
-            with recv_lock:
+            with target._ps_recv_lock:
                 # the receiver's hooks run under its node identity (in the
                 # reference they run in the receiver's process)...
                 _set_current_node(target.node)
@@ -186,17 +186,27 @@ def run_system(
             _app_registry.append(app)
         workers = [a for a in apps if a.node.role == Node.WORKER]
         threads = []
+        errors: List[BaseException] = []
+        errors_lock = threading.Lock()
         for app in workers:
 
             def body(app: App = app) -> None:
                 _set_current_node(app.node)
-                app.run()
+                try:
+                    app.run()
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    with errors_lock:
+                        errors.append(e)
 
             t = threading.Thread(target=body, name=f"run_{app.node.id}")
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
+        if errors:
+            # a crashed worker must fail the program, not vanish with its
+            # thread (the reference's process exit code propagates)
+            raise errors[0]
         for app in apps:
             if app.node.role != Node.WORKER:
                 _set_current_node(app.node)

@@ -121,3 +121,35 @@ def test_package_exports():
     assert pst.__version__
     assert pst.KVVector is not None and pst.KVMap is not None
     assert pst.ps.App is ps.App
+
+
+def test_worker_exception_propagates():
+    """A crashed worker run() must fail run_system, not vanish (ref: the
+    worker process's exit code propagates through local.sh)."""
+
+    class Crasher(ps.App):
+        def run(self):
+            if ps.is_worker():
+                raise RuntimeError("worker died")
+
+    with pytest.raises(RuntimeError, match="worker died"):
+        ps.run_system(Crasher, num_workers=2, num_servers=1)
+
+
+def test_group_broadcast_delivers_to_self():
+    """Groups include the sender's own node when its role matches (ref
+    executor.cc AddNode: every node joins kLiveGroup + its role group)."""
+    got = []
+
+    class Echo(ps.App):
+        def process_request(self, msg):
+            got.append((msg.sender, ps.my_node_id()))
+
+        def run(self):
+            if ps.my_node_id() == "W0":
+                self.wait(ps.submit(self, Task(), ps.NodeGroups.LIVE_GROUP))
+
+    ps.run_system(Echo, num_workers=2, num_servers=1)
+    receivers = {r for s, r in got if s == "W0"}
+    assert "W0" in receivers  # self-delivery via loopback
+    assert receivers == {"H0", "S0", "W0", "W1"}
