@@ -253,12 +253,24 @@ def prep_batch_ell(
         y[:nsub] = batch.y[lo_r:hi_r]
         mask = np.zeros(rows_pad, np.float32)
         mask[:nsub] = 1.0
-        slots = np.full((rows_pad, lanes), num_slots, np.int32)
-        vals = None if binary else np.zeros((rows_pad, lanes), np.float32)
         counts = np.diff(batch.indptr[lo_r : hi_r + 1]).astype(np.int64)
         seg = slice(batch.indptr[lo_r], batch.indptr[hi_r])
         slot_ids = directory.slots(batch.indices[seg])
-        if nsub and (counts == lanes).all():
+        uniform = bool(nsub) and bool((counts == lanes).all())
+        if uniform and nsub == rows_pad:
+            # full uniform batch (the CTR hot path): the freshly-hashed ids
+            # ARE the ELL array — reshape in place, no fill, no copy
+            slots = slot_ids.reshape(nsub, lanes)
+            vals = (
+                None
+                if binary
+                else batch.values[seg].astype(np.float32, copy=False).reshape(nsub, lanes)
+            )
+            shards.append((y, mask, slots, vals))
+            continue
+        slots = np.full((rows_pad, lanes), num_slots, np.int32)
+        vals = None if binary else np.zeros((rows_pad, lanes), np.float32)
+        if uniform:
             # uniform rows (fixed-width data): ELL packing is a reshape
             slots[:nsub] = slot_ids.reshape(nsub, lanes)
             if not binary:
@@ -273,20 +285,25 @@ def prep_batch_ell(
                 vals[flat_rows, flat_lanes] = batch.values[seg][keep]
         shards.append((y, mask, slots, vals))
     ys, masks, slotss, valss = zip(*shards)
+    if num_shards == 1:
+        # single data shard: add the leading axis as a view, not a stack copy
+        stack = lambda xs: xs[0][None]  # noqa: E731
+    else:
+        stack = np.stack
     if pack:
         assert num_slots < (1 << 24), "u24 wire format needs num_slots < 2^24"
         out = ELLPackedBatch(
-            y=np.stack(ys),
-            mask=np.stack(masks).astype(np.uint8),
-            slots_u24=pack_u24(np.stack(slotss)),
-            vals=None if binary else np.stack(valss),
+            y=stack(ys),
+            mask=stack(masks).astype(np.uint8),
+            slots_u24=pack_u24(stack(slotss)),
+            vals=None if binary else stack(valss),
         )
     else:
         out = ELLBatch(
-            y=np.stack(ys),
-            mask=np.stack(masks),
-            slots=np.stack(slotss),
-            vals=None if binary else np.stack(valss),
+            y=stack(ys),
+            mask=stack(masks),
+            slots=stack(slotss),
+            vals=None if binary else stack(valss),
         )
     if device_put:
         out = jax.device_put(out)

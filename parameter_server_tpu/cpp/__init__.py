@@ -32,6 +32,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ps_mix64.restype = ctypes.c_uint64
     lib.ps_mix64_array.argtypes = [u64p, ctypes.c_uint64, ctypes.c_uint64, u64p]
     lib.ps_mix64_array.restype = None
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ps_hash_slots.argtypes = [
+        u64p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, i32p,
+    ]
+    lib.ps_hash_slots.restype = None
     for name in ("ps_parse_libsvm", "ps_parse_criteo"):
         fn = getattr(lib, name)
         fn.argtypes = [
@@ -52,7 +57,11 @@ def native() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_DIR, "psnative.cc")
+        stale = os.path.exists(_LIB_PATH) and os.path.exists(src) and (
+            os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        )
+        if not os.path.exists(_LIB_PATH) or stale:
             try:
                 subprocess.run(
                     ["make", "-C", _DIR],
@@ -64,6 +73,8 @@ def native() -> Optional[ctypes.CDLL]:
                 return None
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so missing newer symbols that slipped
+            # past the mtime check — honor the None contract, don't raise
             _lib = None
         return _lib

@@ -73,6 +73,21 @@ void ps_mix64_array(const uint64_t* keys, uint64_t n, uint64_t seed,
   for (uint64_t i = 0; i < n; ++i) out[i] = ps_mix64(keys[i], seed);
 }
 
+// Fused key→slot mapping for hashed directories (KeyDirectory.slots): hash
+// and reduce into [0, num_slots) in one pass, int32 out — saves the numpy
+// uint64 temporaries and the second masking pass on the prep critical path.
+void ps_hash_slots(const uint64_t* keys, uint64_t n, uint64_t seed,
+                   uint64_t num_slots, int32_t* out) {
+  if ((num_slots & (num_slots - 1)) == 0) {
+    const uint64_t mask = num_slots - 1;
+    for (uint64_t i = 0; i < n; ++i)
+      out[i] = (int32_t)(ps_mix64(keys[i], seed) & mask);
+  } else {
+    for (uint64_t i = 0; i < n; ++i)
+      out[i] = (int32_t)(ps_mix64(keys[i], seed) % num_slots);
+  }
+}
+
 // ---------------------------------------------------------------------------
 // Text parsers (libsvm / criteo). Parse a buffer of newline-separated
 // examples into CSR arrays. Caller supplies output buffers sized by

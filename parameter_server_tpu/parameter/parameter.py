@@ -24,7 +24,7 @@ import numpy as np
 
 from ..system.customer import Customer
 from ..system.message import INVALID_TIME, FilterSpec, Task
-from ..utils.murmur import murmur64_np
+from ..utils.murmur import hash_slots
 from ..utils.range import Range
 
 
@@ -83,11 +83,7 @@ class KeyDirectory:
         sentinel slot ``num_slots`` (dropped by device range masks)."""
         keys = np.asarray(keys)
         if self.hashed:
-            h = murmur64_np(keys.astype(np.uint64))
-            if self.num_slots & (self.num_slots - 1) == 0:
-                # pow2 table: bitmask beats uint64 modulo by ~5x on host
-                return (h & np.uint64(self.num_slots - 1)).astype(np.int32)
-            return (h % np.uint64(self.num_slots)).astype(np.int32)
+            return hash_slots(keys, self.num_slots)
         assert self.keys is not None, "exact directory requires keys"
         pos = np.searchsorted(self.keys, keys)
         posc = np.minimum(pos, len(self.keys) - 1) if len(self.keys) else pos

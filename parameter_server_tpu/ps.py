@@ -181,7 +181,9 @@ def run_system(
             app = create_app()
             app.node = node
             app.name = node.id  # messages identify nodes by id (ref van.cc)
-            app._ps_recv_lock = threading.Lock()
+            # RLock: process_request may itself submit to a group that now
+            # includes this node (self-delivery), re-entering the lock
+            app._ps_recv_lock = threading.RLock()
             apps.append(app)
             _app_registry.append(app)
         workers = [a for a in apps if a.node.role == Node.WORKER]

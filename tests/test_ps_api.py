@@ -153,3 +153,23 @@ def test_group_broadcast_delivers_to_self():
     receivers = {r for s, r in got if s == "W0"}
     assert "W0" in receivers  # self-delivery via loopback
     assert receivers == {"H0", "S0", "W0", "W1"}
+
+
+def test_reentrant_submit_from_process_request():
+    """process_request may relay a broadcast to a group containing its own
+    node — the receive lock must be re-entrant, not deadlock."""
+    relayed = []
+
+    class Relay(ps.App):
+        def process_request(self, msg):
+            if msg.task.cmd == 1 and ps.is_scheduler():
+                ps.submit(self, Task(cmd=2), ps.NodeGroups.LIVE_GROUP)
+            elif msg.task.cmd == 2:
+                relayed.append(ps.my_node_id())
+
+        def run(self):
+            if ps.my_node_id() == "W0":
+                self.wait(ps.submit(self, Task(cmd=1), ps.scheduler_id()))
+
+    ps.run_system(Relay, num_workers=1, num_servers=1)
+    assert set(relayed) == {"H0", "S0", "W0"}

@@ -214,3 +214,21 @@ class TestBitmapAssign:
         assert apply_op(AssignOp.PLUS, 2.0, 3.0) == 5.0
         assert apply_op(AssignOp.ASSIGN, 2.0, 3.0) == 3.0
         assert apply_op(AssignOp.TIMES, 2.0, 3.0) == 6.0
+
+
+def test_hash_slots_batchsize_invariant():
+    """C++ fused path (large batches) and NumPy fallback (small) must map
+    identical keys to identical slots — slot assignment can never depend on
+    batch size or native-library availability."""
+    from parameter_server_tpu.utils.murmur import hash_slots
+
+    keys = np.random.default_rng(3).integers(0, 1 << 62, size=8192).astype(np.int64)
+    big = hash_slots(keys, 1 << 20)
+    small = np.concatenate([hash_slots(keys[i : i + 64], 1 << 20) for i in range(0, 8192, 64)])
+    np.testing.assert_array_equal(big, small)
+    assert big.dtype == np.int32 and big.min() >= 0 and big.max() < (1 << 20)
+    # non-pow2 table size exercises the modulo path
+    np.testing.assert_array_equal(
+        hash_slots(keys, 1_000_003),
+        np.concatenate([hash_slots(keys[:4096], 1_000_003), hash_slots(keys[4096:], 1_000_003)]),
+    )
