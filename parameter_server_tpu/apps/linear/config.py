@@ -112,6 +112,9 @@ _ENUMS = {
     "STANDARD": "standard", "TEXT": "text", "LIBSVM": "libsvm",
     "CRITEO": "criteo", "ADFEA": "adfea", "TERAFEA": "terafea",
     "BIN": "bin", "PROTO": "record",
+    "SPARSE": "ps_sparse", "SPARSE_BINARY": "ps_sparse_binary",
+    "DENSE": "ps_dense", "KEY_CACHING": "key_caching",
+    "COMPRESSING": "compressing", "FIXING_FLOAT": "fixing_float",
 }
 
 

@@ -188,6 +188,71 @@ def parse_ps_sparse(lines: List[str]) -> SparseBatch:
     return _batch_from_rows(labels, keys, vals)
 
 
+def parse_ps_sparse_binary(lines: List[str]) -> SparseBatch:
+    """ref ParsePS SPARSE_BINARY: "label;grp_id key key ...;" — every token
+    after the group id is a bare uint64 key, values implicitly 1."""
+    labels, keys = [], []
+    for line in lines:
+        groups = [g for g in line.strip().split(";") if g]
+        if not groups:
+            continue
+        try:
+            label = float(groups[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k = []
+        for grp in groups[1:]:
+            toks = grp.split()
+            if not toks:
+                continue
+            try:
+                gid = int(toks[0])
+            except ValueError:
+                continue
+            for tok in toks[1:]:
+                try:
+                    k.append(gid * SLOT_SPACE + int(tok))
+                except ValueError:
+                    continue
+        keys.append(np.asarray(k, dtype=np.int64))
+    return _batch_from_rows(labels, keys, None)
+
+
+def parse_ps_dense(lines: List[str]) -> SparseBatch:
+    """ref ParsePS DENSE: "label;grp_id val val ...;" — float values at
+    implicit positional indices within each group."""
+    labels, keys, vals = [], [], []
+    for line in lines:
+        groups = [g for g in line.strip().split(";") if g]
+        if not groups:
+            continue
+        try:
+            label = float(groups[0])
+        except ValueError:
+            continue
+        labels.append(1.0 if label > 0 else -1.0)
+        k, v = [], []
+        for grp in groups[1:]:
+            toks = grp.split()
+            if not toks:
+                continue
+            try:
+                gid = int(toks[0])
+            except ValueError:
+                continue
+            for pos, tok in enumerate(toks[1:]):
+                try:
+                    x = float(tok)
+                except ValueError:
+                    continue
+                k.append(gid * SLOT_SPACE + pos)
+                v.append(x)
+        keys.append(np.asarray(k, dtype=np.int64))
+        vals.append(np.asarray(v, dtype=np.float32))
+    return _batch_from_rows(labels, keys, vals)
+
+
 def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBatch]:
     lib = native()
     if lib is None:
@@ -232,6 +297,8 @@ _PY_PARSERS = {
     "terafea": parse_terafea,
     "ps": parse_ps_sparse,
     "ps_sparse": parse_ps_sparse,
+    "ps_sparse_binary": parse_ps_sparse_binary,
+    "ps_dense": parse_ps_dense,
 }
 _NATIVE = {"libsvm": "ps_parse_libsvm", "criteo": "ps_parse_criteo"}
 

@@ -10,6 +10,7 @@ a line reader. HDFS/S3 URLs are recognized and rejected with a clear error
 from __future__ import annotations
 
 import glob as _glob
+import re
 import gzip
 import os
 from typing import IO, Iterable, Iterator, List
@@ -41,13 +42,36 @@ def open_write(path: str, mode: str = "w") -> IO:
 
 
 def expand_globs(patterns: Iterable[str]) -> List[str]:
+    """Expand data-file patterns.
+
+    The reference matches the basename as a REGEX against the files in the
+    pattern's directory (data/common.cc:113-134 searchFiles), which is why
+    its example configs say ``part.*``. We accept both: shell glob first
+    (the pythonic convenience), then reference-style anchored basename
+    regex when the glob finds nothing.
+    """
     out: List[str] = []
     for p in patterns:
         if is_remote(p):
             out.append(p)
             continue
         hits = sorted(_glob.glob(p))
-        out.extend(hits if hits else ([p] if os.path.exists(p) else []))
+        if not hits and os.path.exists(p):
+            hits = [p]
+        if not hits:
+            dirname, base = os.path.split(p)
+            try:
+                rx = re.compile(base)
+                d = dirname or "."
+                if os.path.isdir(d):
+                    hits = sorted(
+                        os.path.join(dirname, f) if dirname else f
+                        for f in os.listdir(d)
+                        if rx.fullmatch(f)
+                    )
+            except re.error:
+                pass
+        out.extend(hits)
     return out
 
 

@@ -1,0 +1,165 @@
+"""Data-plane tests: golden lines for every text format the reference's
+ExampleParser handles (data/text_parser.cc: libsvm, criteo, adfea, terafea,
+ps dense/sparse/sparse_binary), C++-vs-Python parser parity, and protobuf-text
+config parsing of every shipped example conf (example/linear/*/*.conf)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.config import parse_conf
+from parameter_server_tpu.data.text_parser import (
+    SLOT_SPACE,
+    ExampleParser,
+    parse_adfea,
+    parse_criteo,
+    parse_libsvm,
+    parse_ps_dense,
+    parse_ps_sparse,
+    parse_ps_sparse_binary,
+    parse_terafea,
+)
+
+CONF_DIR = os.path.join(os.path.dirname(__file__), "..", "configs")
+
+
+class TestGoldenLines:
+    def test_libsvm(self):
+        b = parse_libsvm(["1 3:0.5 7:2", "-1 1:1", "0 2:4"])
+        assert b.n == 3 and b.nnz == 4
+        np.testing.assert_array_equal(b.y, [1, -1, -1])  # label>0 → +1 else -1
+        np.testing.assert_array_equal(b.indices[:2], [3, 7])
+        np.testing.assert_allclose(b.values[:3], [0.5, 2.0, 1.0])
+
+    def test_libsvm_skips_garbage(self):
+        b = parse_libsvm(["", "notalabel 1:2", "1 5:1"])
+        assert b.n == 1 and b.indices[0] == 5
+
+    def test_criteo(self):
+        line = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + "\t".join(
+            ["68fd1e64"] * 26
+        )
+        b = parse_criteo([line, line.replace("1\t", "0\t", 1)])
+        assert b.n == 2 and b.nnz == 78
+        np.testing.assert_array_equal(b.y, [1, -1])
+        # numeric slots carry values at the slot-stripe base key
+        assert b.indices[0] == 1 * SLOT_SPACE and b.values[0] == 1.0
+        assert b.indices[12] == 13 * SLOT_SPACE and b.values[12] == 13.0
+        # categorical slots: hashed into per-slot stripes, binary value
+        assert b.indices[13] // SLOT_SPACE == 14 and b.values[13] == 1.0
+
+    def test_criteo_missing_fields(self):
+        b = parse_criteo(["1\t\t2\t" + "\t".join([""] * 36)])
+        assert b.n == 1 and b.nnz == 1  # only numeric slot 2 present
+        assert b.indices[0] == 2 * SLOT_SPACE and b.values[0] == 2.0
+
+    def test_adfea(self):
+        b = parse_adfea(["100;1;123:4 456:7", "101;0;789:2"])
+        assert b.n == 2 and b.nnz == 3
+        np.testing.assert_array_equal(b.y, [1, -1])
+        assert b.indices[0] == 4 * SLOT_SPACE + 123
+        assert b.indices[2] == 2 * SLOT_SPACE + 789
+        assert b.binary
+
+    def test_terafea(self):
+        b = parse_terafea(["1 |ns1 a b |ns2 c", "-1 |ns1 a"])
+        assert b.n == 2 and b.nnz == 4
+        # same namespace+feature maps to the same key across rows
+        assert b.indices[0] == b.indices[3]
+
+    def test_ps_sparse(self):
+        b = parse_ps_sparse(["1;2 3:0.5 4:1.5;7 9:2;", "-1;2 3:1;"])
+        assert b.n == 2 and b.nnz == 4
+        assert b.indices[0] == 2 * SLOT_SPACE + 3
+        assert b.indices[2] == 7 * SLOT_SPACE + 9
+        np.testing.assert_allclose(b.values[:3], [0.5, 1.5, 2.0])
+
+    def test_ps_sparse_binary(self):
+        b = parse_ps_sparse_binary(["1;2 3 4;7 9;", "0;2 3;"])
+        assert b.n == 2 and b.nnz == 4 and b.binary
+        np.testing.assert_array_equal(b.y, [1, -1])
+        assert b.indices[0] == 2 * SLOT_SPACE + 3
+        assert b.indices[2] == 7 * SLOT_SPACE + 9
+
+    def test_ps_dense(self):
+        b = parse_ps_dense(["1;2 0.5 1.5 2.5;", "-1;2 9;"])
+        assert b.n == 2 and b.nnz == 4
+        # positional keys within the group stripe
+        np.testing.assert_array_equal(
+            b.indices[:3] - 2 * SLOT_SPACE, [0, 1, 2]
+        )
+        np.testing.assert_allclose(b.values[:3], [0.5, 1.5, 2.5])
+
+
+class TestNativeParity:
+    """The C++ fast path must produce byte-identical CSR output to the
+    Python fallback (ref: one parser, two deployments)."""
+
+    @pytest.mark.parametrize("fmt,lines", [
+        (
+            "libsvm",
+            ["1 3:0.5 7:2", "-1 1:1 2:0.25 9:4", "1 5:1"],
+        ),
+        (
+            "criteo",
+            [
+                "1\t" + "\t".join(str(i) for i in range(1, 14))
+                + "\t" + "\t".join(["68fd1e64", "80e26c9b"] * 13),
+                # well-formed line with empty numeric/categorical fields
+                # (the common Criteo missing-value shape)
+                "0\t" + "\t".join(["", "2", ""] + [str(i) for i in range(3, 13)])
+                + "\t" + "\t".join((["a1b2c3", ""] * 13)),
+            ],
+        ),
+    ])
+    def test_native_matches_python(self, fmt, lines):
+        native = ExampleParser(fmt, use_native=True)
+        python = ExampleParser(fmt, use_native=False)
+        if not native.use_native:
+            pytest.skip("native lib unavailable")
+        a, b = native.parse_lines(lines), python.parse_lines(lines)
+        np.testing.assert_array_equal(a.y, b.y)
+        np.testing.assert_array_equal(a.indptr, b.indptr)
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_allclose(a.values, b.values)
+
+
+class TestShippedConfigs:
+    """Every conf under configs/ must parse (mirrors the reference's
+    example/linear/* protobuf-text files driving main.cc)."""
+
+    @pytest.mark.parametrize(
+        "path",
+        sorted(glob.glob(os.path.join(CONF_DIR, "*", "*.conf"))),
+        ids=lambda p: "/".join(p.split(os.sep)[-2:]),
+    )
+    def test_parses(self, path):
+        conf = parse_conf(open(path).read())
+        assert conf.training_data or conf.validation_data
+        if "batch" in os.path.basename(path) and "eval" not in os.path.basename(path):
+            assert conf.darlin is not None
+        if "online" in os.path.basename(path) and "eval" not in os.path.basename(path):
+            assert conf.async_sgd is not None
+        if "eval" in os.path.basename(path):
+            assert conf.model_input is not None and conf.validation_data is not None
+
+
+class TestFileMatching:
+    def test_expand_globs_reference_regex(self, tmp_path):
+        """Reference configs use basename REGEX patterns like "part.*"
+        (data/common.cc searchFiles) — they must match part-0, part-1."""
+        from parameter_server_tpu.utils import file as psfile
+
+        d = tmp_path / "train"
+        d.mkdir()
+        for name in ("part-0", "part-1", "other.txt"):
+            (d / name).write_text("x")
+        hits = psfile.expand_globs([str(d / "part.*")])
+        assert [os.path.basename(h) for h in hits] == ["part-0", "part-1"]
+        # shell glob still works and wins when it matches
+        hits = psfile.expand_globs([str(d / "*.txt")])
+        assert [os.path.basename(h) for h in hits] == ["other.txt"]
+        # regex is anchored: "art.*" must not match "part-0"
+        assert psfile.expand_globs([str(d / "art.*")]) == []
