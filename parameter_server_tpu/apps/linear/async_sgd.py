@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -750,8 +750,12 @@ class AsyncSGDWorker(ISGDCompNode):
             "logloss": evaluation.logloss(batch.y, xw),
         }
 
-    def save_model(self, path: str) -> None:
-        """Nonzero weights as key\\tvalue text (ref SaveModel/WriteToFile).
+    def save_model(self, path: str) -> List[str]:
+        """Nonzero weights as key\\tvalue text, one file per server shard
+        named ``{path}_S{k}`` (ref AsyncSGDServer::SaveModel writes
+        ``file + "_" + MyNodeID()`` — example eval configs match
+        ``model_S.*``). Shard k holds its owned slot range, exactly the
+        device sharding of the table.
 
         With a hashed directory the original keys are unrecoverable, so the
         keys written are table slots and a ``#hashed <num_slots>`` header
@@ -761,15 +765,23 @@ class AsyncSGDWorker(ISGDCompNode):
         w = self.weights_dense()
         nz = np.flatnonzero(w)
         keys = self.directory.keys
-        with psfile.open_write(path) as f:
-            if self.directory.hashed:
-                f.write(f"#hashed\t{self.num_slots}\n")
-                for i in nz:
-                    f.write(f"{i}\t{float(w[i])!r}\n")
-            else:
-                for i in nz:
-                    if i < len(keys):
-                        f.write(f"{keys[i]}\t{float(w[i])!r}\n")
+        n_server = meshlib.num_servers(self.mesh)
+        shard_size = self.num_slots // n_server
+        written = []
+        for s in range(n_server):
+            spath = f"{path}_S{s}"
+            sel = nz[(nz >= s * shard_size) & (nz < (s + 1) * shard_size)]
+            with psfile.open_write(spath) as f:
+                if self.directory.hashed:
+                    f.write(f"#hashed\t{self.num_slots}\n")
+                    for i in sel:
+                        f.write(f"{i}\t{float(w[i])!r}\n")
+                else:
+                    for i in sel:
+                        if i < len(keys):
+                            f.write(f"{keys[i]}\t{float(w[i])!r}\n")
+            written.append(spath)
+        return written
 
 
 class AsyncSGDScheduler(ISGDScheduler):

@@ -343,11 +343,22 @@ class DarlinScheduler(BCDScheduler):
             prev_objv = prog.objective
         return prog
 
-    def save_model(self, path: str) -> None:
-        """key\\tweight text dump (ref BCDServer::SaveModel)."""
+    def save_model(self, path: str) -> List[str]:
+        """key\\tweight text dump, one file per server shard named
+        ``{path}_S{k}`` (ref BCDServer::SaveModel → WriteToFile with
+        ``file + "_" + MyNodeID()``; eval configs match ``model_S.*``).
+        Shards take contiguous key ranges (Range::EvenDivide)."""
         keys = self.global_keys
         w = self.solver.w
-        with psfile.open_write(path) as f:
-            for k, v in zip(keys, w):
-                if v != 0 and not np.isnan(v):
-                    f.write(f"{k}\t{float(v)!r}\n")
+        n_server = meshlib.num_servers(self.solver.mesh)
+        bounds = [len(keys) * s // n_server for s in range(n_server + 1)]
+        written = []
+        for s in range(n_server):
+            spath = f"{path}_S{s}"
+            with psfile.open_write(spath) as f:
+                for i in range(bounds[s], bounds[s + 1]):
+                    v = w[i]
+                    if v != 0 and not np.isnan(v):
+                        f.write(f"{keys[i]}\t{float(v)!r}\n")
+            written.append(spath)
+        return written

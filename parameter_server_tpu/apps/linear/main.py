@@ -42,8 +42,8 @@ def main(argv=None) -> int:
         sched.load_data(td.file, td.text if td.format == "text" else td.format)
         sched.run_loaded(verbose=True)
         if conf.model_output is not None and conf.model_output.file:
-            sched.save_model(conf.model_output.file[0])
-            print(f"model written to {conf.model_output.file[0]}")
+            files = sched.save_model(conf.model_output.file[0])
+            print(f"model written to {', '.join(files)}")
         print(sched.show_progress(max(sched.g_progress) if sched.g_progress else 0))
     elif conf.async_sgd is not None:
         from .async_sgd import AsyncSGDScheduler, AsyncSGDWorker
@@ -71,8 +71,8 @@ def main(argv=None) -> int:
             sched.workload_pool.finish(load.id)
         sched.monitor.maybe_print(force=True)
         if conf.model_output is not None and conf.model_output.file:
-            worker.save_model(conf.model_output.file[0])
-            print(f"model written to {conf.model_output.file[0]}")
+            files = worker.save_model(conf.model_output.file[0])
+            print(f"model written to {', '.join(files)}")
         if conf.validation_data is not None and conf.validation_data.file:
             from ...data.stream_reader import StreamReader
 

@@ -123,8 +123,15 @@ class TestConvergence:
         worker = AsyncSGDWorker(make_conf(num_slots=4096), mesh=mesh8)
         worker.train(synth(5, w_true))
         path = tmp_path / "model.txt"
-        worker.save_model(str(path))
-        lines = path.read_text().strip().splitlines()
+        files = worker.save_model(str(path))
+        # one file per server shard, reference naming: model.txt_S0, _S1...
+        assert files and all(f.startswith(str(path) + "_S") for f in files)
+        lines = [
+            line
+            for f in files
+            for line in open(f).read().strip().splitlines()
+            if not line.startswith("#")
+        ]
         assert len(lines) > 10
         key, val = lines[0].split("\t")
         assert float(val) != 0

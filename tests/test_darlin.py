@@ -156,8 +156,9 @@ class TestConvergence:
         sched = DarlinScheduler(make_conf(passes=4), mesh=mesh8)
         prog = sched.run_on(data)
         path = tmp_path / "darlin.txt"
-        sched.save_model(str(path))
-        lines = path.read_text().strip().splitlines()
+        files = sched.save_model(str(path))
+        assert files and all(f.startswith(str(path) + "_S") for f in files)
+        lines = [l for f in files for l in open(f).read().strip().splitlines()]
         assert len(lines) == prog.nnz_w
 
 
