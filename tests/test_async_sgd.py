@@ -228,3 +228,53 @@ class TestU24Wire:
             return worker.weights_dense()
 
         np.testing.assert_allclose(train(True), train(False), atol=1e-6)
+
+
+def synth_binary(n_batches, w_true, seed0=0):
+    for i in range(n_batches):
+        yield random_sparse(256, 512, 8, seed=seed0 + i, w_true=w_true, binary=True)
+
+
+class TestBitsWire:
+    """wire="bits": minimal bitstream encoding (slot/label bits, counts)."""
+
+    def _train(self, mesh8, w_true, wire):
+        conf = make_conf(num_slots=4096)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = wire
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        worker.train(synth_binary(5, w_true))
+        return worker.weights_dense()
+
+    def test_bits_step_matches_i32(self, mesh8, w_true):
+        """bits wire is a pure encoding: identical state evolution."""
+        np.testing.assert_allclose(
+            self._train(mesh8, w_true, "bits"),
+            self._train(mesh8, w_true, "i32"),
+            atol=1e-6,
+        )
+
+    def test_bits_prep_emits_bits_batch(self, mesh8, w_true):
+        from parameter_server_tpu.apps.linear.async_sgd import ELLBitsBatch
+
+        conf = make_conf(num_slots=4096)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        batch = next(synth_binary(1, w_true))
+        prepped = worker.prep(batch, device_put=False)
+        assert isinstance(prepped, ELLBitsBatch)
+        assert prepped.num_examples == 256
+
+    def test_valued_batch_falls_back_to_u24(self, mesh8, w_true):
+        """Non-binary data can't ride the bits wire; prep must degrade to
+        the sentinel-carrying u24 format, not fail."""
+        from parameter_server_tpu.apps.linear.async_sgd import ELLPackedBatch
+
+        conf = make_conf(num_slots=4096)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        batch = next(synth(1, w_true))  # valued features
+        prepped = worker.prep(batch, device_put=False)
+        assert isinstance(prepped, ELLPackedBatch)

@@ -232,3 +232,52 @@ def test_hash_slots_batchsize_invariant():
         hash_slots(keys, 1_000_003),
         np.concatenate([hash_slots(keys[:4096], 1_000_003), hash_slots(keys[4096:], 1_000_003)]),
     )
+
+
+class TestBitpack:
+    """utils/bitpack: bitstream wire format (pack host-side, unpack in jit)."""
+
+    def test_cpp_matches_numpy(self, rng):
+        from parameter_server_tpu.utils import bitpack
+
+        for bits in (7, 22, 23, 24):
+            vals = rng.integers(0, 1 << bits, 9000).astype(np.int32)
+            np.testing.assert_array_equal(
+                bitpack.pack_bits(vals, bits), bitpack.pack_bits_np(vals, bits)
+            )
+
+    def test_fused_hash_pack_matches_two_pass(self, rng):
+        from parameter_server_tpu.utils import bitpack
+        from parameter_server_tpu.utils.murmur import hash_slots
+
+        keys = rng.integers(0, 1 << 62, 50000).astype(np.uint64)
+        num_slots = 1 << 18
+        want = bitpack.pack_bits_np(hash_slots(keys, num_slots), 18)
+        np.testing.assert_array_equal(
+            bitpack.hash_slots_packed(keys, num_slots, 18), want
+        )
+
+    def test_device_unpack_roundtrip(self, rng):
+        import jax
+
+        from parameter_server_tpu.utils import bitpack
+
+        for bits in (13, 22):
+            vals = rng.integers(0, 1 << bits, 4096 * 3 + 5).astype(np.int32)
+            words = bitpack.stream_to_words(
+                bitpack.pack_bits(vals, bits), vals.size, bits
+            )
+            out = jax.jit(
+                lambda w, n=vals.size, b=bits: bitpack.unpack_bits(w, n, b)
+            )(words)
+            np.testing.assert_array_equal(np.asarray(out), vals)
+
+    def test_sign_bits_roundtrip(self, rng):
+        import jax
+
+        from parameter_server_tpu.utils import bitpack
+
+        y = np.where(rng.random(1000) > 0.5, 1.0, -1.0).astype(np.float32)
+        packed = np.packbits(y > 0, bitorder="little")
+        out = jax.jit(lambda b: bitpack.unpack_sign_bits(b, y.size))(packed)
+        np.testing.assert_array_equal(np.asarray(out), y)
