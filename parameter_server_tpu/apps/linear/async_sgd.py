@@ -43,6 +43,7 @@ from ...system.message import Task
 from ...utils import evaluation
 from ...utils.bitpack import (
     hash_slots_packed,
+    packed_nwords,
     slot_bits,
     unpack_bits,
     unpack_sign_bits,
@@ -144,7 +145,6 @@ def prep_batch_hashed(
     rows_pad: int,
     nnz_pad: int,
     num_slots: int,
-    device_put: bool = False,
 ) -> HashedBatch:
     """Vectorized hash+pad prep (no sort): ~20x cheaper than prep_batch."""
     shards = []
@@ -171,10 +171,7 @@ def prep_batch_hashed(
         )
         shards.append((y, mask, rows, slots, vals))
     stack = [np.stack(x) for x in zip(*shards)]
-    out = HashedBatch(*stack)
-    if device_put:
-        out = jax.device_put(out)  # async upload off the dispatch path
-    return out
+    return HashedBatch(*stack)
 
 
 @jax.tree_util.register_dataclass
@@ -242,6 +239,9 @@ class ELLBitsBatch:
     y_bits: np.ndarray  # [D, ceil(R/8)] uint8 little-endian sign bits
     counts: np.ndarray  # [D] int32 live-row count per data shard
     slots_words: np.ndarray  # [D, W] uint32 bitstream words
+    # static row padding (R): y_bits rounds R to bytes, so the true row
+    # count must ride along for the consumer's step builder
+    rows: int = dataclasses.field(metadata=dict(static=True), default=0)
 
     @property
     def num_examples(self) -> int:
@@ -267,7 +267,6 @@ def prep_batch_ell(
     rows_pad: int,
     lanes: int,
     num_slots: int,
-    device_put: bool = False,
     pack: bool = False,
 ) -> ELLBatch:
     """Pack a CSR batch into ELL lanes (rows with more than ``lanes``
@@ -334,8 +333,6 @@ def prep_batch_ell(
             slots=stack(slotss),
             vals=None if binary else stack(valss),
         )
-    if device_put:
-        out = jax.device_put(out)
     return out
 
 
@@ -346,12 +343,13 @@ def prep_batch_ell_bits(
     rows_pad: int,
     lanes: int,
     num_slots: int,
-    device_put: bool = False,
 ) -> Optional[ELLBitsBatch]:
     """Minimal-wire ELL prep: fused hash→slot→bitstream (one C++ pass per
     shard), labels as sign bits, mask as a row count. Applies only to the
     hashed/binary/uniform-row case — returns None otherwise so the caller
-    falls back to the u24 format (which carries sentinels and values)."""
+    falls back to the u24 format (which carries sentinels and values).
+    Returns host arrays; device placement goes through the worker's
+    ``upload`` (which handles multi-process assembly)."""
     if not (batch.binary and directory.hashed):
         return None
     counts_all = np.diff(batch.indptr)
@@ -364,7 +362,7 @@ def prep_batch_ell_bits(
         return None
     bits = slot_bits(num_slots)
     per = -(-batch.n // num_shards)
-    nwords = (rows_pad * lanes * bits + 31) // 32 + 1
+    nwords = packed_nwords(rows_pad * lanes, bits)
     y_nbytes = (rows_pad + 7) // 8
     slots_words = np.zeros((num_shards, nwords), "<u4")
     y_bits = np.zeros((num_shards, y_nbytes), np.uint8)
@@ -380,10 +378,9 @@ def prep_batch_ell_bits(
         yb = np.packbits(batch.y[lo_r:hi_r] > 0, bitorder="little")
         y_bits[d, : yb.size] = yb
         counts[d] = nsub
-    out = ELLBitsBatch(y_bits=y_bits, counts=counts, slots_words=slots_words)
-    if device_put:
-        out = jax.device_put(out)
-    return out
+    return ELLBitsBatch(
+        y_bits=y_bits, counts=counts, slots_words=slots_words, rows=rows_pad
+    )
 
 
 def _lane_positions(counts: np.ndarray, lanes: int) -> np.ndarray:
@@ -762,9 +759,20 @@ class AsyncSGDWorker(ISGDCompNode):
         self._pads: Optional[Tuple[int, int, int]] = None
         self.progress = SGDProgress()
 
+    def _num_shards(self) -> int:
+        """Data shards THIS process preps. Single-process: the whole data
+        axis. Multi-process: only the rows this host's devices own — each
+        host localizes its own file partition (ref DataAssigner) and the
+        shards assemble into one global batch in :meth:`upload`."""
+        from ...parallel import distributed
+
+        if distributed.is_multiprocess():
+            return distributed.local_data_shards(self.mesh)
+        return meshlib.num_workers(self.mesh)
+
     def _padding(self, batch: SparseBatch) -> Tuple[int, int, int]:
         if self._pads is None:
-            d = meshlib.num_workers(self.mesh)
+            d = self._num_shards()
             rows = self.sgd.rows_pad or -(-batch.n // d)
             per_nnz = -(-batch.nnz // d)
             # tight padding: 25% headroom rounded to 4k — transfer bytes are
@@ -778,61 +786,68 @@ class AsyncSGDWorker(ISGDCompNode):
         + ComputeGradient)."""
         return self._submit_prepped(self.prep(batch, device_put=False))
 
+    def upload(self, prepped):
+        """Host-prepped shards → device arrays. Multi-process: assemble
+        this host's shards into the global data-sharded batch."""
+        from ...parallel import distributed
+
+        return distributed.global_from_local(self.mesh, prepped)
+
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
         rows_pad, nnz_pad, uniq_pad = self._padding(batch)
+        num_shards = self._num_shards()
+        out = None
         if self.sgd.ell_lanes > 0 and self.directory.hashed:
             wire = self.sgd.wire or ("u24" if self.sgd.wire_u24 else "i32")
             if wire == "bits":
-                prepped = prep_batch_ell_bits(
+                out = prep_batch_ell_bits(
                     batch,
                     self.directory,
-                    meshlib.num_workers(self.mesh),
+                    num_shards,
                     rows_pad,
                     self.sgd.ell_lanes,
                     self.num_slots,
-                    device_put=device_put,
                 )
-                if prepped is not None:
-                    return prepped
-                wire = "u24"  # non-uniform/valued batch: sentinel wire
-            return prep_batch_ell(
+                if out is None:
+                    wire = "u24"  # non-uniform/valued batch: sentinel wire
+            if out is None:
+                out = prep_batch_ell(
+                    batch,
+                    self.directory,
+                    num_shards,
+                    rows_pad,
+                    self.sgd.ell_lanes,
+                    self.num_slots,
+                    pack=wire == "u24" and self.num_slots < (1 << 24),
+                )
+        elif self.directory.hashed:
+            out = prep_batch_hashed(
                 batch,
                 self.directory,
-                meshlib.num_workers(self.mesh),
-                rows_pad,
-                self.sgd.ell_lanes,
-                self.num_slots,
-                device_put=device_put,
-                pack=wire == "u24" and self.num_slots < (1 << 24),
-            )
-        if self.directory.hashed:
-            return prep_batch_hashed(
-                batch,
-                self.directory,
-                meshlib.num_workers(self.mesh),
+                num_shards,
                 rows_pad,
                 nnz_pad,
                 self.num_slots,
-                device_put=device_put,
             )
-        return prep_batch(
-            batch,
-            self.directory,
-            meshlib.num_workers(self.mesh),
-            rows_pad,
-            nnz_pad,
-            uniq_pad,
-            self.num_slots,
-        )
+        else:
+            out = prep_batch(
+                batch,
+                self.directory,
+                num_shards,
+                rows_pad,
+                nnz_pad,
+                uniq_pad,
+                self.num_slots,
+            )
+        return self.upload(out) if device_put else out
 
     def _get_step(self, prepped, with_aux: bool):
         if isinstance(prepped, ELLBitsBatch):
-            rows_pad, _, _ = self._pads
-            key = ("ell_bits", True, with_aux)
+            key = ("ell_bits", prepped.rows, with_aux)
             builder = lambda: make_train_step_ell_bits(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
-                rows=rows_pad, lanes=self.sgd.ell_lanes, with_aux=with_aux,
+                rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
             )
         elif isinstance(prepped, (ELLBatch, ELLPackedBatch)):
             packed = isinstance(prepped, ELLPackedBatch)
@@ -861,6 +876,14 @@ class AsyncSGDWorker(ISGDCompNode):
         ``with_aux=False`` skips the per-example xw/y/mask outputs (host AUC)
         — the cheap mode for throughput-critical loops.
         """
+        from ...parallel import distributed
+
+        if distributed.is_multiprocess() and any(
+            isinstance(leaf, np.ndarray) for leaf in jax.tree.leaves(prepped)
+        ):
+            # host shards can't be auto-sharded across processes by jit;
+            # assemble the global batch explicitly
+            prepped = self.upload(prepped)
         tau = self.sgd.max_delay
         if tau <= 0 or self._steps_since_snapshot >= tau:
             self._pull_state = self.state

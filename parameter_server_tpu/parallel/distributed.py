@@ -1,0 +1,150 @@
+"""Multi-host (multi-process) support — the DCN side of the fabric.
+
+The reference scales across machines with ZMQ sockets bootstrapped by a
+scheduler node (``src/system/van.cc Van::Connect``; launched by
+``script/local.sh`` / ``mpi_node.sh``). The TPU-native equivalent is one
+JAX process per host joined through ``jax.distributed`` (gRPC coordination
+service = the scheduler rendezvous), after which every process sees the
+GLOBAL device list and a single ``Mesh`` spans all hosts — collectives
+ride ICI within a slice and DCN across slices, chosen by XLA from the mesh
+axis layout.
+
+What this module adds on top of ``jax.distributed.initialize``:
+
+- :func:`initialize` — env-driven bootstrap (PS_COORDINATOR_ADDRESS /
+  PS_NUM_PROCESSES / PS_PROCESS_ID, the analog of the reference's
+  scheduler node string in ``env.cc``), with the CPU cross-process
+  collective backend (gloo) configured and clear errors for the
+  backend-already-initialized trap.
+- :func:`global_from_local` — assemble a process-local batch pytree into
+  global device arrays sharded over the mesh's data axis
+  (``jax.make_array_from_process_local_data``): each host feeds its own
+  examples, the SPMD step sees one global batch. This is the reference's
+  "every worker reads its own file partition" (DataAssigner) made
+  explicit.
+- :func:`local_data_shards` — how many data-axis rows this process owns
+  (its share of the worker group).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as meshlib
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-process rendezvous. Returns True when running
+    multi-process, False for plain single-process use.
+
+    Args default from the environment (set by ``script/local.sh`` or the
+    cluster launcher): ``PS_COORDINATOR_ADDRESS`` (host:port of process
+    0's coordination service — the reference's scheduler node),
+    ``PS_NUM_PROCESSES``, ``PS_PROCESS_ID``.
+
+    Must run before the first JAX computation. If another component
+    already initialized the backend (e.g. an accelerator plugin loaded at
+    interpreter start), joining is impossible — we raise with the fix
+    rather than silently degrading to process_count()==1.
+    """
+    global _initialized
+    addr = coordinator_address or os.environ.get("PS_COORDINATOR_ADDRESS")
+    if not addr:
+        return False
+    if _initialized:
+        return True
+    n = int(num_processes or os.environ.get("PS_NUM_PROCESSES", "1"))
+    pid = int(process_id if process_id is not None else os.environ.get("PS_PROCESS_ID", "0"))
+    if n <= 1:
+        return False
+    # CPU hosts talk gloo for cross-process collectives; set before the
+    # backend spins up or psum silently stays process-local.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax: option absent
+            pass
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=n, process_id=pid
+    )
+    if jax.process_count() != n:
+        raise RuntimeError(
+            f"jax.distributed joined {jax.process_count()} processes, expected "
+            f"{n}. A backend was initialized before the rendezvous — on this "
+            "image the axon TPU plugin registers at interpreter start; launch "
+            "with PALLAS_AXON_POOL_IPS unset (and JAX_PLATFORMS=cpu) for "
+            "multi-process CPU runs, or initialize before any jax use."
+        )
+    _initialized = True
+    return True
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def local_data_shards(mesh: Mesh) -> int:
+    """Number of data-axis rows whose devices belong to this process.
+
+    A data row must be WHOLLY owned by one process: the batch is sharded
+    P(data) and replicated over the server axis, and
+    ``make_array_from_process_local_data`` has no way to check that two
+    processes feeding the same row agree — split ownership would let
+    divergent per-host batches masquerade as one global row (silent
+    corruption). We raise instead; pick num_server / devices-per-host so
+    each host owns whole rows (e.g. num_server ≤ local device count and
+    divides it).
+    """
+    this = jax.process_index()
+    rows = 0
+    axes = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
+    arr = np.asarray(mesh.devices)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    data_dim = axes.get(meshlib.DATA_AXIS, 0)
+    for r in range(arr.shape[data_dim]):
+        row = arr[r] if data_dim == 0 else arr[:, r]
+        owners = {d.process_index for d in np.ravel(row)}
+        if this in owners:
+            if len(owners) > 1:
+                raise ValueError(
+                    f"data row {r} spans processes {sorted(owners)}; each "
+                    "data-axis row must be wholly owned by one process — "
+                    "choose num_server to divide the per-host device count"
+                )
+            rows += 1
+    return rows
+
+
+def global_from_local(mesh: Mesh, tree, axis_name: str = None):
+    """Assemble per-process host arrays into global jax.Arrays sharded
+    over the data axis (leading dim). Single-process: plain device_put.
+
+    Each leaf's leading dim is this process's local data-shard count; the
+    global array's leading dim is the full data axis.
+    """
+    axis = axis_name or meshlib.DATA_AXIS
+    if not is_multiprocess():
+        return jax.device_put(tree)
+    d_global = mesh.shape[axis]
+
+    def put(leaf):
+        if leaf is None:
+            return None
+        leaf = np.asarray(leaf)
+        sharding = NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+        global_shape = (d_global,) + leaf.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, leaf, global_shape)
+
+    return jax.tree.map(put, tree, is_leaf=lambda x: x is None)

@@ -18,7 +18,6 @@ this environment is single-host.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Sequence
 
 import jax
@@ -70,15 +69,11 @@ class Van:
 def init_distributed() -> None:
     """Multi-host bootstrap (ref Van::Connect scheduler rendezvous).
 
-    Uses jax.distributed when coordinator env vars are present; no-op on a
-    single host. COORDINATOR_ADDRESS/PROCESS_ID/NUM_PROCESSES mirror the
-    reference's scheduler host:port + node ids in env.cc.
+    Joins jax.distributed when coordinator env vars are present
+    (PS_COORDINATOR_ADDRESS / PS_NUM_PROCESSES / PS_PROCESS_ID — the
+    reference's scheduler host:port + node ids in env.cc); no-op on a
+    single host. Full logic in parallel/distributed.py.
     """
-    addr = os.environ.get("PS_COORDINATOR_ADDRESS")
-    if not addr:
-        return
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=int(os.environ.get("PS_NUM_PROCESSES", "1")),
-        process_id=int(os.environ.get("PS_PROCESS_ID", "0")),
-    )
+    from ..parallel import distributed
+
+    distributed.initialize()

@@ -35,6 +35,13 @@ def packed_nbytes(n: int, bits: int) -> int:
     return (n * bits + 7) // 8
 
 
+def packed_nwords(n: int, bits: int) -> int:
+    """uint32 words holding n b-bit values PLUS one slack word — the
+    device-side ``unpack_bits`` gathers ``words[lo+1]`` unconditionally,
+    so every packer and the unpacker must agree on this layout."""
+    return (n * bits + 31) // 32 + 1
+
+
 def pack_bits_np(vals: np.ndarray, bits: int) -> np.ndarray:
     """Pure-NumPy bitstream pack (correctness reference / C++ fallback)."""
     v = np.ascontiguousarray(vals, dtype=np.uint32).ravel()
@@ -96,8 +103,7 @@ def stream_to_words(stream: np.ndarray, n: int, bits: int) -> np.ndarray:
     """Pad a byte stream and view it as the uint32 word array the device
     unpacker expects (one extra word so the ``w1`` gather stays in
     bounds)."""
-    nwords = (n * bits + 31) // 32 + 1
-    buf = np.zeros(nwords * 4, np.uint8)
+    buf = np.zeros(packed_nwords(n, bits) * 4, np.uint8)
     buf[: stream.size] = stream
     return buf.view("<u4")
 

@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Kill stray local runs (ref script/kill_node.sh).
+pkill -f "parameter_server_tpu.apps" 2>/dev/null || true

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Thin app launcher (ref script/ps.sh): run a linear-method config.
+#   script/ps.sh <config.conf> [main.py args...]
+set -euo pipefail
+conf=${1:?usage: ps.sh <config.conf> [args...]}; shift
+exec python -m parameter_server_tpu.apps.linear.main "$conf" "$@"

@@ -1,0 +1,72 @@
+"""Child program for the multi-process integration test (run via
+script/local.sh semantics: PS_* env set by the parent). Mirrors the
+reference's `*_ps.cc` binaries that local.sh launches N times.
+
+Each process preps ITS OWN minibatch (its file partition, per
+DataAssigner semantics), the shards assemble into one global data-sharded
+batch, and the SPMD step psums gradients across processes over DCN
+(gloo on CPU test meshes). Prints PS_OK <global_examples> on success.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    PenaltyConfig,
+    SGDConfig,
+)
+from parameter_server_tpu.parallel import distributed
+from parameter_server_tpu.parallel import mesh as meshlib
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import random_sparse
+
+import jax
+
+
+def main() -> int:
+    po = Postoffice.instance().start(num_server=2)  # joins rendezvous
+    assert distributed.is_multiprocess(), "expected a multi-process run"
+    n_data = meshlib.num_workers(po.mesh)
+    local = distributed.local_data_shards(po.mesh)
+
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=0.5, beta=1.0)
+    per_host_rows = 64 * local
+    conf.async_sgd = SGDConfig(
+        algo="ftrl",
+        minibatch=per_host_rows,
+        num_slots=1 << 12,
+        max_delay=1,
+        ell_lanes=8,
+        wire="bits",
+    )
+    worker = AsyncSGDWorker(conf, mesh=po.mesh)
+
+    # each host draws a DIFFERENT batch (its own partition)
+    seed = 100 + jax.process_index()
+    rng = np.random.default_rng(0)
+    w_true = (rng.normal(size=1 << 12) * (rng.random(1 << 12) < 0.2)).astype(
+        np.float32
+    )
+    for i in range(3):
+        batch = random_sparse(
+            per_host_rows, 1 << 12, 8, seed=seed + i, w_true=w_true, binary=True
+        )
+        prog = worker.collect(worker.process_minibatch(batch))
+        # each step's num_ex is psum'd over the FULL data axis: all hosts
+        assert prog.num_examples_processed == 64 * n_data, prog
+    total = worker.progress.num_examples_processed
+    expected = 64 * n_data * 3
+    assert total == expected, f"examples {total} != {expected}"
+    print(f"PS_OK {total}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""Multi-process (multi-host) integration: the DCN story.
+
+Counterpart of the reference's local.sh-driven ``*_ps.cc`` runs with
+separate server/worker OS processes. Here N processes join via
+jax.distributed (gloo collectives on CPU standing in for DCN), form one
+global mesh, and run real training steps where each process feeds its own
+data partition — see tests/multihost_child.py.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_local_sh_two_hosts(nproc):
+    """script/local.sh launches N federated processes; every one trains the
+    same global model and reports the psum'd example count."""
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["PS_PORT"] = str(_free_port())
+    env["PS_LOCAL_DEVICES"] = "2"
+    # local.sh overrides JAX_PLATFORMS/XLA_FLAGS itself
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "script", "local.sh"), str(nproc),
+         sys.executable, os.path.join(REPO, "tests", "multihost_child.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    # processes share the pipe, so two PS_OK prints can interleave on one
+    # line — parse occurrences, not lines
+    import re
+
+    oks = re.findall(r"PS_OK (\d+)", proc.stdout)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert len(oks) == nproc, proc.stdout[-2000:]
+    # all processes agree on the global example count
+    assert len(set(oks)) == 1
