@@ -757,22 +757,47 @@ class AsyncSGDWorker(ISGDCompNode):
         self._pull_state = self.state
         self._steps_since_snapshot = 0
         self._pads: Optional[Tuple[int, int, int]] = None
+        self._num_shards_cache: Optional[int] = None
         self.progress = SGDProgress()
 
     def _num_shards(self) -> int:
         """Data shards THIS process preps. Single-process: the whole data
         axis. Multi-process: only the rows this host's devices own — each
         host localizes its own file partition (ref DataAssigner) and the
-        shards assemble into one global batch in :meth:`upload`."""
-        from ...parallel import distributed
+        shards assemble into one global batch in :meth:`upload`.
+        Cached: the mesh is fixed for the worker's lifetime and the walk
+        is O(mesh size), too slow for the per-minibatch prep path."""
+        if self._num_shards_cache is None:
+            from ...parallel import distributed
 
-        if distributed.is_multiprocess():
-            return distributed.local_data_shards(self.mesh)
-        return meshlib.num_workers(self.mesh)
+            if distributed.is_multiprocess():
+                self._num_shards_cache = distributed.local_data_shards(self.mesh)
+            else:
+                self._num_shards_cache = meshlib.num_workers(self.mesh)
+        return self._num_shards_cache
 
     def _padding(self, batch: SparseBatch) -> Tuple[int, int, int]:
         if self._pads is None:
+            from ...parallel import distributed
+
             d = self._num_shards()
+            if distributed.is_multiprocess():
+                # every process must jit the SAME shapes or the collectives
+                # mismatch: derive padding from config (identical on all
+                # hosts), never from this host's first batch
+                rows = self.sgd.rows_pad or -(-self.sgd.minibatch // d)
+                if self.sgd.ell_lanes > 0:
+                    nnz = self.sgd.nnz_pad or rows * self.sgd.ell_lanes
+                elif self.sgd.nnz_pad:
+                    nnz = self.sgd.nnz_pad
+                else:
+                    raise ValueError(
+                        "multi-process runs need SGDConfig.nnz_pad set "
+                        "explicitly (auto-sizing from the first local batch "
+                        "would give each host different compiled shapes)"
+                    )
+                self._pads = (rows, nnz, nnz)
+                return self._pads
             rows = self.sgd.rows_pad or -(-batch.n // d)
             per_nnz = -(-batch.nnz // d)
             # tight padding: 25% headroom rounded to 4k — transfer bytes are
@@ -810,6 +835,18 @@ class AsyncSGDWorker(ISGDCompNode):
                     self.num_slots,
                 )
                 if out is None:
+                    from ...parallel import distributed
+
+                    if distributed.is_multiprocess():
+                        # a silent per-host fallback would jit DIFFERENT
+                        # step programs on different hosts -> collective
+                        # mismatch/hang; the wire must be uniform
+                        raise ValueError(
+                            "wire='bits' needs binary features, uniform "
+                            f"{self.sgd.ell_lanes}-lane rows and ±1 labels "
+                            "on every host; this host's batch does not "
+                            "qualify — use wire='u24' for this data"
+                        )
                     wire = "u24"  # non-uniform/valued batch: sentinel wire
             if out is None:
                 out = prep_batch_ell(

@@ -1,0 +1,45 @@
+"""Per-component performance tests.
+
+Counterpart of the reference's perf binaries in ``src/test/``:
+``kv_vector_perf_ps.cc``, ``kv_map_perf_ps.cc``, ``kv_layer_perf_ps.cc``,
+``network_perf_ps.cc``, ``sparse_matrix_perf.cc``. Each module times one
+subsystem on the live backend (the real chip, or a virtual CPU mesh under
+``JAX_PLATFORMS=cpu``) and prints one JSON line per metric:
+``{"metric": ..., "value": ..., "unit": ...}``.
+
+Run all:    python -m parameter_server_tpu.benchmarks [--smoke]
+Run one:    python -m parameter_server_tpu.benchmarks kv_vector [--smoke]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict
+
+REGISTRY: Dict[str, Callable[[bool], None]] = {}
+
+
+def benchmark(name: str):
+    def deco(fn):
+        REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def report(metric: str, value: float, unit: str) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 2), "unit": unit}), flush=True)
+
+
+def timeit(fn, n: int, warmup: int = 3) -> float:
+    """Median-of-3 windows of n calls; returns seconds per call."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        times.append((time.perf_counter() - t0) / n)
+    return sorted(times)[1]

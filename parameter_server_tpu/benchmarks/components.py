@@ -1,0 +1,182 @@
+"""Component benchmarks (see package docstring for the reference map)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import benchmark, report, timeit
+
+
+def _mesh():
+    from ..system.postoffice import Postoffice
+
+    Postoffice.reset()
+    return Postoffice.instance().start().mesh
+
+
+@benchmark("kv_vector")
+def kv_vector_perf(smoke: bool = False) -> None:
+    """Push/pull throughput of the sharded dense table
+    (ref src/test/kv_vector_perf_ps.cc)."""
+    import jax
+
+    from ..parameter.kv_vector import KVVector
+
+    mesh = _mesh()
+    n_keys = 1 << (12 if smoke else 18)
+    k = 4
+    kv = KVVector(mesh=mesh, k=k, num_slots=2 * n_keys, hashed=True)
+    keys = np.random.default_rng(0).integers(0, 1 << 40, n_keys).astype(np.int64)
+    vals = np.ones((n_keys, k), np.float32)
+
+    def push():
+        kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+
+    def pull():
+        jax.block_until_ready(kv.wait_pull(kv.pull(kv.request(channel=0), keys=keys)))
+
+    n = 3 if smoke else 10
+    sec = timeit(push, n)
+    report("kv_vector_push_keys_per_sec", n_keys / sec, "keys/sec")
+    report("kv_vector_push_mb_per_sec", vals.nbytes / sec / 1e6, "MB/s")
+    sec = timeit(pull, n)
+    report("kv_vector_pull_keys_per_sec", n_keys / sec, "keys/sec")
+
+
+@benchmark("kv_map")
+def kv_map_perf(smoke: bool = False) -> None:
+    """Entry-update throughput (ref src/test/kv_map_perf_ps.cc): vectorized
+    FTRL entries over the sharded struct-of-arrays state."""
+    from ..parameter.kv_map import AddEntry, KVMap
+
+    mesh = _mesh()
+    n_keys = 1 << (12 if smoke else 18)
+    m = KVMap(AddEntry(), mesh=mesh, k=1, num_slots=2 * n_keys, hashed=True)
+    keys = np.random.default_rng(0).integers(0, 1 << 40, n_keys).astype(np.int64)
+    vals = np.ones((n_keys, 1), np.float32)
+
+    def push():
+        m.wait(m.push(m.request(), keys, vals))
+
+    sec = timeit(push, 3 if smoke else 10)
+    report("kv_map_entry_updates_per_sec", n_keys / sec, "entries/sec")
+
+
+@benchmark("kv_layer")
+def kv_layer_perf(smoke: bool = False) -> None:
+    """Dense-layer push/pull throughput (ref src/test/kv_layer_perf_ps.cc)."""
+    import jax
+
+    from ..parameter.kv_layer import KVLayer, SGDUpdater
+
+    mesh = _mesh()
+    shape = (256, 64) if smoke else (4096, 512)
+    layer = KVLayer(partition_thr=1024, updater=SGDUpdater(lr=0.1), mesh=mesh)
+    layer.init_layer("w", shape)
+    grad = np.ones(shape, np.float32)
+    nbytes = grad.nbytes
+
+    def push():
+        layer.wait(layer.push(layer.request(), "w", grad))
+
+    def pull():
+        jax.block_until_ready(layer.wait_pull(layer.pull(layer.request(), "w")))
+
+    n = 3 if smoke else 10
+    report("kv_layer_push_mb_per_sec", nbytes / timeit(push, n) / 1e6, "MB/s")
+    report("kv_layer_pull_mb_per_sec", nbytes / timeit(pull, n) / 1e6, "MB/s")
+
+
+@benchmark("network")
+def network_perf(smoke: bool = False) -> None:
+    """Wire latency/bandwidth by message size (ref
+    src/test/network_perf_ps.cc): host→device transfer (the PCIe/tunnel
+    hop) and the in-mesh psum collective."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    mesh = _mesh()
+    sizes_kb = [8, 64] if smoke else [8, 64, 1024, 8192]
+    for kb in sizes_kb:
+        x = np.ones(kb * 1024 // 4, np.float32)
+
+        def h2d():
+            jax.block_until_ready(jax.device_put(x))
+
+        sec = timeit(h2d, 3 if smoke else 10)
+        report(f"network_h2d_{kb}kb_ms", sec * 1e3, "ms")
+        report(f"network_h2d_{kb}kb_mb_per_sec", x.nbytes / sec / 1e6, "MB/s")
+
+    x = np.ones((64 if smoke else 1024) * 256, np.float32)
+    xd = jax.device_put(x)
+    psum = jax.jit(
+        shard_map(
+            lambda v: jax.lax.psum(v, DATA_AXIS),
+            mesh=mesh,
+            in_specs=P(),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    jax.block_until_ready(psum(xd))
+
+    def coll():
+        jax.block_until_ready(psum(xd))
+
+    sec = timeit(coll, 5 if smoke else 20)
+    report("network_psum_ms", sec * 1e3, "ms")
+
+
+@benchmark("sparse_matrix")
+def sparse_matrix_perf(smoke: bool = False) -> None:
+    """Host sparse-matrix pipeline (ref src/test/sparse_matrix_perf.cc):
+    key uniquification (countUniqIndex), localization, and the device
+    SpMV."""
+    import jax
+
+    from ..ops import spmv
+    from ..utils.localizer import Localizer, count_uniq_keys
+    from ..utils.sparse import random_sparse
+
+    _mesh()
+    n = 1 << (10 if smoke else 14)
+    nnz = 64
+    batch = random_sparse(n, 1 << 24, nnz, seed=0)
+
+    def uniq():
+        count_uniq_keys(batch)
+
+    sec = timeit(uniq, 3 if smoke else 10)
+    report("sparse_uniq_keys_per_sec", batch.nnz / sec, "keys/sec")
+
+    loc = Localizer()
+    keys, _ = loc.count_uniq_index(batch)
+
+    def localize():
+        loc.remap_index(keys)
+
+    sec = timeit(localize, 3 if smoke else 10)
+    report("sparse_localize_keys_per_sec", batch.nnz / sec, "keys/sec")
+
+    local = loc.remap_index(keys)
+    w = np.random.default_rng(0).normal(size=len(keys)).astype(np.float32)
+    rows = local.row_ids().astype(np.int32)
+    ucols = local.indices.astype(np.int32)
+    vals = (
+        np.ones(local.nnz, np.float32)
+        if local.binary
+        else local.values.astype(np.float32)
+    )
+    args = [jax.device_put(a) for a in (vals, ucols, rows, w)]
+    fn = jax.jit(lambda v, c, r, w: spmv.spmv(v, c, r, w, n))
+    jax.block_until_ready(fn(*args))
+
+    def mv():
+        jax.block_until_ready(fn(*args))
+
+    sec = timeit(mv, 5 if smoke else 20)
+    report("sparse_spmv_mnnz_per_sec", batch.nnz / sec / 1e6, "Mnnz/s")

@@ -124,6 +124,12 @@ def local_data_shards(mesh: Mesh) -> int:
                     "choose num_server to divide the per-host device count"
                 )
             rows += 1
+    if rows == 0:
+        raise ValueError(
+            f"process {this} owns no data-axis rows of mesh {dict(mesh.shape)} "
+            "(its devices were left idle by the mesh layout); every process "
+            "must own at least one row — grow num_data or shrink the job"
+        )
     return rows
 
 

@@ -1,0 +1,35 @@
+"""The per-component perf suite must stay runnable (ref src/test/
+*_perf_ps.cc built under the same make target as the unit tests)."""
+
+import json
+import subprocess
+import sys
+
+
+def test_benchmarks_smoke_all(capsys):
+    from parameter_server_tpu.benchmarks import REGISTRY
+    from parameter_server_tpu.benchmarks import components  # noqa: F401
+    from parameter_server_tpu.system.postoffice import Postoffice
+
+    assert set(REGISTRY) == {
+        "kv_vector", "kv_map", "kv_layer", "network", "sparse_matrix",
+    }
+    for name, fn in sorted(REGISTRY.items()):
+        fn(True)
+    Postoffice.reset()
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    metrics = [json.loads(l) for l in lines]
+    assert len(metrics) >= 10
+    for m in metrics:
+        assert m["value"] > 0, m
+        assert {"metric", "value", "unit"} <= set(m)
+
+
+def test_benchmarks_cli_rejects_unknown():
+    proc = subprocess.run(
+        [sys.executable, "-m", "parameter_server_tpu.benchmarks", "nope"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode != 0
+    assert "unknown benchmark" in proc.stderr

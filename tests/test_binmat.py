@@ -1,0 +1,79 @@
+"""Binary matrix container + MATLAB-toolbox parity (ref src/data/matlab:
+bin2mat/save_bin/load_bin/saveas_pserver/filter_fea, and the
+writeToBinFile layout in src/util/sparse_matrix.h)."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data import binmat
+from parameter_server_tpu.data.text_parser import (
+    parse_ps_sparse,
+    parse_ps_sparse_binary,
+)
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+def test_save_load_bin_roundtrip(tmp_path):
+    p = str(tmp_path / "v.bin")
+    x = np.arange(17, dtype=np.float64)
+    binmat.save_bin(p, x)
+    np.testing.assert_array_equal(binmat.load_bin(p), x)
+    # offset/count slicing like load_bin.m
+    np.testing.assert_array_equal(binmat.load_bin(p, "float64", 5, 3), x[5:8])
+    # dtype override
+    binmat.save_bin(p, x, np.uint32)
+    assert binmat.load_bin(p, np.uint32).dtype == np.uint32
+
+
+def test_dense_mat2bin_roundtrip(tmp_path):
+    name = str(tmp_path / "D")
+    m = np.arange(12, dtype=np.float64).reshape(3, 4)
+    binmat.mat2bin(name, m)
+    np.testing.assert_array_equal(binmat.bin2mat(name), m)
+
+
+def test_sparse_mat2bin_roundtrip(tmp_path):
+    name = str(tmp_path / "S")
+    b = random_sparse(16, 64, 4, seed=0)
+    keys = np.arange(64, dtype=np.uint64)
+    binmat.mat2bin(name, b, keys=keys)
+    b2, keys2 = binmat.bin2mat(name)
+    np.testing.assert_array_equal(b2.indptr, b.indptr)
+    np.testing.assert_array_equal(b2.indices, b.indices)
+    np.testing.assert_allclose(b2.values, b.values, rtol=1e-6)
+    np.testing.assert_array_equal(keys2, keys)
+
+
+def test_sparse_binary_mat2bin_roundtrip(tmp_path):
+    name = str(tmp_path / "B")
+    b = random_sparse(8, 32, 3, seed=1, binary=True)
+    binmat.mat2bin(name, b)
+    b2, keys = binmat.bin2mat(name)
+    assert b2.binary and keys is None
+    np.testing.assert_array_equal(b2.indices, b.indices)
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_saveas_pserver_parses_back(tmp_path, binary):
+    b = random_sparse(10, 40, 5, seed=2, binary=binary)
+    p = str(tmp_path / "ps.txt")
+    binmat.saveas_pserver(p, np.where(b.y > 0, 1, -1), b)
+    lines = open(p).read().splitlines()
+    parsed = (parse_ps_sparse_binary if binary else parse_ps_sparse)(lines)
+    assert parsed.n == b.n and parsed.nnz == b.nnz
+
+
+def test_saveas_pserver_rejects_unsorted_groups(tmp_path):
+    b = random_sparse(4, 8, 2, seed=3)
+    gid = np.array([1, 0] + [2] * 6)
+    with pytest.raises(ValueError):
+        binmat.saveas_pserver(str(tmp_path / "x"), b.y, b, group_id=gid)
+
+
+def test_filter_fea_drops_rare():
+    b = random_sparse(64, 32, 4, seed=4)
+    fb, keep = binmat.filter_fea(b, 2)
+    _, counts = np.unique(b.indices, return_counts=True)
+    assert len(keep) == (counts > 2).sum()
+    assert fb.cols == len(keep)
+    assert fb.nnz <= b.nnz
