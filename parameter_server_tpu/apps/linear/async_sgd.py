@@ -364,7 +364,13 @@ def prep_batch_ell_bits(
     per = -(-batch.n // num_shards)
     nwords = packed_nwords(rows_pad * lanes, bits)
     y_nbytes = (rows_pad + 7) // 8
-    slots_words = np.zeros((num_shards, nwords), "<u4")
+    # np.empty, not zeros: the hash→pack pass overwrites every payload
+    # byte in place, and bits past each value's own span are masked off by
+    # the device unpacker — zeroing 2MB/batch would just burn host cycles.
+    # Bits belonging to PADDING rows decode to garbage slots, which is
+    # fine: their gradients, touched-flags and metrics are all gated on
+    # the row mask inside the step.
+    slots_words = np.empty((num_shards, nwords), "<u4")
     y_bits = np.zeros((num_shards, y_nbytes), np.uint8)
     counts = np.zeros((num_shards,), np.int32)
     for d in range(num_shards):
@@ -373,8 +379,13 @@ def prep_batch_ell_bits(
         if nsub > rows_pad:
             raise ValueError(f"batch exceeds padding: {nsub}>{rows_pad}")
         seg = slice(batch.indptr[lo_r], batch.indptr[hi_r])
-        stream = hash_slots_packed(batch.indices[seg], num_slots, bits)
-        slots_words[d].view(np.uint8)[: stream.size] = stream
+        nbytes = (nsub * lanes * bits + 7) // 8
+        hash_slots_packed(
+            batch.indices[seg],
+            num_slots,
+            bits,
+            out=slots_words[d].view(np.uint8)[:nbytes],
+        )
         yb = np.packbits(batch.y[lo_r:hi_r] > 0, bitorder="little")
         y_bits[d, : yb.size] = yb
         counts[d] = nsub

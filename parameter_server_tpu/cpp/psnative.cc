@@ -97,6 +97,29 @@ void ps_hash_slots(const uint64_t* keys, uint64_t n, uint64_t seed,
 // fixing_float filter (src/filter/fixing_float.h), applied to keys.
 // ---------------------------------------------------------------------------
 
+// Flush whole 32-bit words from the accumulator (single unaligned store
+// instead of a per-byte loop — the packer's inner loop is on the prep
+// critical path), then drain the <32-bit tail bytewise.
+static inline uint8_t* flush32(uint8_t* w, uint64_t* acc, uint32_t* accbits) {
+  if (*accbits >= 32) {
+    uint32_t lo = (uint32_t)*acc;
+    memcpy(w, &lo, 4);
+    w += 4;
+    *acc >>= 32;
+    *accbits -= 32;
+  }
+  return w;
+}
+
+static inline uint8_t* drain_tail(uint8_t* w, uint64_t acc, uint32_t accbits) {
+  while (accbits > 0) {
+    *w++ = (uint8_t)acc;
+    acc >>= 8;
+    accbits = accbits >= 8 ? accbits - 8 : 0;
+  }
+  return w;
+}
+
 // Pack n b-bit values (b <= 31) into a little-endian bitstream. out must
 // hold ceil(n*b/8) bytes.
 void ps_pack_bits(const int32_t* vals, uint64_t n, uint32_t bits,
@@ -108,9 +131,9 @@ void ps_pack_bits(const int32_t* vals, uint64_t n, uint32_t bits,
   for (uint64_t i = 0; i < n; ++i) {
     acc |= ((uint64_t)(uint32_t)vals[i] & vmask) << accbits;
     accbits += bits;
-    while (accbits >= 8) { *w++ = (uint8_t)acc; acc >>= 8; accbits -= 8; }
+    w = flush32(w, &acc, &accbits);
   }
-  if (accbits) *w++ = (uint8_t)acc;
+  drain_tail(w, acc, accbits);
 }
 
 // Fused hash → slot → bit-pack: one pass over the key stream, no int32
@@ -128,9 +151,9 @@ void ps_hash_slots_packbits(const uint64_t* keys, uint64_t n, uint64_t seed,
     s = pow2 ? (s & mask) : (s % num_slots);
     acc |= s << accbits;
     accbits += bits;
-    while (accbits >= 8) { *w++ = (uint8_t)acc; acc >>= 8; accbits -= 8; }
+    w = flush32(w, &acc, &accbits);
   }
-  if (accbits) *w++ = (uint8_t)acc;
+  drain_tail(w, acc, accbits);
 }
 
 // ---------------------------------------------------------------------------

@@ -20,6 +20,7 @@ stream bit ``k`` lives in byte ``k>>3`` at in-byte position ``k&7``
 from __future__ import annotations
 
 import ctypes
+from typing import Optional
 
 import numpy as np
 
@@ -70,11 +71,17 @@ def pack_bits(vals: np.ndarray, bits: int) -> np.ndarray:
 
 
 def hash_slots_packed(
-    keys: np.ndarray, num_slots: int, bits: int, seed: int = 0
+    keys: np.ndarray,
+    num_slots: int,
+    bits: int,
+    seed: int = 0,
+    out: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Fused hash → slot → bitstream over a raw key array: the localization
     hot path (one C++ pass, no int32 temporary). Bit-exact with
-    ``hash_slots`` + ``pack_bits_np``."""
+    ``hash_slots`` + ``pack_bits_np``. ``out``, when given, must be a
+    C-contiguous uint8 buffer of exactly ``packed_nbytes(n, bits)`` — the
+    stream is written in place (skips an allocation + copy per batch)."""
     from ..cpp import native
     from .murmur import hash_slots
 
@@ -84,10 +91,19 @@ def hash_slots_packed(
     else:
         k = np.ascontiguousarray(k, dtype=np.uint64)
     k = k.ravel()
+    nbytes = packed_nbytes(k.size, bits)
+    if out is not None:
+        assert out.dtype == np.uint8 and out.flags.c_contiguous
+        assert out.size == nbytes, (out.size, nbytes)
     lib = native()
     if lib is None or k.size < 4096:
-        return pack_bits_np(hash_slots(k, num_slots, seed), bits)
-    out = np.zeros(packed_nbytes(k.size, bits), np.uint8)
+        stream = pack_bits_np(hash_slots(k, num_slots, seed), bits)
+        if out is None:
+            return stream
+        out[:] = stream
+        return out
+    if out is None:
+        out = np.empty(nbytes, np.uint8)
     lib.ps_hash_slots_packbits(
         k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         k.size,
