@@ -1,0 +1,10 @@
+#!/bin/bash
+# Criteo Display Advertising Challenge data (ref example/linear/criteo/
+# download.sh pointed at the now-retired criteolabs URL; fetch the
+# kaggle/criteo terabyte-sample from your mirror of choice), then shard:
+#   split -n l/16 train.txt data/criteo/train/part-
+set -e
+echo "Place criteo train.txt/test.txt under data/criteo/ and shard with split(1)."
+echo "The original criteolabs download URL has been retired; see"
+echo "https://ailab.criteo.com/ressources/ for current hosting."
+exit 1

@@ -1,0 +1,5 @@
+#!/bin/bash
+# Fetch the sample CTR dataset (ref example/linear/ctr/download.sh).
+set -e
+dir=$(dirname "$0")
+git clone https://github.com/mli/ctr-data "$dir/../../data/ctr"

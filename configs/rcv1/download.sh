@@ -1,0 +1,23 @@
+#!/bin/bash
+# Fetch + shard the rcv1 binary-classification dataset (ref
+# example/linear/rcv1/download.sh): 8 libsvm part files per split under
+# data/rcv1/{train,test}. Needs network; for offline smoke data use
+# ../synth_data.py instead.
+set -e
+dir=$(dirname "$0")
+mkdir -p "$dir/../../data" && cd "$dir/../../data"
+
+for t in train test; do
+  if ! [ -e rcv1_${t}.binary ]; then
+    wget http://www.csie.ntu.edu.tw/~cjlin/libsvmtools/datasets/binary/rcv1_${t}.binary.bz2
+    bunzip2 rcv1_${t}.binary.bz2
+  fi
+  rnd=rcv1_${t}_rand
+  shuf rcv1_${t}.binary > $rnd
+  mkdir -p rcv1/${t}
+  rm -f rcv1/${t}/*
+  split -n l/8 --numeric-suffixes=1 --suffix-length=3 $rnd rcv1/${t}/part-
+  rm $rnd
+done
+# the reference swaps splits so "train" is the bigger file set
+mv rcv1/train tmp && mv rcv1/test rcv1/train && mv tmp rcv1/test

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Generate synthetic libsvm/criteo-format shards so every config under
+configs/ can run without network access (the reference's download.sh
+scripts need egress; this is the offline stand-in).
+
+    python configs/synth_data.py rcv1    # data/rcv1/{train,test}/part-*
+    python configs/synth_data.py criteo  # data/criteo/{train,test}/part.*
+    python configs/synth_data.py ctr     # data/ctr/{train,test}/part-*
+
+Labels follow a sparse ground-truth weight vector so the solvers have
+signal to converge on (same trick as tests/test_async_sgd.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+
+def _rows(rng, n, p, nnz, w):
+    idx = rng.integers(0, p, size=(n, nnz))
+    y = np.where(w[idx].sum(axis=1) > 0, 1, -1)
+    return y, idx
+
+
+def write_libsvm(path: str, rng, n: int, p: int, nnz: int, w) -> None:
+    y, idx = _rows(rng, n, p, nnz, w)
+    with open(path, "w") as f:
+        for i in range(n):
+            feats = " ".join(f"{j}:1" for j in sorted(set(idx[i].tolist())))
+            f.write(f"{y[i]} {feats}\n")
+
+
+def write_ps_sparse_binary(path: str, rng, n: int, p: int, nnz: int, w) -> None:
+    """ps SPARSE_BINARY text: "label; grp key key ...;" (the ctr-data
+    sample's format)."""
+    y, idx = _rows(rng, n, p, nnz, w)
+    with open(path, "w") as f:
+        for i in range(n):
+            keys = " ".join(str(j) for j in sorted(set(idx[i].tolist())))
+            f.write(f"{1 if y[i] > 0 else 0}; 0 {keys};\n")
+
+
+def write_criteo(path: str, rng, n: int, p: int, w) -> None:
+    y, idx = _rows(rng, n, p, 26, w)
+    ints = rng.integers(0, 100, size=(n, 13))
+    with open(path, "w") as f:
+        for i in range(n):
+            label = 1 if y[i] > 0 else 0
+            num = "\t".join(str(v) for v in ints[i])
+            cat = "\t".join(f"{v:08x}" for v in idx[i])
+            f.write(f"{label}\t{num}\t{cat}\n")
+
+
+def main() -> int:
+    name = sys.argv[1] if len(sys.argv) > 1 else "rcv1"
+    shards = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    rows = int(sys.argv[3]) if len(sys.argv) > 3 else 5000
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "data")
+    rng = np.random.default_rng(0)
+    p = 1 << 16
+    w = (rng.normal(size=p) * (rng.random(p) < 0.1)).astype(np.float32)
+    for split in ("train", "test"):
+        d = os.path.join(root, name, split)
+        os.makedirs(d, exist_ok=True)
+        for s in range(shards):
+            # criteo configs match "part.*", libsvm ones "part-*": use a
+            # name both globs accept
+            part = os.path.join(d, f"part-{s + 1:03d}")
+            if name == "criteo":
+                part = os.path.join(d, f"part.{s + 1:03d}")
+                write_criteo(part, rng, rows, p, w)
+            elif name == "ctr":
+                write_ps_sparse_binary(part, rng, rows, p, 32, w)
+            else:
+                write_libsvm(part, rng, rows, p, 32, w)
+        print(f"wrote {shards} shards under {d}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+#!/bin/bash
+# Regenerate doc/API_REFERENCE.md (ref doc/gendoc.sh runs doxygen).
+dir=$(dirname "$0")
+exec python "$dir/gendoc.py"
