@@ -80,8 +80,12 @@ void ps_hash_slots(const uint64_t* keys, uint64_t n, uint64_t seed,
                    uint64_t num_slots, int32_t* out) {
   if ((num_slots & (num_slots - 1)) == 0) {
     const uint64_t mask = num_slots - 1;
-    for (uint64_t i = 0; i < n; ++i)
-      out[i] = (int32_t)(ps_mix64(keys[i], seed) & mask);
+    for (uint64_t i = 0; i < n; ++i) {  // expanded inline: auto-vectorizes
+      uint64_t z = keys[i] + seed + 0x9E3779B97F4A7C15ull;
+      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+      z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+      out[i] = (int32_t)((z ^ (z >> 31)) & mask);
+    }
   } else {
     for (uint64_t i = 0; i < n; ++i)
       out[i] = (int32_t)(ps_mix64(keys[i], seed) % num_slots);
@@ -136,22 +140,41 @@ void ps_pack_bits(const int32_t* vals, uint64_t n, uint32_t bits,
   drain_tail(w, acc, accbits);
 }
 
-// Fused hash → slot → bit-pack: one pass over the key stream, no int32
-// temporary. This is the localization hot path for hashed directories
-// (prep_batch_ell_bits); on a single-core host every avoided pass counts.
+// Fused hash → slot → bit-pack, tiled: the hash tile below is a plain
+// elementwise loop with no loop-carried state, so -march=native
+// vectorizes it (8-lane vpmullq on AVX-512DQ); the sequential pack
+// accumulator then drains the cache-hot tile. One pass over the key
+// stream, no full-size int32 temporary — the localization hot path for
+// hashed directories (prep_batch_ell_bits).
 void ps_hash_slots_packbits(const uint64_t* keys, uint64_t n, uint64_t seed,
                             uint64_t num_slots, uint32_t bits, uint8_t* out) {
   const int pow2 = (num_slots & (num_slots - 1)) == 0;
   const uint64_t mask = num_slots - 1;
+  enum { TILE = 2048 };
+  uint32_t tile[TILE];
   uint64_t acc = 0;
   uint32_t accbits = 0;
   uint8_t* w = out;
-  for (uint64_t i = 0; i < n; ++i) {
-    uint64_t s = ps_mix64(keys[i], seed);
-    s = pow2 ? (s & mask) : (s % num_slots);
-    acc |= s << accbits;
-    accbits += bits;
-    w = flush32(w, &acc, &accbits);
+  for (uint64_t start = 0; start < n; start += TILE) {
+    const uint64_t m = n - start < TILE ? n - start : TILE;
+    const uint64_t* k = keys + start;
+    if (pow2) {
+      for (uint64_t j = 0; j < m; ++j) {  // auto-vectorized
+        uint64_t z = k[j] + seed + 0x9E3779B97F4A7C15ull;
+        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+        z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+        tile[j] = (uint32_t)((z ^ (z >> 31)) & mask);
+      }
+    } else {
+      for (uint64_t j = 0; j < m; ++j) {
+        tile[j] = (uint32_t)(ps_mix64(k[j], seed) % num_slots);
+      }
+    }
+    for (uint64_t j = 0; j < m; ++j) {
+      acc |= ((uint64_t)tile[j]) << accbits;
+      accbits += bits;
+      w = flush32(w, &acc, &accbits);
+    }
   }
   drain_tail(w, acc, accbits);
 }
