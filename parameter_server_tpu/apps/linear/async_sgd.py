@@ -405,6 +405,54 @@ def _lane_positions(counts: np.ndarray, lanes: int) -> np.ndarray:
     return np.where(pos < lanes, pos, -1)
 
 
+def make_push_reduce(push_quant: int):
+    """Cross-worker gradient reduction, optionally through the quantized
+    wire: the device-side realization of the reference's FIXING_FLOAT
+    push filter (src/filter/fixing_float.h) — each worker stochastically
+    rounds its shard gradient to ``push_quant``-byte fixed point with its
+    OWN [min, max] scale (the reference's per-message scale, reusing
+    filter/fixing_float.quantize_jax) and the decoded values are summed.
+    Zero entries are masked back to exactly zero so slots a worker never
+    touched contribute nothing — the sparse_filter ∘ fixing_float chain
+    of the reference's confs (absent keys get no quantization noise)."""
+    if not push_quant:
+        return lambda g, seed: jax.lax.psum(g, DATA_AXIS)
+    from ...filter.fixing_float import dequantize_jax, quantize_jax
+
+    def reduce(g, seed):
+        key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        q, lo, hi = quantize_jax(g, push_quant, key)
+        dec = dequantize_jax(q, lo, hi, push_quant)
+        dec = jnp.where(g != 0, dec, 0.0)
+        return jax.lax.psum(dec, DATA_AXIS)
+
+    return reduce
+
+
+def make_pull_weights(updater, pull_quant: int):
+    """Server-side weight derivation for the pull path, optionally
+    through the quantized wire (FIXING_FLOAT pull_filter): each server
+    shard derives its dense weight vector from its live state — the
+    reference's servers send WEIGHTS, not raw state — and, when
+    ``pull_quant`` is set, stochastically rounds it to n-byte fixed point
+    (per-shard scale) before workers gather it. Exact zeros (L1-pruned
+    coordinates) stay exactly zero, as under the sparse_filter chain."""
+    if not pull_quant:
+        return lambda pulled, seed: updater.weights(pulled)
+    from ...filter.fixing_float import dequantize_jax, quantize_jax
+
+    def pull(pulled, seed):
+        w = updater.weights(pulled)
+        key = jax.random.fold_in(jax.random.PRNGKey(0xF00D), seed)
+        key = jax.random.fold_in(key, jax.lax.axis_index(SERVER_AXIS))
+        q, lo, hi = quantize_jax(w, pull_quant, key)
+        dec = dequantize_jax(q, lo, hi, pull_quant)
+        return jnp.where(w != 0, dec, 0.0)
+
+    return pull
+
+
 def _progress_metrics(loss, y, xw, mask, with_aux: bool):
     """SGDProgress scalars (padding rows masked out of the objective); the
     per-example xw/y/mask aux — needed only for host-side AUC — costs three
@@ -429,14 +477,18 @@ def make_train_step_ell(
     binary: bool,
     with_aux: bool = True,
     packed: bool = False,
+    push_quant: int = 0,
+    pull_quant: int = 0,
 ):
     """Fused SPMD step over ELL batches: Xw is a lane reduction (no row
     scatter); only the push keeps a scatter-add. ``packed`` accepts the
     u24-wire ELLPackedBatch and unpacks indices on device."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
+    push_reduce = make_push_reduce(push_quant)
+    pull_weights = make_pull_weights(updater, pull_quant)
 
-    def local_step(live, pulled, y, mask, slots, vals):
+    def local_step(live, pulled, seed, y, mask, slots, vals):
         y, mask, slots = y[0], mask[0], slots[0]
         vals = None if binary else vals[0]
         if packed:
@@ -447,13 +499,12 @@ def make_train_step_ell(
         rel = jnp.clip(flat - lo, 0, shard - 1)
         ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
 
-        def gather(leaf):
-            if leaf.ndim == 0:
-                return leaf
-            return jax.lax.psum(jnp.where(ok, leaf[rel], 0), SERVER_AXIS)
-
-        state_e = jax.tree.map(gather, pulled)
-        w_e = updater.weights(state_e).reshape(slots.shape)  # [R, K]
+        # pull: each server derives (and optionally quantizes) its dense
+        # weight shard once, workers gather entries + assemble via psum
+        w_shard = pull_weights(pulled, seed)
+        w_e = jax.lax.psum(
+            jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS
+        ).reshape(slots.shape)  # [R, K]
         x = w_e if binary else w_e * vals
         xw = x.sum(axis=1)
 
@@ -470,7 +521,7 @@ def make_train_step_ell(
             .at[rel]
             .max(ok & valid.reshape(-1))
         )
-        g_shard = jax.lax.psum(g_shard, DATA_AXIS)
+        g_shard = push_reduce(g_shard, seed)
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
         new_state = updater.apply(live, g_shard, touched)
 
@@ -483,7 +534,7 @@ def make_train_step_ell(
         )
 
     @jax.jit
-    def step(live_state, pull_state, batch):
+    def step(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         slots = batch.slots_u24 if packed else batch.slots
         # binary batches carry no vals; pass slots as an unused placeholder
@@ -492,10 +543,10 @@ def make_train_step_ell(
         return shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(specs, specs, *batch_specs),
+            in_specs=(specs, specs, P(), *batch_specs),
             out_specs=(specs, P()),
             check_vma=False,
-        )(live_state, pull_state, batch.y, batch.mask, slots, vals)
+        )(live_state, pull_state, seed, batch.y, batch.mask, slots, vals)
 
     return step
 
@@ -508,6 +559,8 @@ def make_train_step_ell_bits(
     rows: int,
     lanes: int,
     with_aux: bool = True,
+    push_quant: int = 0,
+    pull_quant: int = 0,
 ):
     """Fused SPMD step over the minimal-wire ELLBitsBatch (binary,
     uniform-row): slot ids unpack from the bitstream, labels from sign
@@ -516,8 +569,10 @@ def make_train_step_ell_bits(
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
     bits = slot_bits(num_slots)
+    push_reduce = make_push_reduce(push_quant)
+    pull_weights = make_pull_weights(updater, pull_quant)
 
-    def local_step(live, pulled, y_bits, counts, words):
+    def local_step(live, pulled, seed, y_bits, counts, words):
         y_bits, count, words = y_bits[0], counts[0], words[0]
         y = unpack_sign_bits(y_bits, rows)
         mask = (jnp.arange(rows) < count).astype(jnp.float32)
@@ -527,13 +582,10 @@ def make_train_step_ell_bits(
         rel = jnp.clip(flat - lo, 0, shard - 1)
         ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
 
-        def gather(leaf):
-            if leaf.ndim == 0:
-                return leaf
-            return jax.lax.psum(jnp.where(ok, leaf[rel], 0), SERVER_AXIS)
-
-        state_e = jax.tree.map(gather, pulled)
-        w_e = updater.weights(state_e).reshape(slots.shape)  # [R, K]
+        w_shard = pull_weights(pulled, seed)
+        w_e = jax.lax.psum(
+            jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS
+        ).reshape(slots.shape)  # [R, K]
         xw = w_e.sum(axis=1)
 
         gr = loss.row_grad(y, xw) * mask  # [R]
@@ -546,7 +598,7 @@ def make_train_step_ell_bits(
         )
         live_row = jnp.broadcast_to(mask[:, None] > 0, slots.shape).reshape(-1)
         touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & live_row)
-        g_shard = jax.lax.psum(g_shard, DATA_AXIS)
+        g_shard = push_reduce(g_shard, seed)
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
         new_state = updater.apply(live, g_shard, touched)
 
@@ -559,44 +611,43 @@ def make_train_step_ell_bits(
         )
 
     @jax.jit
-    def step(live_state, pull_state, batch):
+    def step(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(3))
         return shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(specs, specs, *batch_specs),
+            in_specs=(specs, specs, P(), *batch_specs),
             out_specs=(specs, P()),
             check_vma=False,
-        )(live_state, pull_state, batch.y_bits, batch.counts, batch.slots_words)
+        )(live_state, pull_state, seed, batch.y_bits, batch.counts,
+          batch.slots_words)
 
     return step
 
 
 def make_train_step_hashed(
-    updater, loss, mesh, num_slots: int, with_aux: bool = True
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0,
 ):
     """Per-entry fused SPMD step (hashed fast path): gather state at each
     nnz slot, segment-sum Xw by row, scatter per-entry gradients densely —
     duplicates fold in the scatter, so no uniquification anywhere."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
+    push_reduce = make_push_reduce(push_quant)
+    pull_weights = make_pull_weights(updater, pull_quant)
 
-    def local_step(live, pulled, y, mask, rows, slots, vals):
+    def local_step(live, pulled, seed, y, mask, rows, slots, vals):
         y, mask, rows, slots, vals = y[0], mask[0], rows[0], slots[0], vals[0]
         lo = jax.lax.axis_index(SERVER_AXIS) * shard
         rel = jnp.clip(slots - lo, 0, shard - 1)
         ok = ((slots - lo) >= 0) & ((slots - lo) < shard)
 
-        def gather(leaf):
-            if leaf.ndim == 0:
-                return leaf
-            return jax.lax.psum(jnp.where(ok, leaf[rel], 0), SERVER_AXIS)
-
-        state_e = jax.tree.map(gather, pulled)
-        # sentinel/padding slots are owned by no shard -> gathered state 0 ->
-        # weights(0) = 0, and their vals are 0, so they vanish from Xw and g
-        w_e = updater.weights(state_e)
+        # sentinel/padding slots are owned by no shard -> gathered weight 0,
+        # and their vals are 0, so they vanish from Xw and g
+        w_shard = pull_weights(pulled, seed)
+        w_e = jax.lax.psum(jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS)
 
         xw = jax.ops.segment_sum(vals * w_e, rows, num_segments=y.shape[0])
         gr = loss.row_grad(y, xw) * mask
@@ -606,7 +657,7 @@ def make_train_step_hashed(
             jnp.where(ok, g_e, 0.0)
         )
         touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & (vals != 0))
-        g_shard = jax.lax.psum(g_shard, DATA_AXIS)
+        g_shard = push_reduce(g_shard, seed)
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
         new_state = updater.apply(live, g_shard, touched)
 
@@ -619,18 +670,19 @@ def make_train_step_hashed(
         )
 
     @jax.jit
-    def step(live_state, pull_state, batch):
+    def step(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(5))
         return shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(specs, specs, *batch_specs),
+            in_specs=(specs, specs, P(), *batch_specs),
             out_specs=(specs, P()),
             check_vma=False,
         )(
             live_state,
             pull_state,
+            seed,
             batch.y,
             batch.mask,
             batch.rows,
@@ -641,14 +693,19 @@ def make_train_step_hashed(
     return step
 
 
-def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
+def make_train_step(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0,
+):
     """Build the fused SPMD train step. Returns jitted
     ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
     """
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
+    push_reduce = make_push_reduce(push_quant)
+    pull_weights = make_pull_weights(updater, pull_quant)
 
-    def local_step(live, pulled, y, mask, rows, ucols, vals, uslots, umask):
+    def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
         # squeeze the per-shard leading dim added by stacking
         y, mask = y[0], mask[0]
         rows, ucols, vals = rows[0], ucols[0], vals[0]
@@ -658,14 +715,9 @@ def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
         rel = jnp.clip(uslots - lo, 0, shard - 1)
         ok = ((uslots - lo) >= 0) & ((uslots - lo) < shard)
 
-        # -- pull (gather + psum over server axis) --
-        def gather(leaf):
-            if leaf.ndim == 0:
-                return leaf
-            return jax.lax.psum(jnp.where(ok, leaf[rel], 0), SERVER_AXIS)
-
-        state_u = jax.tree.map(gather, pulled)
-        w_u = updater.weights(state_u) * umask
+        # -- pull (server-side weight derivation, gather + psum assembly) --
+        w_shard = pull_weights(pulled, seed)
+        w_u = jax.lax.psum(jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS) * umask
 
         # -- worker compute (Xw, row grad, X^T g) --
         xw = jax.ops.segment_sum(vals * w_u[ucols], rows, num_segments=y.shape[0])
@@ -676,7 +728,7 @@ def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
         # -- push (dense scatter into owned shard + psum over data axis) --
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(jnp.where(ok, g_u, 0))
         touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & (umask > 0))
-        g_shard = jax.lax.psum(g_shard, DATA_AXIS)
+        g_shard = push_reduce(g_shard, seed)
         touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
 
         def apply_leafwise(state):
@@ -694,18 +746,19 @@ def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
         )
 
     @jax.jit
-    def step(live_state, pull_state, batch):
+    def step(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(7))
         return shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(specs, specs, *batch_specs),
+            in_specs=(specs, specs, P(), *batch_specs),
             out_specs=(specs, P()),
             check_vma=False,
         )(
             live_state,
             pull_state,
+            seed,
             batch.y,
             batch.mask,
             batch.rows,
@@ -716,6 +769,35 @@ def make_train_step(updater, loss, mesh, num_slots: int, with_aux: bool = True):
         )
 
     return step
+
+
+_SUPPORTED_FILTERS = ("fixing_float", "key_caching", "sparse", "compressing")
+
+
+def _fixing_float_bytes(filters, where: str) -> int:
+    """num_bytes of a FIXING_FLOAT entry in a conf filter list (0 = none),
+    validated; accepts dicts (conf parse) or FilterSpec-likes."""
+    import logging
+
+    nb = 0
+    for f in filters or ():
+        if isinstance(f, dict):
+            ftype, fnb = f.get("type"), f.get("num_bytes", 1)
+        else:
+            ftype, fnb = getattr(f, "type", None), getattr(f, "num_bytes", 1)
+        ftype = str(ftype).lower() if ftype is not None else ""
+        if ftype == "fixing_float":
+            nb = int(fnb or 1)
+            if nb not in (1, 2):
+                raise ValueError(
+                    f"{where} FIXING_FLOAT num_bytes must be 1 or 2, got {nb}"
+                )
+        elif ftype not in _SUPPORTED_FILTERS:
+            logging.getLogger(__name__).warning(
+                "%s filter %r is not applied by the fused async-SGD step",
+                where, ftype,
+            )
+    return nb
 
 
 class AsyncSGDWorker(ISGDCompNode):
@@ -749,6 +831,14 @@ class AsyncSGDWorker(ISGDCompNode):
                 f"unknown SGDConfig.wire {sgd.wire!r}; expected "
                 "'i32', 'u24', 'bits', or '' (legacy wire_u24 flag)"
             )
+        # FIXING_FLOAT push/pull filters → n-byte quantized wire inside the
+        # fused step (KEY_CACHING needs no device work here — streaming
+        # minibatches never repeat key sets, and darlin keeps its blocks
+        # device-resident outright; SPARSE's zero-masking is folded into
+        # the quantized paths)
+        self._push_quant = _fixing_float_bytes(sgd.push_filter, "push_filter")
+        self._pull_quant = _fixing_float_bytes(sgd.pull_filter, "pull_filter")
+        self._seed_counter = 0
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         self.directory = KeyDirectory(self.num_slots, hashed=True)
         self.state = jax.tree.map(
@@ -896,6 +986,7 @@ class AsyncSGDWorker(ISGDCompNode):
             builder = lambda: make_train_step_ell_bits(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
                 rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
+                push_quant=self._push_quant, pull_quant=self._pull_quant,
             )
         elif isinstance(prepped, (ELLBatch, ELLPackedBatch)):
             packed = isinstance(prepped, ELLPackedBatch)
@@ -903,16 +994,21 @@ class AsyncSGDWorker(ISGDCompNode):
             builder = lambda: make_train_step_ell(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
                 binary=prepped.vals is None, with_aux=with_aux, packed=packed,
+                push_quant=self._push_quant, pull_quant=self._pull_quant,
             )
         elif isinstance(prepped, HashedBatch):
             key = ("hashed", False, with_aux)
             builder = lambda: make_train_step_hashed(  # noqa: E731
-                self.updater, self.loss, self.mesh, self.num_slots, with_aux=with_aux
+                self.updater, self.loss, self.mesh, self.num_slots,
+                with_aux=with_aux, push_quant=self._push_quant,
+                pull_quant=self._pull_quant,
             )
         else:
             key = ("exact", False, with_aux)
             builder = lambda: make_train_step(  # noqa: E731
-                self.updater, self.loss, self.mesh, self.num_slots, with_aux=with_aux
+                self.updater, self.loss, self.mesh, self.num_slots,
+                with_aux=with_aux, push_quant=self._push_quant,
+                pull_quant=self._pull_quant,
             )
         if key not in self._steps:
             self._steps[key] = builder()
@@ -937,9 +1033,11 @@ class AsyncSGDWorker(ISGDCompNode):
             self._pull_state = self.state
             self._steps_since_snapshot = 0
         step_fn = self._get_step(prepped, with_aux)
+        self._seed_counter += 1
+        seed = np.uint32(self._seed_counter)
 
         def step():
-            new_state, metrics = step_fn(self.state, self._pull_state, prepped)
+            new_state, metrics = step_fn(self.state, self._pull_state, prepped, seed)
             self.state = new_state
             return metrics
 

@@ -185,6 +185,14 @@ def _coerce(raw: str):
         return raw
 
 
+def _filter_list(v) -> list:
+    """Normalize repeated ``push_filter { ... }`` blocks to a list of
+    dicts (ref FilterConfig in proto/filter.proto)."""
+    if v is None:
+        return []
+    return list(v) if isinstance(v, list) else [v]
+
+
 def _data_config(d: dict) -> DataConfig:
     files = d.get("file", [])
     if not isinstance(files, list):
@@ -244,6 +252,8 @@ def parse_conf(text: str) -> Config:
             ell_lanes=int(s.get("ell_lanes", 0)),
             wire_u24=bool(s.get("wire_u24", False)),
             wire=str(s.get("wire", "")),
+            push_filter=_filter_list(s.get("push_filter")),
+            pull_filter=_filter_list(s.get("pull_filter")),
         )
     if "darlin" in d:
         b = d["darlin"]

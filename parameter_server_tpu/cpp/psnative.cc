@@ -61,12 +61,17 @@ uint32_t ps_crc32c(const uint8_t* data, uint64_t n) {
 // 64-bit mixing hash — must match utils/murmur.py (splitmix64 finalizer).
 // ---------------------------------------------------------------------------
 
-uint64_t ps_mix64(uint64_t z, uint64_t seed) {
+// The one definition of the mix — static inline so the hot loops below
+// inline (and auto-vectorize) it while every entry point stays bit-exact
+// with the others and with utils/murmur.py.
+static inline uint64_t mix64(uint64_t z, uint64_t seed) {
   z += seed + 0x9E3779B97F4A7C15ull;
   z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
   z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
   return z ^ (z >> 31);
 }
+
+uint64_t ps_mix64(uint64_t z, uint64_t seed) { return mix64(z, seed); }
 
 void ps_mix64_array(const uint64_t* keys, uint64_t n, uint64_t seed,
                     uint64_t* out) {
@@ -80,15 +85,11 @@ void ps_hash_slots(const uint64_t* keys, uint64_t n, uint64_t seed,
                    uint64_t num_slots, int32_t* out) {
   if ((num_slots & (num_slots - 1)) == 0) {
     const uint64_t mask = num_slots - 1;
-    for (uint64_t i = 0; i < n; ++i) {  // expanded inline: auto-vectorizes
-      uint64_t z = keys[i] + seed + 0x9E3779B97F4A7C15ull;
-      z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
-      z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
-      out[i] = (int32_t)((z ^ (z >> 31)) & mask);
-    }
+    for (uint64_t i = 0; i < n; ++i)  // inlined mix: auto-vectorizes
+      out[i] = (int32_t)(mix64(keys[i], seed) & mask);
   } else {
     for (uint64_t i = 0; i < n; ++i)
-      out[i] = (int32_t)(ps_mix64(keys[i], seed) % num_slots);
+      out[i] = (int32_t)(mix64(keys[i], seed) % num_slots);
   }
 }
 
@@ -159,16 +160,11 @@ void ps_hash_slots_packbits(const uint64_t* keys, uint64_t n, uint64_t seed,
     const uint64_t m = n - start < TILE ? n - start : TILE;
     const uint64_t* k = keys + start;
     if (pow2) {
-      for (uint64_t j = 0; j < m; ++j) {  // auto-vectorized
-        uint64_t z = k[j] + seed + 0x9E3779B97F4A7C15ull;
-        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
-        z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
-        tile[j] = (uint32_t)((z ^ (z >> 31)) & mask);
-      }
+      for (uint64_t j = 0; j < m; ++j)  // inlined mix: auto-vectorized
+        tile[j] = (uint32_t)(mix64(k[j], seed) & mask);
     } else {
-      for (uint64_t j = 0; j < m; ++j) {
-        tile[j] = (uint32_t)(ps_mix64(k[j], seed) % num_slots);
-      }
+      for (uint64_t j = 0; j < m; ++j)
+        tile[j] = (uint32_t)(mix64(k[j], seed) % num_slots);
     }
     for (uint64_t j = 0; j < m; ++j) {
       acc |= ((uint64_t)tile[j]) << accbits;

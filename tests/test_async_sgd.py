@@ -278,3 +278,82 @@ class TestBitsWire:
         batch = next(synth(1, w_true))  # valued features
         prepped = worker.prep(batch, device_put=False)
         assert isinstance(prepped, ELLPackedBatch)
+
+
+class TestQuantizedPush:
+    """FIXING_FLOAT push filter → stochastic n-byte gradient reduce
+    (ref filter/fixing_float.h applied to the push wire)."""
+
+    def _train(self, mesh8, w_true, num_bytes, ell=True, seed0=0):
+        conf = make_conf(num_slots=4096)
+        if ell:
+            conf.async_sgd.ell_lanes = 8
+        if num_bytes:
+            conf.async_sgd.push_filter = [
+                {"type": "fixing_float", "num_bytes": num_bytes}
+            ]
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        worker.train(synth_binary(8, w_true, seed0=seed0))
+        return worker
+
+    def test_two_byte_quant_tracks_exact(self, mesh8, w_true):
+        wq = self._train(mesh8, w_true, 2).weights_dense()
+        we = self._train(mesh8, w_true, 0).weights_dense()
+        # 16-bit fixed point: same support, small coordinate error
+        err = np.abs(wq - we).max()
+        assert err < 0.05, err
+        assert err > 0, "quantization had no effect at all"
+
+    def test_one_byte_quant_still_converges(self, mesh8, w_true):
+        w = self._train(mesh8, w_true, 1)
+        first = w.progress.objective[0] / 256
+        # fresh worker to measure final logloss on the SAME stream
+        prog = w.train(synth_binary(4, w_true, seed0=100))
+        last = prog.objective[-1] / max(1, prog.num_examples_processed)
+        assert last < first, (first, last)
+
+    def test_conf_parses_push_filter(self):
+        from parameter_server_tpu.apps.linear.config import parse_conf
+
+        conf = parse_conf(
+            """
+            async_sgd {
+              algo: FTRL
+              push_filter { type: KEY_CACHING }
+              push_filter { type: FIXING_FLOAT num_bytes: 1 }
+            }
+            """
+        )
+        types = [f["type"] for f in conf.async_sgd.push_filter]
+        assert types == ["key_caching", "fixing_float"]
+
+    def test_nonell_path_quantizes_too(self, mesh8, w_true):
+        w = self._train(mesh8, w_true, 2, ell=False)
+        assert w._push_quant == 2
+        assert np.isfinite(w.weights_dense()).all()
+
+
+class TestQuantizedPull:
+    """FIXING_FLOAT pull_filter → servers quantize derived weights."""
+
+    def test_pull_quant_converges_and_differs(self, mesh8, w_true):
+        def train(pull_bytes):
+            conf = make_conf(num_slots=4096)
+            conf.async_sgd.ell_lanes = 8
+            if pull_bytes:
+                conf.async_sgd.pull_filter = [
+                    {"type": "fixing_float", "num_bytes": pull_bytes}
+                ]
+            worker = AsyncSGDWorker(conf, mesh=mesh8)
+            worker.train(synth_binary(8, w_true))
+            return worker.weights_dense()
+
+        wq, we = train(2), train(0)
+        err = np.abs(wq - we).max()
+        assert 0 < err < 0.05, err
+
+    def test_bad_num_bytes_rejected(self, mesh8):
+        conf = make_conf()
+        conf.async_sgd.push_filter = [{"type": "fixing_float", "num_bytes": 4}]
+        with pytest.raises(ValueError, match="num_bytes"):
+            AsyncSGDWorker(conf, mesh=mesh8)
