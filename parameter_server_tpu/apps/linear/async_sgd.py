@@ -1127,6 +1127,41 @@ class AsyncSGDWorker(ISGDCompNode):
             written.append(spath)
         return written
 
+    # -- full-state checkpoint/resume (ref save_model_every_n_iter +
+    #    Parameter::Recover: the durable analog of server replicas) --
+
+    def checkpoint(self, manager, step: int) -> str:
+        """Durably save the full optimizer state (all server shards) plus
+        the worker's clock, via a parameter.replica.CheckpointManager."""
+        self.executor.wait_all()
+        return manager.save(
+            step,
+            {"state": self.state, "seed_counter": np.int64(self._seed_counter)},
+        )
+
+    def restore(self, manager, step: Optional[int] = None) -> int:
+        """Restore state from the latest (or given) checkpoint and return
+        its step. Training resumed from here replays bit-identically:
+        the seed counter (quantization noise stream) comes back too."""
+        if step is None:
+            step = manager.latest_step()
+            assert step is not None, "no checkpoint found"
+        like = {"state": self.state, "seed_counter": np.int64(0)}
+        tree = manager.restore(step, like=like)
+        self.state = jax.tree.map(
+            lambda leaf: jax.device_put(
+                np.asarray(leaf),
+                NamedSharding(
+                    self.mesh, P(SERVER_AXIS) if np.ndim(leaf) >= 1 else P()
+                ),
+            ),
+            tree["state"],
+        )
+        self._pull_state = self.state
+        self._steps_since_snapshot = 0
+        self._seed_counter = int(tree["seed_counter"])
+        return step
+
 
 class AsyncSGDScheduler(ISGDScheduler):
     """Workload dispatch + progress display (ref AsyncSGDScheduler)."""

@@ -268,5 +268,6 @@ def parse_conf(text: str) -> Config:
             delta_init_value=float(b.get("delta_init_value", 1.0)),
             delta_max_value=float(b.get("delta_max_value", 5.0)),
             kkt_filter_threshold_ratio=float(b.get("kkt_filter_threshold_ratio", 10.0)),
+            comm_filter=_filter_list(b.get("comm_filter")),
         )
     return cfg

@@ -281,6 +281,20 @@ class DarlinScheduler(BCDScheduler):
     def __init__(self, conf: Config, mesh=None, name: str = "darlin_scheduler"):
         super().__init__(conf.darlin or BCDConfig(), name=name)
         self.conf = conf
+        # comm_filter parity (ref bcd.conf): KEY_CACHING is structurally
+        # subsumed — feature blocks stay device-resident across passes, so
+        # keys are never resent at all; other filter types would change
+        # numerics and warn rather than silently no-op
+        import logging
+
+        for f in (conf.darlin.comm_filter if conf.darlin else []) or []:
+            ftype = str(f.get("type", "") if isinstance(f, dict) else f).lower()
+            if ftype not in ("key_caching", "compressing"):
+                logging.getLogger(__name__).warning(
+                    "darlin comm_filter %r is not applied (blocks are "
+                    "device-resident; only key_caching/compressing "
+                    "semantics are subsumed)", ftype,
+                )
         self.solver = DarlinSolver(conf, mesh=mesh)
         self.seed = 0
         self._converged_once = False

@@ -357,3 +357,31 @@ class TestQuantizedPull:
         conf.async_sgd.push_filter = [{"type": "fixing_float", "num_bytes": 4}]
         with pytest.raises(ValueError, match="num_bytes"):
             AsyncSGDWorker(conf, mesh=mesh8)
+
+
+class TestCheckpointResume:
+    """Full-state checkpoint → crash → restore → bit-identical resume
+    (ref save_model_every_n_iter + Parameter::Recover)."""
+
+    def test_resume_is_bit_identical(self, mesh8, w_true, tmp_path):
+        from parameter_server_tpu.parameter.replica import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path))
+
+        def fresh():
+            conf = make_conf(num_slots=4096)
+            conf.async_sgd.ell_lanes = 8
+            return AsyncSGDWorker(conf, mesh=mesh8)
+
+        # uninterrupted run: 5 + 3 batches
+        w1 = fresh()
+        w1.train(synth_binary(5, w_true))
+        w1.checkpoint(mgr, step=5)
+        w1.train(synth_binary(3, w_true, seed0=50))
+        want = w1.weights_dense()
+
+        # "crash": brand-new worker, restore, replay the same tail
+        w2 = fresh()
+        assert w2.restore(mgr) == 5
+        w2.train(synth_binary(3, w_true, seed0=50))
+        np.testing.assert_array_equal(w2.weights_dense(), want)
