@@ -48,7 +48,7 @@ class FTRLUpdater:
     def apply(self, state, grad, touched):
         z, sqrt_n = state["z"], state["sqrt_n"]
         if self.lr.type == LearningRate.DECAY and z.ndim == 1:
-            # fused Pallas kernel (ops/ftrl.py): one HBM pass, ~10x the XLA
+            # fused Pallas kernel (ops/ftrl.py): one HBM pass vs the XLA
             # elementwise chain on TPU; the op itself falls back to the
             # reference path off-TPU and for non-tile-aligned shards
             from ...ops.ftrl import ftrl_update
