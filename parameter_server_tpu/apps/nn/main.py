@@ -1,0 +1,68 @@
+"""NN-through-KVLayer CLI (the reference's CXXNET/Minerva guinea pig,
+README "KVLayer" integration: a conv net whose layers live in the
+parameter server):
+
+    python -m parameter_server_tpu.apps.nn.main \
+        [--model mlp|convnet] [--steps N] [--batch B] [--num-servers S]
+
+Trains on synthetic data (blobs for the MLP, random images for the conv
+net) so it runs anywhere; prints per-interval loss/accuracy like the
+reference's progress rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", choices=("mlp", "convnet"), default="mlp")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--num-servers", type=int, default=1)
+    ap.add_argument("--report-every", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    from ...models.convnet import ConvNet, MLP
+    from ...system.postoffice import Postoffice
+    from .trainer import NNTrainer
+
+    po = Postoffice.instance().start(num_server=args.num_servers)
+
+    rng = np.random.default_rng(0)
+    if args.model == "convnet":
+        model = ConvNet(num_classes=args.classes)
+        input_shape = (16, 16, 3)
+        centers = rng.normal(size=(args.classes,) + input_shape).astype(np.float32)
+
+        def batch():
+            y = rng.integers(0, args.classes, args.batch).astype(np.int32)
+            x = centers[y] + 0.5 * rng.normal(size=(args.batch,) + input_shape)
+            return x.astype(np.float32), y
+    else:
+        model = MLP(num_classes=args.classes)
+        input_shape = (32,)
+        centers = rng.normal(size=(args.classes, 32)).astype(np.float32)
+
+        def batch():
+            y = rng.integers(0, args.classes, args.batch).astype(np.int32)
+            x = centers[y] + 0.5 * rng.normal(size=(args.batch, 32))
+            return x.astype(np.float32), y
+
+    trainer = NNTrainer(model, input_shape=input_shape, mesh=po.mesh)
+    print(f"{'step':>5} {'loss':>9} {'accuracy':>9}")
+    for step in range(1, args.steps + 1):
+        x, y = batch()
+        m = trainer.train_step(x, y)
+        if step % args.report_every == 0 or step == args.steps:
+            print(f"{step:>5} {m['loss']:>9.5f} {m['accuracy']:>9.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
