@@ -1,0 +1,90 @@
+"""Executor DAG semantics (ref src/system/executor.{h,cc} +
+task_tracker.h): logical clocks, wait_time dependencies, bounded-delay
+throttling, and the race-detection asserts of SURVEY §5 (a step may not
+depend on a timestamp at/after its own; timestamps cannot be reused)."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.system.executor import Executor, TaskTracker
+from parameter_server_tpu.system.message import Task
+
+
+class TestTaskTracker:
+    def test_start_finish_cycle(self):
+        t = TaskTracker()
+        assert not t.was_started(3) and not t.is_finished(3)
+        t.start(3)
+        assert t.was_started(3) and not t.is_finished(3)
+        t.finish(3)
+        assert t.is_finished(3)
+
+
+class TestExecutor:
+    def test_timestamps_monotonic(self):
+        ex = Executor()
+        ts = [ex.submit(lambda: None) for _ in range(3)]
+        assert ts == [0, 1, 2]
+
+    def test_wait_returns_value_once(self):
+        ex = Executor()
+        ts = ex.submit(lambda: 42)
+        assert ex.wait(ts) == 42
+        assert ex.wait(ts) is None  # evicted after first wait
+
+    def test_dependencies_run_first(self):
+        ex = Executor()
+        order = []
+        t0 = ex.submit(lambda: order.append("a"))
+        t1 = ex.submit(lambda: order.append("b"), Task(wait_time=[t0]))
+        ex.wait(t1)
+        assert order == ["a", "b"]
+        assert ex.tracker.is_finished(t0)  # dep was waited, not just queued
+
+    def test_forward_dependency_rejected(self):
+        """Race-detection: a step cannot read a snapshot newer than itself
+        (dep >= own timestamp is a program error, not a silent reorder)."""
+        ex = Executor()
+        ex.submit(lambda: None)
+        with pytest.raises(ValueError, match="not before"):
+            ex.submit(lambda: None, Task(time=5, wait_time=[7]))
+
+    def test_timestamp_reuse_rejected(self):
+        ex = Executor()
+        ts = ex.submit(lambda: 1, Task(time=4))
+        with pytest.raises(ValueError, match="already used"):
+            ex.submit(lambda: 2, Task(time=4))
+        assert ex.wait(ts) == 1
+
+    def test_explicit_timestamp_advances_clock(self):
+        ex = Executor()
+        ex.submit(lambda: None, Task(time=10))
+        assert ex.submit(lambda: None) == 11
+
+    def test_bounded_delay_throttles(self):
+        """max_in_flight=2: submitting step t blocks until t-2 finished —
+        the reference's bounded-delay message-clock window."""
+        ex = Executor(max_in_flight=2)
+        done = []
+        for i in range(5):
+            ex.submit(lambda i=i: done.append(i))
+        # with the sliding window, step 4's submit waited on step 2;
+        # everything up to 2 must be finished already
+        assert ex.tracker.is_finished(2)
+        ex.wait_all()
+        assert done == list(range(5))
+
+    def test_callback_fires_on_wait(self):
+        ex = Executor()
+        fired = []
+        ts = ex.submit(lambda: 7, callback=lambda: fired.append(True))
+        assert not fired
+        ex.wait(ts)
+        assert fired == [True]
+
+    def test_wait_all_drains(self):
+        ex = Executor()
+        for i in range(4):
+            ex.submit(lambda i=i: np.zeros(2) + i)
+        ex.wait_all()
+        assert all(ex.tracker.is_finished(t) for t in range(4))
