@@ -1,0 +1,86 @@
+"""Server key-range reassignment (ref src/test/
+reassign_server_key_range_ps.cc): state saved under one server split must
+restore — values intact — onto a mesh with a DIFFERENT number of server
+shards, and training must continue. On TPU the key ranges are the table
+sharding, so reassignment = restore with the new mesh's NamedSharding."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.parallel import mesh as meshlib
+from parameter_server_tpu.system.postoffice import Postoffice
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def test_kv_vector_reshards_2_to_4_servers(mesh8):
+    from parameter_server_tpu.parameter.kv_vector import KVVector
+
+    mesh_a = meshlib.make_mesh(num_data=4, num_server=2)
+    mesh_b = meshlib.make_mesh(num_data=2, num_server=4)
+    keys = np.array([3, 17, 40, 99, 512, 1000], dtype=np.int64)
+    vals = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    kv_a = KVVector(mesh=mesh_a, k=2, num_slots=1024, hashed=False)
+    kv_a.set_keys(0, keys)
+    kv_a.wait(kv_a.push(kv_a.request(channel=0), keys=keys, values=vals))
+    snap = kv_a.get_replica()
+
+    kv_b = KVVector(mesh=mesh_b, k=2, num_slots=1024, hashed=False)
+    kv_b.set_keys(0, keys)
+    kv_b.set_replica(snap)
+    np.testing.assert_allclose(kv_b.values(0, keys), vals)
+    # the restored table is really sharded 4 ways now
+    table = kv_b.table(0)
+    assert dict(table.sharding.mesh.shape)["server"] == 4
+    # and stays writable: pushes land on the new shards
+    kv_b.wait(kv_b.push(kv_b.request(channel=0), keys=keys, values=vals))
+    np.testing.assert_allclose(kv_b.values(0, keys), 2 * vals)
+
+
+def test_worker_checkpoint_restores_across_server_counts(tmp_path, mesh8):
+    from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+    from parameter_server_tpu.apps.linear.config import (
+        Config,
+        LearningRateConfig,
+        PenaltyConfig,
+        SGDConfig,
+    )
+    from parameter_server_tpu.parameter.replica import CheckpointManager
+    from parameter_server_tpu.utils.sparse import random_sparse
+
+    rng = np.random.default_rng(0)
+    w_true = (rng.normal(size=512) * (rng.random(512) < 0.2)).astype(np.float32)
+
+    def make_worker(mesh):
+        conf = Config()
+        conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+        conf.learning_rate = LearningRateConfig(type="decay", alpha=0.5, beta=1.0)
+        conf.async_sgd = SGDConfig(
+            algo="ftrl", minibatch=256, num_slots=4096, ell_lanes=8
+        )
+        return AsyncSGDWorker(conf, mesh=mesh)
+
+    def batches(n, seed0=0):
+        for i in range(n):
+            yield random_sparse(
+                256, 512, 8, seed=seed0 + i, w_true=w_true, binary=True
+            )
+
+    mgr = CheckpointManager(str(tmp_path))
+    w_a = make_worker(meshlib.make_mesh(num_data=4, num_server=2))
+    w_a.train(batches(5))
+    w_a.checkpoint(mgr, step=5)
+    w_a.train(batches(3, seed0=50))
+    want = w_a.weights_dense()
+
+    # "cluster resize": 4 servers now; same checkpoint, same replay
+    w_b = make_worker(meshlib.make_mesh(num_data=2, num_server=4))
+    assert w_b.restore(mgr) == 5
+    w_b.train(batches(3, seed0=50))
+    np.testing.assert_allclose(w_b.weights_dense(), want, atol=1e-6)
