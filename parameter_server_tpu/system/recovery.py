@@ -1,0 +1,101 @@
+"""Failure detection → recovery orchestration.
+
+Counterpart of the reference manager's dead-node handling
+(``src/system/manager.cc``: heartbeat timeouts surface dead nodes; the
+scheduler then restores the dead worker's workloads —
+``WorkloadPool::Restore`` — and has a replacement server ``Recover()``
+from its replica). This module is that glue: a coordinator polls the
+HeartbeatCollector and dispatches role-specific recovery callbacks
+exactly once per dead node.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .heartbeat import HeartbeatCollector
+from .manager import Node
+
+_LOG = logging.getLogger(__name__)
+
+
+class RecoveryCoordinator:
+    """Watches liveness and fires per-role recovery handlers.
+
+    Typical wiring (see tests/test_recovery.py):
+
+    - worker dead  → ``workload_pool.restore(node_id)`` so its unfinished
+      file assignments go back to the pool for live workers;
+    - server dead  → ``replica_manager.recover(parameter)`` on the
+      replacement shard (or a CheckpointManager restore).
+    """
+
+    def __init__(self, collector: HeartbeatCollector):
+        self.collector = collector
+        self._handlers: Dict[str, List[Callable[[str], None]]] = {
+            Node.WORKER: [],
+            Node.SERVER: [],
+            Node.SCHEDULER: [],
+        }
+        self._recovered: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def on_worker_dead(self, cb: Callable[[str], None]) -> None:
+        self._handlers[Node.WORKER].append(cb)
+
+    def on_server_dead(self, cb: Callable[[str], None]) -> None:
+        self._handlers[Node.SERVER].append(cb)
+
+    def on_scheduler_dead(self, cb: Callable[[str], None]) -> None:
+        self._handlers[Node.SCHEDULER].append(cb)
+
+    @staticmethod
+    def _role_of(node_id: str) -> str:
+        return {"W": Node.WORKER, "S": Node.SERVER, "H": Node.SCHEDULER}.get(
+            node_id[:1], Node.WORKER
+        )
+
+    def check(self, now: Optional[float] = None) -> List[str]:
+        """One detection pass; returns nodes newly handled this call."""
+        handled = []
+        for nid in self.collector.dead_nodes(now):
+            with self._lock:
+                if nid in self._recovered:
+                    continue
+                self._recovered.add(nid)
+            _LOG.warning("node %s declared dead; running recovery", nid)
+            for cb in self._handlers[self._role_of(nid)]:
+                try:
+                    cb(nid)
+                except Exception:  # noqa: BLE001 — keep recovering others
+                    _LOG.exception("recovery handler failed for %s", nid)
+            handled.append(nid)
+        return handled
+
+    def revive(self, node_id: str) -> None:
+        """A node reported again after recovery — allow future detection."""
+        with self._lock:
+            self._recovered.discard(node_id)
+
+    # -- background polling (the scheduler's heartbeat thread) --
+
+    def start(self, interval: float = 1.0) -> None:
+        assert self._thread is None, "already started"
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval):
+                self.check()
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="recovery")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,95 @@
+"""Failure detection → recovery orchestration (system/recovery.py,
+mirroring the reference manager's dead-node flow): a dead worker's
+workloads return to the pool, a dead server's shard recovers from its
+replica, each exactly once."""
+
+import numpy as np
+
+from parameter_server_tpu.learner.workload_pool import Workload, WorkloadPool
+from parameter_server_tpu.parameter.replica import ReplicaManager
+from parameter_server_tpu.system.heartbeat import HeartbeatCollector, HeartbeatReport
+from parameter_server_tpu.system.recovery import RecoveryCoordinator
+
+
+def _collector(timeout=5.0):
+    c = HeartbeatCollector(timeout=timeout)
+    for nid in ("W0", "W1", "S0"):
+        c.report(nid, HeartbeatReport(hostname=nid))
+    return c
+
+
+def test_dead_worker_workload_restored():
+    c = _collector()
+    pool = WorkloadPool(Workload(files=["a", "b", "c"]))
+    got_w0 = pool.assign("W0")
+    pool.assign("W1")
+    assert got_w0 is not None
+
+    rc = RecoveryCoordinator(c)
+    rc.on_worker_dead(pool.restore)
+
+    # nothing dead yet
+    assert rc.check(now=c._last_seen["W0"] + 1) == []
+    # W0 goes silent past the timeout; W1 keeps reporting
+    late = c._last_seen["W0"] + 6
+    c.report("W1", HeartbeatReport())
+    c.report("S0", HeartbeatReport())
+    c._last_seen["W1"] = late
+    c._last_seen["S0"] = late
+    assert rc.check(now=late) == ["W0"]
+    # W0's files are assignable again — a live worker picks them up
+    again = pool.assign("W1")
+    assert again is not None
+    assert set(again.files) & set(got_w0.files)
+    # exactly-once: a second pass does not re-fire
+    assert rc.check(now=late + 1) == []
+
+
+def test_dead_server_recovers_from_replica(mesh8):
+    from parameter_server_tpu.parameter.kv_vector import KVVector
+
+    c = _collector()
+    kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False, name="table")
+    keys = np.array([1, 5, 9], dtype=np.int64)
+    kv.set_keys(0, keys)
+    kv.wait(kv.push(kv.request(channel=0), keys=keys, values=np.ones((3, 1), np.float32)))
+
+    rm = ReplicaManager()
+    rm.backup(kv)
+
+    # "S0 dies": wipe the table, as a replacement shard would start empty
+    kv.set_table(0, kv._zeros())
+    recovered = []
+
+    def recover_server(nid):
+        assert rm.recover(kv)
+        recovered.append(nid)
+
+    rc = RecoveryCoordinator(c)
+    rc.on_server_dead(recover_server)
+    assert rc.check(now=c._last_seen["S0"] + 6) != []
+    assert "S0" in recovered
+    np.testing.assert_allclose(kv.values(0, keys), np.ones((3, 1)))
+
+
+def test_revive_allows_redetection():
+    c = _collector()
+    rc = RecoveryCoordinator(c)
+    seen = []
+    rc.on_worker_dead(seen.append)
+    t0 = c._last_seen["W0"]
+    rc.check(now=t0 + 6)
+    rc.revive("W0")
+    rc.check(now=t0 + 12)
+    assert seen.count("W0") == 2
+
+
+def test_handler_exception_does_not_block_others():
+    c = _collector()
+    rc = RecoveryCoordinator(c)
+    calls = []
+    rc.on_worker_dead(lambda nid: (_ for _ in ()).throw(RuntimeError("boom")))
+    rc.on_worker_dead(calls.append)
+    t0 = c._last_seen["W0"]
+    handled = rc.check(now=t0 + 6)
+    assert "W0" in handled and "W0" in calls
