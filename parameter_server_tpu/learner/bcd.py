@@ -17,7 +17,7 @@ then apps drive per-block UPDATE_MODEL/EVALUATE_PROGRESS tasks. Here:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

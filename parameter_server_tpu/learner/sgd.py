@@ -11,7 +11,6 @@
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np

@@ -22,7 +22,6 @@ localizer/directory; out-of-range or padding entries use slot id ``P``
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -11,7 +11,7 @@ All functions must be called inside ``shard_map`` over the named axis.
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ bandwidth-bound) — this is the server-side compute of the parameter server.
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, Optional, Protocol
 
 import jax
@@ -32,7 +31,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..parallel import mesh as meshlib
-from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
+from ..parallel.mesh import SERVER_AXIS
 from ..system.message import Task
 from .parameter import KeyDirectory, Parameter, pad_slots
 

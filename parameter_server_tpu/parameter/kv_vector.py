@@ -29,7 +29,6 @@ from ..utils import file as psfile
 from ..ops import kv_ops
 from ..parallel import mesh as meshlib
 from ..system.message import Task
-from ..utils.range import Range
 from .parameter import KeyDirectory, Parameter, pad_slots
 
 

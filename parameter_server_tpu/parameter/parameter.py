@@ -18,7 +18,7 @@ Key directories come in two modes, both host-side:
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
