@@ -104,24 +104,26 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
 
 
 def parse_adfea(lines: List[str]) -> SparseBatch:
-    """ref ParseAdfea: "line_id; clicked; key:group_id key:group_id ...";
-    binary features, keys striped by group id."""
+    """ref ParseAdfea (text_parser.cc:90-121): tokens split on space/colon
+    are ``line_id 1 label key:slot_id key:slot_id ...`` — the LABEL is the
+    third token (the second is the constant example count "1"). Binary
+    features; keys striped by their slot (group) id."""
     labels, keys = [], []
     for line in lines:
-        toks = line.replace(";", " ").split()
-        if len(toks) < 2:
+        toks = line.replace(":", " ").split()
+        if len(toks) < 3:
             continue
         try:
-            label = float(toks[1])
+            label = float(toks[2])
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
         k = []
-        for tok in toks[2:]:
-            i, _, grp = tok.partition(":")
+        pairs = toks[3:]
+        for j in range(0, len(pairs) - 1, 2):
             try:
-                key = int(i)
-                g = int(grp) if grp else 0
+                key = int(pairs[j])
+                g = int(pairs[j + 1])
             except ValueError:
                 continue
             k.append(g * SLOT_SPACE + key % (SLOT_SPACE - 1))
@@ -130,26 +132,28 @@ def parse_adfea(lines: List[str]) -> SparseBatch:
 
 
 def parse_terafea(lines: List[str]) -> SparseBatch:
-    """ref ParseTerafea: "label |ns feature ..." VW-flavoured namespaces."""
+    """ref ParseTerafea (text_parser.cc:128-160): space-separated
+    ``label line_id separator key key ...``; the group id lives in the top
+    bits of each key (``key >> 54``) and the WHOLE key is the feature id,
+    so keys pass through unchanged (masked into the non-negative int64
+    range, keeping the reference's low-collision intent)."""
     labels, keys = [], []
     for line in lines:
-        parts = line.split("|")
-        head = parts[0].split()
-        if not head:
+        toks = line.split()
+        if len(toks) < 3:
             continue
         try:
-            label = float(head[0])
+            label = float(toks[0])
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
         k = []
-        for ns_block in parts[1:]:
-            toks = ns_block.split()
-            if not toks:
+        for tok in toks[3:]:
+            try:
+                key = int(tok)
+            except ValueError:
                 continue
-            ns = hash(toks[0]) & 0x3FF
-            for feat in toks[1:]:
-                k.append(ns * SLOT_SPACE + (hash(feat) & (SLOT_SPACE - 2)))
+            k.append(key & 0x7FFFFFFFFFFFFFFF)
         keys.append(np.asarray(k, dtype=np.int64))
     return _batch_from_rows(labels, keys, None)
 

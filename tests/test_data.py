@@ -56,7 +56,9 @@ class TestGoldenLines:
         assert b.indices[0] == 2 * SLOT_SPACE and b.values[0] == 2.0
 
     def test_adfea(self):
-        b = parse_adfea(["100;1;123:4 456:7", "101;0;789:2"])
+        # ref ParseAdfea tokens (split on " :"): line_id, "1", label, then
+        # key:slot pairs — text_parser.cc:90-121
+        b = parse_adfea(["100 1 1 123:4 456:7", "101 1 0 789:2"])
         assert b.n == 2 and b.nnz == 3
         np.testing.assert_array_equal(b.y, [1, -1])
         assert b.indices[0] == 4 * SLOT_SPACE + 123
@@ -64,10 +66,18 @@ class TestGoldenLines:
         assert b.binary
 
     def test_terafea(self):
-        b = parse_terafea(["1 |ns1 a b |ns2 c", "-1 |ns1 a"])
+        # ref ParseTerafea: "label line_id separator key key ..."; group id
+        # rides in key >> 54, whole key is the feature id
+        k1 = (3 << 54) | 123
+        k2 = (3 << 54) | 456
+        k3 = (9 << 54) | 123
+        b = parse_terafea([f"1 1000 | {k1} {k2} {k3}", f"-1 1001 | {k1}"])
         assert b.n == 2 and b.nnz == 4
-        # same namespace+feature maps to the same key across rows
-        assert b.indices[0] == b.indices[3]
+        np.testing.assert_array_equal(b.y, [1, -1])
+        # whole-key identity: same key maps identically across rows,
+        # different group bits keep same low bits distinct
+        assert b.indices[0] == b.indices[3] == k1
+        assert b.indices[2] == k3 != k1
 
     def test_ps_sparse(self):
         b = parse_ps_sparse(["1;2 3:0.5 4:1.5;7 9:2;", "-1;2 3:1;"])
