@@ -44,6 +44,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint32, u8p,
     ]
     lib.ps_hash_slots_packbits.restype = None
+    lib.ps_murmur3_x64_128.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32, u64p,
+    ]
+    lib.ps_murmur3_x64_128.restype = None
     for name in ("ps_parse_libsvm", "ps_parse_criteo"):
         fn = getattr(lib, name)
         fn.argtypes = [

@@ -94,6 +94,76 @@ void ps_hash_slots(const uint64_t* keys, uint64_t n, uint64_t seed,
 }
 
 // ---------------------------------------------------------------------------
+// MurmurHash3 x64 128-bit (Austin Appleby's public-domain algorithm; the
+// reference's util/murmurhash3.cc uses the same function — criteo
+// categorical tokens are keyed by h[0]^h[1] with seed 512927377, so this
+// must be the real thing, bit-for-bit).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t rotl64(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t fmix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdull;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ull;
+  k ^= k >> 33;
+  return k;
+}
+
+void ps_murmur3_x64_128(const uint8_t* data, uint64_t len, uint32_t seed,
+                        uint64_t* out) {
+  const uint64_t nblocks = len / 16;
+  uint64_t h1 = seed, h2 = seed;
+  const uint64_t c1 = 0x87c37b91114253d5ull;
+  const uint64_t c2 = 0x4cf5ad432745937full;
+
+  for (uint64_t i = 0; i < nblocks; ++i) {
+    uint64_t k1, k2;
+    memcpy(&k1, data + i * 16, 8);
+    memcpy(&k2, data + i * 16 + 8, 8);
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52dce729ull;
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495ab5ull;
+  }
+
+  const uint8_t* tail = data + nblocks * 16;
+  uint64_t k1 = 0, k2 = 0;
+  switch (len & 15) {
+    case 15: k2 ^= (uint64_t)tail[14] << 48;  // fallthrough
+    case 14: k2 ^= (uint64_t)tail[13] << 40;  // fallthrough
+    case 13: k2 ^= (uint64_t)tail[12] << 32;  // fallthrough
+    case 12: k2 ^= (uint64_t)tail[11] << 24;  // fallthrough
+    case 11: k2 ^= (uint64_t)tail[10] << 16;  // fallthrough
+    case 10: k2 ^= (uint64_t)tail[9] << 8;    // fallthrough
+    case 9:
+      k2 ^= (uint64_t)tail[8];
+      k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+      // fallthrough
+    case 8: k1 ^= (uint64_t)tail[7] << 56;  // fallthrough
+    case 7: k1 ^= (uint64_t)tail[6] << 48;  // fallthrough
+    case 6: k1 ^= (uint64_t)tail[5] << 40;  // fallthrough
+    case 5: k1 ^= (uint64_t)tail[4] << 32;  // fallthrough
+    case 4: k1 ^= (uint64_t)tail[3] << 24;  // fallthrough
+    case 3: k1 ^= (uint64_t)tail[2] << 16;  // fallthrough
+    case 2: k1 ^= (uint64_t)tail[1] << 8;   // fallthrough
+    case 1:
+      k1 ^= (uint64_t)tail[0];
+      k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= len; h2 ^= len;
+  h1 += h2; h2 += h1;
+  h1 = fmix64(h1); h2 = fmix64(h2);
+  h1 += h2; h2 += h1;
+  out[0] = h1;
+  out[1] = h2;
+}
+
+// ---------------------------------------------------------------------------
 // Bit-packed wire format for slot-id streams. The host→device link is the
 // pipeline's scarce resource; slot ids for a table of S entries need only
 // ceil(log2 S) bits each, so we ship a little-endian bitstream instead of
@@ -233,9 +303,12 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
   return row;
 }
 
-// criteo tsv: "label \t i1..i13 numeric \t c14..c39 hex-categorical"
-// (ref data/text_parser.cc ParseCriteo: numeric slots keyed by slot id,
-// categorical values hashed into a per-slot key space)
+// criteo tsv: "label \t i1..i13 ints \t c14..c39 categorical tokens".
+// Reference semantics (data/text_parser.cc ParseCriteo): ALL features are
+// BINARY keys — integer slot i with count c becomes key kMaxKey/13*i + c
+// (one-hot by count), and a categorical token longer than 4 chars hashes
+// through MurmurHash3_x64_128(seed 512927377) to h[0]^h[1]. Lines missing
+// the integer-field tabs are dropped, as the reference returns false.
 int64_t ps_parse_criteo(const char* buf, int64_t len,
                         float* y, int64_t* indptr, uint64_t* indices,
                         float* values, int64_t max_rows, int64_t max_nnz,
@@ -244,39 +317,48 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
   const char* end = buf + len;
   int64_t row = 0, nnz = 0;
   indptr[0] = 0;
-  const uint64_t kSlotSpace = 1ull << 52;  // per-slot key stripe
+  const uint64_t kStripe = 0xFFFFFFFFFFFFFFFFull / 13;  // kMaxKey / 13
   while (p < end && row < max_rows) {
     const char* line_end = (const char*)memchr(p, '\n', end - p);
     if (!line_end) line_end = end;
     if (p >= line_end) { p = line_end + 1; continue; }
+    int64_t row_nnz_start = nnz;
     char* q;
-    long label = strtol(p, &q, 10);
-    if (q == p) { p = line_end + 1; continue; }
-    p = q;
-    int slot = 0;
-    while (p < line_end && slot < 39) {
-      if (*p != '\t') break;
-      ++p;  // consume tab
-      ++slot;
-      if (p >= line_end || *p == '\t') continue;  // missing field
-      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
-      if (slot <= 13) {  // integer feature: value = log-ish raw, key = slot
+    double label = strtod(p, &q);
+    const char* f = (const char*)memchr(p, '\t', line_end - p);
+    if (q == p || !f) { p = line_end + 1; continue; }
+    p = f + 1;
+    int ok = 1;
+    for (int i = 0; i < 13; ++i) {  // integer count features
+      f = (const char*)memchr(p, '\t', line_end - p);
+      if (!f) { ok = 0; break; }  // ref: missing int tab drops the line
+      if (f > p) {
         char* e;
-        double v = strtod(p, &e);
-        if (e == p) { continue; }
-        indices[nnz] = (uint64_t)slot * kSlotSpace;
-        values[nnz] = (float)v;
-        ++nnz;
-        p = e;
-      } else {  // categorical: 8-hex-char id, hashed into slot stripe
-        char* e;
-        uint64_t h = strtoull(p, &e, 16);
-        if (e == p) { continue; }
-        indices[nnz] = (uint64_t)slot * kSlotSpace + (h % (kSlotSpace - 1)) + 1;
+        long cnt = strtol(p, &e, 10);
+        if (e != p) {
+          if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
+          indices[nnz] = kStripe * (uint64_t)i + (uint64_t)(int64_t)cnt;
+          values[nnz] = 1.0f;
+          ++nnz;
+        }
+      }
+      p = f + 1;
+    }
+    if (!ok) { nnz = row_nnz_start; p = line_end + 1; continue; }
+    for (int i = 0; i < 26 && p <= line_end; ++i) {  // categorical tokens
+      f = (const char*)memchr(p, '\t', line_end - p);
+      const char* tok_end = f ? f : line_end;
+      int64_t n = tok_end - p;
+      if (n > 4) {  // ref: short/empty tokens are skipped
+        if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
+        uint64_t h[2];
+        ps_murmur3_x64_128((const uint8_t*)p, (uint64_t)n, 512927377u, h);
+        indices[nnz] = h[0] ^ h[1];
         values[nnz] = 1.0f;
         ++nnz;
-        p = e;
       }
+      if (!f) break;
+      p = f + 1;
     }
     y[row] = label > 0 ? 1.0f : -1.0f;
     indptr[++row] = nnz;

@@ -67,40 +67,44 @@ def parse_libsvm(lines: List[str]) -> SparseBatch:
     return _batch_from_rows(labels, keys, vals)
 
 
+_CRITEO_STRIPE = ((1 << 64) - 1) // 13  # ref: kMaxKey / 13
+_CRITEO_SEED = 512927377
+
+
 def parse_criteo(lines: List[str]) -> SparseBatch:
-    """label\\t13 ints\\t26 hex cats; numeric slots 1-13 keyed by slot id,
-    categorical slots 14-39 hashed into per-slot stripes (ref ParseCriteo)."""
-    labels, keys, vals = [], [], []
+    """label\\t13 ints\\t26 categorical tokens — reference semantics
+    (ParseCriteo, text_parser.cc): ALL features are BINARY keys. Integer
+    slot i with count c → key ``kMaxKey/13*i + c`` (one-hot by count);
+    categorical tokens longer than 4 chars → ``h0 ^ h1`` of
+    MurmurHash3_x64_128(token, seed 512927377). Lines missing the 13
+    integer tab fields are dropped (the reference returns false)."""
+    from ..utils.murmur import murmur3_x64_128
+
+    labels, keys = [], []
     for line in lines:
         f = line.rstrip("\n").split("\t")
-        if len(f) < 2:
+        if len(f) < 14:  # label + 13 ints minimum, as the reference demands
             continue
         try:
-            label = int(f[0])
+            label = float(f[0])
         except ValueError:
             continue
-        labels.append(1.0 if label > 0 else -1.0)
-        k, v = [], []
-        for slot, tok in enumerate(f[1:40], start=1):
+        k = []
+        for i, tok in enumerate(f[1:14]):
             if not tok:
                 continue
-            if slot <= 13:
-                try:
-                    x = float(tok)
-                except ValueError:
-                    continue
-                k.append(slot * SLOT_SPACE)
-                v.append(x)
-            else:
-                try:
-                    h = int(tok, 16)
-                except ValueError:
-                    continue
-                k.append(slot * SLOT_SPACE + h % (SLOT_SPACE - 1) + 1)
-                v.append(1.0)
-        keys.append(np.asarray(k, dtype=np.int64))
-        vals.append(np.asarray(v, dtype=np.float32))
-    return _batch_from_rows(labels, keys, vals)
+            try:
+                cnt = int(tok)
+            except ValueError:
+                continue
+            k.append((_CRITEO_STRIPE * i + cnt) & ((1 << 64) - 1))
+        for tok in f[14:40]:
+            if len(tok) > 4:
+                h0, h1 = murmur3_x64_128(tok.encode(), _CRITEO_SEED)
+                k.append(h0 ^ h1)
+        labels.append(1.0 if label > 0 else -1.0)
+        keys.append(np.asarray(k, dtype=np.uint64).view(np.int64))
+    return _batch_from_rows(labels, keys, None)
 
 
 def parse_adfea(lines: List[str]) -> SparseBatch:
@@ -286,11 +290,14 @@ def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBa
             # the value budget was hit mid-stream — retry with a bigger buffer
             max_nnz *= 2
             continue
+        # .view keeps the raw 64 bits for keys >= 2^63 (criteo murmur keys)
         return SparseBatch(
             y=y[:rows].copy(),
             indptr=indptr[: rows + 1].copy(),
-            indices=indices[:nnz].astype(np.int64),
-            values=values[:nnz].copy(),
+            indices=indices[:nnz].view(np.int64).copy(),
+            # criteo is a binary format in the reference (all keys, no
+            # values); the C ABI still fills 1.0s, dropped here
+            values=None if fn_name == "ps_parse_criteo" else values[:nnz].copy(),
         )
 
 

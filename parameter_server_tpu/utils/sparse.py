@@ -35,6 +35,10 @@ class SparseBatch:
     indices: np.ndarray  # [nnz] int64 — feature keys (global or localized)
     values: Optional[np.ndarray] = None  # [nnz] float32, None if binary
     num_cols: Optional[int] = None  # p; None = max(indices)+1
+    # per-entry feature-group ids (ref Example proto slots) for formats
+    # whose keys don't encode the group (criteo's global hash keys);
+    # transforms that reindex entries may drop this side channel
+    slot_ids: Optional[np.ndarray] = None  # [nnz] int16 or None
 
     @property
     def n(self) -> int:
@@ -94,6 +98,7 @@ class SparseBatch:
             indices=self.indices[lo:hi],
             values=None if self.binary else self.values[lo:hi],
             num_cols=self.num_cols,
+            slot_ids=None if self.slot_ids is None else self.slot_ids[lo:hi],
         )
 
     def pad_device(

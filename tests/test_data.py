@@ -38,22 +38,57 @@ class TestGoldenLines:
         assert b.n == 1 and b.indices[0] == 5
 
     def test_criteo(self):
+        from parameter_server_tpu.data.text_parser import _CRITEO_STRIPE
+        from parameter_server_tpu.utils.murmur import murmur3_x64_128
+
         line = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + "\t".join(
             ["68fd1e64"] * 26
         )
         b = parse_criteo([line, line.replace("1\t", "0\t", 1)])
-        assert b.n == 2 and b.nnz == 78
+        assert b.n == 2 and b.nnz == 78 and b.binary
         np.testing.assert_array_equal(b.y, [1, -1])
-        # numeric slots carry values at the slot-stripe base key
-        assert b.indices[0] == 1 * SLOT_SPACE and b.values[0] == 1.0
-        assert b.indices[12] == 13 * SLOT_SPACE and b.values[12] == 13.0
-        # categorical slots: hashed into per-slot stripes, binary value
-        assert b.indices[13] // SLOT_SPACE == 14 and b.values[13] == 1.0
+        # reference key construction: integer slot i, count c -> binary key
+        # kMaxKey/13*i + c (ParseCriteo, text_parser.cc)
+        assert np.uint64(b.indices[0]) == np.uint64(1)  # i=0, cnt=1
+        assert np.uint64(b.indices[12]) == np.uint64(
+            (_CRITEO_STRIPE * 12 + 13) & ((1 << 64) - 1)
+        )
+        # categorical tokens: murmur3_x64_128 h0^h1, seed 512927377
+        h0, h1 = murmur3_x64_128(b"68fd1e64", 512927377)
+        assert np.uint64(b.indices[13]) == np.uint64(h0 ^ h1)
 
     def test_criteo_missing_fields(self):
-        b = parse_criteo(["1\t\t2\t" + "\t".join([""] * 36)])
-        assert b.n == 1 and b.nnz == 1  # only numeric slot 2 present
-        assert b.indices[0] == 2 * SLOT_SPACE and b.values[0] == 2.0
+        # empty int fields skipped; short (<5 char) categorical tokens
+        # skipped; a line without the 13 int tabs is dropped entirely
+        ints = ["", "2"] + [""] * 11
+        cats = ["abc"] + ["longtoken"] + [""] * 24
+        b = parse_criteo(
+            ["1\t" + "\t".join(ints) + "\t" + "\t".join(cats), "1\t2\t3"]
+        )
+        assert b.n == 1 and b.nnz == 2
+        from parameter_server_tpu.data.text_parser import _CRITEO_STRIPE
+
+        # the surviving int feature: slot i=1 (second field), count 2
+        assert np.uint64(b.indices[0]) == np.uint64(
+            (_CRITEO_STRIPE * 1 + 2) & ((1 << 64) - 1)
+        )
+
+    def test_criteo_python_matches_native(self):
+        from parameter_server_tpu.data.text_parser import _parse_native
+
+        rng = np.random.default_rng(3)
+        lines = []
+        for _ in range(50):
+            ints = [str(rng.integers(-2, 50)) if rng.random() > 0.2 else "" for _ in range(13)]
+            cats = [f"{rng.integers(0, 1 << 32):08x}" if rng.random() > 0.3 else "ab" for _ in range(26)]
+            lines.append(f"{rng.integers(0, 2)}\t" + "\t".join(ints) + "\t" + "\t".join(cats))
+        py = parse_criteo(lines)
+        cc = _parse_native(("\n".join(lines) + "\n").encode(), "ps_parse_criteo", 60)
+        if cc is None:  # no native lib in this environment
+            return
+        np.testing.assert_array_equal(py.indices, cc.indices)
+        np.testing.assert_array_equal(py.indptr, cc.indptr)
+        np.testing.assert_array_equal(py.y, cc.y)
 
     def test_adfea(self):
         # ref ParseAdfea tokens (split on " :"): line_id, "1", label, then
@@ -133,7 +168,9 @@ class TestNativeParity:
         np.testing.assert_array_equal(a.y, b.y)
         np.testing.assert_array_equal(a.indptr, b.indptr)
         np.testing.assert_array_equal(a.indices, b.indices)
-        np.testing.assert_allclose(a.values, b.values)
+        assert a.binary == b.binary
+        if not a.binary:
+            np.testing.assert_allclose(a.values, b.values)
 
 
 class TestShippedConfigs:

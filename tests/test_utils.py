@@ -281,3 +281,31 @@ class TestBitpack:
         packed = np.packbits(y > 0, bitorder="little")
         out = jax.jit(lambda b: bitpack.unpack_sign_bits(b, y.size))(packed)
         np.testing.assert_array_equal(np.asarray(out), y)
+
+
+class TestMurmur3:
+    """Real MurmurHash3 x64 128 (ref util/murmurhash3.cc; criteo keys)."""
+
+    def test_python_matches_cpp(self):
+        import parameter_server_tpu.cpp as cpp
+        from parameter_server_tpu.utils.murmur import murmur3_x64_128
+
+        if cpp.native() is None:
+            return
+        tests = [b"", b"a", b"hello", b"0a1b2c3d", b"x" * 15, b"y" * 16, b"z" * 33]
+        want = [murmur3_x64_128(t, 512927377) for t in tests]
+        real = cpp.native
+        cpp.native = lambda: None
+        try:
+            got = [murmur3_x64_128(t, 512927377) for t in tests]
+        finally:
+            cpp.native = real
+        assert want == got
+
+    def test_deterministic_and_seeded(self):
+        from parameter_server_tpu.utils.murmur import murmur3_x64_128
+
+        a = murmur3_x64_128(b"token", 512927377)
+        assert a == murmur3_x64_128(b"token", 512927377)
+        assert a != murmur3_x64_128(b"token", 1)
+        assert a != murmur3_x64_128(b"tokeN", 512927377)
