@@ -23,6 +23,15 @@ G/U are segment-sums over static-shape COO column blocks followed by a
 psum over the data axis — that psum IS the worker→server gradient push of
 the reference, and the broadcasted shrink result IS the server→worker
 weight pull.
+
+Bounded delay τ (ref darlin.h AddWaitTime / Submit with wait ≤ τ): block
+steps are submitted through the Executor with a dependency on step
+``ts − τ − 1``, so up to τ+1 block updates are in flight. All block state
+(w/δ/active per block, the dual) stays device-resident; the host never
+blocks on a step's result inside a pass, it only waits for the bounded-
+delay horizon — XLA's async dispatch pipelines the queued steps while the
+host prepares the next submissions, reproducing the reference's overlap
+of block compute with communication.
 """
 
 from __future__ import annotations
@@ -79,15 +88,19 @@ class DarlinSolver:
         self.eta = float(conf.learning_rate.alpha)
         self.n_workers = meshlib.num_workers(self.mesh)
         self._block_steps: Dict[Tuple[int, int], object] = {}
-        # host state, set by init_data
+        # device state, set by init_data
         self.y: Optional[jax.Array] = None
         self.dual: Optional[jax.Array] = None
         self.row_mask: Optional[jax.Array] = None
-        self.w: Optional[np.ndarray] = None
-        self.delta: Optional[np.ndarray] = None
-        self.active: Optional[np.ndarray] = None
+        # per-block device-resident model state (jax arrays) — the host
+        # never syncs on these inside a pass (τ-delay pipelining)
+        self.w_blk: List[jax.Array] = []
+        self.delta_blk: List[jax.Array] = []
+        self.active_blk: List[jax.Array] = []
+        self.fea_blocks: List[FeatureBlock] = []
         self.blocks: List[ColBlock] = []
         self.num_ex = 0
+        self.num_cols = 0
         self.rows_per_shard = 0
 
     # -- preprocessing (ref BCDWorker::PreprocessData) --
@@ -110,9 +123,16 @@ class DarlinSolver:
         self.dual = jax.device_put(jnp.ones((d, per), jnp.float32), batch_sh)
 
         f = data.cols
-        self.w = np.zeros(f, np.float32)
-        self.delta = np.full(f, self.bcd.delta_init_value, np.float32)
-        self.active = np.ones(f, bool)
+        self.num_cols = f
+        self.fea_blocks = list(fea_blocks)
+        self.w_blk, self.delta_blk, self.active_blk = [], [], []
+        for blk in fea_blocks:
+            c = blk.col_range.size()
+            self.w_blk.append(jnp.zeros(c, jnp.float32))
+            self.delta_blk.append(
+                jnp.full(c, self.bcd.delta_init_value, jnp.float32)
+            )
+            self.active_blk.append(jnp.ones(c, bool))
 
         # build per-block static COO (cols local to block, rows local to shard)
         csc = data.to_csc()
@@ -224,21 +244,20 @@ class DarlinSolver:
         self._block_steps[key] = step
         return step
 
-    def update_block(
-        self, blk_id: int, fea_blocks: List[FeatureBlock], thr: float, reset: bool
-    ) -> float:
-        """One block update; returns the block's KKT violation."""
-        blk = fea_blocks[blk_id]
+    def dispatch_block(self, blk_id: int, thr: float, reset: bool) -> jax.Array:
+        """Dispatch one block update WITHOUT host sync; returns the block's
+        KKT violation as an async device scalar (ref Submit(UPDATE_MODEL)).
+
+        The new block state replaces the device references immediately —
+        XLA's dependency tracking chains consecutive steps through the
+        shared dual, so program order is preserved while the host runs
+        ahead (bounded by the scheduler's τ horizon)."""
         data = self.blocks[blk_id]
-        c0, c1 = blk.col_range.begin, blk.col_range.end
         step = self._get_step(data.num_cols, data.vals.shape[-1])
-        w_b = jnp.asarray(self.w[c0:c1])
-        delta_b = jnp.asarray(self.delta[c0:c1])
-        active_b = jnp.asarray(self.active[c0:c1])
         new_w, new_delta, new_active, new_dual, violation = step(
-            w_b,
-            delta_b,
-            active_b,
+            self.w_blk[blk_id],
+            self.delta_blk[blk_id],
+            self.active_blk[blk_id],
             self.dual,
             self.y,
             self.row_mask,
@@ -248,11 +267,42 @@ class DarlinSolver:
             jnp.float32(thr),
             jnp.int32(1 if reset else 0),
         )
-        self.w[c0:c1] = np.asarray(new_w)
-        self.delta[c0:c1] = np.asarray(new_delta)
-        self.active[c0:c1] = np.asarray(new_active)
+        self.w_blk[blk_id] = new_w
+        self.delta_blk[blk_id] = new_delta
+        self.active_blk[blk_id] = new_active
         self.dual = new_dual
-        return float(violation)
+        return violation
+
+    def update_block(
+        self, blk_id: int, fea_blocks: List[FeatureBlock], thr: float, reset: bool
+    ) -> float:
+        """Synchronous single-block update (parity tests / debugging)."""
+        del fea_blocks  # block geometry is fixed at init_data
+        return float(self.dispatch_block(blk_id, thr, reset))
+
+    def reset_active(self) -> None:
+        """Re-activate every coordinate (ref reset_kkt_filter → fill(true))."""
+        self.active_blk = [jnp.ones_like(a) for a in self.active_blk]
+
+    # -- host views of the device-resident model (materialize on demand) --
+
+    def _assemble(self, parts: List[jax.Array], fill, dtype) -> np.ndarray:
+        out = np.full(self.num_cols, fill, dtype)
+        for blk, p in zip(self.fea_blocks, parts):
+            out[blk.col_range.begin : blk.col_range.end] = np.asarray(p)
+        return out
+
+    @property
+    def w(self) -> np.ndarray:
+        return self._assemble(self.w_blk, 0.0, np.float32)
+
+    @property
+    def delta(self) -> np.ndarray:
+        return self._assemble(self.delta_blk, self.bcd.delta_init_value, np.float32)
+
+    @property
+    def active(self) -> np.ndarray:
+        return self._assemble(self.active_blk, True, bool)
 
     # -- evaluation (ref DarlinServer::Evaluate + worker objective) --
 
@@ -261,9 +311,10 @@ class DarlinSolver:
         dual = np.asarray(self.dual)
         mask = np.asarray(self.row_mask) > 0
         logloss = float(np.log1p(1.0 / dual[mask]).sum())
+        w = self.w  # materialize the device blocks once
         return BCDProgress(
-            objective=logloss + self.lam * float(np.abs(self.w).sum()),
-            nnz_w=int((self.w != 0).sum()),
+            objective=logloss + self.lam * float(np.abs(w).sum()),
+            nnz_w=int((w != 0).sum()),
             nnz_active_set=int(self.active.sum()),
         )
 
@@ -298,6 +349,15 @@ class DarlinScheduler(BCDScheduler):
         self.solver = DarlinSolver(conf, mesh=mesh)
         self.seed = 0
         self._converged_once = False
+        # τ-delay instrumentation. max_dispatch_window counts steps the host
+        # submitted without waiting for completion (the bounded-delay window
+        # the scheduler is ALLOWED to run ahead — deterministic, = τ+1 when
+        # enough blocks exist). max_in_flight_observed probes jax.Array
+        # .is_ready() at submit time: steps whose device computation had
+        # genuinely not finished yet (timing-dependent; reported, the window
+        # is what tests assert on).
+        self.max_dispatch_window = 0
+        self.max_in_flight_observed = 0
 
     def run_on(self, data: SparseBatch, verbose: bool = False) -> BCDProgress:
         self.set_data(data)
@@ -312,13 +372,18 @@ class DarlinScheduler(BCDScheduler):
         blocks = self.divide_feature_blocks()
         self.solver.init_data(localized, blocks)
 
-        tau = self.bcd_conf.max_block_delay
+        from ...system.executor import Executor
+        from ...system.message import Task
+
+        # bounded block delay τ (ref darlin.h AddWaitTime: step ts waits on
+        # everything up to ts − τ − 1, so ≤ τ+1 block tasks are in flight)
+        tau = max(0, self.bcd_conf.max_block_delay)
+        executor = Executor(name=self.name)
         kkt_threshold = 1e20
         reset_kkt = False
         rng = random.Random(self.seed)
         prev_objv = None
         prog = BCDProgress()
-        del tau  # device queue serializes steps; τ staleness is a no-op here
         for iteration in range(self.bcd_conf.num_data_pass):
             order = list(self.blk_order)
             if self.bcd_conf.random_feature_block_order:
@@ -326,14 +391,35 @@ class DarlinScheduler(BCDScheduler):
             if reset_kkt:
                 # reference resets the active set for ALL groups
                 # (darlin.h Update: reset_kkt_filter -> fill(true) per grp)
-                self.solver.active[:] = True
+                self.solver.reset_active()
                 reset_kkt = False
-            violation = 0.0
+            pass_start = executor.time()
+            vio_futs = {}
             for blk_id in order:
-                vio = self.solver.update_block(
-                    blk_id, self.fea_blk, kkt_threshold, reset=False
+                ts_next = executor.time()
+                dep = ts_next - (tau + 1)
+                task = Task(wait_time=[dep] if dep >= pass_start else [])
+                ts = executor.submit(
+                    lambda b=blk_id, t=kkt_threshold: self.solver.dispatch_block(
+                        b, t, reset=False
+                    ),
+                    task,
                 )
-                violation = max(violation, vio)
+                vio_futs[ts] = executor.result(ts)
+                window = sum(
+                    1 for t in vio_futs if not executor.tracker.is_finished(t)
+                )
+                self.max_dispatch_window = max(self.max_dispatch_window, window)
+                in_flight = sum(
+                    1
+                    for t, v in vio_futs.items()
+                    if not executor.tracker.is_finished(t) and not v.is_ready()
+                )
+                self.max_in_flight_observed = max(
+                    self.max_in_flight_observed, in_flight
+                )
+            executor.wait_all()
+            violation = max(float(v) for v in vio_futs.values()) if vio_futs else 0.0
             prog = self.solver.evaluate()
             prog.violation = violation
             if prev_objv is not None and prev_objv > 0:

@@ -52,7 +52,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, name)
         fn.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,
-            f32p, i64p, u64p, f32p,
+            f32p, i64p, u64p, f32p, i32p,
             ctypes.c_int64, ctypes.c_int64, i64p,
         ]
         fn.restype = ctypes.c_int64

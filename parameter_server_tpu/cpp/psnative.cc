@@ -251,7 +251,10 @@ void ps_hash_slots_packbits(const uint64_t* keys, uint64_t n, uint64_t seed,
 // ps_parse_* return contract: returns #examples parsed (NEGATED minus one,
 // i.e. -(rows+1), when the value-capacity budget was hit mid-stream so the
 // caller can retry with a bigger buffer), fills nnz via out_nnz (rolled
-// back to the last complete row on a capacity stop).
+// back to the last complete row on a capacity stop). `slots` (nullable)
+// receives the per-entry feature-group id, matching the reference Example
+// proto's Slot.id (data/text_parser.cc: libsvm features live in slot 1;
+// criteo int feature i → slot i+1, categorical i → slot i+14).
 // ---------------------------------------------------------------------------
 
 static inline const char* skip_ws(const char* p, const char* end) {
@@ -262,8 +265,8 @@ static inline const char* skip_ws(const char* p, const char* end) {
 // libsvm: "label idx:val idx:val ..." (ref data/text_parser.cc ParseLibsvm)
 int64_t ps_parse_libsvm(const char* buf, int64_t len,
                         float* y, int64_t* indptr, uint64_t* indices,
-                        float* values, int64_t max_rows, int64_t max_nnz,
-                        int64_t* out_nnz) {
+                        float* values, int32_t* slots, int64_t max_rows,
+                        int64_t max_nnz, int64_t* out_nnz) {
   const char* p = buf;
   const char* end = buf + len;
   int64_t row = 0, nnz = 0;
@@ -291,6 +294,7 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
       if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
       indices[nnz] = idx;
       values[nnz] = (float)val;
+      if (slots) slots[nnz] = 1;
       ++nnz;
       p = e2;
     }
@@ -308,11 +312,13 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
 // BINARY keys — integer slot i with count c becomes key kMaxKey/13*i + c
 // (one-hot by count), and a categorical token longer than 4 chars hashes
 // through MurmurHash3_x64_128(seed 512927377) to h[0]^h[1]. Lines missing
-// the integer-field tabs are dropped, as the reference returns false.
+// the integer-field tabs are dropped, as the reference returns false; a
+// tab missing before the 25th categorical field likewise drops the line
+// (ParseCriteo: `if (pp == NULL) { if (i != 25) return false; }`).
 int64_t ps_parse_criteo(const char* buf, int64_t len,
                         float* y, int64_t* indptr, uint64_t* indices,
-                        float* values, int64_t max_rows, int64_t max_nnz,
-                        int64_t* out_nnz) {
+                        float* values, int32_t* slots, int64_t max_rows,
+                        int64_t max_nnz, int64_t* out_nnz) {
   const char* p = buf;
   const char* end = buf + len;
   int64_t row = 0, nnz = 0;
@@ -339,14 +345,16 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
           if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
           indices[nnz] = kStripe * (uint64_t)i + (uint64_t)(int64_t)cnt;
           values[nnz] = 1.0f;
+          if (slots) slots[nnz] = i + 1;
           ++nnz;
         }
       }
       p = f + 1;
     }
     if (!ok) { nnz = row_nnz_start; p = line_end + 1; continue; }
-    for (int i = 0; i < 26 && p <= line_end; ++i) {  // categorical tokens
-      f = (const char*)memchr(p, '\t', line_end - p);
+    for (int i = 0; i < 26; ++i) {  // categorical tokens
+      f = (p <= line_end) ? (const char*)memchr(p, '\t', line_end - p) : NULL;
+      if (!f && i != 25) { ok = 0; break; }  // ref: missing cat tab drops line
       const char* tok_end = f ? f : line_end;
       int64_t n = tok_end - p;
       if (n > 4) {  // ref: short/empty tokens are skipped
@@ -355,11 +363,12 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
         ps_murmur3_x64_128((const uint8_t*)p, (uint64_t)n, 512927377u, h);
         indices[nnz] = h[0] ^ h[1];
         values[nnz] = 1.0f;
+        if (slots) slots[nnz] = i + 14;
         ++nnz;
       }
-      if (!f) break;
-      p = f + 1;
+      p = tok_end + 1;
     }
+    if (!ok) { nnz = row_nnz_start; p = line_end + 1; continue; }
     y[row] = label > 0 ? 1.0f : -1.0f;
     indptr[++row] = nnz;
     p = line_end + 1;

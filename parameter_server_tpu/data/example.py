@@ -56,23 +56,30 @@ class ExampleInfo:
 
 
 def batch_to_bytes(batch: SparseBatch) -> bytes:
-    """Serialize a SparseBatch (the Example-records payload)."""
+    """Serialize a SparseBatch (the Example-records payload).
+
+    The third header word is a flags field: bit0 = binary (no values), bit1 =
+    slot ids present (ref example.proto Slot.id, appended after values).
+    Pre-slot files wrote 0/1 here, so they decode unchanged.
+    """
     buf = io.BytesIO()
     buf.write(_MAGIC)
-    binary = 1 if batch.binary else 0
-    buf.write(struct.pack("<qqq", batch.n, batch.nnz, binary))
+    flags = (1 if batch.binary else 0) | (2 if batch.slot_ids is not None else 0)
+    buf.write(struct.pack("<qqq", batch.n, batch.nnz, flags))
     buf.write(batch.y.astype(np.float32).tobytes())
     buf.write(batch.indptr.astype(np.int64).tobytes())
     buf.write(batch.indices.astype(np.int64).tobytes())
-    if not binary:
+    if not batch.binary:
         buf.write(batch.values.astype(np.float32).tobytes())
+    if batch.slot_ids is not None:
+        buf.write(batch.slot_ids.astype(np.int32).tobytes())
     return buf.getvalue()
 
 
 def batch_from_bytes(data: bytes) -> SparseBatch:
     if data[:4] != _MAGIC:
         raise IOError("bad batch magic")
-    n, nnz, binary = struct.unpack_from("<qqq", data, 4)
+    n, nnz, flags = struct.unpack_from("<qqq", data, 4)
     off = 4 + 24
     y = np.frombuffer(data, np.float32, n, off).copy()
     off += 4 * n
@@ -81,6 +88,10 @@ def batch_from_bytes(data: bytes) -> SparseBatch:
     indices = np.frombuffer(data, np.int64, nnz, off).copy()
     off += 8 * nnz
     values = None
-    if not binary:
+    if not (flags & 1):
         values = np.frombuffer(data, np.float32, nnz, off).copy()
-    return SparseBatch(y=y, indptr=indptr, indices=indices, values=values)
+        off += 4 * nnz
+    slot_ids = None
+    if flags & 2:
+        slot_ids = np.frombuffer(data, np.int32, nnz, off).copy()
+    return SparseBatch(y=y, indptr=indptr, indices=indices, values=values, slot_ids=slot_ids)

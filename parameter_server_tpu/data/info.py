@@ -15,7 +15,9 @@ def info_from_batch(batch: SparseBatch, split_slots: bool = True) -> ExampleInfo
     info = ExampleInfo(num_ex=batch.n)
     if batch.nnz == 0:
         return info
-    if split_slots:
+    if split_slots and batch.slot_ids is not None:
+        slot_of = batch.slot_ids.astype(np.int64)
+    elif split_slots:
         slot_of = (batch.indices // SLOT_SPACE).astype(np.int64)
     else:
         slot_of = np.zeros(batch.nnz, np.int64)

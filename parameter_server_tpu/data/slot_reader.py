@@ -1,11 +1,14 @@
 """Slot reader: grouped-feature column cache for BCD preprocessing.
 
 Counterpart of ``src/data/slot_reader.{h,cc}``: the reference reads all
-files once, splits features into their slots (feature groups), and caches
-each slot's CSC arrays (offset/index/value) compressed on disk so darlin
-can load one feature group at a time. Here: slots are derived from the
-key striping the parsers emit (key // SLOT_SPACE), and per-slot CSR
-partitions are cached as .npz under a cache dir.
+files once, splits features into their slots (feature groups, Example
+proto Slot.id), and caches each slot's CSC arrays (offset/index/value)
+compressed on disk so darlin can load one feature group at a time. Here:
+slots come from the per-entry slot ids the parsers emit
+(``SparseBatch.slot_ids``, matching ``text_parser.cc`` Slot.set_id); for
+batches without that side channel (e.g. synthetic data) they fall back to
+the key striping (key // SLOT_SPACE). Per-slot CSR partitions are cached
+as .npz under a cache dir.
 """
 
 from __future__ import annotations
@@ -53,7 +56,10 @@ class SlotReader:
         if batch is None:
             return self.info
         self._labels = batch.y
-        slot_of = (batch.indices // SLOT_SPACE).astype(np.int64)
+        if batch.slot_ids is not None:
+            slot_of = batch.slot_ids.astype(np.int64)
+        else:
+            slot_of = (batch.indices // SLOT_SPACE).astype(np.int64)
         self.info = ExampleInfo(num_ex=batch.n)
         rows = batch.row_ids()
         vals = batch.value_array()

@@ -28,6 +28,7 @@ def _concat_batches(parts: List[SparseBatch]) -> SparseBatch:
         indptr.append(p.indptr[1:] + offset)
         offset += p.indptr[-1]
     binary = all(p.binary for p in parts)
+    has_slots = all(p.slot_ids is not None for p in parts)
     return SparseBatch(
         y=y,
         indptr=np.concatenate(indptr),
@@ -35,6 +36,7 @@ def _concat_batches(parts: List[SparseBatch]) -> SparseBatch:
         values=None
         if binary
         else np.concatenate([p.value_array() for p in parts]),
+        slot_ids=np.concatenate([p.slot_ids for p in parts]) if has_slots else None,
     )
 
 

@@ -3,7 +3,9 @@
 Formats, as in the reference: libsvm ("label idx:val ..."), criteo
 (label \\t 13 numeric \\t 26 hex categorical), adfea ("line_id key:groupid ..."
 with label first), terafea, and ps_sparse/ps_dense. Output is a SparseBatch
-(CSR over uint64 feature keys). The C++ fast path (cpp/psnative.cc
+(CSR over uint64 feature keys) carrying per-entry feature-group slot ids,
+matching the reference's Example proto slots (``src/data/proto/example.proto``,
+``text_parser.cc`` Slot.set_id). The C++ fast path (cpp/psnative.cc
 ps_parse_*) handles the two hot formats; NumPy/Python fallbacks cover all.
 """
 
@@ -22,7 +24,10 @@ SLOT_SPACE = 1 << 52
 
 
 def _batch_from_rows(
-    labels: List[float], row_keys: List[np.ndarray], row_vals: Optional[List[np.ndarray]]
+    labels: List[float],
+    row_keys: List[np.ndarray],
+    row_vals: Optional[List[np.ndarray]],
+    row_slots: Optional[List[np.ndarray]] = None,
 ) -> SparseBatch:
     n = len(labels)
     counts = np.array([len(k) for k in row_keys], dtype=np.int64)
@@ -38,13 +43,26 @@ def _batch_from_rows(
             if n and indptr[-1]
             else np.zeros(0, np.float32)
         )
+    slot_ids = None
+    if row_slots is not None:
+        slot_ids = (
+            np.concatenate(row_slots).astype(np.int32)
+            if n and indptr[-1]
+            else np.zeros(0, np.int32)
+        )
     return SparseBatch(
-        y=np.asarray(labels, dtype=np.float32), indptr=indptr, indices=indices, values=values
+        y=np.asarray(labels, dtype=np.float32),
+        indptr=indptr,
+        indices=indices,
+        values=values,
+        slot_ids=slot_ids,
     )
 
 
 def parse_libsvm(lines: List[str]) -> SparseBatch:
-    labels, keys, vals = [], [], []
+    """All libsvm features live in feature-group slot 1 (ref ParseLibsvm,
+    text_parser.cc: ``fea_slot->set_id(1)``; slot 0 holds the label)."""
+    labels, keys, vals, slots = [], [], [], []
     for line in lines:
         parts = line.split()
         if not parts:
@@ -64,7 +82,8 @@ def parse_libsvm(lines: List[str]) -> SparseBatch:
                 continue
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
-    return _batch_from_rows(labels, keys, vals)
+        slots.append(np.ones(len(k), dtype=np.int32))
+    return _batch_from_rows(labels, keys, vals, slots)
 
 
 _CRITEO_STRIPE = ((1 << 64) - 1) // 13  # ref: kMaxKey / 13
@@ -76,20 +95,23 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
     (ParseCriteo, text_parser.cc): ALL features are BINARY keys. Integer
     slot i with count c → key ``kMaxKey/13*i + c`` (one-hot by count);
     categorical tokens longer than 4 chars → ``h0 ^ h1`` of
-    MurmurHash3_x64_128(token, seed 512927377). Lines missing the 13
-    integer tab fields are dropped (the reference returns false)."""
+    MurmurHash3_x64_128(token, seed 512927377). Lines missing any tab
+    before the last categorical field are dropped (the reference returns
+    false for a missing int tab, and for a missing cat tab when i != 25).
+    Feature-group slots match the reference Example proto: int feature i
+    → slot i+1, categorical i → slot i+14."""
     from ..utils.murmur import murmur3_x64_128
 
-    labels, keys = [], []
+    labels, keys, slots = [], [], []
     for line in lines:
         f = line.rstrip("\n").split("\t")
-        if len(f) < 14:  # label + 13 ints minimum, as the reference demands
+        if len(f) < 40:  # label + 13 ints + 26 cats; ref drops short lines
             continue
         try:
             label = float(f[0])
         except ValueError:
             continue
-        k = []
+        k, s = [], []
         for i, tok in enumerate(f[1:14]):
             if not tok:
                 continue
@@ -98,21 +120,25 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
             except ValueError:
                 continue
             k.append((_CRITEO_STRIPE * i + cnt) & ((1 << 64) - 1))
-        for tok in f[14:40]:
+            s.append(i + 1)
+        for i, tok in enumerate(f[14:40]):
             if len(tok) > 4:
                 h0, h1 = murmur3_x64_128(tok.encode(), _CRITEO_SEED)
                 k.append(h0 ^ h1)
+                s.append(i + 14)
         labels.append(1.0 if label > 0 else -1.0)
         keys.append(np.asarray(k, dtype=np.uint64).view(np.int64))
-    return _batch_from_rows(labels, keys, None)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, None, slots)
 
 
 def parse_adfea(lines: List[str]) -> SparseBatch:
     """ref ParseAdfea (text_parser.cc:90-121): tokens split on space/colon
     are ``line_id 1 label key:slot_id key:slot_id ...`` — the LABEL is the
     third token (the second is the constant example count "1"). Binary
-    features; keys striped by their slot (group) id."""
-    labels, keys = [], []
+    features; keys striped by their slot (group) id, which is also emitted
+    as the entry's feature-group slot (ref: ``slot->set_id(slot_id)``)."""
+    labels, keys, slots = [], [], []
     for line in lines:
         toks = line.replace(":", " ").split()
         if len(toks) < 3:
@@ -122,7 +148,7 @@ def parse_adfea(lines: List[str]) -> SparseBatch:
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k = []
+        k, s = [], []
         pairs = toks[3:]
         for j in range(0, len(pairs) - 1, 2):
             try:
@@ -131,8 +157,10 @@ def parse_adfea(lines: List[str]) -> SparseBatch:
             except ValueError:
                 continue
             k.append(g * SLOT_SPACE + key % (SLOT_SPACE - 1))
+            s.append(g)
         keys.append(np.asarray(k, dtype=np.int64))
-    return _batch_from_rows(labels, keys, None)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, None, slots)
 
 
 def parse_terafea(lines: List[str]) -> SparseBatch:
@@ -140,8 +168,10 @@ def parse_terafea(lines: List[str]) -> SparseBatch:
     ``label line_id separator key key ...``; the group id lives in the top
     bits of each key (``key >> 54``) and the WHOLE key is the feature id,
     so keys pass through unchanged (masked into the non-negative int64
-    range, keeping the reference's low-collision intent)."""
-    labels, keys = [], []
+    range, keeping the reference's low-collision intent). The top-10-bit
+    group id is emitted as the feature-group slot (ref ParseTerafea:
+    ``slot_id = key >> 54``)."""
+    labels, keys, slots = [], [], []
     for line in lines:
         toks = line.split()
         if len(toks) < 3:
@@ -151,21 +181,23 @@ def parse_terafea(lines: List[str]) -> SparseBatch:
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k = []
+        k, s = [], []
         for tok in toks[3:]:
             try:
                 key = int(tok)
             except ValueError:
                 continue
             k.append(key & 0x7FFFFFFFFFFFFFFF)
+            s.append((key >> 54) & 0x3FF)
         keys.append(np.asarray(k, dtype=np.int64))
-    return _batch_from_rows(labels, keys, None)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, None, slots)
 
 
 def parse_ps_sparse(lines: List[str]) -> SparseBatch:
     """ref ParsePS sparse: "label;grp_id idx:val ...;grp_id ...;" — we fold
-    groups into key stripes like criteo."""
-    labels, keys, vals = [], [], []
+    groups into key stripes like criteo; the group id is the slot id."""
+    labels, keys, vals, slots = [], [], [], []
     for line in lines:
         groups = [g for g in line.strip().split(";") if g]
         if not groups:
@@ -175,7 +207,7 @@ def parse_ps_sparse(lines: List[str]) -> SparseBatch:
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k, v = [], []
+        k, v, s = [], [], []
         for grp in groups[1:]:
             toks = grp.split()
             if not toks:
@@ -189,17 +221,19 @@ def parse_ps_sparse(lines: List[str]) -> SparseBatch:
                 try:
                     k.append(gid * SLOT_SPACE + int(i))
                     v.append(float(x) if x else 1.0)
+                    s.append(gid)
                 except ValueError:
                     continue
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
-    return _batch_from_rows(labels, keys, vals)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, vals, slots)
 
 
 def parse_ps_sparse_binary(lines: List[str]) -> SparseBatch:
     """ref ParsePS SPARSE_BINARY: "label;grp_id key key ...;" — every token
     after the group id is a bare uint64 key, values implicitly 1."""
-    labels, keys = [], []
+    labels, keys, slots = [], [], []
     for line in lines:
         groups = [g for g in line.strip().split(";") if g]
         if not groups:
@@ -209,7 +243,7 @@ def parse_ps_sparse_binary(lines: List[str]) -> SparseBatch:
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k = []
+        k, s = [], []
         for grp in groups[1:]:
             toks = grp.split()
             if not toks:
@@ -221,16 +255,18 @@ def parse_ps_sparse_binary(lines: List[str]) -> SparseBatch:
             for tok in toks[1:]:
                 try:
                     k.append(gid * SLOT_SPACE + int(tok))
+                    s.append(gid)
                 except ValueError:
                     continue
         keys.append(np.asarray(k, dtype=np.int64))
-    return _batch_from_rows(labels, keys, None)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, None, slots)
 
 
 def parse_ps_dense(lines: List[str]) -> SparseBatch:
     """ref ParsePS DENSE: "label;grp_id val val ...;" — float values at
     implicit positional indices within each group."""
-    labels, keys, vals = [], [], []
+    labels, keys, vals, slots = [], [], [], []
     for line in lines:
         groups = [g for g in line.strip().split(";") if g]
         if not groups:
@@ -240,7 +276,7 @@ def parse_ps_dense(lines: List[str]) -> SparseBatch:
         except ValueError:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k, v = [], []
+        k, v, s = [], [], []
         for grp in groups[1:]:
             toks = grp.split()
             if not toks:
@@ -256,9 +292,11 @@ def parse_ps_dense(lines: List[str]) -> SparseBatch:
                     continue
                 k.append(gid * SLOT_SPACE + pos)
                 v.append(x)
+                s.append(gid)
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
-    return _batch_from_rows(labels, keys, vals)
+        slots.append(np.asarray(s, dtype=np.int32))
+    return _batch_from_rows(labels, keys, vals, slots)
 
 
 def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBatch]:
@@ -272,6 +310,7 @@ def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBa
         indptr = np.zeros(max_rows + 1, np.int64)
         indices = np.zeros(max_nnz, np.uint64)
         values = np.zeros(max_nnz, np.float32)
+        slots = np.zeros(max_nnz, np.int32)
         out_nnz = ctypes.c_int64(0)
         rows = fn(
             text,
@@ -280,6 +319,7 @@ def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBa
             indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             indices.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             max_rows,
             max_nnz,
             ctypes.byref(out_nnz),
@@ -298,6 +338,7 @@ def _parse_native(text: bytes, fn_name: str, max_rows: int) -> Optional[SparseBa
             # criteo is a binary format in the reference (all keys, no
             # values); the C ABI still fills 1.0s, dropped here
             values=None if fn_name == "ps_parse_criteo" else values[:nnz].copy(),
+            slot_ids=slots[:nnz].copy(),
         )
 
 

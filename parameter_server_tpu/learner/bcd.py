@@ -4,12 +4,17 @@
 then apps drive per-block UPDATE_MODEL/EVALUATE_PROGRESS tasks. Here:
 
 - ``load_data``: stream all training files into one SparseBatch per worker
-  shard (the reference assigns file slices via DataAssigner).
-- ``preprocess``: global key localization — the reference's workers send
-  unique keys to servers to build the model key arrays (bcd.h
-  PreprocessData); we build the global sorted key union + remapped columns.
-- ``divide_feature_blocks``: partition features into ~ratio×groups blocks,
-  mirroring fea_blk_ pairs (group, key range).
+  shard (the reference assigns file slices via DataAssigner); the
+  SlotReader path caches per-slot column partitions like the reference's
+  compressed slot cache.
+- ``preprocess``: key localization — the reference's workers send unique
+  keys to servers to build the model key arrays (bcd.h PreprocessData); we
+  build the key union per feature group and lay columns out slot-major, so
+  every feature group owns a contiguous column range.
+- ``divide_feature_blocks``: reference semantics (bcd.cc
+  DivideFeatureBlocks): per feature group, ``ceil(nnz_per_row * ratio)``
+  blocks when the group's features are correlated (nnz_per_row > 1), one
+  block otherwise; blocks even-divide the group's column range.
 
 ``BCDProgress`` mirrors learner/proto/bcd.proto.
 """
@@ -65,37 +70,175 @@ class BCDScheduler(App):
         self.blk_order: List[int] = []
         self.global_keys: Optional[np.ndarray] = None
         self.data: Optional[SparseBatch] = None  # localized, cols = len(global_keys)
+        # slot-major layout: per-column group id + per-group column range
+        self.col_slots: Optional[np.ndarray] = None  # [cols] int32
+        self.slot_ranges: Dict[int, Range] = {}
+        self.info = None  # ExampleInfo (per-group nnz stats)
 
     # -- Run() stages (ref bcd.cc) --
 
-    def load_data(self, files: List[str], data_format: str = "libsvm") -> SparseBatch:
+    def load_data(
+        self,
+        files: List[str],
+        data_format: str = "libsvm",
+        cache_dir: Optional[str] = None,
+    ) -> SparseBatch:
+        """LoadData stage. With ``cache_dir`` the SlotReader path is used
+        (per-slot column partitions cached on disk, ref slot_reader.cc);
+        otherwise a plain streaming read."""
+        if cache_dir is not None:
+            return self.load_via_slot_reader(files, data_format, cache_dir)
         reader = StreamReader(files, data_format)
         batch = reader.read_all()
         if batch is None:
             raise ValueError(f"no data in {files}")
         return self.set_data(batch)
 
-    def set_data(self, batch: SparseBatch) -> SparseBatch:
-        """Preprocess: global localization (ref PreprocessData key union)."""
-        loc = Localizer()
-        keys, _ = loc.count_uniq_index(batch)
-        self.global_keys = keys
-        self.data = loc.remap_index(keys)
+    def load_via_slot_reader(
+        self, files: List[str], data_format: str, cache_dir: Optional[str] = None
+    ) -> SparseBatch:
+        """LoadData through SlotReader (ref BCDWorker data loading): read
+        once, split per feature group, then localize each group into its own
+        contiguous column segment (slot-major layout)."""
+        from ..data.slot_reader import SlotReader
+
+        self._reset_slot_state()
+        sr = SlotReader(files, data_format, cache_dir=cache_dir)
+        self.info = sr.read()
+        labels = sr.labels
+        if labels is None:
+            raise ValueError(f"no data in {files}")
+        n = len(labels)
+        col_off = 0
+        keys_parts, slot_parts = [], []
+        rows_parts, cols_parts, vals_parts = [], [], []
+        for s in self.info.slot:
+            sub = sr.slot(s.id)
+            if sub is None or sub.nnz == 0:
+                continue
+            uniq = np.unique(sub.indices)
+            local = np.searchsorted(uniq, sub.indices)
+            keys_parts.append(uniq)
+            slot_parts.append(np.full(len(uniq), s.id, np.int32))
+            rows_parts.append(sub.row_ids())
+            cols_parts.append(local.astype(np.int64) + col_off)
+            vals_parts.append(sub.value_array())
+            self.slot_ranges[s.id] = Range(col_off, col_off + len(uniq))
+            col_off += len(uniq)
+            sr.clear(s.id)
+        self.global_keys = (
+            np.concatenate(keys_parts) if keys_parts else np.zeros(0, np.int64)
+        )
+        self.col_slots = (
+            np.concatenate(slot_parts) if slot_parts else np.zeros(0, np.int32)
+        )
+        rows = np.concatenate(rows_parts) if rows_parts else np.zeros(0, np.int32)
+        cols = np.concatenate(cols_parts) if cols_parts else np.zeros(0, np.int64)
+        vals = np.concatenate(vals_parts) if vals_parts else np.zeros(0, np.float32)
+        order = np.argsort(rows, kind="stable")
+        counts = np.bincount(rows, minlength=n).astype(np.int64)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        self.data = SparseBatch(
+            y=np.asarray(labels, np.float32),
+            indptr=indptr,
+            indices=cols[order],
+            values=vals[order],
+            num_cols=col_off,
+            slot_ids=None,  # encoded structurally via col_slots now
+        )
         return self.data
 
+    def set_data(self, batch: SparseBatch) -> SparseBatch:
+        """Preprocess: key localization (ref PreprocessData key union). When
+        the batch carries per-entry slot ids, columns are permuted to
+        slot-major order so each feature group is a contiguous range."""
+        self._reset_slot_state()
+        loc = Localizer()
+        keys, _ = loc.count_uniq_index(batch)
+        localized = loc.remap_index(keys)
+        if batch.slot_ids is not None and batch.nnz:
+            col_slot = np.zeros(len(keys), np.int32)
+            col_slot[localized.indices] = batch.slot_ids
+            order = np.argsort(col_slot, kind="stable")  # keys stay sorted per slot
+            inv = np.empty_like(order)
+            inv[order] = np.arange(len(order))
+            localized = SparseBatch(
+                y=localized.y,
+                indptr=localized.indptr,
+                indices=inv[localized.indices],
+                values=localized.values,
+                num_cols=localized.num_cols,
+            )
+            self.global_keys = keys[order]
+            self.col_slots = col_slot[order]
+            self._fill_slot_ranges()
+            from ..data.info import info_from_batch
+
+            self.info = info_from_batch(batch)
+        else:
+            self.global_keys = keys
+            self.col_slots = None
+        self.data = localized
+        return self.data
+
+    def _reset_slot_state(self) -> None:
+        """Loading new data must not inherit the previous dataset's slot
+        layout (stale ranges would mis-divide the new feature blocks)."""
+        self.slot_ranges = {}
+        self.col_slots = None
+        self.info = None
+
+    def _fill_slot_ranges(self) -> None:
+        self.slot_ranges = {}
+        if self.col_slots is None or not len(self.col_slots):
+            return
+        bounds = np.flatnonzero(np.diff(self.col_slots)) + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(self.col_slots)]])
+        for lo, hi in zip(starts, ends):
+            self.slot_ranges[int(self.col_slots[lo])] = Range(int(lo), int(hi))
+
     def divide_feature_blocks(self, num_groups: int = 1) -> List[FeatureBlock]:
-        """ref BCDScheduler::DivideFeatureBlocks: ~ratio blocks per group."""
+        """ref BCDScheduler::DivideFeatureBlocks. With per-group slot info:
+        a group whose features are correlated (nnz_per_row > 1) is split
+        into ``ceil(nnz_per_row * feature_block_ratio)`` blocks over its
+        column range; uncorrelated groups get one block (bcd.cc:70-89).
+        Without slot structure, falls back to ~ratio×num_groups even blocks
+        over all columns."""
         assert self.data is not None, "load data first"
-        f = self.data.cols
         ratio = max(self.bcd_conf.feature_block_ratio, 0)
-        nblk = max(1, int(round(ratio * num_groups))) if ratio > 0 else 1
-        nblk = min(nblk, max(1, f))
-        full = Range(0, f)
-        self.fea_blk = [
-            FeatureBlock(group=0, col_range=full.even_divide(nblk, i))
-            for i in range(nblk)
-        ]
-        self.blk_order = list(range(nblk))
+        self.fea_blk = []
+        if self.info is not None and self.slot_ranges:
+            # NOTE: the reference skips slot 0 here because its Example proto
+            # stores the label in slot 0 (bcd.cc:75). Our parsers never put
+            # labels in slots (they live in SparseBatch.y), so every slot in
+            # slot_ranges is a genuine feature group — including group id 0,
+            # which terafea (key >> 54 == 0) and adfea/ps files can emit.
+            by_id = {s.id: s for s in self.info.slot}
+            for sid in sorted(self.slot_ranges):
+                crange = self.slot_ranges[sid]
+                s = by_id.get(sid)
+                nblk = 1
+                if s is not None and s.nnz_ex > 0:
+                    nnz_per_row = s.nnz_ele / s.nnz_ex
+                    if nnz_per_row > 1 + 1e-6 and ratio > 0:
+                        nblk = max(1, int(np.ceil(nnz_per_row * ratio)))
+                nblk = min(nblk, max(1, crange.size()))
+                for i in range(nblk):
+                    blk = crange.even_divide(nblk, i)
+                    if blk.size() > 0:
+                        self.fea_blk.append(FeatureBlock(group=sid, col_range=blk))
+        else:
+            f = self.data.cols
+            nblk = max(1, int(round(ratio * num_groups))) if ratio > 0 else 1
+            nblk = min(nblk, max(1, f))
+            full = Range(0, f)
+            self.fea_blk = [
+                FeatureBlock(group=0, col_range=full.even_divide(nblk, i))
+                for i in range(nblk)
+            ]
+        self.blk_order = list(range(len(self.fea_blk)))
         return self.fea_blk
 
     def merge_progress(self, iteration: int, prog: BCDProgress) -> None:

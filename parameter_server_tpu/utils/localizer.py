@@ -52,6 +52,7 @@ def remap(batch: SparseBatch, keep_keys: np.ndarray) -> SparseBatch:
         indices=new_idx.astype(np.int64),
         values=None if batch.binary else batch.values[hit],
         num_cols=len(keep_keys),
+        slot_ids=None if batch.slot_ids is None else batch.slot_ids[hit],
     )
 
 

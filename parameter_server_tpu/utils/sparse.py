@@ -35,10 +35,11 @@ class SparseBatch:
     indices: np.ndarray  # [nnz] int64 — feature keys (global or localized)
     values: Optional[np.ndarray] = None  # [nnz] float32, None if binary
     num_cols: Optional[int] = None  # p; None = max(indices)+1
-    # per-entry feature-group ids (ref Example proto slots) for formats
-    # whose keys don't encode the group (criteo's global hash keys);
-    # transforms that reindex entries may drop this side channel
-    slot_ids: Optional[np.ndarray] = None  # [nnz] int16 or None
+    # per-entry feature-group ids (ref Example proto Slot.id,
+    # data/proto/example.proto) — load-bearing for formats whose keys don't
+    # encode the group (criteo's global hash keys); SlotReader groups by
+    # these when present
+    slot_ids: Optional[np.ndarray] = None  # [nnz] int32 or None
 
     @property
     def n(self) -> int:

@@ -162,6 +162,114 @@ class TestConvergence:
         assert len(lines) == prog.nnz_w
 
 
+class TestTauPipelining:
+    """ref darlin.h AddWaitTime / Submit(wait ≤ τ): with max_block_delay=τ,
+    up to τ+1 block steps must be in flight simultaneously."""
+
+    def test_blocks_pipeline_with_tau(self, mesh8, dataset):
+        data, _ = dataset
+        conf = make_conf(passes=3, ratio=8.0)
+        conf.darlin.max_block_delay = 2
+        sched = DarlinScheduler(conf, mesh=mesh8)
+        prog = sched.run_on(data)
+        assert len(sched.fea_blk) >= 4, "need several blocks to pipeline"
+        assert sched.max_dispatch_window >= 2
+        objs = [sched.g_progress[i].objective for i in sorted(sched.g_progress)]
+        assert objs[-1] < objs[0]  # still converges with delayed blocks
+
+    def test_tau_zero_serializes(self, mesh8, dataset):
+        data, _ = dataset
+        conf = make_conf(passes=2, ratio=8.0)
+        conf.darlin.max_block_delay = 0
+        sched = DarlinScheduler(conf, mesh=mesh8)
+        sched.run_on(data)
+        assert sched.max_dispatch_window <= 1
+
+    def test_tau_matches_serial_result(self, mesh8, dataset):
+        # block steps chain through the dual on device, so τ>0 pipelining
+        # must be numerically identical to the serial schedule
+        data, _ = dataset
+        runs = []
+        for tau in (0, 3):
+            Postoffice.reset()
+            conf = make_conf(passes=3, ratio=4.0)
+            conf.darlin.max_block_delay = tau
+            conf.darlin.random_feature_block_order = False
+            sched = DarlinScheduler(conf, mesh=mesh8)
+            sched.run_on(data)
+            runs.append(sched.solver.w)
+        np.testing.assert_allclose(runs[0], runs[1], atol=1e-6)
+
+
+class TestCriteoEndToEnd:
+    """VERDICT r1 #1 done-criterion: darlin end-to-end on criteo text via
+    SlotReader with per-slot feature blocks."""
+
+    def _write_criteo(self, tmp_path, n=300):
+        path = tmp_path / "train.criteo"
+        rng = np.random.default_rng(7)
+        with open(path, "w") as f:
+            for _ in range(n):
+                ints = "\t".join(str(rng.integers(0, 8)) for _ in range(13))
+                cats = "\t".join(
+                    f"tok{rng.integers(0, 30):04d}" for _ in range(26)
+                )
+                label = int(rng.integers(0, 2))
+                f.write(f"{label}\t{ints}\t{cats}\n")
+        return str(path)
+
+    def test_darlin_on_criteo_slots(self, mesh8, tmp_path):
+        path = self._write_criteo(tmp_path)
+        conf = make_conf(lam=0.1, passes=4, ratio=0.5)
+        sched = DarlinScheduler(conf, mesh=mesh8)
+        sched.load_data([path], "criteo", cache_dir=str(tmp_path / "cache"))
+        # slot-major layout: 39 feature groups, contiguous column ranges
+        assert len(sched.slot_ranges) == 39
+        blocks = sched.divide_feature_blocks()
+        assert {b.group for b in blocks} == set(range(1, 40))
+        prog = sched.run_loaded()
+        objs = [sched.g_progress[i].objective for i in sorted(sched.g_progress)]
+        assert objs[-1] < objs[0]
+        # per-slot blocks partition the whole column space
+        total = sum(b.col_range.size() for b in sched.fea_blk)
+        assert total == sched.data.cols
+
+
+class TestSlotEdgeCases:
+    def test_group_zero_features_train(self, mesh8, tmp_path):
+        # terafea keys below 2^54 land in group 0; they must still be
+        # covered by a feature block (our labels never live in slots)
+        rng = np.random.default_rng(5)
+        path = tmp_path / "t.terafea"
+        with open(path, "w") as f:
+            for i in range(200):
+                k0 = rng.integers(0, 50)          # group 0
+                k1 = (1 << 54) | rng.integers(0, 50)  # group 1
+                f.write(f"{i % 2 * 2 - 1} {i} | {k0} {k1}\n")
+        conf = make_conf(lam=0.05, passes=3, ratio=0)
+        sched = DarlinScheduler(conf, mesh=mesh8)
+        sched.load_data([str(path)], "terafea")
+        blocks = sched.divide_feature_blocks()
+        assert 0 in {b.group for b in blocks}
+        total = sum(b.col_range.size() for b in blocks)
+        assert total == sched.data.cols  # every column owned by a block
+
+    def test_reload_resets_slot_layout(self, mesh8, dataset, tmp_path):
+        # criteo load populates slot_ranges; a later synthetic batch (no
+        # slot ids) must not inherit them
+        t = TestCriteoEndToEnd()
+        path = t._write_criteo(tmp_path, n=50)
+        data, _ = dataset
+        sched = DarlinScheduler(make_conf(passes=2), mesh=mesh8)
+        sched.load_data([path], "criteo", cache_dir=str(tmp_path / "c"))
+        assert sched.slot_ranges
+        sched.set_data(data)  # synthetic, slot-free
+        assert not sched.slot_ranges and sched.info is None
+        blocks = sched.divide_feature_blocks()
+        total = sum(b.col_range.size() for b in blocks)
+        assert total == sched.data.cols
+
+
 class TestBCDFramework:
     def test_divide_feature_blocks(self, mesh8, dataset):
         data, _ = dataset

@@ -171,6 +171,76 @@ class TestNativeParity:
         assert a.binary == b.binary
         if not a.binary:
             np.testing.assert_allclose(a.values, b.values)
+        np.testing.assert_array_equal(a.slot_ids, b.slot_ids)
+
+
+class TestSlotIds:
+    """Per-entry feature-group slots, matching the reference Example proto
+    (text_parser.cc Slot.set_id: libsvm → 1; criteo int i → i+1, cat i →
+    i+14; adfea/ps → group id; terafea → key >> 54)."""
+
+    def test_criteo_slots(self):
+        line = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + "\t".join(
+            ["68fd1e64"] * 26
+        )
+        b = parse_criteo([line])
+        np.testing.assert_array_equal(b.slot_ids[:13], np.arange(1, 14))
+        np.testing.assert_array_equal(b.slot_ids[13:], np.arange(14, 40))
+
+    def test_criteo_truncated_cat_line_dropped(self):
+        # ref ParseCriteo: a tab missing before the 25th categorical field
+        # (i != 25) returns false — the whole line is dropped
+        good = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + "\t".join(
+            ["68fd1e64"] * 26
+        )
+        truncated = "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t" + "\t".join(
+            ["68fd1e64"] * 10
+        )
+        for use_native in (False, True):
+            p = ExampleParser("criteo", use_native=use_native)
+            if use_native and not p.use_native:
+                continue
+            b = p.parse_lines([good, truncated, good])
+            assert b.n == 2, "truncated line must be dropped"
+
+    def test_libsvm_slots(self):
+        b = parse_libsvm(["1 3:0.5 7:2", "-1 1:1"])
+        np.testing.assert_array_equal(b.slot_ids, [1, 1, 1])
+
+    def test_adfea_slots(self):
+        b = parse_adfea(["100 1 1 123:4 456:7", "101 1 0 789:2"])
+        np.testing.assert_array_equal(b.slot_ids, [4, 7, 2])
+
+    def test_terafea_slots(self):
+        k1, k2 = (3 << 54) | 123, (9 << 54) | 456
+        b = parse_terafea([f"1 1000 | {k1} {k2}"])
+        np.testing.assert_array_equal(b.slot_ids, [3, 9])
+
+    def test_ps_sparse_slots(self):
+        b = parse_ps_sparse(["1;2 3:0.5 4:1.5;7 9:2;"])
+        np.testing.assert_array_equal(b.slot_ids, [2, 2, 7])
+
+    def test_record_roundtrip_keeps_slots(self):
+        from parameter_server_tpu.data.example import batch_from_bytes, batch_to_bytes
+
+        b = parse_criteo(
+            [
+                "1\t" + "\t".join(str(i) for i in range(1, 14)) + "\t"
+                + "\t".join(["68fd1e64"] * 26)
+            ]
+        )
+        rt = batch_from_bytes(batch_to_bytes(b))
+        np.testing.assert_array_equal(rt.slot_ids, b.slot_ids)
+        np.testing.assert_array_equal(rt.indices, b.indices)
+
+    def test_slice_and_localize_keep_slots(self):
+        from parameter_server_tpu.utils.localizer import remap
+
+        b = parse_libsvm(["1 3:0.5 7:2", "-1 1:1", "1 9:2"])
+        s = b.slice_rows(0, 2)
+        np.testing.assert_array_equal(s.slot_ids, [1, 1, 1])
+        kept = remap(b, np.array([1, 3, 9], dtype=np.int64))
+        assert kept.slot_ids is not None and len(kept.slot_ids) == kept.nnz
 
 
 class TestShippedConfigs:
