@@ -114,6 +114,12 @@ def mat2bin(
         save_bin(name + ".value", mat, np.float64)
         return
     b: SparseBatch = mat
+    # a non-localized batch can carry global 64-bit hash keys (criteo);
+    # casting those to uint32 would silently corrupt the .index file, so
+    # widen sizeof_index to 8 when the indices don't fit
+    fits32 = b.nnz == 0 or (
+        int(b.indices.min()) >= 0 and int(b.indices.max()) < 2**32
+    )
     _write_info(
         name,
         [
@@ -122,12 +128,16 @@ def mat2bin(
             ("row", (0, b.n)),
             ("col", (0, b.cols)),
             ("nnz", b.nnz),
-            ("sizeof_index", 4),
+            ("sizeof_index", 4 if fits32 else 8),
             ("sizeof_value", 8),
         ],
     )
     save_bin(name + ".offset", b.indptr, np.uint64)
-    save_bin(name + ".index", b.indices, np.uint32)
+    if fits32:
+        save_bin(name + ".index", b.indices, np.uint32)
+    else:
+        # .view keeps the raw 64 bits for keys >= 2^63 stored as negative int64
+        save_bin(name + ".index", b.indices.astype(np.int64).view(np.uint64), np.uint64)
     if not b.binary:
         save_bin(name + ".value", b.values, np.float64)
     if keys is not None:
@@ -147,7 +157,10 @@ def bin2mat(
         vals = load_bin(name + ".value", np.float64)
         return vals.reshape(rows, cols)
     indptr = load_bin(name + ".offset", np.uint64).astype(np.int64)
-    indices = load_bin(name + ".index", np.uint32).astype(np.int64)
+    if int(info.get("sizeof_index", 4)) == 8:
+        indices = load_bin(name + ".index", np.uint64).view(np.int64)
+    else:
+        indices = load_bin(name + ".index", np.uint32).astype(np.int64)
     values = (
         None
         if "BINARY" in mtype

@@ -44,6 +44,22 @@ def test_sparse_mat2bin_roundtrip(tmp_path):
     np.testing.assert_array_equal(keys2, keys)
 
 
+def test_sparse_mat2bin_wide_indices_roundtrip(tmp_path):
+    # non-localized global 64-bit hash keys (criteo) must not be wrapped
+    # into uint32 — mat2bin widens sizeof_index to 8 (ADVICE r1)
+    name = str(tmp_path / "W")
+    idx = np.array(
+        [5, 2**32 + 7, np.int64(np.uint64(2**63 + 11).view(np.int64))],
+        dtype=np.int64,
+    )
+    b = random_sparse(3, 8, 1, seed=0)
+    b.indices = idx
+    b.num_cols = None
+    binmat.mat2bin(name, b)
+    b2, _ = binmat.bin2mat(name)
+    np.testing.assert_array_equal(b2.indices, idx)
+
+
 def test_sparse_binary_mat2bin_roundtrip(tmp_path):
     name = str(tmp_path / "B")
     b = random_sparse(8, 32, 3, seed=1, binary=True)
