@@ -1029,14 +1029,20 @@ class AsyncSGDWorker(ISGDCompNode):
             # assemble the global batch explicitly
             prepped = self.upload(prepped)
         tau = self.sgd.max_delay
-        if tau <= 0 or self._steps_since_snapshot >= tau:
-            self._pull_state = self.state
+        # snapshot *scheduling* happens at submit time (deterministic in
+        # submission order), but the snapshot itself must be taken when the
+        # step RUNS on the executor's dispatch thread — self.state is only
+        # advanced there, and steps execute in submission order
+        do_snapshot = tau <= 0 or self._steps_since_snapshot >= tau
+        if do_snapshot:
             self._steps_since_snapshot = 0
         step_fn = self._get_step(prepped, with_aux)
         self._seed_counter += 1
         seed = np.uint32(self._seed_counter)
 
         def step():
+            if do_snapshot:
+                self._pull_state = self.state
             new_state, metrics = step_fn(self.state, self._pull_state, prepped, seed)
             self.state = new_state
             return metrics
@@ -1078,6 +1084,9 @@ class AsyncSGDWorker(ISGDCompNode):
         return self.progress
 
     def weights_dense(self) -> np.ndarray:
+        # drain in-flight steps (state advances on the executor thread)
+        # WITHOUT popping: metrics stay claimable by a later collect()
+        self.executor.wait_all(pop=False)
         return np.asarray(self._weights_fn(self.state))
 
     def evaluate(self, batch: SparseBatch) -> Dict[str, float]:

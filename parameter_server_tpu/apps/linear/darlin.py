@@ -373,15 +373,22 @@ class DarlinScheduler(BCDScheduler):
         self.solver.init_data(localized, blocks)
 
         from ...system.executor import Executor
-        from ...system.message import Task
 
         # bounded block delay τ (ref darlin.h AddWaitTime: step ts waits on
         # everything up to ts − τ − 1, so ≤ τ+1 block tasks are in flight)
         tau = max(0, self.bcd_conf.max_block_delay)
         executor = Executor(name=self.name)
+        rng = random.Random(self.seed)
+        try:
+            return self._run_passes(executor, tau, rng, verbose)
+        finally:
+            executor.stop()
+
+    def _run_passes(self, executor, tau, rng, verbose) -> BCDProgress:
+        from ...system.message import Task
+
         kkt_threshold = 1e20
         reset_kkt = False
-        rng = random.Random(self.seed)
         prev_objv = None
         prog = BCDProgress()
         for iteration in range(self.bcd_conf.num_data_pass):
@@ -394,10 +401,9 @@ class DarlinScheduler(BCDScheduler):
                 self.solver.reset_active()
                 reset_kkt = False
             pass_start = executor.time()
-            vio_futs = {}
+            pending_ts = []
             for blk_id in order:
-                ts_next = executor.time()
-                dep = ts_next - (tau + 1)
+                dep = executor.time() - (tau + 1)
                 task = Task(wait_time=[dep] if dep >= pass_start else [])
                 ts = executor.submit(
                     lambda b=blk_id, t=kkt_threshold: self.solver.dispatch_block(
@@ -405,21 +411,24 @@ class DarlinScheduler(BCDScheduler):
                     ),
                     task,
                 )
-                vio_futs[ts] = executor.result(ts)
-                window = sum(
-                    1 for t in vio_futs if not executor.tracker.is_finished(t)
-                )
-                self.max_dispatch_window = max(self.max_dispatch_window, window)
-                in_flight = sum(
-                    1
-                    for t, v in vio_futs.items()
-                    if not executor.tracker.is_finished(t) and not v.is_ready()
-                )
+                pending_ts.append(ts)
+                # probe genuine device-side concurrency: dispatched steps
+                # whose violation scalars have not materialized yet
+                probe = 0
+                for t in pending_ts:
+                    v = executor.result(t)
+                    if v is not None and hasattr(v, "is_ready") and not v.is_ready():
+                        probe += 1
                 self.max_in_flight_observed = max(
-                    self.max_in_flight_observed, in_flight
+                    self.max_in_flight_observed, probe
                 )
-            executor.wait_all()
-            violation = max(float(v) for v in vio_futs.values()) if vio_futs else 0.0
+            vios = [executor.wait(t) for t in pending_ts]
+            self.max_dispatch_window = max(
+                self.max_dispatch_window, executor.max_dispatched_in_flight
+            )
+            violation = max(
+                (float(v) for v in vios if v is not None), default=0.0
+            )
             prog = self.solver.evaluate()
             prog.violation = violation
             if prev_objv is not None and prev_objv > 0:

@@ -119,16 +119,21 @@ def submit(
     NodeGroups constant or a node id like "S0"), invoking each receiver's
     ``process_request``; receivers that do not reply themselves are acked by
     the system (ref executor.cc). Returns the timestamp to ``app.wait`` on;
-    ``callback`` fires when the last reply lands (delivery is synchronous on
-    this runtime, so by the time submit returns the callback has run —
-    waiting on the timestamp is not required for it to fire).
+    ``callback`` fires when the last reply lands. Delivery is asynchronous
+    (the step runs on the sender's executor dispatch thread, like the
+    reference's per-customer engine): ``app.wait(ts)`` before relying on
+    side effects or the callback having fired.
     """
     task = dataclasses.replace(task) if task is not None else Task()
     if task.time < 0:
         task.time = app.executor.time()
+    # capture the sender identity on the CALLING thread — the step body runs
+    # on the executor's dispatch thread (out-of-order engine), whose
+    # thread-local node is not the submitting worker's
+    me = _current_node()
 
     def step() -> None:
-        me = _current_node()
+        _set_current_node(me)
         # groups include the sender's own node when its role matches (ref
         # executor.cc AddNode: every node joins kLiveGroup and its role
         # group), so a broadcast delivers to self via loopback too
@@ -214,8 +219,26 @@ def run_system(
                 _set_current_node(app.node)
                 app.run()
     finally:
+        # drain every app's executor before tearing the registry down —
+        # ps.submit is asynchronous, and a fire-and-forget broadcast still
+        # enqueued on a dispatch thread must deliver before nodes vanish
+        import sys
+
+        unwinding = sys.exc_info()[0] is not None
+        drain_errors: List[BaseException] = []
+        for app in apps:
+            try:
+                app.executor.wait_all()
+                app.executor.stop()
+            except BaseException as e:  # noqa: BLE001 — collected below
+                drain_errors.append(e)
         _set_current_node(None)
         stop_system()
+        if drain_errors and not unwinding:
+            # a fire-and-forget step crashed: fail the program like the
+            # reference's process exit code would (but never mask an
+            # exception already unwinding)
+            raise drain_errors[0]
     return apps
 
 

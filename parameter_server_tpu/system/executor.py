@@ -1,26 +1,28 @@
 """Executor: logical clocks and dependency tracking over XLA async dispatch.
 
 Counterpart of ``src/system/executor.{h,cc}`` + ``task_tracker.h``. The
-reference runs a per-customer DAG engine thread that picks received messages
-whose ``wait_time`` dependencies are finished. On TPU the same pipelining
-falls out of XLA's async dispatch: submitting a jitted step returns
-immediately with future arrays; ordering *within* a device queue is program
-order, and cross-step constraints are enforced by blocking on tracked
-futures before dispatch.
+reference runs a per-customer DAG engine thread that picks any received
+message whose ``wait_time`` dependencies are finished (executor.cc
+PickActiveMsg) — messages behind an unmet dependency do NOT block ready
+ones submitted later. This executor reproduces that: ``submit`` enqueues
+and returns immediately; a dispatch thread repeatedly runs the
+lowest-timestamp *ready* step (all deps finished), skipping over blocked
+ones. When nothing is ready it resolves the oldest blocked step's
+dependencies by materializing their device futures (XLA async dispatch
+means a "run" step may still be computing on device; a dependency counts
+as finished only once its results are ready — the reference's handler-ran
+== message-finished contract).
 
-``Submit`` assigns a timestamp, runs the step's host closure (which
-dispatches device work), and records returned jax arrays as the step's
-future. ``Wait(ts)`` blocks until that step's arrays are materialized —
-``Customer::Wait`` semantics. Bounded-delay consistency = submit without
-waiting, with a sliding window: ``Submit`` itself blocks when more than
-``max_in_flight`` steps are unfinished (the reference throttles identically
-through its message clocks).
+``Wait(ts)`` blocks until step ``ts`` has run and its arrays materialized
+— ``Customer::Wait`` semantics. Bounded-delay consistency: ``submit``
+itself blocks when more than ``max_in_flight`` steps are unfinished (the
+reference throttles identically through its message clocks).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -51,26 +53,36 @@ class TaskTracker:
         with self._lock:
             return ts in self._started
 
+    def in_flight(self) -> int:
+        """Started (dispatched) but not yet finished."""
+        with self._lock:
+            return len(self._started - self._finished)
+
 
 class Executor:
     def __init__(self, name: str = "", max_in_flight: int = 0):
         self.name = name
         self._time = 0
-        self._futures: Dict[int, Any] = {}  # ts -> pytree of jax arrays
+        self._pending: Dict[int, Tuple[Callable[[], Any], List[int]]] = {}
+        self._running: Optional[int] = None  # picked, step() executing now
+        self._ran: set[int] = set()  # ran, not finished yet (pruned on finish)
+        self._futures: Dict[int, Any] = {}  # ts -> pytree (run, maybe async)
         self._callbacks: Dict[int, Callable[[], None]] = {}
+        self._errors: Dict[int, BaseException] = {}
         self.tracker = TaskTracker()
-        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
         self.max_in_flight = max_in_flight  # 0 = unbounded (eventual consistency)
+        # telemetry: max |started \ finished| ever observed at dispatch time
+        # (τ-bounded-delay proof for the darlin scheduler)
+        self.max_dispatched_in_flight = 0
 
     def time(self) -> int:
-        with self._lock:
+        with self._cv:
             return self._time
 
-    def _next_time(self) -> int:
-        with self._lock:
-            ts = self._time
-            self._time += 1
-            return ts
+    # -- submission (ref Customer::Submit) --
 
     def submit(
         self,
@@ -78,40 +90,43 @@ class Executor:
         task: Optional[Task] = None,
         callback: Optional[Callable[[], None]] = None,
     ) -> int:
-        """Dispatch ``step`` with dependency waits; returns its timestamp.
+        """Enqueue ``step``; returns its timestamp immediately.
 
         ``task.wait_time`` lists timestamps that must be *finished* before
         this step runs (ref executor.cc PickActiveMsg dependency check).
         Dependencies must reference already-submitted steps — the reference
         allocates timestamps at Submit, so a dep can never be in the future.
+        The step runs on the executor's dispatch thread, possibly after
+        later-submitted steps whose dependencies cleared earlier.
         """
         task = task or Task()
-        if task.time != INVALID_TIME:
-            ts = task.time
-            with self._lock:
-                if ts in self._futures or (
-                    ts < self._time and self.tracker.was_started(ts)
+        with self._cv:
+            if task.time != INVALID_TIME:
+                ts = task.time
+                if ts < self._time and self.tracker.was_started(ts) or (
+                    ts in self._pending
                 ):
                     raise ValueError(f"timestamp {ts} already used")
                 # keep the auto counter ahead of explicit timestamps so they
                 # can never collide with a later auto-assigned one
                 self._time = max(self._time, ts + 1)
-        else:
-            ts = self._next_time()
-        for dep in task.wait_time:
-            if dep == INVALID_TIME:
-                continue
-            if dep >= ts:
-                raise ValueError(f"dependency {dep} is not before step {ts}")
-            self.wait(dep)
-        if self.max_in_flight > 0:
-            self._throttle(ts)
-        self.tracker.start(ts)
-        result = step()
-        with self._lock:
-            self._futures[ts] = result
+            else:
+                ts = self._time
+                self._time += 1
+            deps = []
+            for dep in task.wait_time:
+                if dep == INVALID_TIME:
+                    continue
+                if dep >= ts:
+                    raise ValueError(f"dependency {dep} is not before step {ts}")
+                deps.append(dep)
+            self._pending[ts] = (step, deps)
             if callback is not None:
                 self._callbacks[ts] = callback
+            self._ensure_thread()
+            self._cv.notify_all()
+        if self.max_in_flight > 0:
+            self._throttle(ts)
         return ts
 
     def _throttle(self, ts: int) -> None:
@@ -125,38 +140,188 @@ class Executor:
         if horizon >= 0:
             self.wait(horizon, pop=False)
 
+    # -- the dispatch thread (ref executor.cc thread + PickActiveMsg) --
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name=f"executor:{self.name}", daemon=True
+            )
+            self._thread.start()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            dep_fut = None
+            with self._cv:
+                while not self._pending and not self._stopped:
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                pick = self._pick_ready_locked()
+                if pick is None:
+                    # Nothing ready: resolve the oldest blocked step's first
+                    # unmet dep. Every unmet dep is an older timestamp, so
+                    # by induction it has already run (or is being waited on
+                    # by another thread) — never pending.
+                    oldest = min(self._pending)
+                    dep = next(
+                        (
+                            d
+                            for d in self._pending[oldest][1]
+                            if not self._dep_done_locked(d)
+                        ),
+                        None,
+                    )
+                    if dep is None:
+                        # a concurrent wait() finished the dep between the
+                        # ready-pick and here — re-evaluate
+                        continue
+                    if dep in self._futures:
+                        dep_fut = self._futures[dep]  # materialize below
+                    else:
+                        # running, or popped by a concurrent wait(): that
+                        # path will finish it and notify — do NOT finish an
+                        # unmaterialized dep here
+                        self._cv.wait()
+                        continue
+                else:
+                    ts, step = pick
+                    self._running = ts
+            if pick is None:
+                if dep_fut is not None:
+                    jax.block_until_ready(dep_fut)
+                self._finish(dep)
+                continue
+            # run the step outside the lock (it may dispatch device work,
+            # or block — submitters and waiters must stay free)
+            self.tracker.start(ts)
+            self.max_dispatched_in_flight = max(
+                self.max_dispatched_in_flight, self.tracker.in_flight()
+            )
+            try:
+                result = step()
+                err = None
+            except BaseException as e:  # propagate to the waiter
+                result, err = None, e
+            with self._cv:
+                self._running = None
+                self._ran.add(ts)
+                if err is not None:
+                    self._errors[ts] = err
+                else:
+                    self._futures[ts] = result
+                self._cv.notify_all()
+
+    def _dep_done_locked(self, d: int) -> bool:
+        """A dependency is satisfied when finished — or never submitted
+        (the reference waits only on timestamps it issued; an unknown ts is
+        a no-op there too)."""
+        if self.tracker.is_finished(d):
+            return True
+        return (
+            d not in self._pending
+            and d != self._running
+            and d not in self._ran
+            and not self.tracker.was_started(d)
+        )
+
+    def _pick_ready_locked(self) -> Optional[Tuple[int, Callable[[], Any]]]:
+        """Lowest-timestamp pending step whose deps are all finished
+        (PickActiveMsg: any ready message may overtake blocked ones)."""
+        for ts in sorted(self._pending):
+            step, deps = self._pending[ts]
+            if all(self._dep_done_locked(d) for d in deps):
+                del self._pending[ts]
+                return ts, step
+        return None
+
+    def _finish(self, ts: int) -> None:
+        """Mark finished (results materialized), prune, fire callback once."""
+        if self.tracker.was_started(ts):
+            self.tracker.finish(ts)
+        with self._cv:
+            self._ran.discard(ts)
+            cb = self._callbacks.pop(ts, None)
+            self._cv.notify_all()
+        if cb is not None:
+            cb()
+
+    # -- waiting (ref Customer::Wait) --
+
     def wait(self, ts: int, pop: bool = True) -> Any:
-        """Block until step ``ts`` has materialized (Customer::Wait).
+        """Block until step ``ts`` has run and materialized (Customer::Wait).
 
         By default evicts the step's future so device buffers are released —
         without this, every intermediate result would stay pinned in HBM.
         ``pop=False`` blocks without consuming (used by the throttle).
         Returns the step's value (None if ts is unknown or already popped).
+        Re-raises the step's exception, if it raised.
         """
-        with self._lock:
+        with self._cv:
+            known = (
+                ts in self._pending
+                or ts == self._running
+                or ts in self._ran
+                or self.tracker.was_started(ts)
+                or self.tracker.is_finished(ts)
+            )
+            if not known:
+                return None
+            while not (
+                ts in self._futures
+                or ts in self._errors
+                or self.tracker.is_finished(ts)
+            ):
+                self._cv.wait()
+            err = self._errors.pop(ts, None) if pop else self._errors.get(ts)
             fut = self._futures.pop(ts, None) if pop else self._futures.get(ts)
-            cb = self._callbacks.pop(ts, None)
+        if err is not None:
+            self._finish(ts)
+            raise err
         if fut is not None:
             jax.block_until_ready(fut)
-        if self.tracker.was_started(ts):
-            self.tracker.finish(ts)
-        if cb is not None:
-            cb()
+        self._finish(ts)
         return fut
 
-    def wait_all(self) -> None:
-        with self._lock:
-            pending = list(self._futures.keys())
-        for ts in pending:
-            self.wait(ts)
+    def wait_all(self, pop: bool = True) -> None:
+        """Drain every unfinished step, including the one executing right
+        now. ``pop=False`` preserves results for later collection."""
+        while True:
+            with self._cv:
+                todo = set(self._pending) | self._ran
+                if self._running is not None:
+                    todo.add(self._running)
+            if not todo:
+                return
+            for ts in sorted(todo):
+                self.wait(ts, pop=pop)
 
     def result(self, ts: int) -> Any:
-        """The (possibly still-async) value of step ts (None once waited)."""
-        with self._lock:
+        """The (possibly still-async) value of step ts (None once waited,
+        or if the step has not been dispatched yet)."""
+        with self._cv:
             return self._futures.get(ts)
 
     def pop_result(self, ts: int) -> Any:
         return self.wait(ts)
+
+    def stop(self, cancel_pending: bool = True) -> None:
+        """Stop the dispatch thread and join it. ``cancel_pending`` drops
+        steps that have not started (the executing one always completes —
+        its state mutation cannot be torn). Idempotent."""
+        with self._cv:
+            if cancel_pending:
+                for ts in list(self._pending):
+                    self._pending.pop(ts)
+                    self._callbacks.pop(ts, None)
+            self._stopped = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None and thread.is_alive() and (
+            thread is not threading.current_thread()
+        ):
+            thread.join(timeout=60)
 
 
 class NodeGroups:

@@ -88,3 +88,107 @@ class TestExecutor:
             ex.submit(lambda i=i: np.zeros(2) + i)
         ex.wait_all()
         assert all(ex.tracker.is_finished(t) for t in range(4))
+
+    def test_step_exception_propagates_to_waiter(self):
+        ex = Executor()
+        ts = ex.submit(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            ex.wait(ts)
+
+
+class TestOutOfOrderDispatch:
+    """ref executor.cc PickActiveMsg: a received message whose wait_time
+    deps are unmet must NOT block later messages that are ready — the
+    engine picks any active message out of order."""
+
+    def test_ready_step_overtakes_blocked_one(self):
+        import threading as th
+
+        ex = Executor()
+        gate = th.Event()
+        order = []
+
+        t0 = ex.submit(lambda: (gate.wait(5), order.append("slow"))[1])
+        t1 = ex.submit(lambda: order.append("dependent"), Task(wait_time=[t0]))
+        t2 = ex.submit(lambda: order.append("independent"))
+        # t0 is executing (blocked on the gate); t1 waits on t0; t2 has no
+        # deps — it must run before t1 even though it was submitted later
+        gate.set()
+        ex.wait_all()
+        assert order.index("independent") < order.index("dependent")
+        assert order[-1] == "dependent"
+
+    def test_interleaved_customers_make_progress(self):
+        """Two logical task chains through one executor: chain A's steps
+        depend on each other; chain B is independent and must interleave
+        without waiting for A's chain to drain."""
+        ex = Executor()
+        log = []
+        a_prev = ex.submit(lambda: log.append("A0"))
+        for i in range(1, 3):
+            a_prev = ex.submit(
+                lambda i=i: log.append(f"A{i}"), Task(wait_time=[a_prev])
+            )
+        b_ts = [ex.submit(lambda i=i: log.append(f"B{i}")) for i in range(3)]
+        ex.wait_all()
+        assert sorted(log) == ["A0", "A1", "A2", "B0", "B1", "B2"]
+        # A-chain order respected
+        ia = [log.index(f"A{i}") for i in range(3)]
+        assert ia == sorted(ia)
+
+    def test_submit_does_not_block_on_deps(self):
+        import time as _time
+
+        ex = Executor()
+        t0 = ex.submit(lambda: _time.sleep(0.2))
+        start = _time.monotonic()
+        ex.submit(lambda: None, Task(wait_time=[t0]))
+        elapsed = _time.monotonic() - start
+        assert elapsed < 0.1, "submit must enqueue, not wait for deps"
+        ex.wait_all()
+
+    def test_dispatched_in_flight_telemetry(self):
+        ex = Executor()
+        for i in range(4):
+            ex.submit(lambda: None)
+        ex.wait_all()
+        assert ex.max_dispatched_in_flight >= 1
+
+    def test_wait_all_drains_currently_executing_step(self):
+        import threading as th
+
+        ex = Executor()
+        entered = th.Event()
+        done = []
+
+        def slow():
+            entered.set()
+            import time as _t
+
+            _t.sleep(0.15)
+            done.append(1)
+
+        ex.submit(slow)
+        entered.wait(5)  # the step is mid-execution on the dispatch thread
+        ex.wait_all()
+        assert done == [1], "wait_all must include the running step"
+
+    def test_wait_all_pop_false_preserves_results(self):
+        ex = Executor()
+        ts = ex.submit(lambda: 41)
+        ex.wait_all(pop=False)
+        assert ex.tracker.is_finished(ts)
+        assert ex.wait(ts) == 41  # still claimable after the drain
+
+    def test_stop_cancels_pending_and_joins(self):
+        import threading as th
+
+        ex = Executor()
+        gate = th.Event()
+        ran = []
+        ex.submit(lambda: (gate.wait(5), ran.append("first"))[1])
+        ex.submit(lambda: ran.append("second"))
+        gate.set()
+        ex.stop()  # joins; the executing step completes, pending may drop
+        assert "first" in ran
+        assert ex._thread is None or not ex._thread.is_alive()
