@@ -185,10 +185,18 @@ class TestOutOfOrderDispatch:
 
         ex = Executor()
         gate = th.Event()
+        entered = th.Event()
         ran = []
-        ex.submit(lambda: (gate.wait(5), ran.append("first"))[1])
+
+        def first():
+            entered.set()
+            gate.wait(5)
+            ran.append("first")
+
+        ex.submit(first)
         ex.submit(lambda: ran.append("second"))
+        entered.wait(5)  # ensure the first step is executing before stop
         gate.set()
-        ex.stop()  # joins; the executing step completes, pending may drop
+        ex.stop()  # joins; the executing step completes, pending is dropped
         assert "first" in ran
         assert ex._thread is None or not ex._thread.is_alive()
