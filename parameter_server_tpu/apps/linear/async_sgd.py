@@ -269,8 +269,22 @@ def prep_batch_ell(
     num_slots: int,
     pack: bool = False,
 ) -> ELLBatch:
-    """Pack a CSR batch into ELL lanes (rows with more than ``lanes``
-    features are truncated — callers size lanes to the data's max row)."""
+    """Pack a CSR batch into ELL lanes.
+
+    A row with more than ``lanes`` features cannot be represented — the
+    reference never drops data, so neither do we: raises ValueError with
+    the dropped-entry count (``prep`` pre-checks and falls back to the
+    hashed COO path instead of calling in)."""
+    max_row = int(np.diff(batch.indptr).max()) if batch.n else 0
+    if max_row > lanes:
+        dropped = int(
+            np.maximum(np.diff(batch.indptr) - lanes, 0).sum()
+        )
+        raise ValueError(
+            f"ELL lane budget {lanes} < widest row {max_row}: packing would "
+            f"silently drop {dropped} features; raise ell_lanes or use the "
+            "hashed COO path"
+        )
     shards = []
     per = -(-batch.n // num_shards)
     binary = batch.binary
@@ -839,6 +853,7 @@ class AsyncSGDWorker(ISGDCompNode):
         self._push_quant = _fixing_float_bytes(sgd.push_filter, "push_filter")
         self._pull_quant = _fixing_float_bytes(sgd.pull_filter, "pull_filter")
         self._seed_counter = 0
+        self._warned_ell_overflow = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         self.directory = KeyDirectory(self.num_slots, hashed=True)
         self.state = jax.tree.map(
@@ -924,7 +939,34 @@ class AsyncSGDWorker(ISGDCompNode):
         rows_pad, nnz_pad, uniq_pad = self._padding(batch)
         num_shards = self._num_shards()
         out = None
-        if self.sgd.ell_lanes > 0 and self.directory.hashed:
+        use_ell = self.sgd.ell_lanes > 0 and self.directory.hashed
+        if use_ell and batch.n:
+            # ELL truncation guard (the reference never drops features): a
+            # row wider than the lane budget falls back to the hashed COO
+            # path — except multiprocess, where a per-host program change
+            # would desync the collectives, so fail loudly instead
+            max_row = int(np.diff(batch.indptr).max())
+            if max_row > self.sgd.ell_lanes:
+                from ...parallel import distributed
+
+                if distributed.is_multiprocess():
+                    raise ValueError(
+                        f"row with {max_row} features exceeds ell_lanes="
+                        f"{self.sgd.ell_lanes}; raise ell_lanes (the wire "
+                        "format must be identical on every host)"
+                    )
+                if not self._warned_ell_overflow:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "batch has a %d-feature row > ell_lanes=%d; "
+                        "falling back to the hashed COO path (no features "
+                        "dropped, ELL fast path disabled for such batches)",
+                        max_row, self.sgd.ell_lanes,
+                    )
+                    self._warned_ell_overflow = True
+                use_ell = False
+        if use_ell:
             wire = self.sgd.wire or ("u24" if self.sgd.wire_u24 else "i32")
             if wire == "bits":
                 out = prep_batch_ell_bits(
