@@ -1095,7 +1095,13 @@ class AsyncSGDWorker(ISGDCompNode):
     def collect(self, ts: int) -> SGDProgress:
         """Wait for a step and fold its metrics into progress (the worker's
         reporter_.Report path)."""
+        self.po.beat(self.name)  # liveness signal (ref heartbeat thread)
+        hb = self.po.aux.info(self.name) if self.po.aux is not None else None
+        if hb is not None:
+            hb.start_timer()  # dashboard busy-time (ref heartbeat_info.h)
         metrics = self.executor.wait(ts)
+        if hb is not None:
+            hb.stop_timer()
         if metrics is None:
             return self.progress
         prog = SGDProgress(

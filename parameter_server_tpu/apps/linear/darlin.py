@@ -422,6 +422,7 @@ class DarlinScheduler(BCDScheduler):
                 self.max_in_flight_observed = max(
                     self.max_in_flight_observed, probe
                 )
+            self.po.beat(self.name)  # liveness signal (ref heartbeat thread)
             vios = [executor.wait(t) for t in pending_ts]
             self.max_dispatch_window = max(
                 self.max_dispatch_window, executor.max_dispatched_in_flight

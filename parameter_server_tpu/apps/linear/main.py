@@ -21,6 +21,16 @@ def main(argv=None) -> int:
     ap.add_argument("--num-servers", type=int, default=1)
     ap.add_argument("--num-workers", type=int, default=0, help="0 = rest of devices")
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument(
+        "--report-interval", type=float, default=0.0,
+        help="print the node dashboard every N seconds (0 = at end only; "
+        "ref dashboard.cc / FLAGS_report_interval)",
+    )
+    ap.add_argument(
+        "--heartbeat-timeout", type=float, default=10.0,
+        help="seconds without a heartbeat before a node is declared dead "
+        "(ref manager.cc dead-node flow)",
+    )
     args = ap.parse_args(argv)
 
     from ...learner.sgd import MinibatchReader
@@ -33,11 +43,19 @@ def main(argv=None) -> int:
     po = Postoffice.instance().start(
         num_data=args.num_workers or None, num_server=args.num_servers
     )
+    # heartbeat → dashboard → recovery, running for every app (the
+    # reference boots these with the postoffice on every node)
+    aux = po.start_aux(heartbeat_timeout=args.heartbeat_timeout)
+    aux.start(
+        check_interval=max(0.2, args.heartbeat_timeout / 5),
+        dashboard_interval=args.report_interval,
+    )
 
     if conf.darlin is not None:
         from .darlin import DarlinScheduler
 
         sched = DarlinScheduler(conf)
+        aux.register(sched.name)
         td = conf.training_data
         sched.load_data(td.file, td.text if td.format == "text" else td.format)
         sched.run_loaded(verbose=True)
@@ -52,6 +70,10 @@ def main(argv=None) -> int:
         sched.run()
         worker = AsyncSGDWorker(conf)
         worker.attach_monitor(sched)
+        aux.register(worker.name)
+        # dead worker → its file workloads go back to the pool; dead
+        # server shard → checkpoint restore (manager.cc dead-node flow)
+        aux.coordinator.on_worker_dead(sched.workload_pool.restore)
         sgd = conf.async_sgd
         while True:
             load = sched.workload_pool.assign(worker.name)
@@ -92,6 +114,8 @@ def main(argv=None) -> int:
     else:
         print("config selects no app", file=sys.stderr)
         return 2
+    if args.verbose or args.report_interval > 0:
+        print(aux.dashboard.report())
     po.stop()
     return 0
 

@@ -158,6 +158,9 @@ def submit(
             # sender, which must see its own identity
             if not getattr(req, "replied", False):
                 target.reply(req)
+            # message receipt doubles as a liveness signal (the reference
+            # piggybacks heartbeat info on messages)
+            target.po.beat(target.node.id)
         if callback is not None:
             callback()
 

@@ -1,0 +1,115 @@
+"""Production wiring of heartbeat → dashboard → recovery.
+
+The reference starts these as part of every run: nodes send
+HeartbeatReports on a timer (``src/system/postoffice.cc`` heartbeat
+thread), the scheduler renders the dashboard (``dashboard.cc``) and its
+manager reacts to dead nodes (``manager.cc`` dead-node flow). Round 1
+built the pieces but never started them from a production loop; this
+module is the glue the apps actually call.
+
+Usage (see apps/linear/main.py and tests/test_aux_integration.py):
+
+    aux = Postoffice.instance().start_aux(heartbeat_timeout=10.0)
+    aux.coordinator.on_worker_dead(pool.restore)
+    aux.start(check_interval=1.0, dashboard_interval=30.0)
+    ...   # hot loops call po.beat(node_id) / aux.beat(node_id)
+    aux.stop()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .dashboard import Dashboard
+from .heartbeat import HeartbeatCollector, HeartbeatInfo
+from .recovery import RecoveryCoordinator
+
+
+class AuxRuntime:
+    """Heartbeat sampling + liveness + dashboard, one per process."""
+
+    def __init__(
+        self,
+        heartbeat_timeout: float = 10.0,
+        print_fn: Callable[[str], None] = print,
+    ):
+        self.collector = HeartbeatCollector(timeout=heartbeat_timeout)
+        self.dashboard = Dashboard()
+        self.coordinator = RecoveryCoordinator(self.collector)
+        self.print_fn = print_fn
+        self._infos: Dict[str, HeartbeatInfo] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- node-side (each logical node beats from its hot loop) --
+
+    def register(self, node_id: str, hostname: str = "") -> HeartbeatInfo:
+        """Create (or return) the node's metrics sampler and report an
+        initial heartbeat so liveness tracking starts immediately."""
+        with self._lock:
+            info = self._infos.get(node_id)
+            if info is None:
+                import socket
+
+                info = HeartbeatInfo(hostname=hostname or socket.gethostname())
+                self._infos[node_id] = info
+        self.beat(node_id)
+        return info
+
+    def beat(self, node_id: str) -> None:
+        """Sample and report one heartbeat (ref postoffice.cc heartbeat
+        thread body). Safe no-op for unregistered nodes."""
+        with self._lock:
+            info = self._infos.get(node_id)
+        if info is None:
+            return
+        report = info.get()
+        self.collector.report(node_id, report)
+        self.dashboard.add_report(node_id, report)
+        # a node beating again after being declared dead is back — allow
+        # future re-detection (ref manager re-adding a returned node)
+        self.coordinator.revive(node_id)
+
+    def info(self, node_id: str) -> Optional[HeartbeatInfo]:
+        with self._lock:
+            return self._infos.get(node_id)
+
+    # -- scheduler-side background services --
+
+    def start(
+        self, check_interval: float = 1.0, dashboard_interval: float = 0.0
+    ) -> None:
+        """Start the liveness/recovery poller; ``dashboard_interval > 0``
+        also prints the dashboard table on that period (ref dashboard.cc
+        scheduler thread)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        last_dash = [time.monotonic()]
+
+        def loop() -> None:
+            while not self._stop.wait(check_interval):
+                self.coordinator.check()
+                if (
+                    dashboard_interval > 0
+                    and time.monotonic() - last_dash[0] >= dashboard_interval
+                ):
+                    last_dash[0] = time.monotonic()
+                    self.print_fn(self.dashboard.report())
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="aux-runtime")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.coordinator.stop()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None

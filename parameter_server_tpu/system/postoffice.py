@@ -28,6 +28,7 @@ class Postoffice:
         self.manager = Manager()
         self.mesh: Optional[Mesh] = None
         self.van: Optional[Van] = None
+        self.aux = None  # AuxRuntime once start_aux() is called
         self._started = False
 
     @classmethod
@@ -75,7 +76,27 @@ class Postoffice:
         self._started = True
         return self
 
+    def start_aux(self, heartbeat_timeout: float = 10.0, print_fn=print):
+        """Create (once) the heartbeat/dashboard/recovery runtime — the
+        reference boots these with every node (postoffice.cc heartbeat
+        thread, manager.cc dead-node flow, dashboard.cc)."""
+        if self.aux is None:
+            from .aux_runtime import AuxRuntime
+
+            self.aux = AuxRuntime(
+                heartbeat_timeout=heartbeat_timeout, print_fn=print_fn
+            )
+        return self.aux
+
+    def beat(self, node_id: str) -> None:
+        """Heartbeat passthrough for hot loops; no-op before start_aux."""
+        if self.aux is not None:
+            self.aux.beat(node_id)
+
     def stop(self) -> None:
+        if self.aux is not None:
+            self.aux.stop()
+            self.aux = None
         self.manager.stop()
         self._started = False
 

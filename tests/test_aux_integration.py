@@ -1,0 +1,136 @@
+"""Production heartbeat → recovery → dashboard integration (VERDICT r1 #4):
+the aux subsystems must run inside an actual training loop, not only unit
+tests. A worker dies mid-run on the 8-device mesh; the recovery
+coordinator returns its workload to the pool and the surviving worker
+finishes training every file."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    LossConfig,
+    PenaltyConfig,
+    SGDConfig,
+)
+from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+from parameter_server_tpu.learner.workload_pool import Workload, WorkloadPool
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def make_conf():
+    conf = Config()
+    conf.loss = LossConfig(type="logit")
+    conf.penalty = PenaltyConfig(type="l2", lambda_=[0.1])
+    conf.learning_rate = LearningRateConfig(alpha=0.5)
+    conf.async_sgd = SGDConfig(algo="ftrl", num_slots=512, minibatch=64)
+    return conf
+
+
+def _batch_for(file_id: str, w_true):
+    return random_sparse(128, 256, 6, seed=hash(file_id) % 1000, w_true=w_true)
+
+
+def test_worker_death_mid_run_recovers_and_finishes(mesh8):
+    po = Postoffice.instance()
+    if not po.started:
+        po.start()
+    aux = po.start_aux(heartbeat_timeout=0.4)
+    pool = WorkloadPool(Workload(files=[f"part-{i}" for i in range(6)]))
+    aux.coordinator.on_worker_dead(pool.restore)
+    aux.start(check_interval=0.05)
+
+    rng = np.random.default_rng(0)
+    w_true = (rng.normal(size=256) * (rng.random(256) < 0.2)).astype(np.float32)
+    conf = make_conf()
+    processed: dict[str, list] = {"W0": [], "W1": []}
+    dead_evt = threading.Event()
+
+    def worker_body(wid: str, die_after: int):
+        worker = AsyncSGDWorker(conf, mesh=mesh8, name=wid)
+        aux.register(wid)
+        n = 0
+        while True:
+            load = pool.assign(wid)
+            if load is None:
+                # pool may refill when a dead peer's workload is restored
+                if pool.wait_until_done(timeout=0.05):
+                    return
+                aux.beat(wid)
+                continue
+            for f in load.files:
+                worker.train(iter([_batch_for(f, w_true)]))
+                aux.beat(wid)
+            n += 1
+            if wid == "W1" and n >= die_after:
+                # crash WITHOUT finishing the workload: it must come back
+                # through the recovery path, not through pool bookkeeping
+                dead_evt.set()
+                return
+            pool.finish(load.id)
+            processed[wid].append(load.files)
+
+    t1 = threading.Thread(target=worker_body, args=("W1", 1))
+    t1.start()
+    t1.join()
+    assert dead_evt.is_set()
+
+    # W1 is now silent; W0 keeps beating while the coordinator declares W1
+    # dead and returns its unfinished file to the pool
+    t0 = threading.Thread(target=worker_body, args=("W0", 10**9))
+    t0.start()
+    deadline = time.time() + 20
+    while not pool.wait_until_done(timeout=0.2) and time.time() < deadline:
+        pass
+    t0.join(timeout=20)
+    assert pool.wait_until_done(timeout=1), "training must finish after recovery"
+    done_files = {f for loads in processed["W0"] for f in loads}
+    assert len(done_files) == 6, "W0 must pick up W1's restored workload"
+    # the dashboard saw both workers
+    table = aux.dashboard.report()
+    assert "W0" in table and "W1" in table
+    aux.stop()
+
+
+def test_dashboard_prints_on_interval(mesh8):
+    po = Postoffice.instance()
+    if not po.started:
+        po.start()
+    lines = []
+    aux = po.start_aux(heartbeat_timeout=5.0, print_fn=lines.append)
+    aux.register("W0")
+    aux.start(check_interval=0.02, dashboard_interval=0.05)
+    for _ in range(10):
+        aux.beat("W0")
+        time.sleep(0.02)
+    aux.stop()
+    assert lines and "W0" in lines[-1]
+
+
+def test_beat_revives_recovered_node(mesh8):
+    po = Postoffice.instance()
+    if not po.started:
+        po.start()
+    aux = po.start_aux(heartbeat_timeout=0.1)
+    seen = []
+    aux.coordinator.on_worker_dead(seen.append)
+    aux.register("W7")
+    time.sleep(0.15)
+    aux.coordinator.check()
+    assert seen == ["W7"]
+    aux.beat("W7")  # returned: future deaths must be detectable again
+    time.sleep(0.15)
+    aux.coordinator.check()
+    assert seen == ["W7", "W7"]
