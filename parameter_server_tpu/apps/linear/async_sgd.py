@@ -872,6 +872,24 @@ class AsyncSGDWorker(ISGDCompNode):
         self.executor.max_in_flight = max(0, sgd.max_delay) + 1
         self._pull_state = self.state
         self._steps_since_snapshot = 0
+        # ongoing replication (ref Parameter::SetReplica, executor.cc
+        # num_replicas_): every replica_every steps the whole table rolls
+        # one shard right, so shard s's segment is mirrored in shard s+1's
+        # HBM — a dead shard loses ≤ replica_every steps
+        self._replica_state = None
+        self._steps_since_replica = 0
+        if sgd.num_replicas > 0:
+            per = self.num_slots // meshlib.num_servers(mesh)
+
+            def _roll(state):
+                return jax.tree.map(
+                    lambda x: jnp.roll(x, per, axis=0) if x.ndim >= 1 else x,
+                    state,
+                )
+
+            self._replicate_fn = jax.jit(_roll, donate_argnums=())
+        else:
+            self._replicate_fn = None
         self._pads: Optional[Tuple[int, int, int]] = None
         self._num_shards_cache: Optional[int] = None
         self.progress = SGDProgress()
@@ -1087,6 +1105,14 @@ class AsyncSGDWorker(ISGDCompNode):
                 self._pull_state = self.state
             new_state, metrics = step_fn(self.state, self._pull_state, prepped, seed)
             self.state = new_state
+            if self._replicate_fn is not None:
+                self._steps_since_replica += 1
+                if (
+                    self._replica_state is None
+                    or self._steps_since_replica >= self.sgd.replica_every
+                ):
+                    self._steps_since_replica = 0
+                    self._replica_state = self._replicate_fn(self.state)
             return metrics
 
         self._steps_since_snapshot += 1
@@ -1136,6 +1162,54 @@ class AsyncSGDWorker(ISGDCompNode):
         # WITHOUT popping: metrics stay claimable by a later collect()
         self.executor.wait_all(pop=False)
         return np.asarray(self._weights_fn(self.state))
+
+    def recover_server_shard(self, shard: int) -> bool:
+        """Rebuild a dead server shard's slot segment from the live
+        neighbor replica (ref Parameter::Recover pulling the dead node's
+        key segment from kReplicaGroup). The restored segment is at most
+        ``replica_every`` steps stale. Submitted through the executor so
+        it is ordered with in-flight training steps."""
+        if self._replica_state is None:
+            return False
+        n_servers = meshlib.num_servers(self.mesh)
+        per = self.num_slots // n_servers
+
+        def do_recover():
+            seg = (jnp.arange(self.num_slots) // per) == shard
+
+            def fix(prim, rep):
+                if getattr(prim, "ndim", 0) < 1:
+                    return prim
+                recovered = jnp.roll(rep, -per, axis=0)
+                m = seg.reshape((-1,) + (1,) * (prim.ndim - 1))
+                return jnp.where(m, recovered, prim)
+
+            self.state = jax.tree.map(fix, self.state, self._replica_state)
+            self._pull_state = self.state
+            return True
+
+        ts = self.submit(do_recover)
+        return bool(self.executor.wait(ts))
+
+    def wipe_server_shard(self, shard: int) -> None:
+        """Test/chaos helper: zero a shard's slot segment, simulating a
+        replacement server that boots empty (ref recovery tests)."""
+        n_servers = meshlib.num_servers(self.mesh)
+        per = self.num_slots // n_servers
+
+        def do_wipe():
+            seg = (jnp.arange(self.num_slots) // per) == shard
+
+            def z(prim):
+                if getattr(prim, "ndim", 0) < 1:
+                    return prim
+                m = seg.reshape((-1,) + (1,) * (prim.ndim - 1))
+                return jnp.where(m, jnp.zeros_like(prim), prim)
+
+            self.state = jax.tree.map(z, self.state)
+            self._pull_state = self.state
+
+        self.executor.wait(self.submit(do_wipe))
 
     def evaluate(self, batch: SparseBatch) -> Dict[str, float]:
         """Validation metrics on a batch (ref COMPUTE_VALIDATION_AUC)."""

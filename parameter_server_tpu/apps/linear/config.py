@@ -76,6 +76,13 @@ class SGDConfig:
     # u24 otherwise — cheapest bytes AND cheapest host cycles via the
     # fused C++ hash→pack pass)
     wire: str = ""
+    # ongoing server replication (ref FLAGS_num_replicas + Parameter::
+    # SetReplica): >0 mirrors each server shard's segment onto its
+    # neighbor shard every `replica_every` steps, so a dead server loses
+    # at most that many steps instead of everything since the last
+    # checkpoint
+    num_replicas: int = 0
+    replica_every: int = 1
 
 
 @dataclasses.dataclass
@@ -252,6 +259,8 @@ def parse_conf(text: str) -> Config:
             ell_lanes=int(s.get("ell_lanes", 0)),
             wire_u24=bool(s.get("wire_u24", False)),
             wire=str(s.get("wire", "")),
+            num_replicas=int(s.get("num_replicas", 0)),
+            replica_every=int(s.get("replica_every", 1)),
             push_filter=_filter_list(s.get("push_filter")),
             pull_filter=_filter_list(s.get("pull_filter")),
         )

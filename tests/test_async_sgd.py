@@ -280,6 +280,79 @@ class TestBitsWire:
         assert isinstance(prepped, ELLPackedBatch)
 
 
+class TestLiveReplication:
+    """VERDICT r1 #5: ongoing server replication — every replica_every
+    steps the table mirrors onto the neighbor shard, so a dead server
+    loses at most replica_every steps (ref Parameter::SetReplica/Recover,
+    FLAGS_num_replicas)."""
+
+    def _worker(self, mesh8, every=2):
+        conf = make_conf(num_slots=512)
+        conf.async_sgd.num_replicas = 1
+        conf.async_sgd.replica_every = every
+        return AsyncSGDWorker(conf, mesh=mesh8)
+
+    def test_recover_restores_dead_shard_with_bounded_staleness(
+        self, mesh8, w_true
+    ):
+        worker = self._worker(mesh8, every=1)  # replica refreshed per step
+        worker.train(synth(4, w_true))
+        before = worker.weights_dense()
+        n_servers = 2  # mesh8 is data4 x server2
+        per = worker.num_slots // n_servers
+        # shard 0 dies: replacement boots empty
+        worker.wipe_server_shard(0)
+        wiped = worker.weights_dense()
+        assert np.abs(wiped[:per]).sum() == 0
+        assert worker.recover_server_shard(0)
+        after = worker.weights_dense()
+        # segment 1 untouched; segment 0 restored from the live replica
+        # (with every=1 the replica is exactly current)
+        np.testing.assert_allclose(after[per:], before[per:], atol=1e-6)
+        np.testing.assert_allclose(after[:per], before[:per], atol=1e-6)
+
+    def test_staleness_bounded_not_zero(self, mesh8, w_true):
+        worker = self._worker(mesh8, every=1000)  # replicate only at step 1
+        batches = list(synth(5, w_true))
+        worker.train(iter(batches[:1]))  # replica taken at first step
+        snap = worker.weights_dense().copy()
+        worker.train(iter(batches[1:]))
+        worker.wipe_server_shard(0)
+        assert worker.recover_server_shard(0)
+        after = worker.weights_dense()
+        per = worker.num_slots // 2
+        # restored segment equals the FIRST-step snapshot (stale but
+        # bounded), not zeros and not the final state
+        np.testing.assert_allclose(after[:per], snap[:per], atol=1e-6)
+
+    def test_recovery_coordinator_drives_shard_recovery(self, mesh8, w_true):
+        from parameter_server_tpu.system.heartbeat import (
+            HeartbeatCollector,
+            HeartbeatReport,
+        )
+        from parameter_server_tpu.system.recovery import RecoveryCoordinator
+
+        worker = self._worker(mesh8, every=1)
+        worker.train(synth(3, w_true))
+        want = worker.weights_dense().copy()
+        worker.wipe_server_shard(1)
+
+        c = HeartbeatCollector(timeout=5.0)
+        c.report("S1", HeartbeatReport())
+        rc = RecoveryCoordinator(c)
+        rc.on_server_dead(
+            lambda nid: worker.recover_server_shard(int(nid[1:]))
+        )
+        assert rc.check(now=c._last_seen["S1"] + 6) == ["S1"]
+        np.testing.assert_allclose(worker.weights_dense(), want, atol=1e-6)
+
+    def test_no_replica_configured_returns_false(self, mesh8, w_true):
+        conf = make_conf(num_slots=512)
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        worker.train(synth(1, w_true))
+        assert not worker.recover_server_shard(0)
+
+
 class TestELLOverflowGuard:
     """VERDICT r1 #7: the reference never drops features — a row wider than
     the ELL lane budget must fall back to the hashed COO path (or raise),
