@@ -54,7 +54,7 @@ class StreamReader:
         from .example import batch_from_bytes
 
         for path in self.files:
-            with open(path, "rb") as f:
+            with psfile.open_read(path, "rb") as f:
                 for payload in recordio.RecordReader(f):
                     yield batch_from_bytes(payload)
 

@@ -1,31 +1,206 @@
 """File IO helpers (ref ``src/util/file.{h,cc}``, ``filelinereader.{h,cc}``,
 ``hdfs.h``).
 
-Local + gzip reading, glob expansion of DataConfig-style file patterns, and
-a line reader. HDFS/S3 URLs are recognized and rejected with a clear error
-(gated, no hadoop client in this environment — ref hdfs.h shells out to
-``hadoop fs``).
+Local + gzip reading, glob expansion of DataConfig-style file patterns, a
+line reader, and a pluggable remote-filesystem registry. Remote URLs
+(``scheme://...``) route to a registered filesystem adapter; the bundled
+``HadoopCliFS`` shells out to ``hadoop fs`` exactly like the reference
+(``util/file.cc hadoopFS()``: ``<home>/bin/hadoop fs -D
+fs.default.name=<namenode> -D hadoop.job.ugi=<ugi> -cat/-ls/-put``).
+Environments without a hadoop client keep the clear gated error.
 """
 
 from __future__ import annotations
 
 import glob as _glob
-import re
 import gzip
+import io
 import os
-from typing import IO, Iterable, Iterator, List
+import re
+import subprocess
+from typing import IO, Dict, Iterable, Iterator, List, Optional
+
+# -- pluggable remote filesystems ------------------------------------------
+
+_REMOTE_FS: Dict[str, "RemoteFS"] = {}
+
+
+class RemoteFS:
+    """Adapter interface for a remote filesystem scheme.
+
+    Counterpart of the reference's HDFS hooks in ``util/file.cc``
+    (hadoopFS -cat / -ls). Implementations provide streaming reads,
+    writes, and pattern listing; gzip decoding is layered on top by
+    :func:`open_read`, mirroring the reference's gzFile path.
+    """
+
+    def open_read(self, path: str) -> IO[bytes]:
+        raise NotImplementedError
+
+    def open_write(self, path: str) -> IO[bytes]:
+        raise NotImplementedError
+
+    def list(self, pattern: str) -> List[str]:
+        raise NotImplementedError
+
+
+def register_filesystem(scheme: str, fs: Optional[RemoteFS]) -> None:
+    """Register (or, with None, remove) the adapter for ``scheme://``."""
+    if fs is None:
+        _REMOTE_FS.pop(scheme, None)
+    else:
+        _REMOTE_FS[scheme] = fs
+
+
+def get_filesystem(path_or_scheme: str) -> Optional[RemoteFS]:
+    scheme = path_or_scheme.split("://", 1)[0] if "://" in path_or_scheme else path_or_scheme
+    return _REMOTE_FS.get(scheme)
+
+
+class HadoopCliFS(RemoteFS):
+    """``hadoop fs`` CLI adapter (ref util/file.cc hadoopFS + hdfs.h).
+
+    Streams bytes through the hadoop client subprocess: ``-cat`` for
+    reads, ``-put -`` for writes, ``-ls`` for listing. ``home``/
+    ``namenode``/``ugi`` mirror the reference's HDFSConfig proto fields;
+    ``home`` falls back to $HADOOP_HOME.
+    """
+
+    def __init__(
+        self,
+        home: str = "",
+        namenode: str = "",
+        ugi: str = "",
+        binary: Optional[str] = None,
+    ):
+        self.home = home or os.environ.get("HADOOP_HOME", "")
+        self.namenode = namenode
+        self.ugi = ugi
+        self._binary = binary  # test hook: explicit executable
+
+    def _cmd(self) -> List[str]:
+        if self._binary:
+            cmd = [self._binary, "fs"]
+        elif self.home:
+            cmd = [os.path.join(self.home, "bin", "hadoop"), "fs"]
+        else:
+            cmd = ["hadoop", "fs"]
+        if self.namenode:
+            cmd += ["-D", f"fs.default.name={self.namenode}"]
+        if self.ugi:
+            cmd += ["-D", f"hadoop.job.ugi={self.ugi}"]
+        return cmd
+
+    def open_read(self, path: str) -> IO[bytes]:
+        proc = subprocess.Popen(
+            self._cmd() + ["-cat", path],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        return _ProcReader(proc, path)
+
+    def open_write(self, path: str) -> IO[bytes]:
+        proc = subprocess.Popen(
+            self._cmd() + ["-put", "-", path],
+            stdin=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+        )
+        return _ProcWriter(proc, path)
+
+    def list(self, pattern: str) -> List[str]:
+        out = subprocess.run(
+            self._cmd() + ["-ls", pattern],
+            capture_output=True,
+            text=True,
+        )
+        if out.returncode != 0:
+            return []
+        files = []
+        for line in out.stdout.splitlines():
+            # `hadoop fs -ls` lines end with the path (ref file.cc
+            # readFilenamesInDirectory: token after the last space)
+            parts = line.split()
+            if parts and "://" in parts[-1] or (parts and parts[-1].startswith("/")):
+                files.append(parts[-1])
+        return sorted(files)
+
+
+class _ProcReader(io.RawIOBase):
+    """File-like over a subprocess stdout; surfaces the exit code."""
+
+    def __init__(self, proc: subprocess.Popen, path: str):
+        self._proc = proc
+        self._path = path
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        chunk = self._proc.stdout.read(len(b))
+        if not chunk:
+            return 0
+        b[: len(chunk)] = chunk
+        return len(chunk)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self._proc.stdout.close()
+        code = self._proc.wait()
+        err = self._proc.stderr.read().decode(errors="replace")
+        self._proc.stderr.close()
+        super().close()
+        if code != 0:
+            raise IOError(f"remote read failed ({code}) for {self._path}: {err.strip()}")
+
+
+class _ProcWriter(io.RawIOBase):
+    def __init__(self, proc: subprocess.Popen, path: str):
+        self._proc = proc
+        self._path = path
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._proc.stdin.write(b)
+        return len(b)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self._proc.stdin.close()
+        code = self._proc.wait()
+        err = self._proc.stderr.read().decode(errors="replace")
+        self._proc.stderr.close()
+        super().close()
+        if code != 0:
+            raise IOError(f"remote write failed ({code}) for {self._path}: {err.strip()}")
+
+
+# -- scheme-aware open/list -------------------------------------------------
 
 
 def is_remote(path: str) -> bool:
-    return path.startswith("hdfs://") or path.startswith("s3://")
+    return "://" in path
 
 
 def open_read(path: str, mode: str = "rt") -> IO:
     if is_remote(path):
-        raise NotImplementedError(
-            f"remote filesystem not available in this environment: {path} "
-            "(reference shells out to `hadoop fs`; gate your DataConfig to local files)"
-        )
+        fs = get_filesystem(path)
+        if fs is None:
+            raise NotImplementedError(
+                f"no filesystem registered for {path!r} — register one with "
+                "utils.file.register_filesystem (e.g. HadoopCliFS for "
+                "hdfs://; the reference shells out to `hadoop fs` the "
+                "same way)"
+            )
+        raw = fs.open_read(path)
+        if path.endswith(".gz"):
+            raw = gzip.open(raw, "rb")
+        if "b" not in mode:
+            return io.TextIOWrapper(io.BufferedReader(raw) if isinstance(raw, io.RawIOBase) else raw)
+        return raw
     if path.endswith(".gz"):
         return gzip.open(path, mode)
     return open(path, mode)
@@ -34,6 +209,19 @@ def open_read(path: str, mode: str = "rt") -> IO:
 def open_write(path: str, mode: str = "w") -> IO:
     """Open for writing, creating parent directories (the reference's
     SaveModel does createDir(getPath(file)) first, bcd.h:225)."""
+    if is_remote(path):
+        fs = get_filesystem(path)
+        if fs is None:
+            raise NotImplementedError(
+                f"no filesystem registered for {path!r} — register one with "
+                "utils.file.register_filesystem"
+            )
+        raw = fs.open_write(path)
+        if path.endswith(".gz"):
+            return gzip.open(raw, "wb")
+        if "b" not in mode:
+            return io.TextIOWrapper(io.BufferedWriter(raw) if isinstance(raw, io.RawIOBase) else raw)
+        return raw
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
     if path.endswith(".gz"):
@@ -48,12 +236,16 @@ def expand_globs(patterns: Iterable[str]) -> List[str]:
     pattern's directory (data/common.cc:113-134 searchFiles), which is why
     its example configs say ``part.*``. We accept both: shell glob first
     (the pythonic convenience), then reference-style anchored basename
-    regex when the glob finds nothing.
+    regex when the glob finds nothing. Remote patterns list through the
+    registered filesystem (ref file.cc readFilenamesInDirectory hdfs -ls),
+    passing through untouched when none is registered.
     """
     out: List[str] = []
     for p in patterns:
         if is_remote(p):
-            out.append(p)
+            fs = get_filesystem(p)
+            hits = fs.list(p) if fs is not None else []
+            out.extend(hits if hits else [p])
             continue
         hits = sorted(_glob.glob(p))
         if not hits and os.path.exists(p):

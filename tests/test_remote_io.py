@@ -1,0 +1,140 @@
+"""Remote filesystem plumbing (ref util/hdfs.h + file.cc hadoopFS):
+pluggable scheme registry, gzip streaming over remote reads, and the
+hadoop-CLI adapter exercised against a local fake `hadoop` executable."""
+
+import gzip
+import os
+import stat
+
+import pytest
+
+from parameter_server_tpu.data.stream_reader import StreamReader
+from parameter_server_tpu.utils import file as psfile
+
+
+class LocalFakeFS(psfile.RemoteFS):
+    """mock:// filesystem backed by a local directory."""
+
+    def __init__(self, root):
+        self.root = str(root)
+
+    def _local(self, path):
+        return os.path.join(self.root, path.split("://", 1)[1])
+
+    def open_read(self, path):
+        return open(self._local(path), "rb")
+
+    def open_write(self, path):
+        local = self._local(path)
+        os.makedirs(os.path.dirname(local), exist_ok=True)
+        return open(local, "wb")
+
+    def list(self, pattern):
+        import glob
+
+        hits = glob.glob(self._local(pattern))
+        return sorted(
+            "mock://" + os.path.relpath(h, self.root) for h in hits
+        )
+
+
+@pytest.fixture
+def mockfs(tmp_path):
+    fs = LocalFakeFS(tmp_path / "remote")
+    psfile.register_filesystem("mock", fs)
+    yield fs
+    psfile.register_filesystem("mock", None)
+
+
+def test_unregistered_scheme_still_gated():
+    with pytest.raises(NotImplementedError, match="register"):
+        psfile.open_read("hdfs://nn/some/file.txt")
+    with pytest.raises(NotImplementedError):
+        psfile.open_write("s3://bucket/key")
+
+
+def test_roundtrip_text_through_registered_fs(mockfs):
+    with psfile.open_write("mock://a/b.txt") as f:
+        f.write("hello\nworld\n")
+    assert list(psfile.read_lines("mock://a/b.txt")) == ["hello", "world"]
+
+
+def test_gzip_streaming_over_remote(mockfs, tmp_path):
+    local = tmp_path / "remote" / "z.gz"
+    os.makedirs(local.parent, exist_ok=True)
+    with gzip.open(local, "wt") as f:
+        f.write("1 1:0.5\n-1 2:1.5\n")
+    lines = list(psfile.read_lines("mock://z.gz"))
+    assert lines == ["1 1:0.5", "-1 2:1.5"]
+
+
+def test_expand_globs_lists_remote(mockfs, tmp_path):
+    root = tmp_path / "remote" / "train"
+    os.makedirs(root)
+    for i in range(3):
+        (root / f"part-{i}").write_text("1 1:1\n")
+    hits = psfile.expand_globs(["mock://train/part-*"])
+    assert hits == [f"mock://train/part-{i}" for i in range(3)]
+
+
+def test_stream_reader_over_remote(mockfs, tmp_path):
+    root = tmp_path / "remote" / "d"
+    os.makedirs(root)
+    (root / "p0").write_text("1 1:0.5\n-1 3:2\n")
+    (root / "p1").write_text("1 2:1\n")
+    batch = StreamReader(["mock://d/p*"], "libsvm").read_all()
+    assert batch is not None and batch.n == 3 and batch.nnz == 3
+
+
+FAKE_HADOOP = """#!/bin/sh
+# tiny `hadoop fs` stand-in: maps hdfs://fake/<p> to $FAKE_HDFS_ROOT/<p>
+shift  # drop "fs"
+while [ "$1" = "-D" ]; do shift 2; done
+op="$1"; shift
+strip() { echo "$1" | sed 's|hdfs://fake/||'; }
+case "$op" in
+  -cat) cat "$FAKE_HDFS_ROOT/$(strip "$1")" ;;
+  -put) src="$1"; dst="$FAKE_HDFS_ROOT/$(strip "$2")"
+        mkdir -p "$(dirname "$dst")"; cat > "$dst" ;;
+  -ls)  for f in "$FAKE_HDFS_ROOT"/$(strip "$1"); do
+          [ -e "$f" ] || exit 1
+          echo "-rw-r--r-- 1 u g 0 2026-01-01 00:00 hdfs://fake/$(basename "$f")"
+        done ;;
+  *) exit 2 ;;
+esac
+"""
+
+
+@pytest.fixture
+def hadoop_cli(tmp_path, monkeypatch):
+    binary = tmp_path / "hadoop"
+    binary.write_text(FAKE_HADOOP)
+    binary.chmod(binary.stat().st_mode | stat.S_IEXEC)
+    root = tmp_path / "hdfs_root"
+    os.makedirs(root)
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(root))
+    fs = psfile.HadoopCliFS(binary=str(binary), namenode="hdfs://fake")
+    psfile.register_filesystem("hdfs", fs)
+    yield root
+    psfile.register_filesystem("hdfs", None)
+
+
+def test_hadoop_cli_read_write_roundtrip(hadoop_cli):
+    with psfile.open_write("hdfs://fake/out/data.txt") as f:
+        f.write("alpha\nbeta\n")
+    assert (hadoop_cli / "out" / "data.txt").read_text() == "alpha\nbeta\n"
+    assert list(psfile.read_lines("hdfs://fake/out/data.txt")) == ["alpha", "beta"]
+
+
+def test_hadoop_cli_ls(hadoop_cli):
+    for i in range(2):
+        (hadoop_cli / f"part-{i}").write_text("x\n")
+    hits = psfile.expand_globs(["hdfs://fake/part-*"])
+    assert hits == ["hdfs://fake/part-0", "hdfs://fake/part-1"]
+
+
+def test_hadoop_cli_missing_file_raises(hadoop_cli):
+    f = psfile.open_read("hdfs://fake/nope.txt")
+    with pytest.raises(IOError):
+        f.read()
+        f.close()
