@@ -432,11 +432,22 @@ def make_push_reduce(push_quant: int):
     if not push_quant:
         return lambda g, seed: jax.lax.psum(g, DATA_AXIS)
     from ...filter.fixing_float import dequantize_jax, quantize_jax
+    from ...ops import quantize as qops
+
+    use_pallas = qops.use_pallas()
 
     def reduce(g, seed):
-        key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
-        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
-        q, lo, hi = quantize_jax(g, push_quant, key)
+        if use_pallas:
+            # fused Pallas normalize+noise+floor (measured ~4% faster than
+            # the XLA chain on v5e for 2M-slot shards; BENCH_r2 notes)
+            s = seed.astype(jnp.int32) * jnp.int32(1000003) + jax.lax.axis_index(
+                DATA_AXIS
+            ).astype(jnp.int32)
+            q, lo, hi = qops.quantize_traced(g, s, num_bytes=push_quant)
+        else:
+            key = jax.random.fold_in(jax.random.PRNGKey(0x5EED), seed)
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            q, lo, hi = quantize_jax(g, push_quant, key)
         dec = dequantize_jax(q, lo, hi, push_quant)
         dec = jnp.where(g != 0, dec, 0.0)
         return jax.lax.psum(dec, DATA_AXIS)
@@ -455,12 +466,21 @@ def make_pull_weights(updater, pull_quant: int):
     if not pull_quant:
         return lambda pulled, seed: updater.weights(pulled)
     from ...filter.fixing_float import dequantize_jax, quantize_jax
+    from ...ops import quantize as qops
+
+    use_pallas = qops.use_pallas()
 
     def pull(pulled, seed):
         w = updater.weights(pulled)
-        key = jax.random.fold_in(jax.random.PRNGKey(0xF00D), seed)
-        key = jax.random.fold_in(key, jax.lax.axis_index(SERVER_AXIS))
-        q, lo, hi = quantize_jax(w, pull_quant, key)
+        if use_pallas:
+            s = seed.astype(jnp.int32) * jnp.int32(999983) + jax.lax.axis_index(
+                SERVER_AXIS
+            ).astype(jnp.int32)
+            q, lo, hi = qops.quantize_traced(w, s, num_bytes=pull_quant)
+        else:
+            key = jax.random.fold_in(jax.random.PRNGKey(0xF00D), seed)
+            key = jax.random.fold_in(key, jax.lax.axis_index(SERVER_AXIS))
+            q, lo, hi = quantize_jax(w, pull_quant, key)
         dec = dequantize_jax(q, lo, hi, pull_quant)
         return jnp.where(w != 0, dec, 0.0)
 

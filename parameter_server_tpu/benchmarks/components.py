@@ -137,8 +137,8 @@ def sparse_matrix_perf(smoke: bool = False) -> None:
     key uniquification (countUniqIndex), localization, and the device
     SpMV."""
     import jax
+    import jax.numpy as jnp
 
-    from ..ops import spmv
     from ..utils.localizer import Localizer, count_uniq_keys
     from ..utils.sparse import random_sparse
 
@@ -172,7 +172,12 @@ def sparse_matrix_perf(smoke: bool = False) -> None:
         else local.values.astype(np.float32)
     )
     args = [jax.device_put(a) for a in (vals, ucols, rows, w)]
-    fn = jax.jit(lambda v, c, r, w: spmv.spmv(v, c, r, w, n))
+    # Xw = segment-sum over the localized COO — the XLA formulation the
+    # fused app steps use (a Pallas spmv was probed and rejected: Mosaic
+    # has no 1-D table gather; see SURVEY §3)
+    fn = jax.jit(
+        lambda v, c, r, w: jax.ops.segment_sum(v * w[c], r, num_segments=n)
+    )
     jax.block_until_ready(fn(*args))
 
     def mv():

@@ -19,6 +19,12 @@ def _use_pallas() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+def use_pallas() -> bool:
+    """Public switch: True when the TPU kernel path is active (the
+    production push/pull wires key off this, async_sgd.make_push_reduce)."""
+    return _use_pallas()
+
+
 _LANES = 128
 _SUBLANES = 8
 _TILE = _LANES * _SUBLANES
@@ -41,17 +47,22 @@ def _kernel(x_ref, lo_ref, hi_ref, seed_ref, out_ref, *, levels):
     out_ref[:] = q
 
 
-@functools.partial(jax.jit, static_argnames=("num_bytes", "force_pallas"))
-def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = False):
-    """Quantize a 1-D float array to n-byte fixed point.
-
-    Returns (q, lo, hi); q is uint8/uint16. Padding to the TPU tile is
-    handled internally.
-    """
+def quantize_traced(x: jax.Array, seed, *, num_bytes: int = 1):
+    """Traceable quantize for use INSIDE jitted/shard_mapped steps (the
+    production push/pull wire, async_sgd.make_push_reduce): ``seed`` is a
+    traced int32 scalar. On TPU this lowers to the fused Pallas kernel;
+    elsewhere to the jnp reference chain."""
     from ..filter.fixing_float import quantize_jax
 
-    if not (force_pallas or _use_pallas()):
-        return quantize_jax(x, num_bytes, jax.random.PRNGKey(seed))
+    if not _use_pallas():
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(0x9A17), jnp.asarray(seed, jnp.uint32)
+        )
+        return quantize_jax(x, num_bytes, key)
+    return _quantize_pallas(x, jnp.asarray(seed, jnp.int32), num_bytes)
+
+
+def _quantize_pallas(x: jax.Array, seed, num_bytes: int):
     levels = float((1 << (8 * num_bytes)) - 1)
     lo = jnp.min(x)
     hi = jnp.maximum(jnp.max(x), lo + 1e-12)
@@ -81,9 +92,23 @@ def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = Fal
         xp,
         lo.reshape(1),
         hi.reshape(1),
-        jnp.asarray([seed], jnp.int32),
+        seed.reshape(1),
     )
     return q.reshape(-1)[:n].astype(dt), lo, hi
+
+
+@functools.partial(jax.jit, static_argnames=("num_bytes", "force_pallas"))
+def quantize(x: jax.Array, seed, *, num_bytes: int = 1, force_pallas: bool = False):
+    """Quantize a 1-D float array to n-byte fixed point.
+
+    Returns (q, lo, hi); q is uint8/uint16. Padding to the TPU tile is
+    handled internally.
+    """
+    from ..filter.fixing_float import quantize_jax
+
+    if not (force_pallas or _use_pallas()):
+        return quantize_jax(x, num_bytes, jax.random.PRNGKey(seed))
+    return _quantize_pallas(x, jnp.asarray(seed, jnp.int32), num_bytes)
 
 
 def dequantize(q: jax.Array, lo, hi, num_bytes: int = 1) -> jax.Array:

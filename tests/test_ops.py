@@ -1,14 +1,33 @@
-"""Ops tests: spmv segment kernels vs dense, FTRL kernel fallback parity,
-quantize roundtrip error bounds (CPU fallback paths; the Pallas variants are
-exercised on TPU by bench/verify runs)."""
+"""Ops tests: the XLA segment-sum spmv formulation vs dense, FTRL kernel
+fallback parity, quantize roundtrip error bounds (CPU fallback paths; the
+Pallas variants are exercised on TPU by bench/verify runs).
 
+The spmv helpers below are the canonical formulations the fused app steps
+inline (darlin/async_sgd); a Pallas spmv kernel was probed on v5e and
+rejected — Mosaic has no 1-D table gather — see SURVEY §3."""
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from parameter_server_tpu.ops.ftrl import ftrl_update, ftrl_update_ref
 from parameter_server_tpu.ops.quantize import dequantize, quantize
-from parameter_server_tpu.ops.spmv import spmv, spmv_t, spmv_t_sq
 from parameter_server_tpu.utils.sparse import random_sparse
+
+
+def spmv(vals, cols, rows, w, n):
+    """Xw over localized COO (loss.h::compute's Eigen matvec)."""
+    return jax.ops.segment_sum(vals * w[cols], rows, num_segments=n)
+
+
+def spmv_t(vals, cols, rows, g, u):
+    """X^T g (loss.h transTimes)."""
+    return jax.ops.segment_sum(vals * g[rows], cols, num_segments=u)
+
+
+def spmv_t_sq(vals, cols, rows, h, u):
+    """(X.^2)^T h (loss.h dotTimes path)."""
+    return jax.ops.segment_sum(vals * vals * h[rows], cols, num_segments=u)
 
 
 class TestSpmv:
