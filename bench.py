@@ -18,12 +18,252 @@ evaluation: ~65k examples/sec/node with sparse LR at ~100 nnz/example).
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
+
+
+# ---------------------------------------------------------------------------
+# --real mode: stream actual criteo-format TEXT from disk through the C++
+# parser → localization → fused device step, parsing INSIDE the timed
+# pipeline, with a logloss-parity check against a NumPy FTRL oracle
+# (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
+# ---------------------------------------------------------------------------
+
+_HEXD = np.frombuffer(b"0123456789abcdef", np.uint8)
+_ROW_BYTES = 275  # 1 label + 13 2-digit ints + 26 8-hex cats + 39 tabs + \n
+
+
+def _write_criteo_chunk(f, rng, n: int, w_true: np.ndarray) -> None:
+    """Vectorized criteo-format text writer: fixed-width rows assembled as
+    one uint8 matrix (no per-row Python formatting — generating multi-GB
+    files at memory speed). Token frequencies follow a power law (cube of
+    a uniform) like real CTR logs; labels carry signal via w_true."""
+    p_cat = w_true.size
+    u = rng.random((n, 26))
+    cats = (u * u * u * p_cat).astype(np.int64)
+    ints = rng.integers(10, 100, size=(n, 13))
+    y = w_true[cats].sum(axis=1) > 0
+    buf = np.empty((n, _ROW_BYTES), np.uint8)
+    buf[:, 0] = ord("0") + y
+    buf[:, 1] = 9  # \t
+    for j in range(13):
+        c = 2 + 3 * j
+        buf[:, c] = ord("0") + ints[:, j] // 10
+        buf[:, c + 1] = ord("0") + ints[:, j] % 10
+        buf[:, c + 2] = 9
+    nib = (cats[:, :, None] >> np.arange(28, -4, -4)) & 0xF
+    hexs = _HEXD[nib]  # [n, 26, 8] ascii
+    for j in range(26):
+        c = 41 + 9 * j
+        buf[:, c : c + 8] = hexs[:, j]
+        buf[:, c + 8] = 9
+    buf[:, _ROW_BYTES - 1] = 10  # \n
+    buf.tofile(f)
+
+
+def ensure_criteo_file(path: str, target_mb: int, p_cat: int = 1 << 24) -> str:
+    """Generate (once, cached on disk) a criteo-format text file of
+    ~target_mb MB. Deterministic: seed 0."""
+    want = target_mb << 20
+    if os.path.exists(path) and abs(os.path.getsize(path) - want) < (_ROW_BYTES << 12):
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rng = np.random.default_rng(0)
+    w_true = (rng.normal(size=p_cat) * (rng.random(p_cat) < 0.05)).astype(np.float32)
+    rows_left = -(-want // _ROW_BYTES)
+    t0 = time.perf_counter()
+    with open(path + ".tmp", "wb") as f:
+        while rows_left > 0:
+            n = min(rows_left, 1 << 18)
+            _write_criteo_chunk(f, rng, n, w_true)
+            rows_left -= n
+    os.replace(path + ".tmp", path)
+    print(
+        f"# generated {os.path.getsize(path) >> 20}MB criteo text in "
+        f"{time.perf_counter() - t0:.1f}s -> {path}",
+        file=sys.stderr,
+    )
+    return path
+
+
+class FtrlOracle:
+    """NumPy FTRL on hashed slots — bit-for-bit the device step's math
+    (updaters.py FTRLUpdater / ref FTRLEntry::Set) restricted to touched
+    slots, using the SAME murmur hash→slot localization. Used to assert
+    logloss parity of the real-data device pipeline."""
+
+    def __init__(self, num_slots: int, alpha: float, beta: float, l1: float):
+        self.num_slots = num_slots
+        self.alpha, self.beta, self.l1 = alpha, beta, l1
+        self.z = np.zeros(num_slots, np.float32)
+        self.sqrt_n = np.zeros(num_slots, np.float32)
+
+    def step(self, batch) -> float:
+        """One minibatch: returns the summed logloss (pre-update weights,
+        matching the device metrics' objective)."""
+        from parameter_server_tpu.utils.murmur import hash_slots
+
+        n_rows = batch.n
+        lanes = batch.nnz // n_rows
+        slots = hash_slots(batch.indices, self.num_slots)
+        u, inv = np.unique(slots, return_inverse=True)
+        eta = self.alpha / (self.sqrt_n[u] + self.beta)
+        zt = -self.z[u] * eta
+        w_u = np.sign(zt) * np.maximum(np.abs(zt) - self.l1 * eta, 0.0)
+        xw = w_u[inv].reshape(n_rows, lanes).sum(axis=1)
+        y = batch.y
+        ll = float(np.logaddexp(0.0, -y * xw).sum())
+        tau = 1.0 / (1.0 + np.exp(np.clip(y * xw, -60, 60)))
+        gr = (-y * tau).astype(np.float32)
+        g_u = np.bincount(
+            inv, weights=np.repeat(gr, lanes), minlength=u.size
+        ).astype(np.float32)
+        n_new = np.sqrt(self.sqrt_n[u] ** 2 + g_u**2)
+        self.z[u] += g_u - (n_new - self.sqrt_n[u]) / self.alpha * w_u
+        self.sqrt_n[u] = n_new
+        return ll
+
+
+def run_real(args) -> int:
+    """End-to-end real-data bench: criteo TEXT on disk → chunked C++ parse
+    (thread pool) → hash/bit-pack localization → device submit, all inside
+    the timed loop; then a device-only rate on pre-staged batches; plus a
+    logloss-parity phase vs FtrlOracle. One JSON line with all three."""
+    import jax
+
+    from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+    from parameter_server_tpu.apps.linear.config import (
+        Config,
+        LearningRateConfig,
+        PenaltyConfig,
+        SGDConfig,
+    )
+    from parameter_server_tpu.data.stream_reader import StreamReader
+    from parameter_server_tpu.system.postoffice import Postoffice
+
+    num_slots = args.num_slots if args.num_slots >= (1 << 26) else (1 << 26)
+    if args.smoke:
+        num_slots = 1 << 18
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "data",
+        "criteo_bench",
+        f"part-{args.real_mb}mb.txt",
+    )
+    ensure_criteo_file(path, args.real_mb)
+    file_rows = os.path.getsize(path) // _ROW_BYTES
+
+    Postoffice.reset()
+    po = Postoffice.instance().start()
+
+    alpha, beta, l1 = 0.1, 1.0, 1.0
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[l1])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=alpha, beta=beta)
+    conf.async_sgd = SGDConfig(
+        algo="ftrl",
+        minibatch=args.minibatch,
+        num_slots=num_slots,
+        max_delay=0,  # parity first; the timed phase relaxes to 4
+        ell_lanes=39,
+        wire="bits",
+    )
+    worker = AsyncSGDWorker(conf, mesh=po.mesh)
+
+    def stream():
+        return StreamReader([path], "criteo").minibatches_bytes(
+            args.minibatch, threads=args.parse_threads
+        )
+
+    # -- phase 1: logloss parity vs the NumPy oracle (sequential weights:
+    # max_delay=0 means the device pulls the latest state every step, so
+    # the oracle sees identical math modulo f32 reduction order) --
+    oracle = FtrlOracle(num_slots, alpha, beta, l1)
+    parity_steps = 4 if args.smoke else args.parity_steps
+    dev_obj = orc_obj = parity_ex = 0.0
+    batches = stream()
+    kept = []
+    for i in range(parity_steps):
+        b = next(batches)
+        if b.n < args.minibatch:
+            break
+        kept.append(b)
+        prepped = jax.device_put(worker.prep(b, device_put=False))
+        m = worker.executor.wait(worker._submit_prepped(prepped, with_aux=False))
+        dev_obj += float(m["objective"])
+        orc_obj += oracle.step(b)
+        parity_ex += b.n
+    assert parity_ex > 0, (
+        f"file too small for parity: need >= {args.minibatch} rows, "
+        f"have {file_rows}"
+    )
+    ll_dev = dev_obj / parity_ex
+    ll_orc = orc_obj / parity_ex
+    parity_ok = abs(ll_dev - ll_orc) <= max(0.01, 0.02 * ll_orc)
+    assert parity_ok, (
+        f"logloss parity FAILED: device {ll_dev:.5f} vs oracle {ll_orc:.5f}"
+    )
+
+    # -- phase 2: end-to-end timed stream, parsing inside the pipeline --
+    worker.sgd.max_delay = 4
+    worker.executor.max_in_flight = 5
+    t0 = time.perf_counter()
+    done_ex = 0
+    pending = []
+    for b in batches:  # continue the same stream: rest of the file
+        prepped = jax.device_put(worker.prep(b, device_put=False))
+        pending.append(worker._submit_prepped(prepped, with_aux=False))
+        done_ex += b.n
+        if len(pending) > 4:
+            worker.executor.wait(pending.pop(0))
+    for ts in pending:
+        worker.executor.wait(ts)
+    jax.block_until_ready(worker.state)
+    dt = time.perf_counter() - t0
+    e2e_rate = done_ex / dt
+
+    # -- phase 3: device-only rate on pre-staged (already parsed+packed)
+    # batches — isolates the fused step + transfer from host parsing --
+    staged = [jax.device_put(worker.prep(b, device_put=False)) for b in kept[:8]]
+    dev_steps = 10 if args.smoke else 60
+    pending = []
+    t0 = time.perf_counter()
+    for i in range(dev_steps):
+        pending.append(
+            worker._submit_prepped(staged[i % len(staged)], with_aux=False)
+        )
+        if len(pending) > 4:
+            worker.executor.wait(pending.pop(0))
+    for ts in pending:
+        worker.executor.wait(ts)
+    jax.block_until_ready(worker.state)
+    dev_rate = dev_steps * args.minibatch / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "criteo_real_e2e_examples_per_sec",
+                "value": round(e2e_rate, 1),
+                "unit": "examples/sec",
+                "vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
+                "device_only": round(dev_rate, 1),
+                "logloss_device": round(ll_dev, 5),
+                "logloss_oracle": round(ll_orc, 5),
+                "parity_ok": parity_ok,
+                "num_slots": num_slots,
+                "file_mb": os.path.getsize(path) >> 20,
+                "file_rows": int(file_rows),
+                "note": "value = parse-included stream rate; device_only = "
+                "pre-staged batches (no parsing)",
+            }
+        )
+    )
+    return 0
 
 
 def main() -> int:
@@ -36,10 +276,22 @@ def main() -> int:
     ap.add_argument("--num-slots", type=int, default=1 << 22)
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--warmup", type=int, default=8)
+    ap.add_argument(
+        "--real",
+        action="store_true",
+        help="stream a real criteo-format text file with parsing inside the "
+        "timed pipeline + logloss parity vs the numpy oracle (table 2^26)",
+    )
+    ap.add_argument("--real-mb", type=int, default=2048, help="file size to stream")
+    ap.add_argument("--parse-threads", type=int, default=4)
+    ap.add_argument("--parity-steps", type=int, default=24)
     args = ap.parse_args()
     if args.smoke:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
         args.num_slots = 1 << 16
+        args.real_mb = min(args.real_mb, 8)
+    if args.real:
+        return run_real(args)
 
     import jax
 

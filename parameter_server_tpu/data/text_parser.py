@@ -372,3 +372,14 @@ class ExampleParser:
             if out is not None:
                 return out
         return _PY_PARSERS[self.format](lines)
+
+    def parse_text(self, text: bytes) -> SparseBatch:
+        """Parse a raw byte chunk (must end at a line boundary) without the
+        line-split/join round trip — the streaming hot path: file chunks go
+        straight into the C++ parser (ref text_parser.cc consumes the
+        mmap'd file the same way)."""
+        if self.use_native and text:
+            out = _parse_native(text, _NATIVE[self.format], text.count(b"\n") + 1)
+            if out is not None:
+                return out
+        return _PY_PARSERS[self.format](text.decode().splitlines())

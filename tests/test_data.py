@@ -280,3 +280,45 @@ class TestFileMatching:
         assert [os.path.basename(h) for h in hits] == ["other.txt"]
         # regex is anchored: "art.*" must not match "part-0"
         assert psfile.expand_globs([str(d / "art.*")]) == []
+
+
+class TestByteStreaming:
+    """Chunked byte path (StreamReader.minibatches_bytes / parse_text):
+    must yield exactly the same minibatches as the line path — chunk
+    boundaries, thread-pool ordering and the tail batch included."""
+
+    def _write_criteo(self, path, rows, seed=0):
+        rng = np.random.default_rng(seed)
+        with open(path, "w") as f:
+            for i in range(rows):
+                ints = "\t".join(str(v) for v in rng.integers(0, 50, 13))
+                cats = "\t".join(
+                    f"{v:08x}" for v in rng.integers(0, 1 << 24, 26)
+                )
+                f.write(f"{i % 2}\t{ints}\t{cats}\n")
+
+    def test_matches_line_path(self, tmp_path):
+        from parameter_server_tpu.data.stream_reader import StreamReader
+
+        p = tmp_path / "part-0"
+        self._write_criteo(str(p), rows=997)
+        line_batches = list(StreamReader([str(p)], "criteo").minibatches(256))
+        byte_batches = list(
+            StreamReader([str(p)], "criteo").minibatches_bytes(
+                256, chunk_bytes=1 << 14, threads=3
+            )
+        )
+        assert len(line_batches) == len(byte_batches) == 4
+        for a, b in zip(line_batches, byte_batches):
+            np.testing.assert_array_equal(a.y, b.y)
+            np.testing.assert_array_equal(a.indptr, b.indptr)
+            np.testing.assert_array_equal(a.indices, b.indices)
+            np.testing.assert_array_equal(a.slot_ids, b.slot_ids)
+
+    def test_parse_text_equals_parse_lines(self):
+        lines = ["1 3:1 7:2", "-1 1:4 9:1"]
+        p = ExampleParser("libsvm")
+        a = p.parse_lines(lines)
+        b = p.parse_text(("\n".join(lines) + "\n").encode())
+        np.testing.assert_array_equal(a.indices, b.indices)
+        np.testing.assert_array_equal(a.y, b.y)
