@@ -14,6 +14,17 @@ Baseline: BASELINE.json publishes no number for the 8-node ZMQ cluster; we
 use 500k examples/sec as the documented estimate for 8-node async FTRL on
 Criteo-scale data (order of magnitude from the parameter-server OSDI'14
 evaluation: ~65k examples/sec/node with sparse LR at ~100 nnz/example).
+
+MEASUREMENT NOTE (round 2): round 1 reported 5.25M examples/sec. That
+number was an artifact — on the tunneled TPU backend,
+``jax.block_until_ready`` on shard_map outputs returns before the device
+work completes, so the "flushed" windows were measuring dispatch rate, not
+throughput. Every flush now fetches a state scalar to the host (a real
+device->host dependency). The honest single-chip rate is ~0.6M ex/s at a
+2^22 table (~0.5M at 2^26), achieved with scan-fused supersteps
+(ELLBitsSuperBatch: T minibatches per launch) — per-launch round trips on
+the tunnel cost more than the device math, so batching launches is the
+main lever.
 """
 
 import argparse
@@ -33,6 +44,16 @@ REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
 # pipeline, with a logloss-parity check against a NumPy FTRL oracle
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
+
+def flush(worker):
+    """REAL pipeline drain: fetch a state scalar to the host. On the
+    tunneled TPU backend ``jax.block_until_ready`` on shard_map outputs
+    returns before the device finishes (the round-1 measurement artifact);
+    a value fetch is a true device->host dependency and cannot."""
+    import jax
+
+    np.asarray(jax.tree.leaves(worker.state)[0][:1])
+
 
 _HEXD = np.frombuffer(b"0123456789abcdef", np.uint8)
 _ROW_BYTES = 275  # 1 label + 13 2-digit ints + 26 8-hex cats + 39 tabs + \n
@@ -209,40 +230,103 @@ def run_real(args) -> int:
         f"logloss parity FAILED: device {ll_dev:.5f} vs oracle {ll_orc:.5f}"
     )
 
-    # -- phase 2: end-to-end timed stream, parsing inside the pipeline --
+    # -- phase 2: end-to-end timed stream, parsing inside the pipeline.
+    # On a multi-core host a producer thread parses (C++ releases the
+    # GIL) + localizes while the main thread stacks supersteps and keeps
+    # launches in flight. On a SINGLE-core host (this image) threads only
+    # add GIL ping-pong — everything host-side runs inline and overlap
+    # comes purely from async device dispatch. --
+    import queue
+    import threading
+
+    from parameter_server_tpu.apps.linear.async_sgd import stack_bits_batches
+
     worker.sgd.max_delay = 4
     worker.executor.max_in_flight = 5
+    T = max(1, args.steps_per_launch)
+    multi_core = (os.cpu_count() or 1) > 2
+
+    def superbatch_from(parts):
+        # cycle to exactly T minibatches so every launch reuses the ONE
+        # compiled ('ell_bits_scan', (rows, T)) program — a mid-benchmark
+        # shape change would put tens of seconds of XLA compile inside a
+        # timed window
+        full = [parts[i % len(parts)] for i in range(T)]
+        return full[0] if T == 1 else stack_bits_batches(full)
+
+    # untimed warmup: compile the scan superstep before the clock starts
+    warm = superbatch_from([worker.prep(b, device_put=False) for b in kept])
+    worker.executor.wait(
+        worker._submit_prepped(jax.device_put(warm), with_aux=False)
+    )
+    flush(worker)
+
+    def prepped_stream():
+        if multi_core:
+            q: "queue.Queue" = queue.Queue(maxsize=3 * T)
+
+            def produce():
+                for b in batches:  # rest of the file
+                    if b.n < args.minibatch:
+                        break  # keep superstep shapes static
+                    q.put(worker.prep(b, device_put=False))
+                q.put(None)
+
+            threading.Thread(target=produce, daemon=True).start()
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        else:
+            for b in batches:
+                if b.n < args.minibatch:
+                    break
+                yield worker.prep(b, device_put=False)
+
     t0 = time.perf_counter()
     done_ex = 0
+    skipped_tail = 0
     pending = []
-    for b in batches:  # continue the same stream: rest of the file
-        prepped = jax.device_put(worker.prep(b, device_put=False))
-        pending.append(worker._submit_prepped(prepped, with_aux=False))
-        done_ex += b.n
-        if len(pending) > 4:
+    parts = []
+    for item in prepped_stream():
+        parts.append(item)
+        if len(parts) < T:
+            continue
+        prepped = parts[0] if len(parts) == 1 else stack_bits_batches(parts)
+        parts = []
+        done_ex += int(prepped.num_examples)
+        pending.append(
+            worker._submit_prepped(jax.device_put(prepped), with_aux=False)
+        )
+        if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
+    # a trailing partial group would compile a second scan shape inside
+    # the timed window; skip it and disclose the drop instead
+    skipped_tail = sum(int(p.num_examples) for p in parts)
     for ts in pending:
         worker.executor.wait(ts)
-    jax.block_until_ready(worker.state)
+    flush(worker)
     dt = time.perf_counter() - t0
     e2e_rate = done_ex / dt
 
     # -- phase 3: device-only rate on pre-staged (already parsed+packed)
-    # batches — isolates the fused step + transfer from host parsing --
-    staged = [jax.device_put(worker.prep(b, device_put=False)) for b in kept[:8]]
-    dev_steps = 10 if args.smoke else 60
+    # supersteps — isolates the fused step from host parsing. Same T as
+    # phase 2, so the compiled program is already cached --
+    staged = jax.device_put(
+        superbatch_from([worker.prep(b, device_put=False) for b in kept])
+    )
+    dev_launches = 3 if args.smoke else 12
     pending = []
     t0 = time.perf_counter()
-    for i in range(dev_steps):
-        pending.append(
-            worker._submit_prepped(staged[i % len(staged)], with_aux=False)
-        )
-        if len(pending) > 4:
+    for i in range(dev_launches):
+        pending.append(worker._submit_prepped(staged, with_aux=False))
+        if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
     for ts in pending:
         worker.executor.wait(ts)
-    jax.block_until_ready(worker.state)
-    dev_rate = dev_steps * args.minibatch / (time.perf_counter() - t0)
+    flush(worker)
+    dev_rate = dev_launches * T * args.minibatch / (time.perf_counter() - t0)
 
     print(
         json.dumps(
@@ -258,6 +342,7 @@ def run_real(args) -> int:
                 "num_slots": num_slots,
                 "file_mb": os.path.getsize(path) >> 20,
                 "file_rows": int(file_rows),
+                "skipped_tail_rows": int(skipped_tail),
                 "note": "value = parse-included stream rate; device_only = "
                 "pre-staged batches (no parsing)",
             }
@@ -274,7 +359,7 @@ def main() -> int:
     # categorical dominating (binary). We bench the binary/ELL hot path.
     ap.add_argument("--nnz-per-row", type=int, default=39)
     ap.add_argument("--num-slots", type=int, default=1 << 22)
-    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--steps", type=int, default=240)
     ap.add_argument("--warmup", type=int, default=8)
     ap.add_argument(
         "--real",
@@ -285,6 +370,13 @@ def main() -> int:
     ap.add_argument("--real-mb", type=int, default=2048, help="file size to stream")
     ap.add_argument("--parse-threads", type=int, default=4)
     ap.add_argument("--parity-steps", type=int, default=24)
+    ap.add_argument(
+        "--steps-per-launch",
+        type=int,
+        default=8,
+        help="minibatches scanned per device launch (ELLBitsSuperBatch); "
+        "amortizes the tunnel round trip",
+    )
     args = ap.parse_args()
     if args.smoke:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
@@ -339,59 +431,69 @@ def main() -> int:
         ).astype(np.float32)
         return b
 
-    # pre-generate raw batches (parsing is benchmarked separately; the
-    # reference criteo bench reads pre-tokenized minibatches similarly),
-    # but run LOCALIZATION (hash→slot + u24 wire packing) + device upload
-    # inside the timed loop — that's the honest host-side cost. The loop is
-    # deliberately single-threaded: device_put is async, so transfers
-    # overlap the next batch's host prep without helper threads (which
-    # contend with the transfer engine for the GIL and *halve* throughput).
-    raw = [gen(i) for i in range(min(args.steps + args.warmup, 16))]
+    # pre-generate raw batches (parsing is benchmarked separately — the
+    # --real mode streams actual criteo text with parsing in the loop);
+    # LOCALIZATION (hash→slot + bit packing), superbatch stacking and the
+    # device upload all run inside the timed loop — the honest host cost.
+    T = max(1, args.steps_per_launch)
+    raw = [gen(i) for i in range(min(args.steps + args.warmup, 32))]
     worker._padding(raw[0])
 
     def prep_upload_submit(i: int):
         # with_aux=False: skip the per-example AUC outputs in the hot loop
-        prepped = worker.prep(raw[i % len(raw)], device_put=False)
+        from parameter_server_tpu.apps.linear.async_sgd import (
+            stack_bits_batches,
+        )
+
+        parts = [
+            worker.prep(raw[(i + j) % len(raw)], device_put=False)
+            for j in range(T)
+        ]
+        prepped = parts[0] if T == 1 else stack_bits_batches(parts)
         return worker._submit_prepped(jax.device_put(prepped), with_aux=False)
 
     # warmup (compile)
     pending = []
-    for i in range(args.warmup):
-        pending.append(prep_upload_submit(i))
+    for i in range(max(1, args.warmup // T)):
+        pending.append(prep_upload_submit(i * T))
     for ts in pending:
         worker.executor.wait(ts)
+    flush(worker)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes
     # (shared link), so a single long average is hostage to one throttled
-    # stretch. Time fixed-size windows — each FLUSHED (pipeline drained +
-    # state ready) before its clock stops, so a window is only credited
-    # work that completed inside it — and report the MEDIAN window rate:
-    # robust to transient throttling in either direction and not biased
-    # upward the way a best-of-K pick would be. best/avg are disclosed
-    # alongside.
-    window = max(10, args.steps // 5)
+    # stretch. Time fixed-size windows — each FLUSHED (scalar fetched, so
+    # the device really finished) before its clock stops — and report the
+    # MEDIAN window rate: robust to transient throttling in either
+    # direction and not biased upward the way best-of-K would be. best/avg
+    # are disclosed alongside.
+    n_launches = max(1, args.steps // T)
+    # each window flush pays a tunnel round trip and drains the pipeline;
+    # keep windows >= 5 launches so the flush cost stays amortized
+    window = max(5, n_launches // 5) if n_launches >= 5 else n_launches
     rates = []
     done = 0
     t0 = time.perf_counter()
     pending = []
     win_done, win_t0 = 0, t0
-    while done < args.steps:
-        pending.append(prep_upload_submit(done))
+    while done < n_launches:
+        pending.append(prep_upload_submit(done * T))
         done += 1
         win_done += 1
-        if len(pending) > 3:
+        if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
         if win_done >= window:
             while pending:
                 worker.executor.wait(pending.pop(0))
-            jax.block_until_ready(worker.state)
+            flush(worker)
             now = time.perf_counter()
-            rates.append(win_done * args.minibatch / (now - win_t0))
+            rates.append(win_done * T * args.minibatch / (now - win_t0))
             win_done, win_t0 = 0, now
     for ts in pending:
         worker.executor.wait(ts)
-    jax.block_until_ready(worker.state)
+    flush(worker)
     dt = time.perf_counter() - t0
+    done *= T
 
     avg_rate = done * args.minibatch / dt
     examples_per_sec = float(np.median(rates)) if rates else avg_rate

@@ -248,6 +248,47 @@ class ELLBitsBatch:
         return int(self.counts.sum())
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ELLBitsSuperBatch:
+    """T minibatches of ELLBits wire stacked on a leading scan axis.
+
+    The device steps through all T minibatches in ONE launch
+    (``lax.scan`` inside the jitted step): on a tunneled/remote TPU the
+    per-launch round trip costs as much as several device steps, so
+    batching launches is the single biggest throughput lever — and it is
+    the idiomatic XLA shape for a sequential optimizer loop anyway.
+    Within a superbatch the weights advance every ministep (staleness 0);
+    the configured ``max_delay`` bound still governs the snapshot taken
+    across superbatch submissions, so the delay bound is never exceeded.
+    """
+
+    y_bits: np.ndarray  # [T, D, ceil(R/8)] uint8
+    counts: np.ndarray  # [T, D] int32
+    slots_words: np.ndarray  # [T, D, W] uint32
+    rows: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def steps(self) -> int:
+        return len(self.counts)
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.counts.sum())
+
+
+def stack_bits_batches(parts: List[ELLBitsBatch]) -> ELLBitsSuperBatch:
+    """Stack T prepped ELLBitsBatch minibatches into one scan superbatch."""
+    rows = parts[0].rows
+    assert all(p.rows == rows for p in parts), "superbatch needs uniform rows"
+    return ELLBitsSuperBatch(
+        y_bits=np.stack([p.y_bits for p in parts]),
+        counts=np.stack([p.counts for p in parts]),
+        slots_words=np.stack([p.slots_words for p in parts]),
+        rows=rows,
+    )
+
+
 def pack_u24(idx: np.ndarray) -> np.ndarray:
     """int32 [..] → uint8 [.., 3] little-endian (values must be < 2^24)."""
     flat = np.ascontiguousarray(idx, dtype="<u4")
@@ -550,13 +591,13 @@ def make_train_step_ell(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_flat, 0.0)
         )
-        touched = (
-            jnp.zeros((shard,), jnp.bool_)
-            .at[rel]
-            .max(ok & valid.reshape(-1))
-        )
         g_shard = push_reduce(g_shard, seed)
-        touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
+        # touched := nonzero aggregated gradient. Equivalent to the boolean
+        # key-membership scatter (dropped: a second 640k-index scatter cost
+        # ~8ms/step on v5e) except for exact float cancellation across a
+        # slot's contributions — a no-op update for FTRL, and a skipped
+        # proximal shrink for AdaGrad/SGD on that measure-zero event.
+        touched = g_shard != 0
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -585,29 +626,16 @@ def make_train_step_ell(
     return step
 
 
-def make_train_step_ell_bits(
-    updater,
-    loss,
-    mesh,
-    num_slots: int,
-    rows: int,
-    lanes: int,
-    with_aux: bool = True,
-    push_quant: int = 0,
-    pull_quant: int = 0,
+def _make_bits_mini_step(
+    updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant, pull_quant
 ):
-    """Fused SPMD step over the minimal-wire ELLBitsBatch (binary,
-    uniform-row): slot ids unpack from the bitstream, labels from sign
-    bits, the mask from the row count — all inside the jitted step, so the
-    host ships ~bits/8 bytes per feature and nothing else."""
-    n_server = meshlib.num_servers(mesh)
-    shard = num_slots // n_server
+    """Shared single-minibatch body for the bits-wire step builders:
+    (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
     bits = slot_bits(num_slots)
     push_reduce = make_push_reduce(push_quant)
     pull_weights = make_pull_weights(updater, pull_quant)
 
-    def local_step(live, pulled, seed, y_bits, counts, words):
-        y_bits, count, words = y_bits[0], counts[0], words[0]
+    def mini_step(live, pulled, seed, y_bits, count, words):
         y = unpack_sign_bits(y_bits, rows)
         mask = (jnp.arange(rows) < count).astype(jnp.float32)
         slots = unpack_bits(words, rows * lanes, bits).reshape(rows, lanes)
@@ -630,24 +658,118 @@ def make_train_step_ell_bits(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_flat, 0.0)
         )
-        live_row = jnp.broadcast_to(mask[:, None] > 0, slots.shape).reshape(-1)
-        touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & live_row)
         g_shard = push_reduce(g_shard, seed)
-        touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
+        touched = g_shard != 0  # see make_train_step_ell: cancellation note
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
 
-    def state_spec(state):
-        return jax.tree.map(
-            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-        )
+    return mini_step
+
+
+def _bits_state_spec(state):
+    return jax.tree.map(
+        lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
+    )
+
+
+def make_train_step_ell_bits(
+    updater,
+    loss,
+    mesh,
+    num_slots: int,
+    rows: int,
+    lanes: int,
+    with_aux: bool = True,
+    push_quant: int = 0,
+    pull_quant: int = 0,
+):
+    """Fused SPMD step over the minimal-wire ELLBitsBatch (binary,
+    uniform-row): slot ids unpack from the bitstream, labels from sign
+    bits, the mask from the row count — all inside the jitted step, so the
+    host ships ~bits/8 bytes per feature and nothing else."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_bits_mini_step(
+        updater, loss, num_slots, shard, rows, lanes, with_aux,
+        push_quant, pull_quant,
+    )
+
+    def local_step(live, pulled, seed, y_bits, counts, words):
+        return mini_step(live, pulled, seed, y_bits[0], counts[0], words[0])
 
     @jax.jit
     def step(live_state, pull_state, batch, seed=np.uint32(0)):
-        specs = state_spec(live_state)
+        specs = _bits_state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(3))
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), *batch_specs),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(live_state, pull_state, seed, batch.y_bits, batch.counts,
+          batch.slots_words)
+
+    return step
+
+
+def make_train_step_ell_bits_scan(
+    updater,
+    loss,
+    mesh,
+    num_slots: int,
+    rows: int,
+    lanes: int,
+    with_aux: bool = True,
+    push_quant: int = 0,
+    pull_quant: int = 0,
+):
+    """Scan-fused superstep: T bits-wire minibatches per launch.
+
+    ``lax.scan`` drives the shared mini-step over the leading T axis
+    inside ONE jitted program — the weights advance every ministep (the
+    sequential-optimizer semantics), while the host pays a single
+    dispatch/transfer round trip for T steps. Metrics come back summed
+    over the superbatch (stacked per-ministep when ``with_aux``)."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_bits_mini_step(
+        updater, loss, num_slots, shard, rows, lanes, with_aux,
+        push_quant, pull_quant,
+    )
+
+    def local_step(live, pulled, seed, y_bits, counts, words):
+        del pulled  # staleness 0 inside the superstep (≤ any delay bound)
+        t_steps = y_bits.shape[0]
+
+        def body(carry, xs):
+            state, i = carry
+            yb, cc, ww = xs
+            new_state, metrics = mini_step(
+                state, state, seed + i, yb[0], cc[0], ww[0]
+            )
+            return (new_state, i + np.uint32(1)), metrics
+
+        (new_state, _), metrics = jax.lax.scan(
+            body, (live, np.uint32(0)), (y_bits, counts, words),
+            length=t_steps,
+        )
+        if not with_aux:
+            metrics = jax.tree.map(lambda m: m.sum(axis=0), metrics)
+        else:
+            # scalars fold; per-example aux stays stacked per ministep
+            metrics = {
+                k: (v.sum(axis=0) if v.ndim == 1 else v)
+                for k, v in metrics.items()
+            }
+        return new_state, metrics
+
+    @jax.jit
+    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = _bits_state_spec(live_state)
+        batch_specs = tuple(P(None, DATA_AXIS) for _ in range(3))
         return shard_map(
             local_step,
             mesh=mesh,
@@ -690,9 +812,8 @@ def make_train_step_hashed(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_e, 0.0)
         )
-        touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & (vals != 0))
         g_shard = push_reduce(g_shard, seed)
-        touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
+        touched = g_shard != 0  # see make_train_step_ell: cancellation note
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -761,9 +882,8 @@ def make_train_step(
 
         # -- push (dense scatter into owned shard + psum over data axis) --
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(jnp.where(ok, g_u, 0))
-        touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok & (umask > 0))
         g_shard = push_reduce(g_shard, seed)
-        touched = jax.lax.psum(touched.astype(jnp.float32), DATA_AXIS) > 0
+        touched = g_shard != 0  # see make_train_step_ell: cancellation note
 
         def apply_leafwise(state):
             return updater.apply(state, g_shard, touched)
@@ -1061,7 +1181,14 @@ class AsyncSGDWorker(ISGDCompNode):
         return self.upload(out) if device_put else out
 
     def _get_step(self, prepped, with_aux: bool):
-        if isinstance(prepped, ELLBitsBatch):
+        if isinstance(prepped, ELLBitsSuperBatch):
+            key = ("ell_bits_scan", (prepped.rows, prepped.steps), with_aux)
+            builder = lambda: make_train_step_ell_bits_scan(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
+                push_quant=self._push_quant, pull_quant=self._pull_quant,
+            )
+        elif isinstance(prepped, ELLBitsBatch):
             key = ("ell_bits", prepped.rows, with_aux)
             builder = lambda: make_train_step_ell_bits(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
@@ -1109,6 +1236,9 @@ class AsyncSGDWorker(ISGDCompNode):
             # assemble the global batch explicitly
             prepped = self.upload(prepped)
         tau = self.sgd.max_delay
+        # a scan superbatch advances the weights n_steps times in one
+        # submission (staleness 0 inside it — within any delay bound)
+        n_steps = prepped.steps if isinstance(prepped, ELLBitsSuperBatch) else 1
         # snapshot *scheduling* happens at submit time (deterministic in
         # submission order), but the snapshot itself must be taken when the
         # step RUNS on the executor's dispatch thread — self.state is only
@@ -1117,8 +1247,8 @@ class AsyncSGDWorker(ISGDCompNode):
         if do_snapshot:
             self._steps_since_snapshot = 0
         step_fn = self._get_step(prepped, with_aux)
-        self._seed_counter += 1
-        seed = np.uint32(self._seed_counter)
+        self._seed_counter += n_steps
+        seed = np.uint32(self._seed_counter - (n_steps - 1))
 
         def step():
             if do_snapshot:
@@ -1126,7 +1256,7 @@ class AsyncSGDWorker(ISGDCompNode):
             new_state, metrics = step_fn(self.state, self._pull_state, prepped, seed)
             self.state = new_state
             if self._replicate_fn is not None:
-                self._steps_since_replica += 1
+                self._steps_since_replica += n_steps
                 if (
                     self._replica_state is None
                     or self._steps_since_replica >= self.sgd.replica_every
@@ -1135,8 +1265,30 @@ class AsyncSGDWorker(ISGDCompNode):
                     self._replica_state = self._replicate_fn(self.state)
             return metrics
 
-        self._steps_since_snapshot += 1
+        self._steps_since_snapshot += n_steps
         return self.submit(step, Task())
+
+    def submit_superbatch(
+        self, batches: List[SparseBatch], with_aux: bool = False
+    ) -> int:
+        """Prep + stack T minibatches and run them as ONE scan-fused
+        device launch (see ELLBitsSuperBatch). Requires the bits wire."""
+        from ...parallel import distributed
+
+        if distributed.is_multiprocess():
+            raise NotImplementedError(
+                "superbatch assembly across processes is not implemented; "
+                "submit per-minibatch steps in multi-host runs"
+            )
+        prepped = [self.prep(b, device_put=False) for b in batches]
+        if not all(isinstance(p, ELLBitsBatch) for p in prepped):
+            raise ValueError(
+                "superbatch needs the bits wire (hashed directory, binary "
+                "uniform-row batches); got a fallback encoding"
+            )
+        return self._submit_prepped(
+            jax.device_put(stack_bits_batches(prepped)), with_aux=with_aux
+        )
 
     def collect(self, ts: int) -> SGDProgress:
         """Wait for a step and fold its metrics into progress (the worker's

@@ -40,6 +40,29 @@ def _concat_batches(parts: List[SparseBatch]) -> SparseBatch:
     )
 
 
+def rebatch(parts_iter: Iterator[SparseBatch], size: int) -> Iterator[SparseBatch]:
+    """Re-slice a stream of arbitrarily-sized batches into ``size``-row
+    minibatches (the last may be smaller). Shared by the record and byte
+    paths — the accumulate/merge/slice bookkeeping lives once."""
+    pending: List[SparseBatch] = []
+    count = 0
+    for b in parts_iter:
+        pending.append(b)
+        count += b.n
+        if count < size:
+            continue
+        merged = _concat_batches(pending)
+        lo = 0
+        while merged.n - lo >= size:
+            yield merged.slice_rows(lo, lo + size)
+            lo += size
+        rest = merged.slice_rows(lo, merged.n)
+        pending = [rest] if rest.n else []
+        count = rest.n
+    if count:
+        yield _concat_batches(pending)
+
+
 class StreamReader:
     def __init__(self, files: List[str], data_format: str = "libsvm"):
         self.files = psfile.expand_globs(files)
@@ -61,19 +84,7 @@ class StreamReader:
     def minibatches(self, size: int) -> Iterator[SparseBatch]:
         """Yield batches of ``size`` examples (last may be smaller)."""
         if self.format == "record":
-            pending: List[SparseBatch] = []
-            count = 0
-            for b in self._record_batches():
-                pending.append(b)
-                count += b.n
-                while count >= size:
-                    merged = _concat_batches(pending)
-                    yield merged.slice_rows(0, size)
-                    rest = merged.slice_rows(size, merged.n)
-                    pending = [rest] if rest.n else []
-                    count = rest.n
-            if count:
-                yield _concat_batches(pending)
+            yield from rebatch(self._record_batches(), size)
             return
         lines: List[str] = []
         for line in self._lines():
@@ -121,38 +132,26 @@ class StreamReader:
         import collections
         from concurrent.futures import ThreadPoolExecutor
 
-        chunks = self._byte_chunks(chunk_bytes)
-        futs: collections.deque = collections.deque()
-        pending: List[SparseBatch] = []
-        count = 0
-        with ThreadPoolExecutor(threads) as pool:
+        def parsed_chunks() -> Iterator[SparseBatch]:
+            chunks = self._byte_chunks(chunk_bytes)
+            futs: collections.deque = collections.deque()
+            with ThreadPoolExecutor(threads) as pool:
 
-            def fill() -> None:
-                while len(futs) < threads + 2:
-                    try:
-                        c = next(chunks)
-                    except StopIteration:
-                        return
-                    futs.append(pool.submit(self.parser.parse_text, c))
+                def fill() -> None:
+                    while len(futs) < threads + 2:
+                        try:
+                            c = next(chunks)
+                        except StopIteration:
+                            return
+                        futs.append(pool.submit(self.parser.parse_text, c))
 
-            fill()
-            while futs:
-                b = futs.popleft().result()
                 fill()
-                pending.append(b)
-                count += b.n
-                if count < size:
-                    continue
-                merged = _concat_batches(pending)
-                lo = 0
-                while merged.n - lo >= size:
-                    yield merged.slice_rows(lo, lo + size)
-                    lo += size
-                rest = merged.slice_rows(lo, merged.n)
-                pending = [rest] if rest.n else []
-                count = rest.n
-        if count:
-            yield _concat_batches(pending)
+                while futs:
+                    b = futs.popleft().result()
+                    fill()
+                    yield b
+
+        yield from rebatch(parsed_chunks(), size)
 
     def read_all(self) -> Optional[SparseBatch]:
         """Whole-dataset read (BCD preprocessing path)."""

@@ -85,10 +85,17 @@ def ftrl_update(
     from jax.experimental.pallas import tpu as pltpu
     rows = p // _LANES
     shape2d = (rows, _LANES)
-    grid = (rows // _SUBLANES,)
+    # big blocks: 6 refs/block (4 in + 2 out) must fit VMEM, but a tiny
+    # (8,128) block makes the grid enormous on multi-M-slot tables (2^26
+    # slots -> 65536 steps) and grid overhead swamps the math. 2048x128
+    # = 1MB/ref keeps the grid <= a few hundred steps at every real size.
+    block_rows = 2048
+    while rows % block_rows:
+        block_rows //= 2
+    grid = (rows // block_rows,)
     t2d = touched.astype(jnp.float32).reshape(shape2d)
     spec = pl.BlockSpec(
-        (_SUBLANES, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        (block_rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     kernel = functools.partial(_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2)
     z_new, n_new = pl.pallas_call(

@@ -489,3 +489,53 @@ class TestCheckpointResume:
         assert w2.restore(mgr) == 5
         w2.train(synth_binary(3, w_true, seed0=50))
         np.testing.assert_array_equal(w2.weights_dense(), want)
+
+
+class TestScanSuperbatch:
+    """Scan-fused superstep (ELLBitsSuperBatch): T minibatches in one
+    launch must produce the same model as T sequential delay-0 steps."""
+
+    def _conf(self):
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        conf.async_sgd.minibatch = 256
+        return conf
+
+    def _batches(self, w_true, n):
+        return [
+            random_sparse(256, 512, 8, seed=100 + i, w_true=w_true, binary=True)
+            for i in range(n)
+        ]
+
+    def test_matches_sequential_steps(self, mesh8, w_true):
+        batches = self._batches(w_true, 6)
+        seq = AsyncSGDWorker(self._conf(), mesh=mesh8, name="seq")
+        for b in batches:
+            seq.collect(seq.process_minibatch(b))
+        Postoffice.reset()
+        Postoffice.instance().start()
+        fused = AsyncSGDWorker(self._conf(), mesh=mesh8, name="fused")
+        prog = fused.collect(fused.submit_superbatch(batches))
+        np.testing.assert_allclose(
+            fused.weights_dense(), seq.weights_dense(), atol=1e-6
+        )
+        assert prog.num_examples_processed == 6 * 256
+
+    def test_aux_metrics_fold(self, mesh8, w_true):
+        batches = self._batches(w_true, 3)
+        worker = AsyncSGDWorker(self._conf(), mesh=mesh8)
+        prog = worker.collect(worker.submit_superbatch(batches, with_aux=True))
+        assert prog.num_examples_processed == 3 * 256
+        assert prog.auc and 0.0 <= prog.auc[-1] <= 1.0
+
+    def test_mixed_with_single_steps(self, mesh8, w_true):
+        batches = self._batches(w_true, 4)
+        worker = AsyncSGDWorker(self._conf(), mesh=mesh8)
+        worker.collect(worker.process_minibatch(batches[0]))
+        worker.collect(worker.submit_superbatch(batches[1:3]))
+        worker.collect(worker.process_minibatch(batches[3]))
+        ev = worker.evaluate(
+            random_sparse(1000, 512, 8, seed=999, w_true=w_true, binary=True)
+        )
+        assert np.isfinite(ev["logloss"])
