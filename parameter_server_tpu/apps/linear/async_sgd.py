@@ -437,7 +437,10 @@ def prep_batch_ell_bits(
         nbytes = (nsub * lanes * bits + 7) // 8
         hash_slots_packed(
             batch.indices[seg],
-            num_slots,
+            # hash modulus = the directory's CONFIGURED slot count — the
+            # same map as every other path (and stable across elastic
+            # resizes); bit width / storage sizing stays padded
+            directory.num_slots,
             bits,
             out=slots_words[d].view(np.uint8)[:nbytes],
         )
@@ -494,6 +497,37 @@ def make_push_reduce(push_quant: int):
         return jax.lax.psum(dec, DATA_AXIS)
 
     return reduce
+
+
+def make_push_touched(push_quant: int):
+    """(g_shard, seed) -> (reduced g, touched membership mask).
+
+    touched gates ``updater.apply`` (untouched slots pass through, ref
+    per-entry Set on received keys only). Without quantization the
+    reduced gradient's support IS membership — up to exact float
+    cancellation across contributions, which is a no-op update for FTRL
+    and a skipped proximal shrink for AdaGrad/SGD on that measure-zero
+    event (the price of dropping a second 640k-index scatter, ~8ms/step
+    on v5e). Under a quantized push that shortcut would be wrong —
+    fixed-point rounding deterministically zeroes small gradients — so
+    membership is collected PRE-quantization with a psum of the support
+    mask (a cheap dense collective, still no scatter)."""
+    push_reduce = make_push_reduce(push_quant)
+    if not push_quant:
+
+        def run(g_shard, seed):
+            g = push_reduce(g_shard, seed)
+            return g, g != 0
+
+    else:
+
+        def run(g_shard, seed):
+            touched = (
+                jax.lax.psum((g_shard != 0).astype(jnp.float32), DATA_AXIS) > 0
+            )
+            return push_reduce(g_shard, seed), touched
+
+    return run
 
 
 def make_pull_weights(updater, pull_quant: int):
@@ -560,7 +594,7 @@ def make_train_step_ell(
     u24-wire ELLPackedBatch and unpacks indices on device."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_reduce = make_push_reduce(push_quant)
+    push_touched = make_push_touched(push_quant)
     pull_weights = make_pull_weights(updater, pull_quant)
 
     def local_step(live, pulled, seed, y, mask, slots, vals):
@@ -591,13 +625,7 @@ def make_train_step_ell(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_flat, 0.0)
         )
-        g_shard = push_reduce(g_shard, seed)
-        # touched := nonzero aggregated gradient. Equivalent to the boolean
-        # key-membership scatter (dropped: a second 640k-index scatter cost
-        # ~8ms/step on v5e) except for exact float cancellation across a
-        # slot's contributions — a no-op update for FTRL, and a skipped
-        # proximal shrink for AdaGrad/SGD on that measure-zero event.
-        touched = g_shard != 0
+        g_shard, touched = push_touched(g_shard, seed)
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -632,7 +660,7 @@ def _make_bits_mini_step(
     """Shared single-minibatch body for the bits-wire step builders:
     (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
     bits = slot_bits(num_slots)
-    push_reduce = make_push_reduce(push_quant)
+    push_touched = make_push_touched(push_quant)
     pull_weights = make_pull_weights(updater, pull_quant)
 
     def mini_step(live, pulled, seed, y_bits, count, words):
@@ -658,8 +686,7 @@ def _make_bits_mini_step(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_flat, 0.0)
         )
-        g_shard = push_reduce(g_shard, seed)
-        touched = g_shard != 0  # see make_train_step_ell: cancellation note
+        g_shard, touched = push_touched(g_shard, seed)
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -791,7 +818,7 @@ def make_train_step_hashed(
     duplicates fold in the scatter, so no uniquification anywhere."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_reduce = make_push_reduce(push_quant)
+    push_touched = make_push_touched(push_quant)
     pull_weights = make_pull_weights(updater, pull_quant)
 
     def local_step(live, pulled, seed, y, mask, rows, slots, vals):
@@ -812,8 +839,7 @@ def make_train_step_hashed(
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
             jnp.where(ok, g_e, 0.0)
         )
-        g_shard = push_reduce(g_shard, seed)
-        touched = g_shard != 0  # see make_train_step_ell: cancellation note
+        g_shard, touched = push_touched(g_shard, seed)
         new_state = updater.apply(live, g_shard, touched)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -857,7 +883,7 @@ def make_train_step(
     """
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_reduce = make_push_reduce(push_quant)
+    push_touched = make_push_touched(push_quant)
     pull_weights = make_pull_weights(updater, pull_quant)
 
     def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
@@ -882,8 +908,7 @@ def make_train_step(
 
         # -- push (dense scatter into owned shard + psum over data axis) --
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(jnp.where(ok, g_u, 0))
-        g_shard = push_reduce(g_shard, seed)
-        touched = g_shard != 0  # see make_train_step_ell: cancellation note
+        g_shard, touched = push_touched(g_shard, seed)
 
         def apply_leafwise(state):
             return updater.apply(state, g_shard, touched)
@@ -995,7 +1020,13 @@ class AsyncSGDWorker(ISGDCompNode):
         self._seed_counter = 0
         self._warned_ell_overflow = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
-        self.directory = KeyDirectory(self.num_slots, hashed=True)
+        # the hash modulus is the CONFIGURED slot count, not the padded
+        # table size: padding depends on the server count, and keys must
+        # keep their slots across elastic resizes (the reference's key
+        # space is likewise fixed while server key ranges move,
+        # manager.cc NodeAdd / Range::EvenDivide). Padded tail slots are
+        # storage only — never addressed.
+        self.directory = KeyDirectory(sgd.num_slots, hashed=True)
         self.state = jax.tree.map(
             lambda leaf: jax.device_put(
                 leaf,
@@ -1432,6 +1463,45 @@ class AsyncSGDWorker(ISGDCompNode):
 
     # -- full-state checkpoint/resume (ref save_model_every_n_iter +
     #    Parameter::Recover: the durable analog of server replicas) --
+
+    def state_host(self) -> dict:
+        """Snapshot the full optimizer state to host memory (device->host,
+        no files) — the live-migration path for elastic resizes (ref
+        Parameter::GetReplica feeding manager.cc NodeAdd key-range moves)."""
+        self.executor.wait_all()
+        return {
+            "state": jax.tree.map(np.asarray, self.state),
+            "seed_counter": np.int64(self._seed_counter),
+        }
+
+    def load_state_host(self, snap: dict) -> None:
+        """Install a host snapshot onto THIS worker's mesh — the receiving
+        half of a live migration. The table may be padded differently
+        under a different server count: the configured slots always carry
+        over; only dead padding is trimmed or zero-extended."""
+        def fit(leaf):
+            leaf = np.asarray(leaf)
+            if leaf.ndim >= 1 and leaf.shape[0] != self.num_slots:
+                if leaf.shape[0] > self.num_slots:
+                    leaf = leaf[: self.num_slots]
+                else:
+                    pad = np.zeros(
+                        (self.num_slots - leaf.shape[0],) + leaf.shape[1:],
+                        leaf.dtype,
+                    )
+                    leaf = np.concatenate([leaf, pad])
+            return jax.device_put(
+                leaf,
+                NamedSharding(
+                    self.mesh, P(SERVER_AXIS) if leaf.ndim >= 1 else P()
+                ),
+            )
+
+        self.state = jax.tree.map(fit, snap["state"])
+        self._pull_state = self.state
+        self._steps_since_snapshot = 0
+        self._replica_state = None
+        self._seed_counter = int(snap["seed_counter"])
 
     def checkpoint(self, manager, step: int) -> str:
         """Durably save the full optimizer state (all server shards) plus

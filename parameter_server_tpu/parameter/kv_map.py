@@ -92,8 +92,16 @@ class KVMap(Parameter):
         self.k = int(k)
         self.entry = entry
         self.num_slots = pad_slots(num_slots, meshlib.num_servers(mesh))
+        # convention: HASHED directories use the CONFIGURED modulus (keys
+        # keep their slots across elastic resizes — async_sgd.py's note);
+        # EXACT directories use the PADDED capacity so the miss sentinel
+        # (== capacity) falls outside every shard's range and unknown
+        # keys are dropped, not scattered into a padding slot
+        is_hashed = keys is None and hashed
         self.directory = KeyDirectory(
-            self.num_slots, keys=keys, hashed=keys is None and hashed
+            int(num_slots) if is_hashed else self.num_slots,
+            keys=keys,
+            hashed=is_hashed,
         )
         sharding = meshlib.table_sharding(mesh)
         self.state: Dict[str, jax.Array] = {

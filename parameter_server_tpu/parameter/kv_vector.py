@@ -60,6 +60,12 @@ class KVVector(Parameter):
         self.k = int(k)
         self.dtype = dtype
         self.buffer_value = buffer_value
+        # convention (same as KVMap): hashed directories use the
+        # CONFIGURED modulus — keys keep their slots across elastic
+        # resizes (async_sgd.py's note); exact directories (set_keys)
+        # use the PADDED capacity so the miss sentinel lands outside
+        # every shard's range
+        self.num_slots_config = int(num_slots)
         self.num_slots = pad_slots(num_slots, meshlib.num_servers(mesh))
         self.hashed = hashed
         self._channels: Dict[int, _Channel] = {}
@@ -68,7 +74,10 @@ class KVVector(Parameter):
 
     def channel(self, ch: int = 0) -> _Channel:
         if ch not in self._channels:
-            directory = KeyDirectory(self.num_slots, hashed=self.hashed)
+            directory = KeyDirectory(
+                self.num_slots_config if self.hashed else self.num_slots,
+                hashed=self.hashed,
+            )
             table = self._zeros()
             self._channels[ch] = _Channel(directory, table)
         return self._channels[ch]

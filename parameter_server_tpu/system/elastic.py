@@ -1,0 +1,151 @@
+"""Live elasticity: node join/leave with key-range migration, TPU-style.
+
+Counterpart of the reference's live cluster membership
+(``src/system/manager.cc``: AddNode assigns the new server a key range and
+broadcasts the updated node set; the dead-node flow re-assigns a dead
+node's work). On TPU a "node" is a mesh slot inside one SPMD program, so
+membership changes are mesh re-factorizations:
+
+- **join/leave (graceful)** — snapshot the sharded table to host memory
+  (``AsyncSGDWorker.state_host``, no files), rebuild the Postoffice mesh
+  with the new data x server split, install the snapshot under the new
+  ``NamedSharding`` (``load_state_host``). Key->slot hashing uses the
+  CONFIGURED modulus, so every key keeps its slot while the per-server
+  key RANGES move — exactly the reference's fixed key space with moving
+  server ranges (``Range::EvenDivide``).
+- **server death (crash)** — first try the in-place live replica
+  (``recover_server_shard``, ref Parameter::GetReplica); if no replica is
+  configured the dead shard's segment is lost (as in the reference) and
+  the cluster shrinks around it.
+
+The Manager records every membership change and broadcasts add/remove
+events to subscribers (ref manager.cc NodeChange).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..utils.range import Range
+from .manager import Node
+from .postoffice import Postoffice
+
+
+class ElasticCoordinator:
+    """Owns the Postoffice lifecycle for one elastic app node.
+
+    ``make_worker(mesh) -> worker`` builds the app on a given mesh; the
+    worker must expose ``state_host``/``load_state_host`` (and
+    ``recover_server_shard`` for crash recovery) — AsyncSGDWorker does.
+    """
+
+    def __init__(
+        self,
+        make_worker: Callable,
+        num_data: int,
+        num_server: int,
+        key_space: Optional[Range] = None,
+    ):
+        self.make_worker = make_worker
+        self.num_data = num_data
+        self.num_server = num_server
+        self.key_space = key_space or Range.all()
+        self.worker = None
+        self._listeners = []
+
+    # -- lifecycle --
+
+    def start(self):
+        po = Postoffice.instance()
+        if not po.started:
+            po.start(
+                num_data=self.num_data,
+                num_server=self.num_server,
+                key_space=self.key_space,
+            )
+        self._resubscribe(po)
+        self.worker = self.make_worker(po.mesh)
+        return self.worker
+
+    def subscribe_nodes(self, cb) -> None:
+        """Node add/remove events survive mesh rebuilds (the Manager is
+        recreated with the Postoffice; the coordinator re-subscribes)."""
+        self._listeners.append(cb)
+        po = Postoffice.instance()
+        if po.started:
+            po.manager.subscribe_nodes(cb)
+
+    def _resubscribe(self, po) -> None:
+        for cb in self._listeners:
+            po.manager.subscribe_nodes(cb)
+
+    # -- membership changes (ref manager.cc AddNode / NodeDisconnected) --
+
+    def resize(self, num_data: Optional[int] = None,
+               num_server: Optional[int] = None):
+        """Live migration to a new data x server split: no files, no
+        training-state loss; key ranges re-divide over the new server
+        set while every key keeps its hash slot."""
+        new_data = self.num_data if num_data is None else num_data
+        new_server = self.num_server if num_server is None else num_server
+        snap = self.worker.state_host() if self.worker is not None else None
+
+        old_nodes = list(Postoffice.instance().manager.nodes)
+        Postoffice.reset()
+        po = Postoffice.instance().start(
+            num_data=new_data, num_server=new_server, key_space=self.key_space
+        )
+        self._resubscribe(po)
+        # emit the membership diff through the (fresh) manager so
+        # subscribers see the same add/remove stream the reference
+        # broadcasts on NodeChange
+        old_ids = {n.id for n in old_nodes}
+        new_ids = {n.id for n in po.manager.nodes}
+        for n in old_nodes:
+            if n.id not in new_ids:
+                po.manager._notify("remove", n)
+        for n in po.manager.nodes:
+            if n.id not in old_ids:
+                po.manager._notify("add", n)
+
+        self.num_data, self.num_server = new_data, new_server
+        self.worker = self.make_worker(po.mesh)
+        if snap is not None:
+            self.worker.load_state_host(snap)
+        return self.worker
+
+    def add_server(self):
+        return self.resize(num_server=self.num_server + 1)
+
+    def remove_server(self):
+        assert self.num_server > 1, "cannot remove the last server"
+        return self.resize(num_server=self.num_server - 1)
+
+    def add_worker(self):
+        return self.resize(num_data=self.num_data + 1)
+
+    def remove_worker(self):
+        assert self.num_data > 1, "cannot remove the last worker"
+        return self.resize(num_data=self.num_data - 1)
+
+    def attach_recovery(self, rc) -> None:
+        """Drive membership from heartbeat timeouts: a RecoveryCoordinator
+        server-death event becomes the manager.cc dead-node flow."""
+        rc.on_server_dead(lambda nid: self.handle_server_death(int(nid[1:])))
+
+    def handle_server_death(self, rank: int) -> str:
+        """Crash path (ref manager.cc dead-node flow): in-place recovery
+        from the live neighbor replica when configured; otherwise the
+        segment is lost and the cluster shrinks around the dead server.
+        Returns "recovered" or "resharded"."""
+        po = Postoffice.instance()
+        if self.worker is not None and self.worker.recover_server_shard(rank):
+            po.manager._notify("add", Node(Node.SERVER, rank))  # replacement
+            return "recovered"
+        if self.worker is not None:
+            # the shard is gone for real: drop its segment before the
+            # survivors re-divide the key space
+            self.worker.wipe_server_shard(rank)
+        po.manager.remove_node(f"S{rank}")
+        self.resize(num_server=max(1, self.num_server - 1))
+        return "resharded"

@@ -2,9 +2,12 @@
 
 Counterpart of ``src/system/manager.{h,cc}``: tracks customers by id,
 assigns fresh customer ids (ref ``NextCustomerID``), records node roles and
-key ranges, and coordinates orderly shutdown. Node join/leave on TPU is mesh
-(re)construction — elastic resize hooks re-shard tables via
-``parameter.replica`` checkpoints rather than live key-range migration.
+key ranges, broadcasts node add/remove events to subscribers (ref
+``AddNode``'s NodeChange broadcast / ``NodeDisconnected``), and coordinates
+orderly shutdown. Node join/leave on TPU is mesh (re)construction: the
+``system.elastic.ElasticCoordinator`` performs the live key-range
+migration (device->host->device reshard, no checkpoint files) and drives
+this registry's events.
 """
 
 from __future__ import annotations
@@ -39,6 +42,37 @@ class Manager:
         self._next_id = 0
         self._lock = threading.Lock()
         self.nodes: List[Node] = []
+        # (event, node) listeners; event in {"add", "remove"} (ref
+        # manager.cc NodeChange broadcast to every connected node)
+        self._node_listeners: List = []
+
+    def subscribe_nodes(self, cb) -> None:
+        """Register a callback for node add/remove events (idempotent —
+        elastic resizes re-subscribe surviving listeners)."""
+        if cb not in self._node_listeners:
+            self._node_listeners.append(cb)
+
+    def _notify(self, event: str, node: Node) -> None:
+        for cb in list(self._node_listeners):
+            cb(event, node)
+
+    def add_node(self, node: Node) -> None:
+        """Record a joined node and broadcast (ref manager.cc AddNode)."""
+        with self._lock:
+            self.nodes.append(node)
+        self._notify("add", node)
+
+    def remove_node(self, node_id: str) -> Optional[Node]:
+        """Drop a node and broadcast (ref manager.cc NodeDisconnected)."""
+        with self._lock:
+            for i, n in enumerate(self.nodes):
+                if n.id == node_id:
+                    dead = self.nodes.pop(i)
+                    break
+            else:
+                return None
+        self._notify("remove", dead)
+        return dead
 
     def next_customer_id(self) -> int:
         with self._lock:

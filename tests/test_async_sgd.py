@@ -539,3 +539,40 @@ class TestScanSuperbatch:
             random_sparse(1000, 512, 8, seed=999, w_true=w_true, binary=True)
         )
         assert np.isfinite(ev["logloss"])
+
+
+class TestBitsWireHashModulus:
+    def test_bits_wire_matches_directory_slots_with_padding(self, mesh8):
+        """Regression: with a table whose padded size differs from the
+        configured slot count (1001 -> 1002 over 2 servers), the bits
+        wire must hash with the directory's CONFIGURED modulus — the
+        same key->slot map as every other path."""
+        from parameter_server_tpu.apps.linear.async_sgd import (
+            ELLBitsBatch,
+            unpack_bits,
+        )
+        from parameter_server_tpu.utils.bitpack import slot_bits
+
+        conf = make_conf(num_slots=1001)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        assert worker.num_slots == 1002
+        assert worker.directory.num_slots == 1001
+        b = random_sparse(256, 512, 8, seed=0, binary=True)
+        prepped = worker.prep(b, device_put=False)
+        assert isinstance(prepped, ELLBitsBatch)
+        import jax.numpy as jnp
+
+        bits = slot_bits(worker.num_slots)
+        want = worker.directory.slots(b.indices)
+        got = []
+        for d in range(prepped.counts.shape[0]):
+            nsub = int(prepped.counts[d])
+            dec = np.asarray(
+                unpack_bits(
+                    jnp.asarray(prepped.slots_words[d]), prepped.rows * 8, bits
+                )
+            )[: nsub * 8]
+            got.append(dec)
+        np.testing.assert_array_equal(np.concatenate(got), want)

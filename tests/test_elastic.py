@@ -1,0 +1,170 @@
+"""Live elasticity (system/elastic.py): node join/leave with key-range
+migration on the virtual 8-device mesh. Mirrors the reference's live
+membership flows (manager.cc AddNode / dead-node): grow and shrink the
+server set mid-training without files, keep every key's slot stable, and
+recover a crashed server from the live replica."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    PenaltyConfig,
+    SGDConfig,
+)
+from parameter_server_tpu.system.elastic import ElasticCoordinator
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+NUM_SLOTS = 1000  # deliberately NOT a power of two: padding varies per
+# server count (1000 -> 1000@2, 1002@3), so these tests prove hashing
+# stays on the configured modulus across resizes
+
+
+def make_worker(mesh):
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=0.5, beta=1.0)
+    conf.async_sgd = SGDConfig(
+        algo="ftrl", minibatch=256, num_slots=NUM_SLOTS, num_replicas=1,
+        replica_every=1,
+    )
+    return AsyncSGDWorker(conf, mesh=mesh)
+
+
+def batches(n, seed0=0):
+    rng = np.random.default_rng(42)
+    w_true = (rng.normal(size=512) * (rng.random(512) < 0.2)).astype(np.float32)
+    return [
+        random_sparse(256, 512, 8, seed=seed0 + i, w_true=w_true)
+        for i in range(n)
+    ]
+
+
+class TestGracefulResize:
+    def test_server_join_migrates_key_ranges(self, mesh8):
+        events = []
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        co.subscribe_nodes(lambda ev, n: events.append((ev, n.id)))
+        w = co.start()
+        for b in batches(3):
+            w.collect(w.process_minibatch(b))
+        before = w.weights_dense()[:NUM_SLOTS]
+
+        w2 = co.add_server()  # 2x2 -> 2x3: key ranges re-divide 3 ways
+        assert co.num_server == 3
+        table = w2.state["z"]
+        assert dict(table.sharding.mesh.shape)["server"] == 3
+        np.testing.assert_allclose(
+            w2.weights_dense()[:NUM_SLOTS], before, atol=1e-6
+        )
+        assert ("add", "S2") in events
+        # training continues on the new split
+        w2.collect(w2.process_minibatch(batches(1, seed0=50)[0]))
+
+    def test_server_leave_keeps_model(self, mesh8):
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        for b in batches(3):
+            w.collect(w.process_minibatch(b))
+        before = w.weights_dense()[:NUM_SLOTS]
+        w2 = co.remove_server()  # graceful decommission: state migrates
+        np.testing.assert_allclose(
+            w2.weights_dense()[:NUM_SLOTS], before, atol=1e-6
+        )
+        w2.collect(w2.process_minibatch(batches(1, seed0=50)[0]))
+
+    def test_worker_join_grows_data_axis(self, mesh8):
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        w.collect(w.process_minibatch(batches(1)[0]))
+        before = w.weights_dense()[:NUM_SLOTS]
+        w2 = co.add_worker()  # 2x2 -> 3x2
+        assert dict(w2.state["z"].sharding.mesh.shape)["data"] == 3
+        np.testing.assert_allclose(
+            w2.weights_dense()[:NUM_SLOTS], before, atol=1e-6
+        )
+        w2.collect(w2.process_minibatch(batches(1, seed0=60)[0]))
+
+    def test_hash_slots_stable_across_resize(self, mesh8):
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        keys = np.array([3, 1 << 40, -5, 999999], dtype=np.int64)
+        slots_before = w.directory.slots(keys)
+        w2 = co.add_server()
+        np.testing.assert_array_equal(w2.directory.slots(keys), slots_before)
+
+
+class TestCrashPath:
+    def test_death_with_replica_recovers_in_place(self, mesh8):
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        for b in batches(3):
+            w.collect(w.process_minibatch(b))
+        want = w.weights_dense()
+        w.wipe_server_shard(0)
+        assert co.handle_server_death(0) == "recovered"
+        np.testing.assert_allclose(co.worker.weights_dense(), want, atol=1e-6)
+        assert co.num_server == 2  # no shrink needed
+
+    def test_death_without_replica_resharding_loses_only_dead_range(
+        self, mesh8
+    ):
+        def make_worker_noreplica(mesh):
+            conf = Config()
+            conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+            conf.learning_rate = LearningRateConfig(
+                type="decay", alpha=0.5, beta=1.0
+            )
+            conf.async_sgd = SGDConfig(
+                algo="ftrl", minibatch=256, num_slots=NUM_SLOTS
+            )
+            return AsyncSGDWorker(conf, mesh=mesh)
+
+        events = []
+        co = ElasticCoordinator(make_worker_noreplica, num_data=2, num_server=2)
+        co.subscribe_nodes(lambda ev, n: events.append((ev, n.id)))
+        w = co.start()
+        for b in batches(3):
+            w.collect(w.process_minibatch(b))
+        before = w.weights_dense()
+        per = w.num_slots // 2
+        assert co.handle_server_death(1) == "resharded"
+        assert co.num_server == 1
+        after = co.worker.weights_dense()
+        # surviving range intact; the dead server's range is lost (zeros)
+        np.testing.assert_allclose(after[:per], before[:per], atol=1e-6)
+        assert np.abs(after[per : 2 * per]).sum() == 0
+        assert ("remove", "S1") in events
+        co.worker.collect(co.worker.process_minibatch(batches(1, seed0=70)[0]))
+
+    def test_heartbeat_timeout_drives_elastic_death_flow(self, mesh8):
+        from parameter_server_tpu.system.heartbeat import (
+            HeartbeatCollector,
+            HeartbeatReport,
+        )
+        from parameter_server_tpu.system.recovery import RecoveryCoordinator
+
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        for b in batches(3):
+            w.collect(w.process_minibatch(b))
+        want = w.weights_dense()
+        w.wipe_server_shard(1)
+
+        c = HeartbeatCollector(timeout=5.0)
+        c.report("S1", HeartbeatReport())
+        rc = RecoveryCoordinator(c)
+        co.attach_recovery(rc)
+        assert rc.check(now=c._last_seen["S1"] + 6) == ["S1"]
+        np.testing.assert_allclose(co.worker.weights_dense(), want, atol=1e-6)

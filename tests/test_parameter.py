@@ -186,3 +186,19 @@ class TestKVLayer:
         l2 = KVLayer(mesh=mesh8)
         l2.set_replica(snap)
         np.testing.assert_allclose(np.asarray(l2["w"]), -0.01 * np.ones(4))
+
+
+class TestPaddedSentinel:
+    def test_exact_kvmap_drops_unknown_keys_when_padded(self, mesh8):
+        """Regression: with num_slots not divisible by the server count
+        (33 -> padded 34), a directory miss must map OUTSIDE every
+        shard's range — unknown keys are dropped, never scattered into a
+        padding slot."""
+        m = KVMap(
+            AssignEntry(), mesh=mesh8, k=1, num_slots=33,
+            keys=np.array([5, 10]),
+        )
+        assert m.num_slots == 34
+        m.wait(m.push(m.request(), np.array([5, 999]), np.array([[1.0], [7.0]])))
+        np.testing.assert_allclose(m.values(np.array([5, 10])), [[1.0], [0.0]])
+        np.testing.assert_allclose(np.asarray(m.values(np.array([999]))), [[0.0]])
