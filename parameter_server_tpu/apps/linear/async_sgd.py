@@ -1118,10 +1118,12 @@ class AsyncSGDWorker(ISGDCompNode):
 
     def upload(self, prepped):
         """Host-prepped shards → device arrays. Multi-process: assemble
-        this host's shards into the global data-sharded batch."""
+        this host's shards into the global data-sharded batch (the data
+        axis sits at dim 1 for scan superbatches, after the T axis)."""
         from ...parallel import distributed
 
-        return distributed.global_from_local(self.mesh, prepped)
+        axis_dim = 1 if isinstance(prepped, ELLBitsSuperBatch) else 0
+        return distributed.global_from_local(self.mesh, prepped, axis_dim=axis_dim)
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
@@ -1304,13 +1306,6 @@ class AsyncSGDWorker(ISGDCompNode):
     ) -> int:
         """Prep + stack T minibatches and run them as ONE scan-fused
         device launch (see ELLBitsSuperBatch). Requires the bits wire."""
-        from ...parallel import distributed
-
-        if distributed.is_multiprocess():
-            raise NotImplementedError(
-                "superbatch assembly across processes is not implemented; "
-                "submit per-minibatch steps in multi-host runs"
-            )
         prepped = [self.prep(b, device_put=False) for b in batches]
         if not all(isinstance(p, ELLBitsBatch) for p in prepped):
             raise ValueError(
@@ -1318,7 +1313,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 "uniform-row batches); got a fallback encoding"
             )
         return self._submit_prepped(
-            jax.device_put(stack_bits_batches(prepped)), with_aux=with_aux
+            self.upload(stack_bits_batches(prepped)), with_aux=with_aux
         )
 
     def collect(self, ts: int) -> SGDProgress:
@@ -1451,7 +1446,10 @@ class AsyncSGDWorker(ISGDCompNode):
             sel = nz[(nz >= s * shard_size) & (nz < (s + 1) * shard_size)]
             with psfile.open_write(spath) as f:
                 if self.directory.hashed:
-                    f.write(f"#hashed\t{self.num_slots}\n")
+                    # header modulus = the directory's CONFIGURED count
+                    # (what evaluation must hash with), not the padded
+                    # table size — they differ on non-divisible tables
+                    f.write(f"#hashed\t{self.directory.num_slots}\n")
                     for i in sel:
                         f.write(f"{i}\t{float(w[i])!r}\n")
                 else:

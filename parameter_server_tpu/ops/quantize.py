@@ -31,10 +31,13 @@ _TILE = _LANES * _SUBLANES
 
 
 def _kernel(x_ref, lo_ref, hi_ref, seed_ref, out_ref, *, levels):
-    from jax.experimental import pallas as pl  # noqa: F401
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    pltpu.prng_seed(seed_ref[0])
+    # fold the grid position into the seed: every block must draw its OWN
+    # noise, not replay block 0's stream (block-correlated rounding noise
+    # is biased in aggregate)
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
     x = x_ref[:]
     lo = lo_ref[0]
     hi = hi_ref[0]
@@ -74,12 +77,18 @@ def _quantize_pallas(x: jax.Array, seed, num_bytes: int):
     pad = (-n) % _TILE
     xp = jnp.pad(x, (0, pad)).reshape(-1, _LANES)
     rows = xp.shape[0]
+    # big blocks (same lesson as ops/ftrl.py): an (8,128) block makes the
+    # grid enormous on multi-M-slot shards and grid overhead dominates;
+    # 2048x128 = 1MB/ref keeps the grid small at every real size
+    block_rows = 2048
+    while rows % block_rows:
+        block_rows //= 2
     spec = pl.BlockSpec(
-        (_SUBLANES, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+        (block_rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
     q = pl.pallas_call(
         functools.partial(_kernel, levels=levels),
-        grid=(rows // _SUBLANES,),
+        grid=(rows // block_rows,),
         out_shape=jax.ShapeDtypeStruct(xp.shape, jnp.float32),
         in_specs=[
             spec,

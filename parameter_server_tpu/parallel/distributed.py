@@ -133,12 +133,14 @@ def local_data_shards(mesh: Mesh) -> int:
     return rows
 
 
-def global_from_local(mesh: Mesh, tree, axis_name: str = None):
+def global_from_local(mesh: Mesh, tree, axis_name: str = None, axis_dim: int = 0):
     """Assemble per-process host arrays into global jax.Arrays sharded
-    over the data axis (leading dim). Single-process: plain device_put.
+    over the data axis. Single-process: plain device_put.
 
-    Each leaf's leading dim is this process's local data-shard count; the
-    global array's leading dim is the full data axis.
+    ``axis_dim`` selects which leaf dimension carries the data shards —
+    0 for per-minibatch trees ([D_local, ...]), 1 for scan superbatches
+    ([T, D_local, ...]); that dim grows from this process's local shard
+    count to the full data axis.
     """
     axis = axis_name or meshlib.DATA_AXIS
     if not is_multiprocess():
@@ -149,8 +151,12 @@ def global_from_local(mesh: Mesh, tree, axis_name: str = None):
         if leaf is None:
             return None
         leaf = np.asarray(leaf)
-        sharding = NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
-        global_shape = (d_global,) + leaf.shape[1:]
+        spec = [None] * leaf.ndim
+        spec[axis_dim] = axis
+        sharding = NamedSharding(mesh, P(*spec))
+        global_shape = tuple(
+            d_global if i == axis_dim else s for i, s in enumerate(leaf.shape)
+        )
         return jax.make_array_from_process_local_data(sharding, leaf, global_shape)
 
     return jax.tree.map(put, tree, is_leaf=lambda x: x is None)

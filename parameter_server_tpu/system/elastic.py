@@ -27,7 +27,6 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..utils.range import Range
-from .manager import Node
 from .postoffice import Postoffice
 
 
@@ -82,31 +81,46 @@ class ElasticCoordinator:
     # -- membership changes (ref manager.cc AddNode / NodeDisconnected) --
 
     def resize(self, num_data: Optional[int] = None,
-               num_server: Optional[int] = None):
+               num_server: Optional[int] = None,
+               notify: bool = True):
         """Live migration to a new data x server split: no files, no
         training-state loss; key ranges re-divide over the new server
-        set while every key keeps its hash slot."""
+        set while every key keeps its hash slot.
+
+        Node ids here are mesh SLOTS (positional, "S0..Sn-1"), so the
+        broadcast diff reports slot-count changes: growing emits adds for
+        the new highest ranks, shrinking emits removes for the dropped
+        ones. A crash names its dead node explicitly first and passes
+        ``notify=False`` — the survivors' renumbering is not a
+        membership change."""
         new_data = self.num_data if num_data is None else num_data
         new_server = self.num_server if num_server is None else num_server
         snap = self.worker.state_host() if self.worker is not None else None
 
-        old_nodes = list(Postoffice.instance().manager.nodes)
+        old_po = Postoffice.instance()
+        old_nodes = list(old_po.manager.nodes)
+        # orderly teardown of the old incarnation: the executor dispatch
+        # thread and any heartbeat/aux runtime must not outlive the mesh
+        # they were built on (a long-lived cluster resizes many times)
+        if self.worker is not None:
+            self.worker.executor.stop()
+        old_po.stop()
         Postoffice.reset()
         po = Postoffice.instance().start(
             num_data=new_data, num_server=new_server, key_space=self.key_space
         )
         self._resubscribe(po)
-        # emit the membership diff through the (fresh) manager so
-        # subscribers see the same add/remove stream the reference
-        # broadcasts on NodeChange
-        old_ids = {n.id for n in old_nodes}
-        new_ids = {n.id for n in po.manager.nodes}
-        for n in old_nodes:
-            if n.id not in new_ids:
-                po.manager._notify("remove", n)
-        for n in po.manager.nodes:
-            if n.id not in old_ids:
-                po.manager._notify("add", n)
+        if notify:
+            # membership diff through the (fresh) manager — the same
+            # add/remove stream the reference broadcasts on NodeChange
+            old_ids = {n.id for n in old_nodes}
+            new_ids = {n.id for n in po.manager.nodes}
+            for n in old_nodes:
+                if n.id not in new_ids:
+                    po.manager.broadcast("remove", n)
+            for n in po.manager.nodes:
+                if n.id not in old_ids:
+                    po.manager.broadcast("add", n)
 
         self.num_data, self.num_server = new_data, new_server
         self.worker = self.make_worker(po.mesh)
@@ -140,12 +154,15 @@ class ElasticCoordinator:
         Returns "recovered" or "resharded"."""
         po = Postoffice.instance()
         if self.worker is not None and self.worker.recover_server_shard(rank):
-            po.manager._notify("add", Node(Node.SERVER, rank))  # replacement
+            # restored in place from the live replica: membership is
+            # unchanged (same slot, same range) — no event
             return "recovered"
         if self.worker is not None:
             # the shard is gone for real: drop its segment before the
             # survivors re-divide the key space
             self.worker.wipe_server_shard(rank)
+        # the DEAD node's identity event; the survivors' positional
+        # renumbering inside resize is suppressed (notify=False)
         po.manager.remove_node(f"S{rank}")
-        self.resize(num_server=max(1, self.num_server - 1))
+        self.resize(num_server=max(1, self.num_server - 1), notify=False)
         return "resharded"

@@ -52,7 +52,9 @@ class Manager:
         if cb not in self._node_listeners:
             self._node_listeners.append(cb)
 
-    def _notify(self, event: str, node: Node) -> None:
+    def broadcast(self, event: str, node: Node) -> None:
+        """Fan a membership event out to subscribers (ref manager.cc
+        NodeChange broadcast). ``event`` in {"add", "remove"}."""
         for cb in list(self._node_listeners):
             cb(event, node)
 
@@ -60,7 +62,7 @@ class Manager:
         """Record a joined node and broadcast (ref manager.cc AddNode)."""
         with self._lock:
             self.nodes.append(node)
-        self._notify("add", node)
+        self.broadcast("add", node)
 
     def remove_node(self, node_id: str) -> Optional[Node]:
         """Drop a node and broadcast (ref manager.cc NodeDisconnected)."""
@@ -71,7 +73,7 @@ class Manager:
                     break
             else:
                 return None
-        self._notify("remove", dead)
+        self.broadcast("remove", dead)
         return dead
 
     def next_customer_id(self) -> int:

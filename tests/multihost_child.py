@@ -61,8 +61,20 @@ def main() -> int:
         prog = worker.collect(worker.process_minibatch(batch))
         # each step's num_ex is psum'd over the FULL data axis: all hosts
         assert prog.num_examples_processed == 64 * n_data, prog
+    # scan superbatch across processes: each host stacks ITS 2 minibatches
+    # [T=2, D_local, ...]; assembly shards dim 1 over the global data axis
+    sup = [
+        random_sparse(
+            per_host_rows, 1 << 12, 8, seed=seed + 50 + i, w_true=w_true,
+            binary=True,
+        )
+        for i in range(2)
+    ]
+    prog = worker.collect(worker.submit_superbatch(sup))
+    assert prog.num_examples_processed == 2 * 64 * n_data, prog
+
     total = worker.progress.num_examples_processed
-    expected = 64 * n_data * 3
+    expected = 64 * n_data * 5
     assert total == expected, f"examples {total} != {expected}"
     print(f"PS_OK {total}", flush=True)
     return 0

@@ -168,3 +168,48 @@ class TestCrashPath:
         co.attach_recovery(rc)
         assert rc.check(now=c._last_seen["S1"] + 6) == ["S1"]
         np.testing.assert_allclose(co.worker.weights_dense(), want, atol=1e-6)
+
+    def test_middle_rank_death_emits_only_the_dead_node(self, mesh8):
+        """Regression: killing rank 0 of 2 must broadcast exactly one
+        remove for S0 — not an inverted stream claiming the SURVIVOR
+        left (the positional renumbering inside the shrink is not a
+        membership change)."""
+        def mk(mesh):
+            conf = Config()
+            conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+            conf.learning_rate = LearningRateConfig(
+                type="decay", alpha=0.5, beta=1.0
+            )
+            conf.async_sgd = SGDConfig(
+                algo="ftrl", minibatch=256, num_slots=NUM_SLOTS
+            )
+            return AsyncSGDWorker(conf, mesh=mesh)
+
+        events = []
+        co = ElasticCoordinator(mk, num_data=2, num_server=2)
+        co.subscribe_nodes(lambda ev, n: events.append((ev, n.id)))
+        w = co.start()
+        w.collect(w.process_minibatch(batches(1)[0]))
+        assert co.handle_server_death(0) == "resharded"
+        assert events == [("remove", "S0")]
+
+    def test_recovery_in_place_emits_no_events(self, mesh8):
+        events = []
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        co.subscribe_nodes(lambda ev, n: events.append((ev, n.id)))
+        w = co.start()
+        w.collect(w.process_minibatch(batches(1)[0]))
+        w.wipe_server_shard(0)
+        assert co.handle_server_death(0) == "recovered"
+        assert events == []
+
+    def test_saved_model_header_uses_configured_modulus(self, mesh8, tmp_path):
+        """Regression: the '#hashed <n>' header must carry the hashing
+        modulus (configured count), not the padded table size — model
+        evaluation rebuilds the key->slot map from it."""
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        w.collect(w.process_minibatch(batches(1)[0]))
+        paths = w.save_model(str(tmp_path / "m"))
+        header = open(paths[0]).readline().split()
+        assert header == ["#hashed", str(NUM_SLOTS)]
