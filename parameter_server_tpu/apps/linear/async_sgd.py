@@ -463,7 +463,29 @@ def _lane_positions(counts: np.ndarray, lanes: int) -> np.ndarray:
     return np.where(pos < lanes, pos, -1)
 
 
-def make_push_reduce(push_quant: int):
+def _make_perturb(noise, salt: int):
+    """ADD_NOISE wire op: N(mean, std) on nonzero entries, or None when
+    disabled. A mean-only filter (std=0, mean!=0) still applies — the
+    reference's normal_distribution(mean, 0) degenerates to adding the
+    constant. The key folds BOTH mesh coordinates so every shard of every
+    worker draws its own iid stream."""
+    if noise is None:
+        return None
+    mean, std = float(noise[0]), float(noise[1])
+    if mean == 0.0 and std <= 0.0:
+        return None
+
+    def perturb(g, seed):
+        key = jax.random.fold_in(jax.random.PRNGKey(salt), seed)
+        key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+        key = jax.random.fold_in(key, jax.lax.axis_index(SERVER_AXIS))
+        n = mean + std * jax.random.normal(key, g.shape, g.dtype)
+        return jnp.where(g != 0, g + n, g)
+
+    return perturb
+
+
+def make_push_reduce(push_quant: int, noise=None):
     """Cross-worker gradient reduction, optionally through the quantized
     wire: the device-side realization of the reference's FIXING_FLOAT
     push filter (src/filter/fixing_float.h) — each worker stochastically
@@ -472,15 +494,26 @@ def make_push_reduce(push_quant: int):
     filter/fixing_float.quantize_jax) and the decoded values are summed.
     Zero entries are masked back to exactly zero so slots a worker never
     touched contribute nothing — the sparse_filter ∘ fixing_float chain
-    of the reference's confs (absent keys get no quantization noise)."""
+    of the reference's confs (absent keys get no quantization noise).
+
+    ``noise=(mean, std)`` applies the ADD_NOISE filter device-side:
+    N(mean, std) on each worker's own contribution (only where it is
+    nonzero — absent keys get no noise), before quantization and
+    aggregation, exactly the wire position of src/filter/add_noise.h."""
+    perturb = _make_perturb(noise, 0xA015E)
+
     if not push_quant:
-        return lambda g, seed: jax.lax.psum(g, DATA_AXIS)
+        if perturb is None:
+            return lambda g, seed: jax.lax.psum(g, DATA_AXIS)
+        return lambda g, seed: jax.lax.psum(perturb(g, seed), DATA_AXIS)
     from ...filter.fixing_float import dequantize_jax, quantize_jax
     from ...ops import quantize as qops
 
     use_pallas = qops.use_pallas()
 
     def reduce(g, seed):
+        if perturb is not None:
+            g = perturb(g, seed)  # ADD_NOISE rides the wire before quantize
         if use_pallas:
             # fused Pallas normalize+noise+floor (measured ~4% faster than
             # the XLA chain on v5e for 2M-slot shards; BENCH_r2 notes)
@@ -499,7 +532,7 @@ def make_push_reduce(push_quant: int):
     return reduce
 
 
-def make_push_touched(push_quant: int):
+def make_push_touched(push_quant: int, noise=None):
     """(g_shard, seed) -> (reduced g, touched membership mask).
 
     touched gates ``updater.apply`` (untouched slots pass through, ref
@@ -512,7 +545,7 @@ def make_push_touched(push_quant: int):
     fixed-point rounding deterministically zeroes small gradients — so
     membership is collected PRE-quantization with a psum of the support
     mask (a cheap dense collective, still no scatter)."""
-    push_reduce = make_push_reduce(push_quant)
+    push_reduce = make_push_reduce(push_quant, noise=noise)
     if not push_quant:
 
         def run(g_shard, seed):
@@ -530,16 +563,21 @@ def make_push_touched(push_quant: int):
     return run
 
 
-def make_pull_weights(updater, pull_quant: int):
+def make_pull_weights(updater, pull_quant: int, noise=None):
     """Server-side weight derivation for the pull path, optionally
     through the quantized wire (FIXING_FLOAT pull_filter): each server
     shard derives its dense weight vector from its live state — the
     reference's servers send WEIGHTS, not raw state — and, when
     ``pull_quant`` is set, stochastically rounds it to n-byte fixed point
     (per-shard scale) before workers gather it. Exact zeros (L1-pruned
-    coordinates) stay exactly zero, as under the sparse_filter chain."""
+    coordinates) stay exactly zero, as under the sparse_filter chain.
+    ``noise`` applies ADD_NOISE to the sent weights (pull_filter), the
+    server→worker direction of src/filter/add_noise.h."""
+    perturb = _make_perturb(noise, 0xA015F)
     if not pull_quant:
-        return lambda pulled, seed: updater.weights(pulled)
+        if perturb is None:
+            return lambda pulled, seed: updater.weights(pulled)
+        return lambda pulled, seed: perturb(updater.weights(pulled), seed)
     from ...filter.fixing_float import dequantize_jax, quantize_jax
     from ...ops import quantize as qops
 
@@ -547,6 +585,8 @@ def make_pull_weights(updater, pull_quant: int):
 
     def pull(pulled, seed):
         w = updater.weights(pulled)
+        if perturb is not None:
+            w = perturb(w, seed)
         if use_pallas:
             s = seed.astype(jnp.int32) * jnp.int32(999983) + jax.lax.axis_index(
                 SERVER_AXIS
@@ -588,14 +628,16 @@ def make_train_step_ell(
     packed: bool = False,
     push_quant: int = 0,
     pull_quant: int = 0,
+    push_noise=None,
+    pull_noise=None,
 ):
     """Fused SPMD step over ELL batches: Xw is a lane reduction (no row
     scatter); only the push keeps a scatter-add. ``packed`` accepts the
     u24-wire ELLPackedBatch and unpacks indices on device."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_touched = make_push_touched(push_quant)
-    pull_weights = make_pull_weights(updater, pull_quant)
+    push_touched = make_push_touched(push_quant, noise=push_noise)
+    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
 
     def local_step(live, pulled, seed, y, mask, slots, vals):
         y, mask, slots = y[0], mask[0], slots[0]
@@ -655,13 +697,14 @@ def make_train_step_ell(
 
 
 def _make_bits_mini_step(
-    updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant, pull_quant
+    updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant,
+    pull_quant, push_noise=None, pull_noise=None,
 ):
     """Shared single-minibatch body for the bits-wire step builders:
     (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
     bits = slot_bits(num_slots)
-    push_touched = make_push_touched(push_quant)
-    pull_weights = make_pull_weights(updater, pull_quant)
+    push_touched = make_push_touched(push_quant, noise=push_noise)
+    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
 
     def mini_step(live, pulled, seed, y_bits, count, words):
         y = unpack_sign_bits(y_bits, rows)
@@ -711,6 +754,8 @@ def make_train_step_ell_bits(
     with_aux: bool = True,
     push_quant: int = 0,
     pull_quant: int = 0,
+    push_noise=None,
+    pull_noise=None,
 ):
     """Fused SPMD step over the minimal-wire ELLBitsBatch (binary,
     uniform-row): slot ids unpack from the bitstream, labels from sign
@@ -720,7 +765,7 @@ def make_train_step_ell_bits(
     shard = num_slots // n_server
     mini_step = _make_bits_mini_step(
         updater, loss, num_slots, shard, rows, lanes, with_aux,
-        push_quant, pull_quant,
+        push_quant, pull_quant, push_noise, pull_noise,
     )
 
     def local_step(live, pulled, seed, y_bits, counts, words):
@@ -752,6 +797,8 @@ def make_train_step_ell_bits_scan(
     with_aux: bool = True,
     push_quant: int = 0,
     pull_quant: int = 0,
+    push_noise=None,
+    pull_noise=None,
 ):
     """Scan-fused superstep: T bits-wire minibatches per launch.
 
@@ -764,7 +811,7 @@ def make_train_step_ell_bits_scan(
     shard = num_slots // n_server
     mini_step = _make_bits_mini_step(
         updater, loss, num_slots, shard, rows, lanes, with_aux,
-        push_quant, pull_quant,
+        push_quant, pull_quant, push_noise, pull_noise,
     )
 
     def local_step(live, pulled, seed, y_bits, counts, words):
@@ -811,15 +858,16 @@ def make_train_step_ell_bits_scan(
 
 def make_train_step_hashed(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
-    push_quant: int = 0, pull_quant: int = 0,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None,
 ):
     """Per-entry fused SPMD step (hashed fast path): gather state at each
     nnz slot, segment-sum Xw by row, scatter per-entry gradients densely —
     duplicates fold in the scatter, so no uniquification anywhere."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_touched = make_push_touched(push_quant)
-    pull_weights = make_pull_weights(updater, pull_quant)
+    push_touched = make_push_touched(push_quant, noise=push_noise)
+    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
 
     def local_step(live, pulled, seed, y, mask, rows, slots, vals):
         y, mask, rows, slots, vals = y[0], mask[0], rows[0], slots[0], vals[0]
@@ -876,15 +924,16 @@ def make_train_step_hashed(
 
 def make_train_step(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
-    push_quant: int = 0, pull_quant: int = 0,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None,
 ):
     """Build the fused SPMD train step. Returns jitted
     ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
     """
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
-    push_touched = make_push_touched(push_quant)
-    pull_weights = make_pull_weights(updater, pull_quant)
+    push_touched = make_push_touched(push_quant, noise=push_noise)
+    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
 
     def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
         # squeeze the per-shard leading dim added by stacking
@@ -950,7 +999,26 @@ def make_train_step(
     return step
 
 
-_SUPPORTED_FILTERS = ("fixing_float", "key_caching", "sparse", "compressing")
+_SUPPORTED_FILTERS = (
+    "fixing_float", "key_caching", "sparse", "compressing", "add_noise",
+)
+
+
+def _add_noise_params(filters):
+    """(mean, std) of an ADD_NOISE entry in a conf filter list, or None.
+    Applied device-side to each worker's gradient contribution before
+    aggregation — the wire position of the reference's filter
+    (src/filter/add_noise.h encodes worker->server messages)."""
+    for f in filters or ():
+        if isinstance(f, dict):
+            ftype = str(f.get("type", "")).lower()
+            mean, std = f.get("mean", 0.0), f.get("std", 0.0)
+        else:
+            ftype = str(getattr(f, "type", "")).lower()
+            mean, std = getattr(f, "mean", 0.0), getattr(f, "std", 0.0)
+        if ftype == "add_noise":
+            return float(mean or 0.0), float(std or 0.0)
+    return None
 
 
 def _fixing_float_bytes(filters, where: str) -> int:
@@ -1017,6 +1085,9 @@ class AsyncSGDWorker(ISGDCompNode):
         # the quantized paths)
         self._push_quant = _fixing_float_bytes(sgd.push_filter, "push_filter")
         self._pull_quant = _fixing_float_bytes(sgd.pull_filter, "pull_filter")
+        # ADD_NOISE push filter -> device-side per-worker gradient noise
+        self._push_noise = _add_noise_params(sgd.push_filter)
+        self._pull_noise = _add_noise_params(sgd.pull_filter)
         self._seed_counter = 0
         self._warned_ell_overflow = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
@@ -1220,6 +1291,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.updater, self.loss, self.mesh, self.num_slots,
                 rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
+                push_noise=self._push_noise, pull_noise=self._pull_noise,
             )
         elif isinstance(prepped, ELLBitsBatch):
             key = ("ell_bits", prepped.rows, with_aux)
@@ -1227,6 +1299,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.updater, self.loss, self.mesh, self.num_slots,
                 rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
+                push_noise=self._push_noise, pull_noise=self._pull_noise,
             )
         elif isinstance(prepped, (ELLBatch, ELLPackedBatch)):
             packed = isinstance(prepped, ELLPackedBatch)
@@ -1235,20 +1308,23 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.updater, self.loss, self.mesh, self.num_slots,
                 binary=prepped.vals is None, with_aux=with_aux, packed=packed,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
+                push_noise=self._push_noise, pull_noise=self._pull_noise,
             )
         elif isinstance(prepped, HashedBatch):
             key = ("hashed", False, with_aux)
             builder = lambda: make_train_step_hashed(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
                 with_aux=with_aux, push_quant=self._push_quant,
-                pull_quant=self._pull_quant,
+                pull_quant=self._pull_quant, push_noise=self._push_noise,
+                pull_noise=self._pull_noise,
             )
         else:
             key = ("exact", False, with_aux)
             builder = lambda: make_train_step(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
                 with_aux=with_aux, push_quant=self._push_quant,
-                pull_quant=self._pull_quant,
+                pull_quant=self._pull_quant, push_noise=self._push_noise,
+                pull_noise=self._pull_noise,
             )
         if key not in self._steps:
             self._steps[key] = builder()

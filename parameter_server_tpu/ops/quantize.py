@@ -74,13 +74,19 @@ def _quantize_pallas(x: jax.Array, seed, num_bytes: int):
     from jax.experimental.pallas import tpu as pltpu
 
     n = x.shape[0]
-    pad = (-n) % _TILE
+    # big blocks (same lesson as ops/ftrl.py): an (8,128) block makes the
+    # grid enormous on multi-M-slot shards and grid overhead dominates.
+    # Large arrays pad up to a whole 2048x128 block (≤1MB of padding —
+    # lo/hi come from the UNpadded x, and padded tail rows are sliced
+    # off) so non-power-of-two shard sizes still run big blocks; small
+    # arrays fall back to the largest power-of-two divisor.
+    block_rows = 2048
+    if n >= _LANES * block_rows:
+        pad = (-n) % (_LANES * block_rows)
+    else:
+        pad = (-n) % _TILE
     xp = jnp.pad(x, (0, pad)).reshape(-1, _LANES)
     rows = xp.shape[0]
-    # big blocks (same lesson as ops/ftrl.py): an (8,128) block makes the
-    # grid enormous on multi-M-slot shards and grid overhead dominates;
-    # 2048x128 = 1MB/ref keeps the grid small at every real size
-    block_rows = 2048
     while rows % block_rows:
         block_rows //= 2
     spec = pl.BlockSpec(

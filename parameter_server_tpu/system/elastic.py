@@ -99,6 +99,7 @@ class ElasticCoordinator:
 
         old_po = Postoffice.instance()
         old_nodes = list(old_po.manager.nodes)
+        old_aux = old_po.aux
         # orderly teardown of the old incarnation: the executor dispatch
         # thread and any heartbeat/aux runtime must not outlive the mesh
         # they were built on (a long-lived cluster resizes many times)
@@ -109,6 +110,14 @@ class ElasticCoordinator:
         po = Postoffice.instance().start(
             num_data=new_data, num_server=new_server, key_space=self.key_space
         )
+        if old_aux is not None:
+            # liveness/dashboard/recovery must survive the resize — a
+            # cluster that goes deaf after its first membership change
+            # would never detect the second death
+            po.start_aux(
+                heartbeat_timeout=old_aux.collector.timeout,
+                print_fn=old_aux.print_fn,
+            )
         self._resubscribe(po)
         if notify:
             # membership diff through the (fresh) manager — the same
@@ -164,5 +173,15 @@ class ElasticCoordinator:
         # the DEAD node's identity event; the survivors' positional
         # renumbering inside resize is suppressed (notify=False)
         po.manager.remove_node(f"S{rank}")
-        self.resize(num_server=max(1, self.num_server - 1), notify=False)
+        new_server = max(1, self.num_server - 1)
+        rebuilt = new_server == self.num_server  # last server: slot reborn
+        self.resize(num_server=new_server, notify=False)
+        if rebuilt:
+            # a 1-server cluster cannot shrink: the slot is rebuilt
+            # (empty) — subscribers must see the replacement join or
+            # their membership view ends at zero servers
+            po2 = Postoffice.instance()
+            for n in po2.manager.nodes:
+                if n.id == f"S{rank}":
+                    po2.manager.broadcast("add", n)
         return "resharded"

@@ -576,3 +576,67 @@ class TestBitsWireHashModulus:
             )[: nsub * 8]
             got.append(dec)
         np.testing.assert_array_equal(np.concatenate(got), want)
+
+
+class TestAddNoisePushFilter:
+    """ADD_NOISE (ref src/filter/add_noise.h) applied device-side to each
+    worker's gradient contribution inside the fused step."""
+
+    def _train(self, mesh8, w_true, push_filter):
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.push_filter = push_filter
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        for b in synth(5, w_true):
+            worker.collect(worker.process_minibatch(b))
+        return worker.weights_dense()
+
+    def test_noise_perturbs_and_replays_deterministically(self, mesh8, w_true):
+        clean = self._train(mesh8, w_true, [])
+        noisy1 = self._train(
+            mesh8, w_true, [{"type": "add_noise", "std": 0.05}]
+        )
+        noisy2 = self._train(
+            mesh8, w_true, [{"type": "add_noise", "std": 0.05}]
+        )
+        assert not np.allclose(noisy1, clean, atol=1e-6)
+        np.testing.assert_allclose(noisy1, noisy2, atol=0)  # seeded replay
+        # zero std is the identity
+        zero = self._train(mesh8, w_true, [{"type": "add_noise", "std": 0.0}])
+        np.testing.assert_allclose(zero, clean, atol=0)
+
+    def test_noise_still_converges(self, mesh8, w_true):
+        conf = make_conf(num_slots=4096)
+        conf.async_sgd.push_filter = [{"type": "add_noise", "std": 0.02}]
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        worker.train(synth(40, w_true))
+        ev = worker.evaluate(random_sparse(2000, 512, 8, seed=999, w_true=w_true))
+        assert ev["auc"] > 0.6
+
+    def test_composes_with_quantized_push(self, mesh8, w_true):
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.push_filter = [
+            {"type": "add_noise", "std": 0.05},
+            {"type": "fixing_float", "num_bytes": 2},
+        ]
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        for b in synth(5, w_true):
+            worker.collect(worker.process_minibatch(b))
+        assert np.isfinite(worker.weights_dense()).all()
+
+    def test_mean_only_noise_applies(self, mesh8, w_true):
+        clean = self._train(mesh8, w_true, [])
+        shifted = self._train(
+            mesh8, w_true, [{"type": "add_noise", "mean": 0.1}]
+        )
+        assert not np.allclose(shifted, clean, atol=1e-6)
+
+    def test_pull_wire_noise(self, mesh8, w_true):
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.pull_filter = [{"type": "add_noise", "std": 0.05}]
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        for b in synth(5, w_true):
+            worker.collect(worker.process_minibatch(b))
+        noisy = worker.weights_dense()
+        clean = self._train(mesh8, w_true, [])
+        assert not np.allclose(noisy, clean, atol=1e-6)
+        assert np.isfinite(noisy).all()

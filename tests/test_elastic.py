@@ -213,3 +213,40 @@ class TestCrashPath:
         paths = w.save_model(str(tmp_path / "m"))
         header = open(paths[0]).readline().split()
         assert header == ["#hashed", str(NUM_SLOTS)]
+
+    def test_aux_runtime_survives_resize(self, mesh8):
+        """Regression: heartbeat/recovery must not go deaf after a
+        membership change — resize carries the aux runtime over."""
+        co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+        w = co.start()
+        po = Postoffice.instance()
+        po.start_aux(heartbeat_timeout=7.5, print_fn=lambda s: None)
+        w.collect(w.process_minibatch(batches(1)[0]))
+        co.add_server()
+        po2 = Postoffice.instance()
+        assert po2.aux is not None
+        assert po2.aux.collector.timeout == 7.5
+
+    def test_single_server_death_rebuilds_slot_with_add_event(self, mesh8):
+        """Regression: a 1-server cluster cannot shrink — the dead slot is
+        rebuilt empty and subscribers must see remove THEN add for S0."""
+        def mk(mesh):
+            conf = Config()
+            conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+            conf.learning_rate = LearningRateConfig(
+                type="decay", alpha=0.5, beta=1.0
+            )
+            conf.async_sgd = SGDConfig(
+                algo="ftrl", minibatch=256, num_slots=NUM_SLOTS
+            )
+            return AsyncSGDWorker(conf, mesh=mesh)
+
+        events = []
+        co = ElasticCoordinator(mk, num_data=2, num_server=1)
+        co.subscribe_nodes(lambda ev, n: events.append((ev, n.id)))
+        w = co.start()
+        w.collect(w.process_minibatch(batches(1)[0]))
+        assert co.handle_server_death(0) == "resharded"
+        assert events == [("remove", "S0"), ("add", "S0")]
+        assert co.num_server == 1
+        co.worker.collect(co.worker.process_minibatch(batches(1, seed0=5)[0]))
