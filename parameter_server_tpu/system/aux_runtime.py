@@ -77,6 +77,16 @@ class AuxRuntime:
         with self._lock:
             return self._infos.get(node_id)
 
+    def forget(self, node_id: str) -> None:
+        """Drop a decommissioned node everywhere (elastic shrink): its
+        sampler, its liveness record, and its dead-handled flag — so it
+        neither false-alarms a 'death' nor blocks re-detection if the
+        same slot id joins again later."""
+        with self._lock:
+            self._infos.pop(node_id, None)
+        self.collector.forget(node_id)
+        self.coordinator.revive(node_id)
+
     # -- scheduler-side background services --
 
     def start(

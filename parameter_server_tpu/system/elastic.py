@@ -99,10 +99,15 @@ class ElasticCoordinator:
 
         old_po = Postoffice.instance()
         old_nodes = list(old_po.manager.nodes)
-        old_aux = old_po.aux
-        # orderly teardown of the old incarnation: the executor dispatch
-        # thread and any heartbeat/aux runtime must not outlive the mesh
-        # they were built on (a long-lived cluster resizes many times)
+        # the aux runtime (heartbeat poller thread, recovery handlers,
+        # per-node samplers) survives the resize as the SAME live object:
+        # a cluster that went deaf after its first membership change
+        # would never detect the second death. Detach it so old_po.stop()
+        # doesn't kill its poller.
+        live_aux = old_po.aux
+        old_po.aux = None
+        # orderly teardown of the rest of the old incarnation: the
+        # executor dispatch thread must not outlive the mesh it ran on
         if self.worker is not None:
             self.worker.executor.stop()
         old_po.stop()
@@ -110,14 +115,13 @@ class ElasticCoordinator:
         po = Postoffice.instance().start(
             num_data=new_data, num_server=new_server, key_space=self.key_space
         )
-        if old_aux is not None:
-            # liveness/dashboard/recovery must survive the resize — a
-            # cluster that goes deaf after its first membership change
-            # would never detect the second death
-            po.start_aux(
-                heartbeat_timeout=old_aux.collector.timeout,
-                print_fn=old_aux.print_fn,
-            )
+        if live_aux is not None:
+            po.aux = live_aux
+            # decommissioned slots must not later be declared dead
+            new_ids = {n.id for n in po.manager.nodes}
+            for n in old_nodes:
+                if n.id not in new_ids:
+                    live_aux.forget(n.id)
         self._resubscribe(po)
         if notify:
             # membership diff through the (fresh) manager — the same

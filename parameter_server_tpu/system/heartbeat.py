@@ -115,6 +115,13 @@ class HeartbeatCollector:
                 if now - seen > self.timeout
             ]
 
+    def forget(self, node_id: str) -> None:
+        """Drop a decommissioned node from liveness tracking (elastic
+        shrink: a node removed on purpose must not later 'die')."""
+        with self._lock:
+            self._reports.pop(node_id, None)
+            self._last_seen.pop(node_id, None)
+
     def reports(self) -> Dict[str, HeartbeatReport]:
         with self._lock:
             return dict(self._reports)

@@ -216,16 +216,32 @@ class TestCrashPath:
 
     def test_aux_runtime_survives_resize(self, mesh8):
         """Regression: heartbeat/recovery must not go deaf after a
-        membership change — resize carries the aux runtime over."""
+        membership change — resize carries the LIVE aux runtime over:
+        same collector state, registered samplers, recovery handlers and
+        poller; decommissioned slots are forgotten (no false deaths)."""
         co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
         w = co.start()
         po = Postoffice.instance()
-        po.start_aux(heartbeat_timeout=7.5, print_fn=lambda s: None)
+        aux = po.start_aux(heartbeat_timeout=7.5, print_fn=lambda s: None)
+        aux.register("W0")
+        deaths = []
+        aux.coordinator.on_server_dead(deaths.append)
         w.collect(w.process_minibatch(batches(1)[0]))
-        co.add_server()
+
+        co.remove_server()  # 2x2 -> 2x1: S1 decommissioned
         po2 = Postoffice.instance()
-        assert po2.aux is not None
-        assert po2.aux.collector.timeout == 7.5
+        assert po2.aux is aux  # the same live object, not a blank copy
+        assert aux.coordinator._handlers["server"] == [deaths.append]
+        assert po2.aux.info("W0") is not None  # samplers carried over
+        po2.beat("W0")  # still a live no-op-free path
+        # the decommissioned S1 must NOT be declared dead later...
+        aux.collector.report("S0", __import__(
+            "parameter_server_tpu.system.heartbeat", fromlist=["HeartbeatReport"]
+        ).HeartbeatReport())
+        late = aux.collector._last_seen["S0"] + 100
+        handled = aux.coordinator.check(now=late)
+        assert "S0" in handled and "S1" not in handled  # ...but S0 can
+        assert deaths == ["S0"]
 
     def test_single_server_death_rebuilds_slot_with_add_event(self, mesh8):
         """Regression: a 1-server cluster cannot shrink — the dead slot is
