@@ -239,8 +239,36 @@ class FMWorker(ISGDCompNode):
 
         return self.submit(run, Task())
 
+    def wipe_server_shard(self, shard: int) -> None:
+        """Simulate/acknowledge a dead server shard: zero its segment
+        (same contract as AsyncSGDWorker.wipe_server_shard)."""
+        n_server = meshlib.num_servers(self.mesh)
+        per = self.num_slots // n_server
+        lo, hi = shard * per, (shard + 1) * per
+
+        def z(leaf):
+            if np.ndim(leaf) >= 1:
+                return leaf.at[lo:hi].set(0.0)
+            return leaf
+
+        self.executor.wait_all()
+        self.state = jax.tree.map(z, self.state)
+
+    def recover_server_shard(self, shard: int) -> bool:
+        """FM keeps no ongoing replica (configure checkpoints for
+        durability): crash recovery reports failure so the elastic
+        coordinator shrinks around the dead range instead."""
+        del shard
+        return False
+
     def collect(self, ts: int) -> SGDProgress:
+        self.po.beat(self.name)  # liveness (ref heartbeat thread)
+        hb = self.po.aux.info(self.name) if self.po.aux is not None else None
+        if hb is not None:
+            hb.start_timer()
         metrics = self.executor.wait(ts)
+        if hb is not None:
+            hb.stop_timer()
         if metrics is None:
             return self.progress
         prog = SGDProgress(
@@ -256,6 +284,7 @@ class FMWorker(ISGDCompNode):
             mask = np.asarray(metrics["mask"]).ravel() > 0
             prog.auc = [evaluation.auc(y[mask], xw[mask])]
         self.progress.merge(prog)
+        self.reporter.report(prog)
         return prog
 
     def train(self, batches) -> SGDProgress:
@@ -268,24 +297,59 @@ class FMWorker(ISGDCompNode):
             self.collect(ts)
         return self.progress
 
-    def predict_margin(self, batch: SparseBatch) -> np.ndarray:
-        """Host-side forward pass (evaluation path)."""
-        w = np.asarray(self.state["w"])
-        v = np.asarray(self.state["v"])
-        b = float(self.state["b"])
-        slots = self.directory.slots(batch.indices)
-        out = np.zeros(batch.n, np.float32)
-        indptr = batch.indptr
-        for r in range(batch.n):
-            sl = slots[indptr[r] : indptr[r + 1]]
-            vr = v[sl]
-            srow = vr.sum(axis=0)
-            out[r] = (
-                b
-                + w[sl].sum()
-                + 0.5 * (float(srow @ srow) - float((vr * vr).sum()))
+    def state_host(self) -> dict:
+        """Host snapshot for live migration (same contract as
+        AsyncSGDWorker.state_host — ElasticCoordinator.resize uses it)."""
+        self.executor.wait_all()
+        return {"state": jax.tree.map(np.asarray, self.state)}
+
+    def load_state_host(self, snap: dict) -> None:
+        def fit(leaf):
+            leaf = np.asarray(leaf)
+            if leaf.ndim >= 1 and leaf.shape[0] != self.num_slots:
+                if leaf.shape[0] > self.num_slots:
+                    leaf = leaf[: self.num_slots]
+                else:
+                    pad = np.zeros(
+                        (self.num_slots - leaf.shape[0],) + leaf.shape[1:],
+                        leaf.dtype,
+                    )
+                    leaf = np.concatenate([leaf, pad])
+            return jax.device_put(
+                leaf,
+                NamedSharding(
+                    self.mesh, P(SERVER_AXIS, *([None] * (np.ndim(leaf) - 1)))
+                    if np.ndim(leaf) >= 1 else P()
+                ),
             )
-        return out
+
+        self.state = jax.tree.map(fit, snap["state"])
+
+    def predict_margin(self, batch: SparseBatch) -> np.ndarray:
+        """Host-side vectorized forward pass (evaluation path): per-row
+        segment sums via ``np.add.reduceat`` — O(nnz*k), no Python loop."""
+        w = np.asarray(self.state["w"]).astype(np.float64)
+        v = np.asarray(self.state["v"]).astype(np.float64)
+        b = float(self.state["b"])
+        if batch.n == 0:
+            return np.zeros(0, np.float32)
+        slots = self.directory.slots(batch.indices)
+        counts = np.diff(batch.indptr)
+        seg = batch.indptr[:-1].astype(np.int64)
+        # reduceat misbehaves on empty segments (repeated offsets) — mask
+        # those rows to the bias afterwards
+        safe_seg = np.minimum(seg, max(batch.nnz - 1, 0))
+        vs = v[slots]  # [nnz, k]
+        sum_w = np.add.reduceat(w[slots], safe_seg) if batch.nnz else np.zeros(batch.n)
+        sum_v = np.add.reduceat(vs, safe_seg, axis=0) if batch.nnz else np.zeros((batch.n, v.shape[1]))
+        sum_v2 = (
+            np.add.reduceat((vs * vs).sum(axis=1), safe_seg)
+            if batch.nnz
+            else np.zeros(batch.n)
+        )
+        out = b + sum_w + 0.5 * ((sum_v * sum_v).sum(axis=1) - sum_v2)
+        out = np.where(counts > 0, out, b)
+        return out.astype(np.float32)
 
     def evaluate(self, batch: SparseBatch) -> Dict[str, float]:
         xw = self.predict_margin(batch)

@@ -95,3 +95,81 @@ def dense_attention(q, k, v, causal: bool = False):
         s = jnp.where(mask[None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkh->bqh", p, v)
+
+
+def dense_mha(q, k, v, n_heads: int, causal: bool = False):
+    """Multi-head reference: [B, S, H] with H = n_heads * dh."""
+    b, s, h = q.shape
+    dh = h // n_heads
+
+    def split(x):
+        return x.reshape(b, s, n_heads, dh).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q), split(k), split(v)
+    scores = jnp.einsum("bnqd,bnkd->bnqk", qh, kh) / jnp.sqrt(dh)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bnqk,bnkd->bnqd", p, vh)
+    return out.transpose(0, 2, 1, 3).reshape(b, s, h)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "n_heads", "causal")
+)
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "data",
+    n_heads: int,
+    causal: bool = False,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: the
+    complement of :func:`ring_attention` for long sequences.
+
+    Inputs arrive sequence-sharded ([B, S, H] with S over ``axis``); one
+    all_to_all re-shards to HEAD-sharded (each device owns n_heads/n full
+    -sequence heads), attention runs densely per local head — a single
+    big MXU matmul instead of a ring of n block steps — and a second
+    all_to_all restores sequence sharding. Two collectives total (vs n-1
+    ppermutes): cheaper when heads divide evenly and the full sequence's
+    scores fit on-chip; ring wins when S^2 memory must stay blocked.
+    """
+    n = mesh.shape[axis]
+    assert n_heads % n == 0, f"n_heads={n_heads} must divide by mesh axis {n}"
+
+    def local(q, k, v):
+        b, s_loc, h = q.shape
+        dh = h // n_heads
+
+        def to_heads(x):
+            # [B, s_loc, H] -> [B, s_loc, nh, dh] -> a2a: scatter heads,
+            # gather sequence -> [B, S, nh/n, dh]
+            x = x.reshape(b, s_loc, n_heads, dh)
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # [B, S, nh/n, dh]
+        s_full = qh.shape[1]
+        scores = jnp.einsum("bqnd,bknd->bnqk", qh, kh) / jnp.sqrt(dh)
+        if causal:
+            mask = jnp.tril(jnp.ones((s_full, s_full), bool))
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnqk,bknd->bqnd", p, vh)  # [B, S, nh/n, dh]
+        # inverse a2a: scatter sequence, gather heads
+        out = jax.lax.all_to_all(
+            out, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+        return out.reshape(b, s_loc, h)
+
+    spec = P(None, axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -122,6 +122,10 @@ class ElasticCoordinator:
             for n in old_nodes:
                 if n.id not in new_ids:
                     live_aux.forget(n.id)
+            # the resize pause itself is not a death: refresh survivors'
+            # last-seen so a rebuild longer than the heartbeat timeout
+            # can't trigger a spurious death cascade on the next check
+            live_aux.collector.touch_all()
         self._resubscribe(po)
         if notify:
             # membership diff through the (fresh) manager — the same
@@ -175,7 +179,13 @@ class ElasticCoordinator:
             # survivors re-divide the key space
             self.worker.wipe_server_shard(rank)
         # the DEAD node's identity event; the survivors' positional
-        # renumbering inside resize is suppressed (notify=False)
+        # renumbering inside resize is suppressed (notify=False). Forget
+        # it in the aux runtime EXPLICITLY — remove_node runs before
+        # resize snapshots the node list, so resize's decommission sweep
+        # won't see it — or a replacement reusing the slot id could have
+        # its own death masked by the stale dead-handled flag.
+        if po.aux is not None:
+            po.aux.forget(f"S{rank}")
         po.manager.remove_node(f"S{rank}")
         new_server = max(1, self.num_server - 1)
         rebuilt = new_server == self.num_server  # last server: slot reborn

@@ -122,6 +122,16 @@ class HeartbeatCollector:
             self._reports.pop(node_id, None)
             self._last_seen.pop(node_id, None)
 
+    def touch_all(self) -> None:
+        """Refresh every tracked node's last-seen time: a deliberate
+        cluster-wide pause (elastic resize, checkpoint restore) is not a
+        death — without this, a pause longer than the timeout would make
+        the next check declare every survivor dead at once."""
+        now = time.time()
+        with self._lock:
+            for nid in self._last_seen:
+                self._last_seen[nid] = now
+
     def reports(self) -> Dict[str, HeartbeatReport]:
         with self._lock:
             return dict(self._reports)

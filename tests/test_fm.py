@@ -143,3 +143,61 @@ class TestInteractionLearning:
 
         assert fm_auc > 0.9, f"FM failed the interaction task: {fm_auc}"
         assert lin_auc < 0.6, f"linear should NOT solve it: {lin_auc}"
+
+
+class TestFMElastic:
+    def test_fm_resizes_live(self, mesh8):
+        from parameter_server_tpu.system.elastic import ElasticCoordinator
+
+        def mk(mesh):
+            return FMWorker(make_conf(num_slots=100, alpha=0.3,
+                                      lambda1=0.001),
+                            k=4, mesh=mesh, v_init_std=0.3, seed=2)
+
+        co = ElasticCoordinator(mk, num_data=2, num_server=2)
+        fm = co.start()
+        fm.train(iter(interaction_batches(40)))
+        test = interaction_batches(1, rows_per=500, seed0=999)[0]
+        auc_before = fm.evaluate(test)["auc"]
+        fm2 = co.add_server()  # 2x2 -> 2x3, non-divisible table padding
+        auc_after = fm2.evaluate(test)["auc"]
+        assert auc_after == auc_before > 0.9
+        fm2.collect(fm2.process_minibatch(interaction_batches(1, seed0=77)[0]))
+
+    def test_fm_crash_path_shrinks(self, mesh8):
+        """FM has no ongoing replica: a server death shrinks the cluster
+        around the dead range (recover_server_shard -> False contract)."""
+        from parameter_server_tpu.system.elastic import ElasticCoordinator
+
+        def mk(mesh):
+            return FMWorker(make_conf(num_slots=100), k=4, mesh=mesh, seed=2)
+
+        co = ElasticCoordinator(mk, num_data=2, num_server=2)
+        fm = co.start()
+        fm.collect(fm.process_minibatch(interaction_batches(1)[0]))
+        assert co.handle_server_death(1) == "resharded"
+        assert co.num_server == 1
+        co.worker.collect(
+            co.worker.process_minibatch(interaction_batches(1, seed0=9)[0])
+        )
+
+    def test_predict_margin_handles_ragged_and_empty_rows(self, mesh8):
+        w = FMWorker(make_conf(num_slots=64, lanes=4), k=3, mesh=mesh8,
+                     v_init_std=0.2, seed=5)
+        # ragged CSR incl. an EMPTY row (bias-only prediction)
+        batch = SparseBatch(
+            y=np.array([1.0, -1.0, 1.0], np.float32),
+            indptr=np.array([0, 3, 3, 7], np.int64),
+            indices=np.array([5, 9, 11, 2, 5, 30, 31], np.int64),
+            values=None,
+        )
+        out = w.predict_margin(batch)
+        # oracle: per-row loop
+        v = np.asarray(w.state["v"]); wl = np.asarray(w.state["w"])
+        b = float(w.state["b"])
+        slots = w.directory.slots(batch.indices)
+        for r in range(3):
+            sl = slots[batch.indptr[r]: batch.indptr[r + 1]]
+            vr = v[sl]; s = vr.sum(0)
+            want = b + wl[sl].sum() + 0.5 * (s @ s - (vr * vr).sum())
+            np.testing.assert_allclose(out[r], want, atol=1e-5)
