@@ -1090,6 +1090,7 @@ class AsyncSGDWorker(ISGDCompNode):
         self._pull_noise = _add_noise_params(sgd.pull_filter)
         self._seed_counter = 0
         self._warned_ell_overflow = False
+        self._warned_scan_fallback = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         # the hash modulus is the CONFIGURED slot count, not the padded
         # table size: padding depends on the server count, and keys must
@@ -1377,20 +1378,52 @@ class AsyncSGDWorker(ISGDCompNode):
         self._steps_since_snapshot += n_steps
         return self.submit(step, Task())
 
+    def _submit_fused(self, prepped: List[ELLBitsBatch], with_aux: bool) -> int:
+        """The one fused-submit path both grouping APIs share."""
+        return self._submit_prepped(
+            self.upload(stack_bits_batches(prepped)), with_aux=with_aux
+        )
+
     def submit_superbatch(
         self, batches: List[SparseBatch], with_aux: bool = False
     ) -> int:
         """Prep + stack T minibatches and run them as ONE scan-fused
-        device launch (see ELLBitsSuperBatch). Requires the bits wire."""
+        device launch (see ELLBitsSuperBatch). Requires the bits wire —
+        raises on ineligible batches (the training loop's submit_group is
+        the tolerant variant)."""
         prepped = [self.prep(b, device_put=False) for b in batches]
         if not all(isinstance(p, ELLBitsBatch) for p in prepped):
             raise ValueError(
                 "superbatch needs the bits wire (hashed directory, binary "
                 "uniform-row batches); got a fallback encoding"
             )
-        return self._submit_prepped(
-            self.upload(stack_bits_batches(prepped)), with_aux=with_aux
-        )
+        return self._submit_fused(prepped, with_aux)
+
+    def submit_group(self, batches: List[SparseBatch], with_aux: bool = True):
+        """Tolerant grouping for the training loop: scan-fuse when every
+        batch takes the bits wire, fall back to per-minibatch steps
+        otherwise (ragged rows, valued features, ...). Returns
+        ``[(timestamp, n_ministeps), ...]`` so callers can bound
+        in-flight work in MINISTEPS, not launches."""
+        prepped = [self.prep(b, device_put=False) for b in batches]
+        if len(prepped) > 1 and all(
+            isinstance(p, ELLBitsBatch) for p in prepped
+        ):
+            return [(self._submit_fused(prepped, with_aux), len(prepped))]
+        if len(prepped) > 1 and not self._warned_scan_fallback:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "steps_per_launch=%d requested but the batch group is not "
+                "bits-wire eligible (needs hashed directory + binary "
+                "uniform rows); running per-minibatch steps",
+                self.sgd.steps_per_launch,
+            )
+            self._warned_scan_fallback = True
+        return [
+            (self._submit_prepped(self.upload(p), with_aux=with_aux), 1)
+            for p in prepped
+        ]
 
     def collect(self, ts: int) -> SGDProgress:
         """Wait for a step and fold its metrics into progress (the worker's
@@ -1410,24 +1443,56 @@ class AsyncSGDWorker(ISGDCompNode):
             accuracy=[float(metrics["correct"]) / max(1.0, float(metrics["num_ex"]))],
         )
         if "xw" in metrics:  # aux present: per-minibatch AUC (ref prog.add_auc)
-            y = np.asarray(metrics["y"]).ravel()
-            xw = np.asarray(metrics["xw"]).ravel()
-            mask = np.asarray(metrics["mask"]).ravel() > 0
-            prog.auc = [evaluation.auc(y[mask], xw[mask])]
+            y = np.asarray(metrics["y"])
+            xw = np.asarray(metrics["xw"])
+            mask = np.asarray(metrics["mask"])
+            if xw.ndim >= 3:
+                # scan superstep: leading ministep axis — one AUC per
+                # ministep (each scored against its own weight version),
+                # preserving the per-minibatch monitoring granularity
+                prog.auc = [
+                    evaluation.auc(
+                        y[t].ravel()[mask[t].ravel() > 0],
+                        xw[t].ravel()[mask[t].ravel() > 0],
+                    )
+                    for t in range(xw.shape[0])
+                ]
+            else:
+                m = mask.ravel() > 0
+                prog.auc = [evaluation.auc(y.ravel()[m], xw.ravel()[m])]
         self.progress.merge(prog)
         self.reporter.report(prog)
         return prog
 
     def train(self, batches: Iterator[SparseBatch]) -> SGDProgress:
-        """Drive a pass over an iterator of minibatches."""
-        pending = []
+        """Drive a pass over an iterator of minibatches.
+
+        With ``steps_per_launch > 1`` (and the bits wire) minibatches are
+        grouped into scan-fused supersteps — one device launch per T
+        steps; a trailing group smaller than T still runs (its own scan
+        length). Weights advance every ministep either way."""
+        T = max(1, self.sgd.steps_per_launch)
+        pending: List[Tuple[int, int]] = []  # (ts, n_ministeps)
+        group: List[SparseBatch] = []
+
+        def submit_group():
+            if not group:
+                return
+            pending.extend(self.submit_group(list(group), with_aux=True))
+            group.clear()
+
+        # backpressure in MINISTEPS (aux memory scales with them), while
+        # always allowing at least one full launch in flight
+        bound = max(T, self.sgd.max_delay + 1)
         for batch in batches:
-            ts = self.process_minibatch(batch)
-            pending.append(ts)
+            group.append(batch)
+            if len(group) >= T:
+                submit_group()
             # collect finished steps opportunistically to keep memory flat
-            while len(pending) > max(1, self.sgd.max_delay + 1):
-                self.collect(pending.pop(0))
-        for ts in pending:
+            while sum(n for _, n in pending) > bound:
+                self.collect(pending.pop(0)[0])
+        submit_group()
+        for ts, _ in pending:
             self.collect(ts)
         return self.progress
 

@@ -83,6 +83,10 @@ class SGDConfig:
     # checkpoint
     num_replicas: int = 0
     replica_every: int = 1
+    # scan-fused supersteps: >1 runs that many minibatches per device
+    # launch (lax.scan inside one jitted program; needs wire="bits") —
+    # the dominant throughput lever on high-latency host<->device links
+    steps_per_launch: int = 1
 
 
 @dataclasses.dataclass
@@ -261,6 +265,7 @@ def parse_conf(text: str) -> Config:
             wire=str(s.get("wire", "")),
             num_replicas=int(s.get("num_replicas", 0)),
             replica_every=int(s.get("replica_every", 1)),
+            steps_per_launch=int(s.get("steps_per_launch", 1)),
             push_filter=_filter_list(s.get("push_filter")),
             pull_filter=_filter_list(s.get("pull_filter")),
         )

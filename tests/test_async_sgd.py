@@ -640,3 +640,40 @@ class TestAddNoisePushFilter:
         clean = self._train(mesh8, w_true, [])
         assert not np.allclose(noisy, clean, atol=1e-6)
         assert np.isfinite(noisy).all()
+
+    def test_train_with_steps_per_launch_matches_sequential(self, mesh8, w_true):
+        def run(T):
+            conf = make_conf(num_slots=2048)
+            conf.async_sgd.ell_lanes = 8
+            conf.async_sgd.wire = "bits"
+            conf.async_sgd.steps_per_launch = T
+            worker = AsyncSGDWorker(conf, mesh=mesh8)
+            worker.train(
+                random_sparse(256, 512, 8, seed=100 + i, w_true=w_true,
+                              binary=True)
+                for i in range(7)  # 7 = 2 full groups of 3 + a tail of 1
+            )
+            return worker
+
+        seq, fused = run(1), run(3)
+        np.testing.assert_allclose(
+            fused.weights_dense(), seq.weights_dense(), atol=1e-6
+        )
+        assert (
+            fused.progress.num_examples_processed
+            == seq.progress.num_examples_processed
+            == 7 * 256
+        )
+
+    def test_train_steps_per_launch_falls_back_on_ragged_batches(
+        self, mesh8, w_true
+    ):
+        """Non-bits-eligible batches (valued features) must run
+        per-minibatch rather than raise (the CLI path with libsvm data)."""
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        conf.async_sgd.steps_per_launch = 3
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        worker.train(synth(5, w_true))  # valued features -> fallback
+        assert worker.progress.num_examples_processed == 5 * 256
