@@ -1475,7 +1475,7 @@ class AsyncSGDWorker(ISGDCompNode):
         pending: List[Tuple[int, int]] = []  # (ts, n_ministeps)
         group: List[SparseBatch] = []
 
-        def submit_group():
+        def flush_group():
             if not group:
                 return
             pending.extend(self.submit_group(list(group), with_aux=True))
@@ -1487,11 +1487,11 @@ class AsyncSGDWorker(ISGDCompNode):
         for batch in batches:
             group.append(batch)
             if len(group) >= T:
-                submit_group()
+                flush_group()
             # collect finished steps opportunistically to keep memory flat
             while sum(n for _, n in pending) > bound:
                 self.collect(pending.pop(0)[0])
-        submit_group()
+        flush_group()
         for ts, _ in pending:
             self.collect(ts)
         return self.progress
