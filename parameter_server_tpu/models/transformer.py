@@ -24,7 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .attention import ring_attention
+from .attention import ring_attention, ulysses_attention
+from .moe import init_moe, moe_ffn
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,6 +35,23 @@ class LMConfig:
     n_heads: int = 4
     n_layers: int = 2
     d_ff: int = 128
+    # sequence-parallel attention schedule: "ring" (ppermute K/V ring,
+    # O(S/n) memory) or "a2a" (Ulysses: all_to_all seq<->head reshard,
+    # dense per-head matmuls; needs n_heads % mesh-axis == 0)
+    attention: str = "ring"
+
+    def __post_init__(self):
+        if self.attention not in ("ring", "a2a"):
+            raise ValueError(
+                f"LMConfig.attention must be 'ring' or 'a2a', got "
+                f"{self.attention!r} — both are exact, so a silent "
+                "fallback would hide the memory/collective profile choice"
+            )
+    # >0: every moe_every-th layer's FFN is an expert-parallel MoE
+    # (models/moe.py) with n_experts switch-routed experts
+    moe_every: int = 0
+    n_experts: int = 8
+    capacity_factor: float = 2.0
 
 
 def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
@@ -49,9 +67,19 @@ def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
         p[f"l{i}/ln2"] = jnp.ones((cfg.d_model,))
         p[f"l{i}/wqkv"] = s * jax.random.normal(k1, (cfg.d_model, 3 * cfg.d_model))
         p[f"l{i}/wo"] = s * jax.random.normal(k2, (cfg.d_model, cfg.d_model))
-        p[f"l{i}/w1"] = s * jax.random.normal(k3, (cfg.d_model, cfg.d_ff))
-        p[f"l{i}/w2"] = s * jax.random.normal(k4, (cfg.d_ff, cfg.d_model))
+        if _is_moe_layer(cfg, i):
+            moe = init_moe(k3, cfg.d_model, cfg.d_ff, cfg.n_experts)
+            p[f"l{i}/moe_router"] = moe["router"]
+            p[f"l{i}/moe_w_in"] = moe["w_in"]
+            p[f"l{i}/moe_w_out"] = moe["w_out"]
+        else:
+            p[f"l{i}/w1"] = s * jax.random.normal(k3, (cfg.d_model, cfg.d_ff))
+            p[f"l{i}/w2"] = s * jax.random.normal(k4, (cfg.d_ff, cfg.d_model))
     return jax.tree.map(lambda x: x.astype(jnp.float32), p)
+
+
+def _is_moe_layer(cfg: LMConfig, i: int) -> bool:
+    return cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
 
 
 def _ln(x, scale):
@@ -80,17 +108,35 @@ def lm_forward(
             t = t.reshape(b, s, cfg.n_heads, hd)
             return t.transpose(0, 2, 1, 3).reshape(b * cfg.n_heads, s, hd)
 
-        att = ring_attention(
-            heads(q), heads(k), heads(v), mesh=mesh, axis=axis, causal=True
-        )
-        att = (
-            att.reshape(b, cfg.n_heads, s, hd)
-            .transpose(0, 2, 1, 3)
-            .reshape(b, s, cfg.d_model)
-        )
+        if cfg.attention == "a2a":
+            # Ulysses: q/k/v stay [B, S, d]; the layer splits heads itself
+            att = ulysses_attention(
+                q, k, v, mesh=mesh, axis=axis, n_heads=cfg.n_heads,
+                causal=True,
+            )
+        else:
+            att = ring_attention(
+                heads(q), heads(k), heads(v), mesh=mesh, axis=axis, causal=True
+            )
+            att = (
+                att.reshape(b, cfg.n_heads, s, hd)
+                .transpose(0, 2, 1, 3)
+                .reshape(b, s, cfg.d_model)
+            )
         x = x + att @ params[f"l{i}/wo"]
         h2 = _ln(x, params[f"l{i}/ln2"])
-        x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
+        if _is_moe_layer(cfg, i):
+            moe_p = {
+                "router": params[f"l{i}/moe_router"],
+                "w_in": params[f"l{i}/moe_w_in"],
+                "w_out": params[f"l{i}/moe_w_out"],
+            }
+            x = x + moe_ffn(
+                moe_p, h2, mesh=mesh, axis=axis,
+                capacity_factor=cfg.capacity_factor,
+            )
+        else:
+            x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
     return _ln(x, params["ln_f"]) @ params["emb"].T
 
 
