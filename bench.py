@@ -45,6 +45,52 @@ REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
 
+def probe_device(timeout_s: float = 180.0) -> bool:
+    """Fail fast when the accelerator is unreachable.
+
+    On the tunneled backend a wedged relay makes ``jax.devices()`` block
+    FOREVER (observed: a killed client left the claim/grant protocol
+    stuck for hours). Probe device init in a child process so the bench
+    can emit an explicit error JSON line instead of hanging the driver."""
+    import subprocess
+
+    # honor JAX_PLATFORMS the way Postoffice.start does: the env var
+    # alone does not override an accelerator plugin's programmatic
+    # platform selection — an explicit config update before init does
+    probe_src = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p:\n"
+        "    jax.config.update('jax_platforms', p)\n"
+        "jax.devices()\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def emit_device_error() -> int:
+    print(
+        json.dumps(
+            {
+                "metric": "criteo_sparse_lr_examples_per_sec",
+                "value": 0,
+                "unit": "examples/sec",
+                "vs_baseline": 0,
+                "error": "accelerator unreachable: jax device init did not "
+                "complete within the probe timeout (tunnel relay down?)",
+            }
+        )
+    )
+    return 1
+
+
 def flush(worker):
     """REAL pipeline drain: fetch a state scalar to the host. On the
     tunneled TPU backend ``jax.block_until_ready`` on shard_map outputs
@@ -382,6 +428,8 @@ def main() -> int:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
         args.num_slots = 1 << 16
         args.real_mb = min(args.real_mb, 8)
+    if not probe_device():
+        return emit_device_error()
     if args.real:
         return run_real(args)
 
