@@ -45,8 +45,10 @@ REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
 
-def probe_device(timeout_s: float = 180.0) -> bool:
-    """Fail fast when the accelerator is unreachable.
+def probe_device(timeout_s: float = 180.0):
+    """Fail fast when the accelerator is unreachable: returns None when
+    healthy, else a human-readable diagnosis (timeout vs crash, with the
+    child's stderr tail).
 
     On the tunneled backend a wedged relay makes ``jax.devices()`` block
     FOREVER (observed: a killed client left the claim/grant protocol
@@ -70,12 +72,18 @@ def probe_device(timeout_s: float = 180.0) -> bool:
             timeout=timeout_s,
             capture_output=True,
         )
-        return r.returncode == 0
+        if r.returncode == 0:
+            return None
+        tail = r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+        return "device init failed: " + " | ".join(tail)
     except subprocess.TimeoutExpired:
-        return False
+        return (
+            "device init did not complete within the probe timeout "
+            "(tunnel relay down?)"
+        )
 
 
-def emit_device_error() -> int:
+def emit_device_error(diagnosis: str) -> int:
     print(
         json.dumps(
             {
@@ -83,8 +91,7 @@ def emit_device_error() -> int:
                 "value": 0,
                 "unit": "examples/sec",
                 "vs_baseline": 0,
-                "error": "accelerator unreachable: jax device init did not "
-                "complete within the probe timeout (tunnel relay down?)",
+                "error": f"accelerator unreachable: {diagnosis}",
             }
         )
     )
@@ -428,8 +435,9 @@ def main() -> int:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
         args.num_slots = 1 << 16
         args.real_mb = min(args.real_mb, 8)
-    if not probe_device():
-        return emit_device_error()
+    diagnosis = probe_device()
+    if diagnosis is not None:
+        return emit_device_error(diagnosis)
     if args.real:
         return run_real(args)
 

@@ -39,6 +39,11 @@ class LMConfig:
     # O(S/n) memory) or "a2a" (Ulysses: all_to_all seq<->head reshard,
     # dense per-head matmuls; needs n_heads % mesh-axis == 0)
     attention: str = "ring"
+    # >0: every moe_every-th layer's FFN is an expert-parallel MoE
+    # (models/moe.py) with n_experts switch-routed experts
+    moe_every: int = 0
+    n_experts: int = 8
+    capacity_factor: float = 2.0
 
     def __post_init__(self):
         if self.attention not in ("ring", "a2a"):
@@ -47,11 +52,6 @@ class LMConfig:
                 f"{self.attention!r} — both are exact, so a silent "
                 "fallback would hide the memory/collective profile choice"
             )
-    # >0: every moe_every-th layer's FFN is an expert-parallel MoE
-    # (models/moe.py) with n_experts switch-routed experts
-    moe_every: int = 0
-    n_experts: int = 8
-    capacity_factor: float = 2.0
 
 
 def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
@@ -65,7 +65,12 @@ def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
         k1, k2, k3, k4 = ks[2 + 4 * i : 6 + 4 * i]
         p[f"l{i}/ln1"] = jnp.ones((cfg.d_model,))
         p[f"l{i}/ln2"] = jnp.ones((cfg.d_model,))
-        p[f"l{i}/wqkv"] = s * jax.random.normal(k1, (cfg.d_model, 3 * cfg.d_model))
+        # separate q/k/v projections (not a fused [d, 3d]): under tensor
+        # parallelism each projection column-shards on its own, so the
+        # qkv split boundaries stay shard-local (the fused-QKV TP pitfall
+        # puts K across two shards and forces per-layer reshards)
+        wqkv = s * jax.random.normal(k1, (cfg.d_model, 3 * cfg.d_model))
+        p[f"l{i}/wq"], p[f"l{i}/wk"], p[f"l{i}/wv"] = jnp.split(wqkv, 3, axis=1)
         p[f"l{i}/wo"] = s * jax.random.normal(k2, (cfg.d_model, cfg.d_model))
         if _is_moe_layer(cfg, i):
             moe = init_moe(k3, cfg.d_model, cfg.d_ff, cfg.n_experts)
@@ -101,8 +106,9 @@ def lm_forward(
     x = params["emb"][tokens] * np.sqrt(cfg.d_model)
     for i in range(cfg.n_layers):
         h = _ln(x, params[f"l{i}/ln1"])
-        qkv = h @ params[f"l{i}/wqkv"]  # [B, S, 3d]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = h @ params[f"l{i}/wq"]
+        k = h @ params[f"l{i}/wk"]
+        v = h @ params[f"l{i}/wv"]
 
         def heads(t):  # [B, S, d] -> [B*nh, S, hd]
             t = t.reshape(b, s, cfg.n_heads, hd)
@@ -164,3 +170,26 @@ def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float 
 
 def shard_tokens(tokens: np.ndarray, mesh: Mesh, axis: str = "data") -> jax.Array:
     return jax.device_put(tokens, NamedSharding(mesh, P(None, axis)))
+
+
+def shard_lm_params(
+    params: Dict[str, jax.Array], mesh: Mesh, axis: str = "server"
+) -> Dict[str, jax.Array]:
+    """Tensor parallelism by placement (Megatron-style): project-in
+    weights (wq/wk/wv, w1) column-sharded over ``axis``, project-out weights
+    (wo, w2) row-sharded; GSPMD inserts the partial-sum psums under jit.
+    Composes with sequence parallelism on the other mesh axis — on the
+    framework's data x server mesh the same 2-D mesh carries sp x tp.
+    Embedding/layernorm/MoE tables stay replicated (MoE experts shard
+    over the sp axis inside moe_ffn itself)."""
+
+    def place(k, v):
+        if k.endswith(("/wq", "/wk", "/wv", "/w1")):
+            spec = P(None, axis)
+        elif k.endswith("/wo") or k.endswith("/w2"):
+            spec = P(axis, None)
+        else:
+            spec = P()
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    return {k: place(k, v) for k, v in params.items()}

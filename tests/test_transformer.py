@@ -138,3 +138,56 @@ class TestMoELM:
         assert np.isfinite(losses[-1])
         assert tail < 0.5 * losses[0], losses[-10:]
         assert tail < np.log(16) * 0.5, losses[-10:]
+
+
+class TestTensorParallel:
+    def test_tp_sharded_params_match_replicated(self, mesh8):
+        """sp x tp on the same 2-D mesh: sequence sharded over 'data',
+        weights Megatron-split over 'server' — logits must not change."""
+        from parameter_server_tpu.models.transformer import (
+            LMConfig,
+            init_lm,
+            lm_forward,
+            shard_lm_params,
+            shard_tokens,
+        )
+
+        cfg = LMConfig(vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+        params = init_lm(jax.random.PRNGKey(1), cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 32, (2, 64)).astype(np.int32)
+        td = shard_tokens(tokens, mesh8)
+        base = lm_forward(params, td, cfg, mesh8, "data")
+        tp_params = shard_lm_params(params, mesh8, "server")
+        tp = lm_forward(tp_params, td, cfg, mesh8, "data")
+        np.testing.assert_allclose(
+            np.asarray(tp), np.asarray(base), atol=2e-4
+        )
+        # placement really is Megatron-split (spec, not just the mesh)
+        assert "server" in str(tp_params["l0/wq"].sharding.spec)
+
+    def test_tp_training_step_runs(self, mesh8):
+        from parameter_server_tpu.models.transformer import (
+            LMConfig,
+            init_lm,
+            make_lm_train_step,
+            shard_lm_params,
+            shard_tokens,
+        )
+
+        cfg = LMConfig(vocab=16, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+        params = shard_lm_params(init_lm(jax.random.PRNGKey(0), cfg), mesh8)
+        step = make_lm_train_step(cfg, mesh8, "data", lr=0.2)
+        rng = np.random.default_rng(0)
+        first = last = None
+        for i in range(30):
+            tok = np.repeat(
+                rng.integers(0, 16, (4, 1)), 32, axis=1
+            ).astype(np.int32)
+            params, loss = step(params, shard_tokens(tok, mesh8))
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert np.isfinite(last) and last < first
+        # weights kept their tp sharding (the SPEC, not just the mesh)
+        # through the jitted update steps
+        assert "server" in str(params["l0/wq"].sharding.spec)
