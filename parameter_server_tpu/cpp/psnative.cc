@@ -277,20 +277,81 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
     p = skip_ws(p, line_end);
     if (p >= line_end) { p = line_end + 1; continue; }
     char* q;
-    double label = strtod(p, &q);
-    if (q == p) { p = line_end + 1; continue; }  // malformed: skip line
+    double label;
+    // fast path for the ubiquitous "+1"/"-1"/"0"/"1" labels
+    if ((*p == '+' || *p == '-') && p + 1 < line_end &&
+        p[1] >= '0' && p[1] <= '9' &&
+        (p + 2 >= line_end || p[2] == ' ' || p[2] == '\t')) {
+      label = (*p == '-') ? -(double)(p[1] - '0') : (double)(p[1] - '0');
+      q = (char*)p + 2;
+    } else if (*p >= '0' && *p <= '9' &&
+               (p + 1 >= line_end || p[1] == ' ' || p[1] == '\t')) {
+      label = (double)(*p - '0');
+      q = (char*)p + 1;
+    } else {
+      label = strtod(p, &q);
+    }
+    // q > line_end: strtod skipped the newline and took the NEXT
+    // line's number — the current line is whitespace-only garbage
+    if (q == p || q > line_end) { p = line_end + 1; continue; }
     p = q;
     int64_t row_start = nnz;
     while (p < line_end) {
       p = skip_ws(p, line_end);
       if (p >= line_end) break;
-      char* e1;
-      uint64_t idx = strtoull(p, &e1, 10);
-      if (e1 == p || e1 >= line_end || *e1 != ':') break;
+      // manual strtoull for the index: optional sign (negation wraps
+      // modulo 2^64, strtoull semantics — Python's int64 view agrees),
+      // digits with ULLONG_MAX clamping
+      const char* e1 = p;
+      int idx_neg = 0;
+      if (e1 < line_end && (*e1 == '+' || *e1 == '-')) {
+        idx_neg = (*e1 == '-');
+        ++e1;
+      }
+      const char* idx_digits = e1;
+      uint64_t idx = 0;
+      int idx_clamped = 0;
+      while (e1 < line_end && *e1 >= '0' && *e1 <= '9') {
+        unsigned d = (unsigned)(*e1++ - '0');
+        if (idx > (0xFFFFFFFFFFFFFFFFull - d) / 10) idx_clamped = 1;
+        idx = idx * 10 + d;
+      }
+      if (idx_clamped) idx = 0xFFFFFFFFFFFFFFFFull;
+      if (idx_neg) idx = 0ull - idx;
+      if (e1 == idx_digits || e1 >= line_end || *e1 != ':') break;
       const char* vp = e1 + 1;
       char* e2;
-      double val = strtod(vp, &e2);
-      if (e2 == vp) break;
+      double val;
+      if (vp >= line_end || *vp == ' ' || *vp == '\t' || *vp == '\r') {
+        // empty value token ("idx:"): the reference parser defaults it
+        // to 1.0 — and an unbounded strtod here would skip the newline
+        // and steal the NEXT line's leading number
+        val = 1.0;
+        e2 = (char*)vp;
+        goto have_val;
+      }
+      // integer values (the binary-feature ":1" case) parse exactly
+      // without strtod as long as they fit double's integer range
+      {
+        const char* v = vp;
+        uint64_t acc = 0;
+        while (v < line_end && *v >= '0' && *v <= '9' &&
+               acc <= 0x1FFFFFFFFFFFFFull) {
+          acc = acc * 10 + (uint64_t)(*v - '0');
+          ++v;
+        }
+        int is_plain_int =
+            v > vp && acc <= 0x1FFFFFFFFFFFFFull &&
+            (v >= line_end || *v == ' ' || *v == '\t' || *v == '\r');
+        if (is_plain_int) {
+          val = (double)acc;
+          e2 = (char*)v;
+        } else {
+          val = strtod(vp, &e2);
+        }
+      }
+    have_val:
+      if (e2 == vp && !(vp >= line_end || *vp == ' ' || *vp == '\t' || *vp == '\r')) break;
       if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
       indices[nnz] = idx;
       values[nnz] = (float)val;
@@ -349,7 +410,8 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
       label = strtod(p, &q);
     }
     const char* f = find_tab(p, line_end);
-    if (q == p || !f) { p = line_end + 1; continue; }
+    // q > line_end: strtod crossed the newline (tabs-only line) — drop
+    if (q == p || q > line_end || !f) { p = line_end + 1; continue; }
     p = f + 1;
     int ok = 1;
     for (int i = 0; i < 13; ++i) {  // integer count features
@@ -375,11 +437,11 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
           acc = acc * 10 + d;
         }
         if (e != digits_start) {
-          long cnt;
-          if (clamped) cnt = neg ? (long)(-0x7FFFFFFFFFFFFFFFll - 1) : 0x7FFFFFFFFFFFFFFFll;
-          else cnt = neg ? -(long)acc : (long)acc;
+          int64_t cnt;
+          if (clamped) cnt = neg ? (-0x7FFFFFFFFFFFFFFFll - 1) : 0x7FFFFFFFFFFFFFFFll;
+          else cnt = neg ? -(int64_t)acc : (int64_t)acc;
           if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
-          indices[nnz] = kStripe * (uint64_t)i + (uint64_t)(int64_t)cnt;
+          indices[nnz] = kStripe * (uint64_t)i + (uint64_t)cnt;
           values[nnz] = 1.0f;
           if (slots) slots[nnz] = i + 1;
           ++nnz;

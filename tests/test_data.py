@@ -322,3 +322,52 @@ class TestByteStreaming:
         b = p.parse_text(("\n".join(lines) + "\n").encode())
         np.testing.assert_array_equal(a.indices, b.indices)
         np.testing.assert_array_equal(a.y, b.y)
+
+
+class TestLibsvmFastPaths:
+    """Regression for the manual-parse fast paths (label, index, integer
+    value): must stay bit-exact with the Python parser on floats,
+    exponents, and values beyond double's exact-integer range."""
+
+    def test_native_matches_python_on_edge_values(self):
+        sample = (
+            "+1 3:1 7:0.25 9:2\n"
+            "-1 1:1e-3 2:1\n"
+            "0 5:1\n"
+            "2.5 4:9007199254740993\n"  # 2^53+1: must take the strtod path
+        )
+        p = ExampleParser("libsvm")
+        a = p.parse_text(sample.encode())
+        c = parse_libsvm(sample.splitlines())
+        np.testing.assert_array_equal(a.y, c.y)
+        np.testing.assert_array_equal(a.indptr, c.indptr)
+        np.testing.assert_allclose(a.values, c.values, rtol=0)
+        # an index beyond uint64 clamps (strtoull ERANGE semantics) in the
+        # native parser — no wraparound key (the Python parser cannot even
+        # represent it in int64, so no cross-check)
+        big = p.parse_text(b"1 18446744073709551999:1\n")
+        assert big.indices.view(np.uint64)[0] == np.uint64(2**64 - 1)
+
+    def test_signed_index_empty_value_and_ws_lines(self):
+        """Review scenarios: '+3:'/'-3:' signed indices (strtoull modulo
+        semantics), empty value tokens defaulting to 1.0, and
+        whitespace-only lines — native must match the Python parser."""
+        sample = "+1 +3:1 -3:2\n1 3:\n1 3: 4:1\n \n1 5:2\n"
+        p = ExampleParser("libsvm")
+        a = p.parse_text(sample.encode())
+        c = parse_libsvm(sample.splitlines())
+        np.testing.assert_array_equal(a.y, c.y)
+        np.testing.assert_array_equal(a.indptr, c.indptr)
+        np.testing.assert_array_equal(a.indices, c.indices)
+        np.testing.assert_allclose(a.values, c.values, rtol=0)
+
+    def test_criteo_tabs_only_line_dropped(self):
+        """A tabs-only line must be dropped — not let strtod cross the
+        newline and steal the next line's label as a phantom row."""
+        tabs_only = "\t" * 39 + "\n"
+        good = (
+            "1\t" + "\t".join("2" for _ in range(13)) + "\t"
+            + "\t".join("LONGTOK%d" % i for i in range(26)) + "\n"
+        )
+        b = ExampleParser("criteo").parse_text((tabs_only + good).encode())
+        assert b.n == 1 and float(b.y[0]) == 1.0
