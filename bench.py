@@ -45,7 +45,7 @@ REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
 
-def probe_device(timeout_s: float = 180.0):
+def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: float = 120.0):
     """Fail fast when the accelerator is unreachable: returns None when
     healthy, else a human-readable diagnosis (timeout vs crash, with the
     child's stderr tail).
@@ -53,7 +53,10 @@ def probe_device(timeout_s: float = 180.0):
     On the tunneled backend a wedged relay makes ``jax.devices()`` block
     FOREVER (observed: a killed client left the claim/grant protocol
     stuck for hours). Probe device init in a child process so the bench
-    can emit an explicit error JSON line instead of hanging the driver."""
+    can emit an explicit error JSON line instead of hanging the driver.
+    Wedges are often TRANSIENT (the relay times out the dead claim), so
+    a failed probe is retried ``attempts`` times with a pause — a bench
+    run should not be zeroed by a hiccup that clears in two minutes."""
     import subprocess
 
     # honor JAX_PLATFORMS the way Postoffice.start does: the env var
@@ -66,21 +69,32 @@ def probe_device(timeout_s: float = 180.0):
         "    jax.config.update('jax_platforms', p)\n"
         "jax.devices()\n"
     )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", probe_src],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        if r.returncode == 0:
-            return None
-        tail = r.stderr.decode(errors="replace").strip().splitlines()[-3:]
-        return "device init failed: " + " | ".join(tail)
-    except subprocess.TimeoutExpired:
-        return (
-            "device init did not complete within the probe timeout "
-            "(tunnel relay down?)"
-        )
+    diagnosis = "probe never ran"
+    for attempt in range(max(1, attempts)):
+        if attempt:
+            print(
+                f"# device probe attempt {attempt} failed ({diagnosis}); "
+                f"retrying in {retry_wait_s:.0f}s",
+                file=sys.stderr,
+            )
+            time.sleep(retry_wait_s)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe_src],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if r.returncode == 0:
+                return None
+            tail = r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+            # a crash (vs a hang) is deterministic — fail fast, no retry
+            return "device init failed: " + " | ".join(tail)
+        except subprocess.TimeoutExpired:
+            diagnosis = (
+                "device init did not complete within the probe timeout "
+                "(tunnel relay down?)"
+            )
+    return diagnosis
 
 
 def emit_device_error(diagnosis: str) -> int:

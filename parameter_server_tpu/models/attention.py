@@ -31,7 +31,26 @@ def _block_attn(q, k, v, mask):
     return s
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
+def _ring_hops(k, v, axis: str, n: int):
+    """Yield ``(kb, vb, src)`` for each of the n ring hops: the K/V chunk
+    currently held and WHICH device's shard it is. The single home of the
+    schedule invariant — ``ring_next``'s ppermute shifts blocks forward,
+    so the held chunk's source index DEcrements — shared by both
+    ring-attention impls so their causal offsets cannot desynchronize."""
+    src = jax.lax.axis_index(axis)
+    kb, vb = k, v
+    for step in range(n):
+        yield kb, vb, src
+        if step + 1 < n:
+            kb = ring_next(kb, axis)
+            vb = ring_next(vb, axis)
+            src = (src - 1) % n
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "axis", "causal", "impl", "use_pallas", "interpret"),
+)
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -40,8 +59,35 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "data",
     causal: bool = False,
+    impl: str = "xla",
+    use_pallas=None,
+    interpret=None,
 ) -> jax.Array:
-    """Exact attention with S sharded over ``axis``. q,k,v: [B, S, H]."""
+    """Exact attention with S sharded over ``axis``. q,k,v: [B, S, H].
+
+    ``impl="xla"`` materializes each visiting chunk's [s_loc, s_loc]
+    score block (fine for moderate chunks); ``impl="flash"`` computes
+    each chunk with the Pallas flash kernel (ops/flash_attention.py) —
+    O(block) VMEM per chunk — and merges chunks by logsumexp, so BOTH
+    levels of the blocking (across devices and within a chunk) stream.
+    """
+    if impl == "flash":
+        return _ring_attention_flash(
+            q, k, v, mesh=mesh, axis=axis, causal=causal,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+    if impl != "xla":
+        raise ValueError(
+            f"ring_attention impl must be 'xla' or 'flash', got {impl!r} — "
+            "both are exact, so a silent fallback would hide the memory "
+            "profile choice"
+        )
+    if use_pallas is not None or interpret is not None:
+        raise ValueError(
+            "use_pallas/interpret only apply to impl='flash'; the xla "
+            "impl would silently ignore them (and you would believe you "
+            "benchmarked the Pallas kernel)"
+        )
     n = mesh.shape[axis]
 
     def local(q, k, v):
@@ -51,10 +97,8 @@ def ring_attention(
         acc = jnp.zeros((b, s_loc, h), jnp.float32)
         row_max = jnp.full((b, s_loc), -jnp.inf, jnp.float32)
         row_sum = jnp.zeros((b, s_loc), jnp.float32)
-        kb, vb = k, v
-        src = my  # which device's K/V block we currently hold
         q_pos = my * s_loc + jnp.arange(s_loc)
-        for step in range(n):
+        for kb, vb, src in _ring_hops(k, v, axis, n):
             k_pos = src * s_loc + jnp.arange(s_loc)
             if causal:
                 mask = q_pos[:, None] >= k_pos[None, :]
@@ -73,16 +117,48 @@ def ring_attention(
             acc = acc * correction[..., None] + jnp.einsum("bqk,bkh->bqh", p, vb)
             row_sum = row_sum * correction + jnp.sum(p, axis=-1)
             row_max = new_max
-            if step + 1 < n:
-                kb = ring_next(kb, axis)
-                vb = ring_next(vb, axis)
-                src = (src - 1) % n  # ppermute shifts blocks forward
         out = acc / jnp.maximum(row_sum, 1e-30)[..., None]
         return out.astype(q.dtype)
 
     spec = P(None, axis, None)
     return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas, interpret):
+    """Ring schedule with the Pallas flash kernel as the chunk compute.
+
+    Each hop produces a NORMALIZED chunk output plus its logsumexp; two
+    chunks merge exactly via softmax-of-lse weights (the FlashAttention-2
+    chunk combination), so the result matches dense attention to float
+    tolerance regardless of hop order."""
+    from ..ops.flash_attention import flash_attention
+
+    n = mesh.shape[axis]
+
+    def local(q, k, v):
+        b, s_loc, h = q.shape
+        my = jax.lax.axis_index(axis)
+        out = jnp.zeros((b, s_loc, h), jnp.float32)
+        lse = jnp.full((b, s_loc), -1e30, jnp.float32)
+        for kb, vb, src in _ring_hops(k, v, axis, n):
+            out_i, lse_i = flash_attention(
+                q, kb, vb, causal=causal,
+                q_offset=my * s_loc, k_offset=src * s_loc,
+                use_pallas=use_pallas, interpret=interpret, with_lse=True,
+            )
+            new_lse = jnp.logaddexp(lse, lse_i)
+            w_old = jnp.exp(lse - new_lse)
+            w_new = jnp.exp(lse_i - new_lse)
+            out = out * w_old[..., None] + out_i.astype(jnp.float32) * w_new[..., None]
+            lse = new_lse
+        return out.astype(q.dtype)
+
+    spec = P(None, axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
     )(q, k, v)
 
 

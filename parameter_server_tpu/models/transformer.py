@@ -36,8 +36,10 @@ class LMConfig:
     n_layers: int = 2
     d_ff: int = 128
     # sequence-parallel attention schedule: "ring" (ppermute K/V ring,
-    # O(S/n) memory) or "a2a" (Ulysses: all_to_all seq<->head reshard,
-    # dense per-head matmuls; needs n_heads % mesh-axis == 0)
+    # O(S/n) memory), "ring_flash" (same ring, but each visiting chunk
+    # runs the Pallas flash kernel — O(block) VMEM, scores never hit
+    # HBM), or "a2a" (Ulysses: all_to_all seq<->head reshard, dense
+    # per-head matmuls; needs n_heads % mesh-axis == 0)
     attention: str = "ring"
     # >0: every moe_every-th layer's FFN is an expert-parallel MoE
     # (models/moe.py) with n_experts switch-routed experts
@@ -46,11 +48,12 @@ class LMConfig:
     capacity_factor: float = 2.0
 
     def __post_init__(self):
-        if self.attention not in ("ring", "a2a"):
+        if self.attention not in ("ring", "ring_flash", "a2a"):
             raise ValueError(
-                f"LMConfig.attention must be 'ring' or 'a2a', got "
-                f"{self.attention!r} — both are exact, so a silent "
-                "fallback would hide the memory/collective profile choice"
+                f"LMConfig.attention must be 'ring', 'ring_flash' or "
+                f"'a2a', got {self.attention!r} — all are exact, so a "
+                "silent fallback would hide the memory/collective "
+                "profile choice"
             )
 
 
@@ -122,7 +125,9 @@ def lm_forward(
             )
         else:
             att = ring_attention(
-                heads(q), heads(k), heads(v), mesh=mesh, axis=axis, causal=True
+                heads(q), heads(k), heads(v), mesh=mesh, axis=axis,
+                causal=True,
+                impl="flash" if cfg.attention == "ring_flash" else "xla",
             )
             att = (
                 att.reshape(b, cfg.n_heads, s, hd)

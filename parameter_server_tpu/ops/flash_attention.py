@@ -1,0 +1,543 @@
+"""Flash attention — Pallas TPU kernels (forward + backward).
+
+The long-context compute core: blockwise attention with an online-softmax
+accumulator held in VMEM, so the [Sq, Sk] score matrix never touches HBM
+(memory O(block) instead of O(S^2)) and every matmul is an MXU-shaped
+``dot_general``. This is the per-device building block that
+``models.attention`` composes with sequence parallelism: ring attention
+calls it once per ICI hop with the visiting K/V chunk's global offset, and
+merges chunks with the returned logsumexp.
+
+Layout note (why everything is "transposed"): scores are computed as
+``s_t[k, q]`` — K on sublanes, Q on lanes — so the per-row softmax
+statistics (max, sum, lse, delta) are naturally ``[1, block_q]`` lane
+vectors, which is the layout Mosaic wants for broadcasting against both
+the score block and the ``[D, block_q]`` output accumulator. No in-kernel
+transposes; the output is materialized as ``[BH, D, Sq]`` and transposed
+once by XLA outside the kernel.
+
+Reference parity: the reference has no attention op (linear methods +
+CXXNET convnets); this kernel exists for the framework's first-class
+long-context requirement. Math follows Dao et al.'s FlashAttention-2
+recurrence; structure follows the canonical TPU grid pattern
+(grid = (batch*heads, q blocks, k blocks), k innermost, accumulators in
+VMEM scratch persisted across the k dimension).
+
+``flash_attention(q, k, v, ...)`` auto-selects: Pallas on TPU backends,
+an identical-math XLA path elsewhere (tests force the kernels through
+interpret mode and compare both, values and gradients).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANE = 128
+_NEG = -1e30  # finite mask value: keeps exp/max arithmetic NaN-free
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# reference path (XLA): identical math, used off-TPU and in tests
+# ---------------------------------------------------------------------------
+
+
+def flash_attention_ref(q, k, v, q_offset, k_offset, *, causal):
+    """[BH, Sq, D] x [BH, Sk, D] -> (out [BH, Sq, D], lse [BH, Sq]).
+
+    lse is the base-e logsumexp of the masked score rows; fully-masked
+    rows return out=0 and lse=_NEG (the merge weight then underflows to
+    zero exactly like the kernel path).
+    """
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        qp = q_offset + jnp.arange(q.shape[1])
+        kp = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where((qp[:, None] >= kp[None, :])[None], s, _NEG)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= _NEG / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG)
+    return out.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    qo_ref, ko_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    acc_ref, m_ref, l_ref, *, causal, scale, nk, k_len, block_q, block_k,
+):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    iq = pl.program_id(1)
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
+    q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_q), 1
+    )
+    k_base = ik * block_k
+    # causal block skip: the whole block is masked when even the LAST q
+    # row precedes the FIRST k row of the block
+    if causal:
+        live = q_off + iq * block_q + block_q - 1 >= k_off + k_base
+    else:
+        live = True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s_t = jax.lax.dot_general(  # [bk, bq]: K sublanes, Q lanes
+            k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+        valid = k_pos < k_len  # tail padding of the K axis
+        if causal:
+            valid = valid & (k_off + k_pos <= q_pos)
+        s_t = jnp.where(valid, s_t, _NEG)
+        m_prev = m_ref[...]  # [1, bq]
+        m_cur = jnp.max(s_t, axis=0, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p_t = jnp.exp(s_t - m_new)
+        p_t = jnp.where(valid, p_t, 0.0)
+        corr = jnp.exp(m_prev - m_new)  # [1, bq]
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p_t, axis=0, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            v, p_t, (((0,), (0,)), ((), ())),  # [D, bq]
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _write():
+        l = l_ref[...]
+        out_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
+            out_ref.dtype
+        )
+        lse_ref[...] = jnp.where(
+            l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)), _NEG
+        )
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _recompute_pt(q, k, lse_blk, *, causal, scale, q_pos, k_pos, k_len):
+    """Shared bwd score recomputation: p_t [bk, bq] from saved lse."""
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    valid = k_pos < k_len
+    if causal:
+        valid = valid & (k_pos + 0 <= q_pos)
+    # exp(s - lse): rows with lse=_NEG (fully masked) still produce 0
+    # because s itself is masked to _NEG there as well
+    s_t = jnp.where(valid, s_t, _NEG)
+    p_t = jnp.exp(s_t - lse_blk)
+    return jnp.where(valid, p_t, 0.0)
+
+
+def _bwd_dq_kernel(
+    qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, c_ref, dq_ref,
+    acc_ref, *, causal, scale, nk, k_len, block_q, block_k,
+):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    iq = pl.program_id(1)
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
+    if causal:
+        live = q_off + iq * block_q + block_q - 1 >= k_off + ik * block_k
+    else:
+        live = True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)  # [bq, D]
+        q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_q), 1
+        ) - k_off
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0
+        )
+        p_t = _recompute_pt(
+            q, k, lse_ref[...], causal=causal, scale=scale,
+            q_pos=q_pos, k_pos=k_pos, k_len=k_len,
+        )
+        dp_t = jax.lax.dot_general(  # [bk, bq] = v . do^T
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds_t = p_t * (dp_t - c_ref[...]) * scale
+        acc_ref[...] += jax.lax.dot_general(  # [D, bq] += k^T . ds_t
+            k, ds_t, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _write():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, c_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, nq, k_len,
+    block_q, block_k,
+):
+    iq = pl.program_id(2)  # q innermost here
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    ik = pl.program_id(1)
+    q_off = qo_ref[0, 0]
+    k_off = ko_ref[0, 0]
+    if causal:
+        live = q_off + iq * block_q + block_q - 1 >= k_off + ik * block_k
+    else:
+        live = True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_q), 1
+        ) - k_off
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0
+        )
+        p_t = _recompute_pt(
+            q, k, lse_ref[...], causal=causal, scale=scale,
+            q_pos=q_pos, k_pos=k_pos, k_len=k_len,
+        )
+        dv_acc[...] += jax.lax.dot_general(  # [bk, D] += p_t . do
+            p_t, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds_t = p_t * (dp_t - c_ref[...]) * scale
+        dk_acc[...] += jax.lax.dot_general(  # [bk, D] += ds_t . q
+            ds_t, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(iq == nq - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call drivers
+# ---------------------------------------------------------------------------
+
+try:  # import at module scope so kernels can reference pl.program_id
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - pallas always present in this image
+    pl = None
+    pltpu = None
+
+
+def _blocks(sq: int, sk: int, block_q: int, block_k: int):
+    bq = min(block_q, max(sq, 1))
+    bk = min(block_k, max(sk, 1))
+    return bq, bk
+
+
+def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
+                interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    bq, bk = _blocks(sq, sk, block_q, block_k)
+    qp = _pad_to(_pad_to(q, 1, bq), 2, _LANE)
+    kp = _pad_to(_pad_to(k, 1, bk), 2, _LANE)
+    vp = _pad_to(_pad_to(v, 1, bk), 2, _LANE)
+    dp_ = qp.shape[2]
+    nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
+    qo = q_offset.astype(jnp.int32).reshape(1, 1)
+    ko = k_offset.astype(jnp.int32).reshape(1, 1)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    out_t, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, causal=causal, scale=scale, nk=nk, k_len=sk,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            smem,
+            smem,
+            pl.BlockSpec((1, bq, dp_), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, dp_), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dp_), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, dp_, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, dp_, qp.shape[1]), q.dtype),
+            jax.ShapeDtypeStruct((bh, qp.shape[1]), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((dp_, bq), jnp.float32),
+            pltpu.VMEM((1, bq), jnp.float32),
+            pltpu.VMEM((1, bq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, qp, kp, vp)
+    out = jnp.swapaxes(out_t, 1, 2)[:, :sq, :d]
+    return out, lse[:, :sq]
+
+
+def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
+                block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    bq, bk = _blocks(sq, sk, block_q, block_k)
+    qp = _pad_to(_pad_to(q, 1, bq), 2, _LANE)
+    kp = _pad_to(_pad_to(k, 1, bk), 2, _LANE)
+    vp = _pad_to(_pad_to(v, 1, bk), 2, _LANE)
+    dop = _pad_to(_pad_to(do, 1, bq), 2, _LANE)
+    # padded q rows: lse=_NEG there would make exp(s-lse) explode for
+    # in-range k; force a huge lse so p underflows to 0 on padding
+    lsep = _pad_to(lse, 1, bq)
+    if lsep.shape[1] != sq:
+        pad_rows = (
+            jax.lax.broadcasted_iota(jnp.int32, lsep.shape, 1) >= sq
+        )
+        lsep = jnp.where(pad_rows, -_NEG, lsep)
+    cp = _pad_to(c, 1, bq)
+    dp_ = qp.shape[2]
+    nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
+    qo = q_offset.astype(jnp.int32).reshape(1, 1)
+    ko = k_offset.astype(jnp.int32).reshape(1, 1)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qspec = pl.BlockSpec((1, bq, dp_), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, dp_), lambda b, i, j: (b, j, 0))
+    vec_q = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    dq_t = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, scale=scale, nk=nk, k_len=sk,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[smem, smem, qspec, kspec, kspec, qspec, vec_q, vec_q],
+        out_specs=pl.BlockSpec((1, dp_, bq), lambda b, i, j: (b, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((bh, dp_, qp.shape[1]), q.dtype),
+        scratch_shapes=[pltpu.VMEM((dp_, bq), jnp.float32)],
+        interpret=interpret,
+    )(qo, ko, qp, kp, vp, dop, lsep, cp)
+    # dkv: k blocks outer (parallel), q blocks inner (accumulated)
+    qspec2 = pl.BlockSpec((1, bq, dp_), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, bk, dp_), lambda b, j, i: (b, j, 0))
+    vec_q2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, nq=nq, k_len=sk,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[smem, smem, qspec2, kspec2, kspec2, qspec2, vec_q2, vec_q2],
+        out_specs=(
+            pl.BlockSpec((1, bk, dp_), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, dp_), lambda b, j, i: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, kp.shape[1], dp_), k.dtype),
+            jax.ShapeDtypeStruct((bh, kp.shape[1], dp_), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, dp_), jnp.float32),
+            pltpu.VMEM((bk, dp_), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, qp, kp, vp, dop, lsep, cp)
+    dq = jnp.swapaxes(dq_t, 1, 2)[:, :sq, :d]
+    return dq, dk[:, :sk, :d], dv[:, :sk, :d]
+
+
+# ---------------------------------------------------------------------------
+# public API with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+)
+def _flash(q, k, v, q_offset, k_offset, causal, block_q, block_k,
+           use_pallas, interpret):
+    if use_pallas:
+        return _fwd_pallas(
+            q, k, v, q_offset, k_offset, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    return flash_attention_ref(q, k, v, q_offset, k_offset, causal=causal)
+
+
+def _flash_fwd(q, k, v, q_offset, k_offset, causal, block_q, block_k,
+               use_pallas, interpret):
+    out, lse = _flash(
+        q, k, v, q_offset, k_offset, causal, block_q, block_k,
+        use_pallas, interpret,
+    )
+    return (out, lse), (q, k, v, out, lse, q_offset, k_offset)
+
+
+def _flash_bwd(causal, block_q, block_k, use_pallas, interpret, res, ct):
+    q, k, v, out, lse, q_offset, k_offset = res
+    do, dlse = ct
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # [BH, Sq]
+    dlse32 = (
+        jnp.zeros_like(delta) if dlse is None else dlse.astype(jnp.float32)
+    )
+    # d s = p * (dp - delta + dlse); fold into one lane vector
+    c = delta - dlse32
+    if use_pallas:
+        dq, dk, dv = _bwd_pallas(
+            q, k, v, do, lse, c, q_offset, k_offset, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    else:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jnp.einsum(
+            "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * scale
+        if causal:
+            qp_ = q_offset + jnp.arange(q.shape[1])
+            kp_ = k_offset + jnp.arange(k.shape[1])
+            s = jnp.where((qp_[:, None] >= kp_[None, :])[None], s, _NEG)
+        p = jnp.exp(s - lse[..., None])
+        p = jnp.where(s <= _NEG / 2, 0.0, p)
+        dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
+        ds = p * (dp - c[..., None]) * scale
+        dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+        dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+    z = np.zeros((), jax.dtypes.float0)  # int offsets: symbolic-zero tangent
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), z, z
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = 128,
+    block_k: int = 128,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+    with_lse: bool = False,
+):
+    """Blockwise exact attention over [BH, S, D] head-major arrays.
+
+    ``q_offset``/``k_offset`` are the GLOBAL sequence positions of row 0
+    (traced values allowed — ring attention passes ``axis_index``-derived
+    offsets), so causal masking is correct on sequence-sharded chunks.
+    Returns ``out`` or ``(out, lse)`` — lse is what chunk-merging needs.
+    """
+    if use_pallas is None:
+        use_pallas = _use_pallas() and pl is not None
+    if interpret is None:
+        interpret = not _use_pallas()
+    q_offset = jnp.asarray(q_offset, jnp.int32)
+    k_offset = jnp.asarray(k_offset, jnp.int32)
+    out, lse = _flash(
+        q, k, v, q_offset, k_offset, causal, block_q, block_k,
+        bool(use_pallas), bool(interpret),
+    )
+    return (out, lse) if with_lse else out
+
+
+def flash_mha(
+    x_q: jax.Array,
+    x_k: jax.Array,
+    x_v: jax.Array,
+    n_heads: int,
+    *,
+    causal: bool = False,
+    q_offset=0,
+    k_offset=0,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-head wrapper: [B, S, H] with H = n_heads * dh, like dense_mha."""
+    b, sq, h = x_q.shape
+    sk = x_k.shape[1]
+    dh = h // n_heads
+
+    def split(x, s):
+        return (
+            x.reshape(b, s, n_heads, dh)
+            .transpose(0, 2, 1, 3)
+            .reshape(b * n_heads, s, dh)
+        )
+
+    out = flash_attention(
+        split(x_q, sq), split(x_k, sk), split(x_v, sk),
+        causal=causal, q_offset=q_offset, k_offset=k_offset,
+        use_pallas=use_pallas, interpret=interpret,
+    )
+    return (
+        out.reshape(b, n_heads, sq, dh).transpose(0, 2, 1, 3).reshape(b, sq, h)
+    )
