@@ -1425,44 +1425,8 @@ class AsyncSGDWorker(ISGDCompNode):
             for p in prepped
         ]
 
-    def collect(self, ts: int) -> SGDProgress:
-        """Wait for a step and fold its metrics into progress (the worker's
-        reporter_.Report path)."""
-        self.po.beat(self.name)  # liveness signal (ref heartbeat thread)
-        hb = self.po.aux.info(self.name) if self.po.aux is not None else None
-        if hb is not None:
-            hb.start_timer()  # dashboard busy-time (ref heartbeat_info.h)
-        metrics = self.executor.wait(ts)
-        if hb is not None:
-            hb.stop_timer()
-        if metrics is None:
-            return self.progress
-        prog = SGDProgress(
-            objective=[float(metrics["objective"])],
-            num_examples_processed=int(metrics["num_ex"]),
-            accuracy=[float(metrics["correct"]) / max(1.0, float(metrics["num_ex"]))],
-        )
-        if "xw" in metrics:  # aux present: per-minibatch AUC (ref prog.add_auc)
-            y = np.asarray(metrics["y"])
-            xw = np.asarray(metrics["xw"])
-            mask = np.asarray(metrics["mask"])
-            if xw.ndim >= 3:
-                # scan superstep: leading ministep axis — one AUC per
-                # ministep (each scored against its own weight version),
-                # preserving the per-minibatch monitoring granularity
-                prog.auc = [
-                    evaluation.auc(
-                        y[t].ravel()[mask[t].ravel() > 0],
-                        xw[t].ravel()[mask[t].ravel() > 0],
-                    )
-                    for t in range(xw.shape[0])
-                ]
-            else:
-                m = mask.ravel() > 0
-                prog.auc = [evaluation.auc(y.ravel()[m], xw.ravel()[m])]
-        self.progress.merge(prog)
-        self.reporter.report(prog)
-        return prog
+    # collect: inherited from ISGDCompNode (shared worker plumbing, incl.
+    # the scan-superstep per-ministep AUC layout)
 
     def train(self, batches: Iterator[SparseBatch]) -> SGDProgress:
         """Drive a pass over an iterator of minibatches.
@@ -1607,7 +1571,9 @@ class AsyncSGDWorker(ISGDCompNode):
         """Snapshot the full optimizer state to host memory (device->host,
         no files) — the live-migration path for elastic resizes (ref
         Parameter::GetReplica feeding manager.cc NodeAdd key-range moves)."""
-        self.executor.wait_all()
+        # pop=False: a mid-training snapshot must not swallow in-flight
+        # steps' metrics — collect(ts) afterwards still accounts them
+        self.executor.wait_all(pop=False)
         return {
             "state": jax.tree.map(np.asarray, self.state),
             "seed_counter": np.int64(self._seed_counter),
@@ -1645,7 +1611,7 @@ class AsyncSGDWorker(ISGDCompNode):
     def checkpoint(self, manager, step: int) -> str:
         """Durably save the full optimizer state (all server shards) plus
         the worker's clock, via a parameter.replica.CheckpointManager."""
-        self.executor.wait_all()
+        self.executor.wait_all(pop=False)  # keep in-flight metrics collectable
         return manager.save(
             step,
             {"state": self.state, "seed_counter": np.int64(self._seed_counter)},

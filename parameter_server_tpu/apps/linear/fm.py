@@ -38,7 +38,7 @@ from ...parameter.parameter import KeyDirectory, pad_slots
 from ...system.message import Task
 from ...utils import evaluation
 from ...utils.sparse import SparseBatch
-from .async_sgd import _progress_metrics, prep_batch_ell
+from .async_sgd import _progress_metrics
 from .config import Config
 from .learning_rate import LearningRate
 from .loss import create_loss
@@ -210,25 +210,8 @@ class FMWorker(ISGDCompNode):
         self._rows_pad: Optional[int] = None
         self.progress = SGDProgress()
 
-    def _prep(self, batch: SparseBatch):
-        d = meshlib.num_workers(self.mesh)
-        if self._rows_pad is None:
-            # honor an explicit conf pad; otherwise size from the first
-            # batch (same policy as AsyncSGDWorker._padding)
-            self._rows_pad = self.sgd.rows_pad or -(-batch.n // d)
-        if -(-batch.n // d) > self._rows_pad:
-            raise ValueError(
-                f"batch of {batch.n} rows exceeds the compiled padding "
-                f"({self._rows_pad} rows/shard x {d} shards); set "
-                "SGDConfig.rows_pad to the largest minibatch up front"
-            )
-        return prep_batch_ell(
-            batch, self.directory, d, self._rows_pad, self.sgd.ell_lanes,
-            self.num_slots,
-        )
-
     def process_minibatch(self, batch: SparseBatch) -> int:
-        prepped = self._prep(batch)
+        prepped = self._prep_ell(batch)  # shared base prep (ISGDCompNode)
 
         def run():
             new_state, metrics = self._step(
@@ -251,7 +234,7 @@ class FMWorker(ISGDCompNode):
                 return leaf.at[lo:hi].set(0.0)
             return leaf
 
-        self.executor.wait_all()
+        self.executor.wait_all(pop=False)
         self.state = jax.tree.map(z, self.state)
 
     def recover_server_shard(self, shard: int) -> bool:
@@ -261,46 +244,12 @@ class FMWorker(ISGDCompNode):
         del shard
         return False
 
-    def collect(self, ts: int) -> SGDProgress:
-        self.po.beat(self.name)  # liveness (ref heartbeat thread)
-        hb = self.po.aux.info(self.name) if self.po.aux is not None else None
-        if hb is not None:
-            hb.start_timer()
-        metrics = self.executor.wait(ts)
-        if hb is not None:
-            hb.stop_timer()
-        if metrics is None:
-            return self.progress
-        prog = SGDProgress(
-            objective=[float(metrics["objective"])],
-            num_examples_processed=int(metrics["num_ex"]),
-            accuracy=[
-                float(metrics["correct"]) / max(1.0, float(metrics["num_ex"]))
-            ],
-        )
-        if "xw" in metrics:
-            y = np.asarray(metrics["y"]).ravel()
-            xw = np.asarray(metrics["xw"]).ravel()
-            mask = np.asarray(metrics["mask"]).ravel() > 0
-            prog.auc = [evaluation.auc(y[mask], xw[mask])]
-        self.progress.merge(prog)
-        self.reporter.report(prog)
-        return prog
-
-    def train(self, batches) -> SGDProgress:
-        pending = []
-        for b in batches:
-            pending.append(self.process_minibatch(b))
-            if len(pending) > 2:
-                self.collect(pending.pop(0))
-        for ts in pending:
-            self.collect(ts)
-        return self.progress
+    # collect/train: inherited from ISGDCompNode (shared worker plumbing)
 
     def state_host(self) -> dict:
         """Host snapshot for live migration (same contract as
         AsyncSGDWorker.state_host — ElasticCoordinator.resize uses it)."""
-        self.executor.wait_all()
+        self.executor.wait_all(pop=False)
         return {"state": jax.tree.map(np.asarray, self.state)}
 
     def load_state_host(self, snap: dict) -> None:
@@ -328,6 +277,9 @@ class FMWorker(ISGDCompNode):
     def predict_margin(self, batch: SparseBatch) -> np.ndarray:
         """Host-side vectorized forward pass (evaluation path): per-row
         segment sums via ``np.add.reduceat`` — O(nnz*k), no Python loop."""
+        # settle in-flight steps (state swaps on the executor thread) so
+        # the margin reads ONE consistent state version, not a mix
+        self.executor.wait_all(pop=False)
         w = np.asarray(self.state["w"]).astype(np.float64)
         v = np.asarray(self.state["v"]).astype(np.float64)
         b = float(self.state["b"])

@@ -89,7 +89,14 @@ class ISGDScheduler(App):
 
 
 class ISGDCompNode(App):
-    """ref ISGDCompNode: has a reporter to the scheduler's monitor."""
+    """ref ISGDCompNode: has a reporter to the scheduler's monitor.
+
+    Also the single home of the worker-side progress plumbing shared by
+    every SGD-family worker (AsyncSGDWorker, FMWorker, DeepCTRWorker):
+    ``collect`` (wait on a step, fold metrics into ``self.progress``,
+    heartbeat + dashboard timers, per-minibatch AUC incl. the scan-
+    superstep layout) and the default ``train`` loop. Subclasses provide
+    ``self.progress`` (an SGDProgress) and ``process_minibatch``."""
 
     def __init__(self, name: str = "sgd_comp", monitor: Optional[MonitorMaster] = None):
         super().__init__(name=name)
@@ -97,6 +104,102 @@ class ISGDCompNode(App):
 
     def attach_monitor(self, scheduler: ISGDScheduler) -> None:
         self.reporter = MonitorSlaver(scheduler.monitor, self.name)
+
+    def collect(self, ts: int) -> SGDProgress:
+        """Wait for a step and fold its metrics into progress (the
+        worker's reporter_.Report path)."""
+        from ..utils import evaluation
+
+        self.po.beat(self.name)  # liveness signal (ref heartbeat thread)
+        hb = self.po.aux.info(self.name) if self.po.aux is not None else None
+        if hb is not None:
+            hb.start_timer()  # dashboard busy-time (ref heartbeat_info.h)
+        metrics = self.executor.wait(ts)
+        if hb is not None:
+            hb.stop_timer()
+        if metrics is None:
+            return self.progress
+        prog = SGDProgress(
+            objective=[float(metrics["objective"])],
+            num_examples_processed=int(metrics["num_ex"]),
+            accuracy=[
+                float(metrics["correct"]) / max(1.0, float(metrics["num_ex"]))
+            ],
+        )
+        if "xw" in metrics:  # aux present: per-minibatch AUC (prog.add_auc)
+            y = np.asarray(metrics["y"])
+            xw = np.asarray(metrics["xw"])
+            mask = np.asarray(metrics["mask"])
+            if xw.ndim >= 3:
+                # scan superstep: leading ministep axis — one AUC per
+                # ministep (each scored against its own weight version),
+                # preserving the per-minibatch monitoring granularity
+                prog.auc = [
+                    evaluation.auc(
+                        y[t].ravel()[mask[t].ravel() > 0],
+                        xw[t].ravel()[mask[t].ravel() > 0],
+                    )
+                    for t in range(xw.shape[0])
+                ]
+            else:
+                m = mask.ravel() > 0
+                prog.auc = [evaluation.auc(y.ravel()[m], xw.ravel()[m])]
+        self.progress.merge(prog)
+        self.reporter.report(prog)
+        return prog
+
+    def train(self, batches) -> SGDProgress:
+        """Default minibatch loop: keep a small in-flight window so the
+        device pipeline stays fed while metrics drain."""
+        pending = []
+        for b in batches:
+            pending.append(self.process_minibatch(b))
+            if len(pending) > 2:
+                self.collect(pending.pop(0))
+        for ts in pending:
+            self.collect(ts)
+        return self.progress
+
+    def checkpoint(self, manager, step: int) -> str:
+        """Durably save the worker's full state via its ``state_host``
+        snapshot (a parameter.replica.CheckpointManager). Workers with
+        extra replay state (e.g. AsyncSGDWorker's seed counter) override.
+        ``state_host`` drains with pop=False, so metrics of steps in
+        flight at checkpoint time remain collectable afterwards."""
+        return manager.save(step, self.state_host())
+
+    def _prep_ell(self, batch):
+        """Shared ELL prep for the embedding-table workers (FM, DeepCTR):
+        ceil-divide rows over the data shards, size the row padding from
+        the conf or the first batch, refuse batches that outgrow the
+        compiled padding. Requires ``self.sgd/.directory/.num_slots`` and
+        a ``self._rows_pad`` slot (None until first use)."""
+        from ..apps.linear.async_sgd import prep_batch_ell  # lazy: apps import us
+        from ..parallel import mesh as meshlib
+
+        d = meshlib.num_workers(self.mesh)
+        if self._rows_pad is None:
+            self._rows_pad = self.sgd.rows_pad or -(-batch.n // d)
+        if -(-batch.n // d) > self._rows_pad:
+            raise ValueError(
+                f"batch of {batch.n} rows exceeds the compiled padding "
+                f"({self._rows_pad} rows/shard x {d} shards); set "
+                "SGDConfig.rows_pad to the largest minibatch up front"
+            )
+        return prep_batch_ell(
+            batch, self.directory, d, self._rows_pad, self.sgd.ell_lanes,
+            self.num_slots,
+        )
+
+    def restore(self, manager, step: Optional[int] = None) -> int:
+        """Restore from the latest (or given) checkpoint; placement goes
+        through ``load_state_host`` so every leaf lands back under its
+        proper sharding (table leaves server-sharded, dense replicated)."""
+        if step is None:
+            step = manager.latest_step()
+            assert step is not None, "no checkpoint found"
+        self.load_state_host(manager.restore(step, like=self.state_host()))
+        return step
 
 
 class MinibatchReader:

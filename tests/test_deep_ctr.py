@@ -1,0 +1,233 @@
+"""Wide & Deep CTR app (apps/linear/deep_ctr.py): device/host forward
+parity, sparse-update semantics (untouched slots, wide-only L1), the
+interaction capability test, and the elastic live-resize contract."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    LossConfig,
+    PenaltyConfig,
+    SGDConfig,
+)
+from parameter_server_tpu.apps.linear.deep_ctr import DeepCTRWorker
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import SparseBatch
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def make_conf(num_slots=64, lanes=2, alpha=0.1, lambda1=0.0):
+    conf = Config()
+    conf.loss = LossConfig(type="logit")
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[lambda1])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=alpha, beta=1.0)
+    conf.async_sgd = SGDConfig(
+        algo="standard", minibatch=256, num_slots=num_slots, ell_lanes=lanes
+    )
+    return conf
+
+
+def batch_of(rows, y):
+    rows = np.asarray(rows, np.int64)
+    n, lanes = rows.shape
+    return SparseBatch(
+        y=np.asarray(y, np.float32),
+        indptr=np.arange(0, lanes * n + 1, lanes, dtype=np.int64),
+        indices=rows.reshape(-1),
+        values=None,
+    )
+
+
+def interaction_batches(n_batches, rows_per=256, seed0=0):
+    """y = +1 iff both features come from the same group — zero linear
+    signal by construction (same task the FM test uses)."""
+    out = []
+    for i in range(n_batches):
+        rng = np.random.default_rng(seed0 + i)
+        a = rng.integers(0, 2, rows_per)
+        b = rng.integers(0, 2, rows_per)
+        keys = np.stack([a, 2 + b], axis=1)
+        y = np.where(a == b, 1.0, -1.0)
+        out.append(batch_of(keys, y))
+    return out
+
+
+def test_device_forward_matches_host_predict(mesh8):
+    w = DeepCTRWorker(
+        make_conf(num_slots=64), k=4, hidden=(8,), mesh=mesh8,
+        v_init_std=0.3, seed=1,
+    )
+    rng = np.random.default_rng(0)
+    n = 16
+    keys = rng.integers(0, 1 << 40, (n, 2))
+    y = np.where(rng.random(n) < 0.5, 1.0, -1.0)
+    batch = batch_of(keys, y)
+    host = w.predict_margin(batch)  # BEFORE any update
+    prepped = w._prep_ell(batch)
+    _, metrics = w._step(w.state, prepped.y, prepped.mask, prepped.slots)
+    xw = np.asarray(metrics["xw"]).ravel()
+    mask = np.asarray(metrics["mask"]).ravel() > 0
+    np.testing.assert_allclose(xw[mask], host, atol=1e-4, rtol=1e-4)
+
+
+def test_untouched_slots_stay_fixed_and_mlp_updates(mesh8):
+    w = DeepCTRWorker(
+        make_conf(num_slots=64), k=4, hidden=(8,), mesh=mesh8,
+        v_init_std=0.3, seed=2,
+    )
+    v0 = np.asarray(w.state["table"]["v"]).copy()
+    mlp0 = [np.asarray(p).copy() for p in w.state["mlp"]]
+    batch = batch_of([[1, 3], [0, 2]], [1.0, -1.0])
+    touched = set(w.directory.slots(batch.indices).tolist())
+    w.collect(w.process_minibatch(batch))
+    v1 = np.asarray(w.state["table"]["v"])
+    for s in range(w.num_slots):
+        if s in touched:
+            assert np.abs(v1[s] - v0[s]).max() > 0, f"slot {s} should move"
+        else:
+            np.testing.assert_array_equal(v1[s], v0[s])
+    # the replicated MLP must move too (deep path carries gradient)
+    assert any(
+        np.abs(np.asarray(p1) - p0).max() > 0
+        for p1, p0 in zip(w.state["mlp"], mlp0)
+    )
+
+
+def test_l1_pins_wide_but_deep_still_learns(mesh8):
+    # heavy L1 on the wide table: w stays at 0, yet the model still
+    # separates the interaction task through the (unpenalized) deep path
+    w = DeepCTRWorker(
+        make_conf(alpha=0.3, lambda1=10.0), k=4, hidden=(16,), mesh=mesh8,
+        v_init_std=0.3, seed=3,
+    )
+    w.train(iter(interaction_batches(40)))
+    assert float(np.abs(np.asarray(w.state["table"]["w"])).max()) == 0.0
+    test = interaction_batches(1, rows_per=1000, seed0=999)[0]
+    assert w.evaluate(test)["auc"] > 0.9
+
+
+def test_wide_deep_learns_interaction_linear_cannot(mesh8):
+    from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+
+    train = interaction_batches(60)
+    test = interaction_batches(1, rows_per=1000, seed0=999)[0]
+
+    deep = DeepCTRWorker(
+        make_conf(alpha=0.3, lambda1=0.001), k=4, hidden=(16,), mesh=mesh8,
+        v_init_std=0.3, seed=2,
+    )
+    deep.train(iter(train))
+    deep_auc = deep.evaluate(test)["auc"]
+
+    linear = AsyncSGDWorker(make_conf(alpha=0.3, lambda1=0.001), mesh=mesh8)
+    linear.train(iter(train))
+    lin_auc = linear.evaluate(test)["auc"]
+
+    assert deep_auc > 0.9, f"wide&deep failed the interaction task: {deep_auc}"
+    assert lin_auc < 0.6, f"linear should NOT solve it: {lin_auc}"
+
+
+def test_checkpoint_mid_flight_keeps_metrics(mesh8, tmp_path):
+    # a checkpoint between submit and collect must not swallow the
+    # in-flight step's metrics (state_host drains with pop=False)
+    from parameter_server_tpu.parameter.replica import CheckpointManager
+
+    w = DeepCTRWorker(
+        make_conf(alpha=0.3, lambda1=0.001), k=4, hidden=(8,), mesh=mesh8,
+        v_init_std=0.3, seed=2,
+    )
+    b = interaction_batches(1)[0]
+    ts = w.process_minibatch(b)
+    w.checkpoint(CheckpointManager(str(tmp_path / "ck")), step=1)
+    prog = w.collect(ts)
+    assert prog.num_examples_processed == 256
+
+
+def test_predict_margin_ragged_and_overflow(mesh8):
+    w = DeepCTRWorker(
+        make_conf(num_slots=64, lanes=4), k=3, hidden=(8,), mesh=mesh8,
+        v_init_std=0.2, seed=5,
+    )
+    # ragged CSR incl. an EMPTY row: short rows pad with zero embeddings
+    batch = SparseBatch(
+        y=np.array([1.0, -1.0, 1.0], np.float32),
+        indptr=np.array([0, 3, 3, 7], np.int64),
+        indices=np.array([5, 9, 11, 2, 5, 30, 31], np.int64),
+        values=None,
+    )
+    out = w.predict_margin(batch)
+    # oracle: per-row loop with explicit lane padding
+    v = np.asarray(w.state["table"]["v"]).astype(np.float64)
+    wl = np.asarray(w.state["table"]["w"]).astype(np.float64)
+    mlp = [np.asarray(p).astype(np.float64) for p in w.state["mlp"]]
+    b = float(w.state["b"])
+    slots = w.directory.slots(batch.indices)
+    for r in range(3):
+        sl = slots[batch.indptr[r] : batch.indptr[r + 1]]
+        e = np.zeros((4, 3))
+        e[: len(sl)] = v[sl]
+        h = e.reshape(1, -1)
+        for i in range(len(mlp) // 2 - 1):
+            h = np.maximum(h @ mlp[2 * i] + mlp[2 * i + 1], 0.0)
+        want = b + wl[sl].sum() + (h @ mlp[-2] + mlp[-1])[0, 0]
+        np.testing.assert_allclose(out[r], want, atol=1e-5)
+    # a row wider than the lane budget must be REJECTED, not truncated
+    wide_batch = SparseBatch(
+        y=np.array([1.0], np.float32),
+        indptr=np.array([0, 5], np.int64),
+        indices=np.array([1, 2, 3, 4, 5], np.int64),
+        values=None,
+    )
+    with pytest.raises(ValueError, match="lane budget"):
+        w.predict_margin(wide_batch)
+
+
+def test_deep_ctr_checkpoint_restore(mesh8, tmp_path):
+    from parameter_server_tpu.parameter.replica import CheckpointManager
+
+    w = DeepCTRWorker(
+        make_conf(alpha=0.3, lambda1=0.001), k=4, hidden=(16,), mesh=mesh8,
+        v_init_std=0.3, seed=2,
+    )
+    w.train(iter(interaction_batches(20)))
+    test = interaction_batches(1, rows_per=500, seed0=999)[0]
+    want = w.predict_margin(test)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    w.checkpoint(mgr, step=7)
+    # a FRESH worker (different seed -> different init) restores exactly
+    w2 = DeepCTRWorker(
+        make_conf(alpha=0.3, lambda1=0.001), k=4, hidden=(16,), mesh=mesh8,
+        v_init_std=0.3, seed=99,
+    )
+    assert w2.restore(mgr) == 7
+    np.testing.assert_allclose(w2.predict_margin(test), want, atol=1e-6)
+    # training continues after restore
+    w2.collect(w2.process_minibatch(interaction_batches(1, seed0=55)[0]))
+
+
+def test_deep_ctr_resizes_live(mesh8):
+    from parameter_server_tpu.system.elastic import ElasticCoordinator
+
+    def mk(mesh):
+        return DeepCTRWorker(
+            make_conf(num_slots=100, alpha=0.3, lambda1=0.001), k=4,
+            hidden=(16,), mesh=mesh, v_init_std=0.3, seed=2,
+        )
+
+    co = ElasticCoordinator(mk, num_data=2, num_server=2)
+    w = co.start()
+    w.train(iter(interaction_batches(40)))
+    test = interaction_batches(1, rows_per=500, seed0=999)[0]
+    auc_before = w.evaluate(test)["auc"]
+    w2 = co.add_server()  # 2x2 -> 2x3, non-divisible table padding
+    auc_after = w2.evaluate(test)["auc"]
+    assert auc_after == auc_before > 0.9
+    w2.collect(w2.process_minibatch(interaction_batches(1, seed0=77)[0]))

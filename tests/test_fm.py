@@ -145,6 +145,24 @@ class TestInteractionLearning:
         assert lin_auc < 0.6, f"linear should NOT solve it: {lin_auc}"
 
 
+class TestFMCheckpoint:
+    def test_fm_checkpoint_restore(self, mesh8, tmp_path):
+        from parameter_server_tpu.parameter.replica import CheckpointManager
+
+        fm = FMWorker(make_conf(alpha=0.3, lambda1=0.001), k=4, mesh=mesh8,
+                      v_init_std=0.3, seed=2)
+        fm.train(iter(interaction_batches(20)))
+        test = interaction_batches(1, rows_per=500, seed0=999)[0]
+        want = fm.predict_margin(test)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        fm.checkpoint(mgr, step=3)
+        fm2 = FMWorker(make_conf(alpha=0.3, lambda1=0.001), k=4, mesh=mesh8,
+                       v_init_std=0.3, seed=42)
+        assert fm2.restore(mgr) == 3
+        np.testing.assert_allclose(fm2.predict_margin(test), want, atol=1e-6)
+        fm2.collect(fm2.process_minibatch(interaction_batches(1, seed0=55)[0]))
+
+
 class TestFMElastic:
     def test_fm_resizes_live(self, mesh8):
         from parameter_server_tpu.system.elastic import ElasticCoordinator
