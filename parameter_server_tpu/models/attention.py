@@ -17,6 +17,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
@@ -70,23 +71,31 @@ def ring_attention(
     each chunk with the Pallas flash kernel (ops/flash_attention.py) —
     O(block) VMEM per chunk — and merges chunks by logsumexp, so BOTH
     levels of the blocking (across devices and within a chunk) stream.
+    ``impl="zigzag"`` is flash over the zigzag-permuted layout
+    (:func:`zigzag_permutation`) — balanced causal work per ring hop;
+    inputs and outputs must already be in that layout.
     """
     if impl == "flash":
         return _ring_attention_flash(
             q, k, v, mesh=mesh, axis=axis, causal=causal,
             use_pallas=use_pallas, interpret=interpret,
         )
+    if impl == "zigzag":
+        return _ring_attention_zigzag(
+            q, k, v, mesh=mesh, axis=axis, causal=causal,
+            use_pallas=use_pallas, interpret=interpret,
+        )
     if impl != "xla":
         raise ValueError(
-            f"ring_attention impl must be 'xla' or 'flash', got {impl!r} — "
-            "both are exact, so a silent fallback would hide the memory "
-            "profile choice"
+            f"ring_attention impl must be 'xla', 'flash' or 'zigzag', got "
+            f"{impl!r} — all are exact, so a silent fallback would hide "
+            "the memory profile choice"
         )
     if use_pallas is not None or interpret is not None:
         raise ValueError(
-            "use_pallas/interpret only apply to impl='flash'; the xla "
-            "impl would silently ignore them (and you would believe you "
-            "benchmarked the Pallas kernel)"
+            "use_pallas/interpret only apply to impl='flash'/'zigzag'; "
+            "the xla impl would silently ignore them (and you would "
+            "believe you benchmarked the Pallas kernel)"
         )
     n = mesh.shape[axis]
 
@@ -126,6 +135,17 @@ def ring_attention(
     )(q, k, v)
 
 
+def _merge_chunk(out, lse, out_i, lse_i):
+    """Exact combination of two normalized partial-attention results via
+    their logsumexps (the FlashAttention-2 chunk merge): order-invariant,
+    and a fully-masked chunk (lse_i ~ -1e30) contributes weight 0."""
+    new_lse = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.exp(lse - new_lse)
+    w_new = jnp.exp(lse_i - new_lse)
+    out = out * w_old[..., None] + out_i.astype(jnp.float32) * w_new[..., None]
+    return out, new_lse
+
+
 def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas, interpret):
     """Ring schedule with the Pallas flash kernel as the chunk compute.
 
@@ -148,12 +168,80 @@ def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas, interpret)
                 q_offset=my * s_loc, k_offset=src * s_loc,
                 use_pallas=use_pallas, interpret=interpret, with_lse=True,
             )
-            new_lse = jnp.logaddexp(lse, lse_i)
-            w_old = jnp.exp(lse - new_lse)
-            w_new = jnp.exp(lse_i - new_lse)
-            out = out * w_old[..., None] + out_i.astype(jnp.float32) * w_new[..., None]
-            lse = new_lse
+            out, lse = _merge_chunk(out, lse, out_i, lse_i)
         return out.astype(q.dtype)
+
+    spec = P(None, axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def zigzag_permutation(seq_len: int, n: int) -> np.ndarray:
+    """Token permutation for the zigzag causal layout: the sequence is
+    split into 2n half-blocks and device i holds half-blocks
+    ``(i, 2n-1-i)``. ``x[:, perm]`` re-orders a natural-layout sequence
+    so a plain ``P(axis)`` sharding lands those pairs on device i;
+    ``argsort(perm)`` inverts. Why: under the contiguous causal layout
+    device 0's queries precede almost every visiting K/V chunk, so it
+    skips most hops while the last device computes on all of them — the
+    hop wall-clock is set by the busiest device. Pairing the i-th and
+    (2n-1-i)-th half-blocks gives every device the same causal workload
+    per hop (the zigzag schedule of Brandon et al.'s striped-attention
+    line of work), while K/V still streams over the same ICI ring."""
+    if seq_len % (2 * n):
+        raise ValueError(f"seq_len {seq_len} must divide by 2n={2 * n}")
+    h = seq_len // (2 * n)
+    blocks = []
+    for i in range(n):
+        blocks.append(np.arange(i * h, (i + 1) * h))
+        blocks.append(np.arange((2 * n - 1 - i) * h, (2 * n - i) * h))
+    return np.concatenate(blocks)
+
+
+def _ring_attention_zigzag(q, k, v, *, mesh, axis, causal, use_pallas, interpret):
+    """Ring attention over ZIGZAG-sharded inputs (see
+    :func:`zigzag_permutation` — inputs/outputs are in the permuted
+    layout). Each device holds two half-blocks with different global
+    offsets, so every hop runs four half×half flash calls (q half × kv
+    half) with the right offset pairs and merges by logsumexp; the
+    kernel's causal block-skip makes the fully-masked combinations
+    cheap. Exact for causal and non-causal alike."""
+    from ..ops.flash_attention import flash_attention
+
+    n = mesh.shape[axis]
+
+    def local(q, k, v):
+        b, s_loc, h_feat = q.shape
+        if s_loc % 2:
+            raise ValueError(
+                f"zigzag needs an even per-device sequence length, got "
+                f"{s_loc} — shard a seq divisible by 2*{n} (see "
+                "zigzag_permutation)"
+            )
+        half = s_loc // 2
+        my = jax.lax.axis_index(axis)
+        q_halves = (q[:, :half], q[:, half:])
+        q_offs = (my * half, (2 * n - 1 - my) * half)
+        outs = [jnp.zeros((b, half, h_feat), jnp.float32) for _ in range(2)]
+        lses = [jnp.full((b, half), -1e30, jnp.float32) for _ in range(2)]
+        for kb, vb, src in _ring_hops(k, v, axis, n):
+            kv_halves = ((kb[:, :half], vb[:, :half]), (kb[:, half:], vb[:, half:]))
+            kv_offs = (src * half, (2 * n - 1 - src) * half)
+            for qi in range(2):
+                for ki in range(2):
+                    out_i, lse_i = flash_attention(
+                        q_halves[qi], kv_halves[ki][0], kv_halves[ki][1],
+                        causal=causal,
+                        q_offset=q_offs[qi], k_offset=kv_offs[ki],
+                        use_pallas=use_pallas, interpret=interpret,
+                        with_lse=True,
+                    )
+                    outs[qi], lses[qi] = _merge_chunk(
+                        outs[qi], lses[qi], out_i, lse_i
+                    )
+        return jnp.concatenate(outs, axis=1).astype(q.dtype)
 
     spec = P(None, axis, None)
     return shard_map(
