@@ -38,8 +38,10 @@ class LMConfig:
     # sequence-parallel attention schedule: "ring" (ppermute K/V ring,
     # O(S/n) memory), "ring_flash" (same ring, but each visiting chunk
     # runs the Pallas flash kernel — O(block) VMEM, scores never hit
-    # HBM), or "a2a" (Ulysses: all_to_all seq<->head reshard, dense
-    # per-head matmuls; needs n_heads % mesh-axis == 0)
+    # HBM), "ring_zigzag" (flash over the zigzag-permuted layout for
+    # balanced causal work per hop; train via zigzag_lm_arrays +
+    # lm_loss_with_targets), or "a2a" (Ulysses: all_to_all seq<->head
+    # reshard, dense per-head matmuls; needs n_heads % mesh-axis == 0)
     attention: str = "ring"
     # >0: every moe_every-th layer's FFN is an expert-parallel MoE
     # (models/moe.py) with n_experts switch-routed experts
@@ -48,12 +50,12 @@ class LMConfig:
     capacity_factor: float = 2.0
 
     def __post_init__(self):
-        if self.attention not in ("ring", "ring_flash", "a2a"):
+        if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
             raise ValueError(
-                f"LMConfig.attention must be 'ring', 'ring_flash' or "
-                f"'a2a', got {self.attention!r} — all are exact, so a "
-                "silent fallback would hide the memory/collective "
-                "profile choice"
+                f"LMConfig.attention must be 'ring', 'ring_flash', "
+                f"'ring_zigzag' or 'a2a', got {self.attention!r} — all "
+                "are exact, so a silent fallback would hide the "
+                "memory/collective profile choice"
             )
 
 
@@ -124,10 +126,12 @@ def lm_forward(
                 causal=True,
             )
         else:
+            impl = {
+                "ring": "xla", "ring_flash": "flash", "ring_zigzag": "zigzag"
+            }[cfg.attention]
             att = ring_attention(
                 heads(q), heads(k), heads(v), mesh=mesh, axis=axis,
-                causal=True,
-                impl="flash" if cfg.attention == "ring_flash" else "xla",
+                causal=True, impl=impl,
             )
             att = (
                 att.reshape(b, cfg.n_heads, s, hd)
@@ -154,11 +158,51 @@ def lm_forward(
 def lm_loss(params, tokens, cfg, mesh, axis="data"):
     """Mean next-token cross entropy; the [:, 1:] shift crosses shard
     boundaries — GSPMD emits the halo exchange."""
+    if cfg.attention == "ring_zigzag":
+        raise ValueError(
+            "lm_loss's [:, 1:] shift assumes NATURAL token order; the "
+            "zigzag layout breaks that adjacency — use "
+            "zigzag_lm_arrays + lm_loss_with_targets instead"
+        )
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    weights = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    return lm_loss_with_targets(
+        params, tokens, targets, weights, cfg, mesh, axis
+    )
+
+
+def lm_loss_with_targets(params, tokens, targets, weights, cfg, mesh, axis="data"):
+    """Weighted next-token cross entropy with EXPLICIT per-position
+    targets — the layout-agnostic loss: under a permuted token layout
+    (zigzag) "next token" is not position+1 locally, so the caller maps
+    labels (see :func:`zigzag_lm_arrays`) instead of the loss shifting."""
     logits = lm_forward(params, tokens, cfg, mesh, axis)
-    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-    tgt = tokens[:, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    # eps only guards all-zero weights (loss 0); fractional weight sums
+    # must divide through unscaled
+    return (nll * w).sum() / jnp.maximum(w.sum(), 1e-9)
+
+
+def zigzag_lm_arrays(tokens: np.ndarray, n: int):
+    """Host-side prep for the zigzag LM layout: permute NATURAL-order
+    tokens into the zigzag sharding and carry each position's next-token
+    target along (the last natural position gets weight 0). Feed the
+    results to :func:`lm_loss_with_targets` with
+    ``LMConfig(attention="ring_zigzag")``."""
+    from .attention import zigzag_permutation
+
+    b, s = tokens.shape
+    perm = zigzag_permutation(s, n)
+    tgt = np.concatenate(
+        [tokens[:, 1:], np.zeros((b, 1), tokens.dtype)], axis=1
+    )
+    weights = np.ones((b, s), np.float32)
+    weights[:, -1] = 0.0
+    return tokens[:, perm], tgt[:, perm], weights[:, perm]
 
 
 def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3):
