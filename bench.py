@@ -59,14 +59,14 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: floa
     run should not be zeroed by a hiccup that clears in two minutes."""
     import subprocess
 
-    # honor JAX_PLATFORMS the way Postoffice.start does: the env var
-    # alone does not override an accelerator plugin's programmatic
-    # platform selection — an explicit config update before init does
+    # honor JAX_PLATFORMS via the shared helper (plugin platform choice
+    # beats the env var alone)
+    repo = os.path.dirname(os.path.abspath(__file__))
     probe_src = (
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p:\n"
-        "    jax.config.update('jax_platforms', p)\n"
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+        "from parameter_server_tpu.parallel.mesh import honor_jax_platforms\n"
+        "honor_jax_platforms()\n"
+        "import jax\n"
         "jax.devices()\n"
     )
     diagnosis = "probe never ran"

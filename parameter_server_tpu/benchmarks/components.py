@@ -185,3 +185,46 @@ def sparse_matrix_perf(smoke: bool = False) -> None:
 
     sec = timeit(mv, 5 if smoke else 20)
     report("sparse_spmv_mnnz_per_sec", batch.nnz / sec / 1e6, "Mnnz/s")
+
+
+@benchmark("attention")
+def attention_perf(smoke: bool = False) -> None:
+    """Flash-kernel vs XLA dense attention on one device (the per-chunk
+    compute that ring/ulysses sequence parallelism schedules). Flushes by
+    fetching a scalar — block_until_ready under-waits on the tunneled
+    backend (see bench.py's measurement note)."""
+    import jax
+
+    from ..ops.flash_attention import _use_pallas, flash_attention
+
+    bh = 4
+    s = 512 if smoke else 4096
+    d = 64
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(rng.normal(size=(bh, s, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+    def make_run(use_pallas):
+        # jit the whole call so the XLA path is the FUSED program the
+        # model paths embed, not an eager per-op chain
+        fn = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, use_pallas=use_pallas,
+                interpret=False if use_pallas else None,
+            )
+        )
+
+        def run():
+            np.asarray(fn(q, k, v)[0, 0, 0])  # true device->host flush
+
+        return run
+
+    flops = 4.0 * bh * s * s * d  # 2 matmuls, causal ~half but count full
+    n = 2 if smoke else 10
+    sec = timeit(make_run(False), n)
+    report("attention_xla_gflops", flops / sec / 1e9, "GFLOP/s")
+    if _use_pallas():  # Mosaic on TPU only (interpret is not a perf path)
+        sec = timeit(make_run(True), n)
+        report("attention_flash_gflops", flops / sec / 1e9, "GFLOP/s")

@@ -280,7 +280,10 @@ def dense_mha(q, k, v, n_heads: int, causal: bool = False):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "n_heads", "causal")
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis", "n_heads", "causal", "impl", "use_pallas", "interpret",
+    ),
 )
 def ulysses_attention(
     q: jax.Array,
@@ -291,6 +294,9 @@ def ulysses_attention(
     axis: str = "data",
     n_heads: int,
     causal: bool = False,
+    impl: str = "xla",
+    use_pallas=None,
+    interpret=None,
 ) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: the
     complement of :func:`ring_attention` for long sequences.
@@ -301,10 +307,22 @@ def ulysses_attention(
     big MXU matmul instead of a ring of n block steps — and a second
     all_to_all restores sequence sharding. Two collectives total (vs n-1
     ppermutes): cheaper when heads divide evenly and the full sequence's
-    scores fit on-chip; ring wins when S^2 memory must stay blocked.
+    scores fit on-chip; ring wins when S^2 memory must stay blocked —
+    unless ``impl="flash"``, which runs the per-head full-sequence
+    attention through the Pallas flash kernel (O(block) VMEM), removing
+    exactly that S^2 limit while keeping the two-collective schedule.
     """
     n = mesh.shape[axis]
     assert n_heads % n == 0, f"n_heads={n_heads} must divide by mesh axis {n}"
+    if impl not in ("xla", "flash"):
+        raise ValueError(
+            f"ulysses_attention impl must be 'xla' or 'flash', got {impl!r}"
+        )
+    if impl == "xla" and (use_pallas is not None or interpret is not None):
+        raise ValueError(
+            "use_pallas/interpret only apply to impl='flash'; the xla "
+            "impl would silently ignore them"
+        )
 
     def local(q, k, v):
         b, s_loc, h = q.shape
@@ -320,12 +338,25 @@ def ulysses_attention(
 
         qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # [B, S, nh/n, dh]
         s_full = qh.shape[1]
-        scores = jnp.einsum("bqnd,bknd->bnqk", qh, kh) / jnp.sqrt(dh)
-        if causal:
-            mask = jnp.tril(jnp.ones((s_full, s_full), bool))
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        p = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bnqk,bknd->bqnd", p, vh)  # [B, S, nh/n, dh]
+        nh_loc = qh.shape[2]
+        if impl == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            def to_bh(x):  # [B, S, nh/n, dh] -> [B*nh/n, S, dh]
+                return x.transpose(0, 2, 1, 3).reshape(b * nh_loc, s_full, dh)
+
+            out = flash_attention(
+                to_bh(qh), to_bh(kh), to_bh(vh), causal=causal,
+                use_pallas=use_pallas, interpret=interpret,
+            )
+            out = out.reshape(b, nh_loc, s_full, dh).transpose(0, 2, 1, 3)
+        else:
+            scores = jnp.einsum("bqnd,bknd->bnqk", qh, kh) / jnp.sqrt(dh)
+            if causal:
+                mask = jnp.tril(jnp.ones((s_full, s_full), bool))
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            p = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bnqk,bknd->bqnd", p, vh)  # [B, S, nh/n, dh]
         # inverse a2a: scatter sequence, gather heads
         out = jax.lax.all_to_all(
             out, axis, split_axis=1, concat_axis=2, tiled=True

@@ -207,10 +207,33 @@ def zigzag_lm_arrays(tokens: np.ndarray, n: int):
 
 def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3):
     """SGD train step; tokens must be placed sharded P(None, axis)."""
+    if cfg.attention == "ring_zigzag":
+        raise ValueError(
+            "the zigzag layout needs explicit targets — use "
+            "make_lm_train_step_with_targets (+ zigzag_lm_arrays)"
+        )
 
     @jax.jit
     def step(params, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg, mesh, axis)
+        new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new, loss
+
+    return step
+
+
+def make_lm_train_step_with_targets(
+    cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3
+):
+    """SGD train step on (tokens, targets, weights) — the layout-agnostic
+    factory: works for any attention mode, and is the sanctioned one for
+    ``ring_zigzag`` (feed it ``zigzag_lm_arrays`` outputs)."""
+
+    @jax.jit
+    def step(params, tokens, targets, weights):
+        loss, grads = jax.value_and_grad(lm_loss_with_targets)(
+            params, tokens, targets, weights, cfg, mesh, axis
+        )
         new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return new, loss
 

@@ -29,6 +29,21 @@ DATA_AXIS = "data"
 SERVER_AXIS = "server"
 
 
+def honor_jax_platforms() -> None:
+    """Apply the JAX_PLATFORMS env var via jax.config BEFORE backend
+    init: an accelerator plugin's programmatic platform selection beats
+    the env var alone, so ``JAX_PLATFORMS=cpu`` silently loses without
+    this. The single home of the dance (Postoffice.start, benchmarks
+    CLI, and bench.py's device probe all call it)."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass  # backend already initialized; nothing to do
+
+
 def make_mesh(
     num_data: Optional[int] = None,
     num_server: int = 1,

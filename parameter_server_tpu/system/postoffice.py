@@ -53,18 +53,9 @@ class Postoffice:
         if self._started:
             return self
         # honor JAX_PLATFORMS even when an accelerator plugin set the
-        # platform programmatically at interpreter start (plugin config
-        # beats env; an explicit config update before backend init beats
-        # both) — this is what lets ps.sh/main.py run on CPU meshes
-        import os
-
-        import jax
-
-        if os.environ.get("JAX_PLATFORMS"):
-            try:
-                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-            except RuntimeError:
-                pass  # backend already up; nothing to do
+        # platform programmatically — this is what lets ps.sh/main.py
+        # run on CPU meshes
+        meshlib.honor_jax_platforms()
         init_distributed()
         self.mesh = meshlib.make_mesh(num_data=num_data, num_server=num_server)
         self.van = Van(self.mesh)

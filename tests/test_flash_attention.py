@@ -237,6 +237,61 @@ def test_zigzag_permutation_roundtrip_and_validation():
         zigzag_permutation(50, 3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_dense(causal):
+    from parameter_server_tpu.models.attention import ulysses_attention
+
+    mesh = make_mesh(num_data=4, num_server=1)
+    b, s, nh, h = 2, 64, 4, 32
+    q, k, v = _rand((b, s, h), 1), _rand((b, s, h), 2), _rand((b, s, h), 3)
+    got = ulysses_attention(
+        q, k, v, mesh=mesh, axis="data", n_heads=nh, causal=causal,
+        impl="flash", use_pallas=True, interpret=True,
+    )
+    np.testing.assert_allclose(
+        got, dense_mha(q, k, v, nh, causal=causal), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_ulysses_flash_gradients_match_dense():
+    from parameter_server_tpu.models.attention import ulysses_attention
+
+    mesh = make_mesh(num_data=2, num_server=1)
+    b, s, nh, h = 1, 32, 2, 16
+    q, k, v = _rand((b, s, h), 1), _rand((b, s, h), 2), _rand((b, s, h), 3)
+    w = _rand((b, s, h), 4)
+
+    def loss_u(q, k, v):
+        out = ulysses_attention(
+            q, k, v, mesh=mesh, axis="data", n_heads=nh, causal=True,
+            impl="flash",
+        )
+        return jnp.sum(out * w)
+
+    def loss_d(q, k, v):
+        return jnp.sum(dense_mha(q, k, v, nh, causal=True) * w)
+
+    gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gu, gd):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=1e-4)
+
+
+def test_ulysses_rejects_bad_impl_and_stray_flags():
+    from parameter_server_tpu.models.attention import ulysses_attention
+
+    mesh = make_mesh(num_data=2, num_server=1)
+    x = _rand((1, 16, 8), 0)
+    with pytest.raises(ValueError, match="impl"):
+        ulysses_attention(
+            x, x, x, mesh=mesh, axis="data", n_heads=2, impl="dense"
+        )
+    with pytest.raises(ValueError, match="use_pallas"):
+        ulysses_attention(
+            x, x, x, mesh=mesh, axis="data", n_heads=2, interpret=True
+        )
+
+
 def test_lm_ring_flash_mode_matches_ring():
     from parameter_server_tpu.models.transformer import (
         LMConfig,

@@ -160,6 +160,37 @@ class TestSeqParallelLM:
         losses = run_copy_training(mesh8, params, cfg_z, steps=30, zigzag=True)
         assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
 
+    def test_zigzag_train_step_factory(self, mesh8, params):
+        """make_lm_train_step refuses zigzag; the with-targets factory
+        trains it."""
+        from parameter_server_tpu.models.transformer import (
+            make_lm_train_step_with_targets,
+            zigzag_lm_arrays,
+        )
+
+        cfg_z = LMConfig(
+            vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            attention="ring_zigzag",
+        )
+        with pytest.raises(ValueError, match="with_targets"):
+            make_lm_train_step(cfg_z, mesh8)
+        step = make_lm_train_step_with_targets(cfg_z, mesh8, lr=0.5)
+        rng = np.random.default_rng(0)
+        p = params
+        first = last = None
+        for i in range(10):
+            const = rng.integers(0, 32, (4, 1)).astype(np.int32)
+            tz, gz, wz = zigzag_lm_arrays(
+                np.broadcast_to(const, (4, 64)).copy(), mesh8.shape["data"]
+            )
+            p, loss = step(
+                p, shard_tokens(tz, mesh8), shard_tokens(gz, mesh8),
+                shard_tokens(wz, mesh8),
+            )
+            first = float(loss) if first is None else first
+            last = float(loss)
+        assert last < first, (first, last)
+
     def test_loss_shift_crosses_shards(self, mesh8, cfg, params):
         """The next-token shift must see across shard boundaries: loss of a
         perfectly periodic stream differs from a shuffled one."""
