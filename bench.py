@@ -59,14 +59,14 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: floa
     run should not be zeroed by a hiccup that clears in two minutes."""
     import subprocess
 
-    # honor JAX_PLATFORMS via the shared helper (plugin platform choice
-    # beats the env var alone)
-    repo = os.path.dirname(os.path.abspath(__file__))
+    # self-contained inline copy of mesh.honor_jax_platforms: the probe
+    # diagnoses DEVICE health, so it must not also depend on the whole
+    # package importing cleanly (plugin platform choice beats env alone)
     probe_src = (
-        f"import sys; sys.path.insert(0, {repo!r})\n"
-        "from parameter_server_tpu.parallel.mesh import honor_jax_platforms\n"
-        "honor_jax_platforms()\n"
-        "import jax\n"
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p:\n"
+        "    jax.config.update('jax_platforms', p)\n"
         "jax.devices()\n"
     )
     diagnosis = "probe never ran"

@@ -17,6 +17,7 @@ axis, at exact-attention quality.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict
 
 import jax
@@ -153,6 +154,81 @@ def lm_forward(
         else:
             x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
     return _ln(x, params["ln_f"]) @ params["emb"].T
+
+
+def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
+    """One KV-cached decoder step. tok [B]; caches [L, B, nh, T, hd];
+    pos scalar int32. Returns (logits [B, vocab], new caches)."""
+    b = tok.shape[0]
+    nh = cfg.n_heads
+    hd = cfg.d_model // nh
+    t_max = kcache.shape[3]
+    x = params["emb"][tok] * np.sqrt(cfg.d_model)  # [B, d]
+    mask = (jnp.arange(t_max) <= pos)[None, None, :]  # [1, 1, T]
+    for i in range(cfg.n_layers):
+        h = _ln(x, params[f"l{i}/ln1"])
+        q = (h @ params[f"l{i}/wq"]).reshape(b, nh, hd)
+        k = (h @ params[f"l{i}/wk"]).reshape(b, nh, hd)
+        v = (h @ params[f"l{i}/wv"]).reshape(b, nh, hd)
+        kcache = kcache.at[i, :, :, pos].set(k)
+        vcache = vcache.at[i, :, :, pos].set(v)
+        s = jnp.einsum("bnd,bntd->bnt", q, kcache[i]) / np.sqrt(hd)
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("bnt,bntd->bnd", p, vcache[i]).reshape(b, cfg.d_model)
+        x = x + att @ params[f"l{i}/wo"]
+        h2 = _ln(x, params[f"l{i}/ln2"])
+        x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
+    return _ln(x, params["ln_f"]) @ params["emb"].T, kcache, vcache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "steps", "return_logits"))
+def lm_generate(
+    params: Dict[str, jax.Array],
+    prompt: jax.Array,  # [B, P] int32
+    cfg: LMConfig,
+    steps: int,
+    return_logits: bool = False,
+) -> jax.Array:
+    """Greedy KV-cached decoding (the serving path — single device; the
+    sharded-mesh schedules are the TRAINING story): teacher-forces the
+    prompt through one lax.scan, then extends it ``steps`` tokens by
+    argmax. Returns [B, P+steps]. Dense FFN layers only (the reference
+    has no serving path at all; MoE decode would need token routing with
+    batch-1 capacity, out of scope)."""
+    if cfg.moe_every > 0:
+        raise ValueError("lm_generate supports dense FFN layers only")
+    b, p_len = prompt.shape
+    total = p_len + steps
+    nh, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
+    kcache = jnp.zeros((cfg.n_layers, b, nh, total, hd), jnp.float32)
+    vcache = jnp.zeros_like(kcache)
+    toks = jnp.concatenate(
+        [prompt.astype(jnp.int32), jnp.zeros((b, steps), jnp.int32)], axis=1
+    )
+
+    def body(carry, pos):
+        toks, kcache, vcache = carry
+        tok = jax.lax.dynamic_index_in_dim(toks, pos, axis=1, keepdims=False)
+        logits, kcache, vcache = _decode_step(
+            params, cfg, tok, kcache, vcache, pos
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # within the prompt: keep the given token (teacher forcing);
+        # past it: write the greedy continuation
+        cur = jax.lax.dynamic_index_in_dim(toks, pos + 1, 1, keepdims=False)
+        write = jnp.where(pos + 1 < p_len, cur, nxt)
+        toks = jax.lax.dynamic_update_index_in_dim(toks, write, pos + 1, axis=1)
+        return (toks, kcache, vcache), logits
+
+    (toks, _, _), logits = jax.lax.scan(
+        body, (toks, kcache, vcache), jnp.arange(total - 1)
+    )
+    if return_logits:
+        # [T-1, B, vocab] -> [B, T-1, vocab]: logits[t] predicts token
+        # t+1 — the decode-vs-full-forward parity hook for tests
+        return toks, jnp.swapaxes(logits, 0, 1)
+    return toks
 
 
 def lm_loss(params, tokens, cfg, mesh, axis="data"):

@@ -41,7 +41,11 @@ def honor_jax_platforms() -> None:
         try:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
-            pass  # backend already initialized; nothing to do
+            logging.getLogger(__name__).warning(
+                "JAX backend already initialized; JAX_PLATFORMS=%s NOT "
+                "applied — call honor_jax_platforms() before any jax use",
+                os.environ["JAX_PLATFORMS"],
+            )
 
 
 def make_mesh(

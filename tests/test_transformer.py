@@ -80,7 +80,7 @@ def run_copy_training(mesh, params, cfg, steps, zigzag=False):
         else:
             p, opt, loss = step(p, opt, shard_tokens(tokens, mesh))
         losses.append(float(loss))
-    return losses
+    return losses, p
 
 
 class TestSeqParallelLM:
@@ -106,7 +106,7 @@ class TestSeqParallelLM:
         sequences (predict next = current) drive loss well below the
         uniform baseline. (Exactness of the sharded attention itself is
         covered by the parity and gradient tests.)"""
-        losses = run_copy_training(mesh8, params, cfg, steps=60)
+        losses, _ = run_copy_training(mesh8, params, cfg, steps=60)
         baseline = np.log(cfg.vocab)
         assert losses[-1] < 0.3 * baseline, (losses[0], losses[-1], baseline)
 
@@ -118,7 +118,7 @@ class TestSeqParallelLM:
             vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
             attention="ring_flash",
         )
-        losses = run_copy_training(mesh8, params, cfg_f, steps=30)
+        losses, _ = run_copy_training(mesh8, params, cfg_f, steps=30)
         assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
 
     def test_lm_zigzag_forward_matches_ring_permuted(self, mesh8, cfg, params):
@@ -157,7 +157,7 @@ class TestSeqParallelLM:
         with pytest.raises(ValueError, match="NATURAL token order"):
             lm_loss(params, np.zeros((1, 64), np.int32), cfg_z, mesh8, "data")
 
-        losses = run_copy_training(mesh8, params, cfg_z, steps=30, zigzag=True)
+        losses, _ = run_copy_training(mesh8, params, cfg_z, steps=30, zigzag=True)
         assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
 
     def test_zigzag_train_step_factory(self, mesh8, params):
@@ -198,6 +198,50 @@ class TestSeqParallelLM:
         t1 = periodic_tokens(rng, 2, 64, cfg.vocab)
         l_seq = float(lm_loss(params, shard_tokens(t1, mesh8), cfg, mesh8))
         assert np.isfinite(l_seq) and l_seq > 0
+
+
+class TestGenerate:
+    def test_decode_logits_match_full_forward(self, mesh8, cfg, params):
+        """KV-cached decode must produce the SAME next-token logits as
+        the full (training) forward pass, position by position."""
+        from parameter_server_tpu.models.transformer import lm_generate
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, cfg.vocab, (2, 16)).astype(np.int32)
+        _, dec_logits = lm_generate(
+            params, tokens, cfg, steps=0, return_logits=True
+        )
+        mesh1 = meshlib.make_mesh(num_data=1, num_server=1)
+        full = lm_forward(
+            params, shard_tokens(tokens, mesh1), cfg, mesh1, "data"
+        )
+        np.testing.assert_allclose(
+            np.asarray(dec_logits), np.asarray(full)[:, :-1], atol=2e-4,
+            rtol=1e-4,
+        )
+
+    def test_greedy_decode_continues_copy_task(self, mesh8, cfg, params):
+        """After copy-task training, greedy decoding from a constant
+        prompt must emit the same constant."""
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        losses, p = run_copy_training(mesh8, params, cfg, steps=60)
+        assert losses[-1] < 0.5, losses[-1]
+        prompt = np.full((2, 8), 7, np.int32)
+        out = np.asarray(lm_generate(p, prompt, cfg, steps=12))
+        assert out.shape == (2, 20)
+        assert (out[:, 8:] == 7).all(), out
+
+    def test_generate_rejects_moe(self, params):
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        cfg_m = LMConfig(
+            vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            moe_every=2,
+        )
+        with pytest.raises(ValueError, match="dense FFN"):
+            lm_generate(params, np.zeros((1, 4), np.int32), cfg_m, steps=1)
 
 
 class TestAttentionModes:
