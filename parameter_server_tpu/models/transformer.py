@@ -49,6 +49,16 @@ class LMConfig:
     moe_every: int = 0
     n_experts: int = 8
     capacity_factor: float = 2.0
+    # rematerialize each decoder layer in the backward pass
+    # (jax.checkpoint): activations are recomputed instead of stored, so
+    # training memory drops from O(layers * S) activations to O(S) +
+    # per-layer recompute — THE long-context memory lever alongside
+    # sequence parallelism. Gradients are numerically identical up to
+    # compiler reassociation of the recomputed ops.
+    remat: bool = False
+    # "bfloat16" runs decoder activations in bf16 (MXU-native): params
+    # and the softmax/logits stay float32, attention accumulates f32
+    compute_dtype: str = "float32"
 
     def __post_init__(self):
         if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
@@ -57,6 +67,11 @@ class LMConfig:
                 f"'ring_zigzag' or 'a2a', got {self.attention!r} — all "
                 "are exact, so a silent fallback would hide the "
                 "memory/collective profile choice"
+            )
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"LMConfig.compute_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.compute_dtype!r}"
             )
 
 
@@ -99,6 +114,13 @@ def _ln(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
 
 
+def _layer_params(params: Dict[str, jax.Array], i: int) -> Dict[str, jax.Array]:
+    """The i-th decoder layer's parameter sub-dict (explicit argument so
+    jax.checkpoint sees them as inputs and differentiates through)."""
+    pre = f"l{i}/"
+    return {k[len(pre):]: v for k, v in params.items() if k.startswith(pre)}
+
+
 def lm_forward(
     params: Dict[str, jax.Array],
     tokens: jax.Array,  # [B, S] int32, S sharded over `axis`
@@ -106,15 +128,18 @@ def lm_forward(
     mesh: Mesh,
     axis: str = "data",
 ) -> jax.Array:
-    """Logits [B, S, vocab]."""
+    """Logits [B, S, vocab] (always float32; decoder activations run in
+    ``cfg.compute_dtype``, rematerialized per layer when ``cfg.remat``)."""
     b, s = tokens.shape
     hd = cfg.d_model // cfg.n_heads
-    x = params["emb"][tokens] * np.sqrt(cfg.d_model)
-    for i in range(cfg.n_layers):
-        h = _ln(x, params[f"l{i}/ln1"])
-        q = h @ params[f"l{i}/wq"]
-        k = h @ params[f"l{i}/wk"]
-        v = h @ params[f"l{i}/wv"]
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+
+    def layer(x, lp, is_moe):
+        cast = lambda k: lp[k].astype(dtype)  # noqa: E731
+        h = _ln(x, cast("ln1"))
+        q = h @ cast("wq")
+        k = h @ cast("wk")
+        v = h @ cast("wv")
 
         def heads(t):  # [B, S, d] -> [B*nh, S, hd]
             t = t.reshape(b, s, cfg.n_heads, hd)
@@ -139,47 +164,69 @@ def lm_forward(
                 .transpose(0, 2, 1, 3)
                 .reshape(b, s, cfg.d_model)
             )
-        x = x + att @ params[f"l{i}/wo"]
-        h2 = _ln(x, params[f"l{i}/ln2"])
-        if _is_moe_layer(cfg, i):
+        x = x + att.astype(dtype) @ cast("wo")
+        h2 = _ln(x, cast("ln2"))
+        if is_moe:
             moe_p = {
-                "router": params[f"l{i}/moe_router"],
-                "w_in": params[f"l{i}/moe_w_in"],
-                "w_out": params[f"l{i}/moe_w_out"],
+                "router": lp["moe_router"],
+                "w_in": lp["moe_w_in"],
+                "w_out": lp["moe_w_out"],
             }
+            # MoE routing (top-1 argmax + capacity bookkeeping) stays in
+            # the params' dtype — f32 — for stable expert selection
             x = x + moe_ffn(
-                moe_p, h2, mesh=mesh, axis=axis,
+                moe_p, h2.astype(jnp.float32), mesh=mesh, axis=axis,
                 capacity_factor=cfg.capacity_factor,
-            )
+            ).astype(dtype)
         else:
-            x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
-    return _ln(x, params["ln_f"]) @ params["emb"].T
+            x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
+        return x
+
+    if cfg.remat:
+        layer = jax.checkpoint(layer, static_argnums=(2,))
+
+    x = (params["emb"][tokens] * np.sqrt(cfg.d_model)).astype(dtype)
+    for i in range(cfg.n_layers):
+        x = layer(x, _layer_params(params, i), _is_moe_layer(cfg, i))
+    x32 = x.astype(jnp.float32)
+    return _ln(x32, params["ln_f"]) @ params["emb"].T
 
 
 def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     """One KV-cached decoder step. tok [B]; caches [L, B, nh, T, hd];
-    pos scalar int32. Returns (logits [B, vocab], new caches)."""
+    pos scalar int32. Returns (logits [B, vocab], new caches). Runs in
+    ``cfg.compute_dtype`` like the training forward (softmax and logits
+    in f32), so decode matches training numerics dtype for dtype."""
     b = tok.shape[0]
     nh = cfg.n_heads
     hd = cfg.d_model // nh
     t_max = kcache.shape[3]
-    x = params["emb"][tok] * np.sqrt(cfg.d_model)  # [B, d]
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    x = (params["emb"][tok] * np.sqrt(cfg.d_model)).astype(dtype)  # [B, d]
     mask = (jnp.arange(t_max) <= pos)[None, None, :]  # [1, 1, T]
     for i in range(cfg.n_layers):
-        h = _ln(x, params[f"l{i}/ln1"])
-        q = (h @ params[f"l{i}/wq"]).reshape(b, nh, hd)
-        k = (h @ params[f"l{i}/wk"]).reshape(b, nh, hd)
-        v = (h @ params[f"l{i}/wv"]).reshape(b, nh, hd)
-        kcache = kcache.at[i, :, :, pos].set(k)
-        vcache = vcache.at[i, :, :, pos].set(v)
-        s = jnp.einsum("bnd,bntd->bnt", q, kcache[i]) / np.sqrt(hd)
+        cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
+        h = _ln(x, cast("ln1"))
+        q = (h @ cast("wq")).reshape(b, nh, hd)
+        k = (h @ cast("wk")).reshape(b, nh, hd)
+        v = (h @ cast("wv")).reshape(b, nh, hd)
+        kcache = kcache.at[i, :, :, pos].set(k.astype(kcache.dtype))
+        vcache = vcache.at[i, :, :, pos].set(v.astype(vcache.dtype))
+        s = jnp.einsum(
+            "bnd,bntd->bnt", q.astype(jnp.float32), kcache[i]
+        ) / np.sqrt(hd)
         s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        att = jnp.einsum("bnt,bntd->bnd", p, vcache[i]).reshape(b, cfg.d_model)
-        x = x + att @ params[f"l{i}/wo"]
-        h2 = _ln(x, params[f"l{i}/ln2"])
-        x = x + jax.nn.gelu(h2 @ params[f"l{i}/w1"]) @ params[f"l{i}/w2"]
-    return _ln(x, params["ln_f"]) @ params["emb"].T, kcache, vcache
+        att = (
+            jnp.einsum("bnt,bntd->bnd", p, vcache[i])
+            .reshape(b, cfg.d_model)
+            .astype(dtype)
+        )
+        x = x + att @ cast("wo")
+        h2 = _ln(x, cast("ln2"))
+        x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
+    x32 = x.astype(jnp.float32)
+    return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "steps", "return_logits"))

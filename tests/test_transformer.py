@@ -1,6 +1,8 @@
 """Sequence-parallel transformer LM: forward parity across mesh layouts,
 training signal, and cross-shard loss shift (models/transformer.py)."""
 
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
@@ -200,6 +202,54 @@ class TestSeqParallelLM:
         assert np.isfinite(l_seq) and l_seq > 0
 
 
+class TestMemoryAndPrecision:
+    def test_remat_gradients_match_exactly(self, mesh8, cfg, params):
+        """jax.checkpoint trades recompute for memory; the gradients must
+        be numerically identical (same program, re-run)."""
+        cfg_r = dataclasses.replace(cfg, remat=True)
+        rng = np.random.default_rng(7)
+        tokens = shard_tokens(
+            rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32), mesh8
+        )
+        g0 = jax.grad(lm_loss)(params, tokens, cfg, mesh8, "data")
+        g1 = jax.grad(lm_loss)(params, tokens, cfg_r, mesh8, "data")
+        for k in g0:
+            np.testing.assert_allclose(
+                np.asarray(g0[k]), np.asarray(g1[k]), atol=1e-6, rtol=1e-6,
+                err_msg=k,
+            )
+
+    def test_bf16_forward_close_and_trains(self, mesh8, cfg, params):
+        cfg_b = dataclasses.replace(cfg, compute_dtype="bfloat16")
+        rng = np.random.default_rng(8)
+        tokens = rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32)
+        f32 = np.asarray(
+            lm_forward(params, shard_tokens(tokens, mesh8), cfg, mesh8, "data")
+        )
+        bf16 = np.asarray(
+            lm_forward(
+                params, shard_tokens(tokens, mesh8), cfg_b, mesh8, "data"
+            )
+        )
+        assert bf16.dtype == np.float32  # logits always f32
+        # bf16 mantissa is 8 bits: loose but bounded agreement
+        assert np.max(np.abs(f32 - bf16)) < 0.05, np.max(np.abs(f32 - bf16))
+        losses, _ = run_copy_training(mesh8, params, cfg_b, steps=30)
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+    def test_remat_composes_with_flash_and_bf16(self, mesh8, params):
+        cfg_all = LMConfig(
+            vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            attention="ring_flash", remat=True, compute_dtype="bfloat16",
+        )
+        losses, _ = run_copy_training(mesh8, params, cfg_all, steps=30)
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+    def test_bad_compute_dtype_rejected(self):
+        with pytest.raises(ValueError, match="compute_dtype"):
+            LMConfig(compute_dtype="float16")
+
+
 class TestGenerate:
     def test_decode_logits_match_full_forward(self, mesh8, cfg, params):
         """KV-cached decode must produce the SAME next-token logits as
@@ -232,6 +282,24 @@ class TestGenerate:
         out = np.asarray(lm_generate(p, prompt, cfg, steps=12))
         assert out.shape == (2, 20)
         assert (out[:, 8:] == 7).all(), out
+
+    def test_decode_honors_bf16(self, mesh8, cfg, params):
+        """Decode runs in cfg.compute_dtype too: bf16 decode logits must
+        track the bf16 training forward within bf16 tolerance."""
+        from parameter_server_tpu.models.transformer import lm_generate
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        cfg_b = dataclasses.replace(cfg, compute_dtype="bfloat16")
+        rng = np.random.default_rng(6)
+        tokens = rng.integers(0, cfg.vocab, (2, 16)).astype(np.int32)
+        _, dec = lm_generate(params, tokens, cfg_b, steps=0, return_logits=True)
+        mesh1 = meshlib.make_mesh(num_data=1, num_server=1)
+        full = lm_forward(
+            params, shard_tokens(tokens, mesh1), cfg_b, mesh1, "data"
+        )
+        assert np.max(
+            np.abs(np.asarray(dec) - np.asarray(full)[:, :-1])
+        ) < 0.05
 
     def test_generate_rejects_moe(self, params):
         from parameter_server_tpu.models.transformer import lm_generate
