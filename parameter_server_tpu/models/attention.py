@@ -50,7 +50,9 @@ def _ring_hops(k, v, axis: str, n: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis", "causal", "impl", "use_pallas", "interpret"),
+    static_argnames=(
+        "mesh", "axis", "causal", "impl", "use_pallas", "interpret", "window",
+    ),
 )
 def ring_attention(
     q: jax.Array,
@@ -63,6 +65,7 @@ def ring_attention(
     impl: str = "xla",
     use_pallas=None,
     interpret=None,
+    window=None,
 ) -> jax.Array:
     """Exact attention with S sharded over ``axis``. q,k,v: [B, S, H].
 
@@ -78,18 +81,23 @@ def ring_attention(
     if impl == "flash":
         return _ring_attention_flash(
             q, k, v, mesh=mesh, axis=axis, causal=causal,
-            use_pallas=use_pallas, interpret=interpret,
+            use_pallas=use_pallas, interpret=interpret, window=window,
         )
     if impl == "zigzag":
         return _ring_attention_zigzag(
             q, k, v, mesh=mesh, axis=axis, causal=causal,
-            use_pallas=use_pallas, interpret=interpret,
+            use_pallas=use_pallas, interpret=interpret, window=window,
         )
     if impl != "xla":
         raise ValueError(
             f"ring_attention impl must be 'xla', 'flash' or 'zigzag', got "
             f"{impl!r} — all are exact, so a silent fallback would hide "
             "the memory profile choice"
+        )
+    if window is not None:
+        raise ValueError(
+            "window (sliding-window attention) is implemented by the "
+            "flash kernels — use impl='flash' or 'zigzag'"
         )
     if use_pallas is not None or interpret is not None:
         raise ValueError(
@@ -146,7 +154,8 @@ def _merge_chunk(out, lse, out_i, lse_i):
     return out, new_lse
 
 
-def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas, interpret):
+def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas,
+                          interpret, window=None):
     """Ring schedule with the Pallas flash kernel as the chunk compute.
 
     Each hop produces a NORMALIZED chunk output plus its logsumexp; two
@@ -167,6 +176,7 @@ def _ring_attention_flash(q, k, v, *, mesh, axis, causal, use_pallas, interpret)
                 q, kb, vb, causal=causal,
                 q_offset=my * s_loc, k_offset=src * s_loc,
                 use_pallas=use_pallas, interpret=interpret, with_lse=True,
+                window=window,
             )
             out, lse = _merge_chunk(out, lse, out_i, lse_i)
         return out.astype(q.dtype)
@@ -200,7 +210,8 @@ def zigzag_permutation(seq_len: int, n: int) -> np.ndarray:
     return np.concatenate(blocks)
 
 
-def _ring_attention_zigzag(q, k, v, *, mesh, axis, causal, use_pallas, interpret):
+def _ring_attention_zigzag(q, k, v, *, mesh, axis, causal, use_pallas,
+                           interpret, window=None):
     """Ring attention over ZIGZAG-sharded inputs (see
     :func:`zigzag_permutation` — inputs/outputs are in the permuted
     layout). Each device holds two half-blocks with different global
@@ -236,7 +247,7 @@ def _ring_attention_zigzag(q, k, v, *, mesh, axis, causal, use_pallas, interpret
                         causal=causal,
                         q_offset=q_offs[qi], k_offset=kv_offs[ki],
                         use_pallas=use_pallas, interpret=interpret,
-                        with_lse=True,
+                        with_lse=True, window=window,
                     )
                     outs[qi], lses[qi] = _merge_chunk(
                         outs[qi], lses[qi], out_i, lse_i

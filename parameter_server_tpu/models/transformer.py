@@ -59,6 +59,11 @@ class LMConfig:
     # "bfloat16" runs decoder activations in bf16 (MXU-native): params
     # and the softmax/logits stay float32, attention accumulates f32
     compute_dtype: str = "float32"
+    # sliding-window (local) attention span: each position attends to
+    # the `window` most recent tokens only. Implemented by the flash
+    # kernels (out-of-window blocks are skipped — O(window)/query), so
+    # it requires a flash attention mode; None = full causal attention
+    window: "int | None" = None
 
     def __post_init__(self):
         if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
@@ -73,6 +78,16 @@ class LMConfig:
                 f"LMConfig.compute_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.compute_dtype!r}"
             )
+        if self.window is not None:
+            if self.attention not in ("ring_flash", "ring_zigzag"):
+                raise ValueError(
+                    "LMConfig.window (sliding-window attention) needs a "
+                    "flash attention mode ('ring_flash' or 'ring_zigzag')"
+                )
+            if self.window < 1:
+                raise ValueError(
+                    f"LMConfig.window must be >= 1, got {self.window}"
+                )
 
 
 def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
@@ -157,7 +172,7 @@ def lm_forward(
             }[cfg.attention]
             att = ring_attention(
                 heads(q), heads(k), heads(v), mesh=mesh, axis=axis,
-                causal=True, impl=impl,
+                causal=True, impl=impl, window=cfg.window,
             )
             att = (
                 att.reshape(b, cfg.n_heads, s, hd)
@@ -203,7 +218,11 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     t_max = kcache.shape[3]
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     x = (params["emb"][tok] * np.sqrt(cfg.d_model)).astype(dtype)  # [B, d]
-    mask = (jnp.arange(t_max) <= pos)[None, None, :]  # [1, 1, T]
+    t_range = jnp.arange(t_max)
+    keep = t_range <= pos
+    if cfg.window is not None:  # sliding window, mirroring lm_forward
+        keep &= (pos - t_range) < cfg.window
+    mask = keep[None, None, :]  # [1, 1, T]
     for i in range(cfg.n_layers):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))

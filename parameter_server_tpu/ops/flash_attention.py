@@ -60,13 +60,14 @@ def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-def flash_attention_ref(q, k, v, q_offset, k_offset, *, causal):
+def flash_attention_ref(q, k, v, q_offset, k_offset, *, causal, window=None):
     """[BH, Sq, D] x [BH, Sk, D] -> (out [BH, Sq, D], lse [BH, Sq]).
 
     lse is the base-e logsumexp of the masked score rows; fully-masked
     rows return out=0 and lse=_NEG (the merge weight then underflows to
-    zero exactly like the kernel path).
-    """
+    zero exactly like the kernel path). ``window`` (with causal) keeps
+    only keys with 0 <= q_pos - k_pos < window (sliding-window/local
+    attention)."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum(
         "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -74,7 +75,10 @@ def flash_attention_ref(q, k, v, q_offset, k_offset, *, causal):
     if causal:
         qp = q_offset + jnp.arange(q.shape[1])
         kp = k_offset + jnp.arange(k.shape[1])
-        s = jnp.where((qp[:, None] >= kp[None, :])[None], s, _NEG)
+        keep = qp[:, None] >= kp[None, :]
+        if window is not None:
+            keep &= (qp[:, None] - kp[None, :]) < window
+        s = jnp.where(keep[None], s, _NEG)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     p = jnp.where(s <= _NEG / 2, 0.0, p)
@@ -93,6 +97,7 @@ def flash_attention_ref(q, k, v, q_offset, k_offset, *, causal):
 def _fwd_kernel(
     qo_ref, ko_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
     acc_ref, m_ref, l_ref, *, causal, scale, nk, k_len, block_q, block_k,
+    window,
 ):
     ik = pl.program_id(2)
 
@@ -109,12 +114,7 @@ def _fwd_kernel(
         jnp.int32, (1, block_q), 1
     )
     k_base = ik * block_k
-    # causal block skip: the whole block is masked when even the LAST q
-    # row precedes the FIRST k row of the block
-    if causal:
-        live = q_off + iq * block_q + block_q - 1 >= k_off + k_base
-    else:
-        live = True
+    live = _block_live(q_off, iq, block_q, k_off, k_base, block_k, causal, window)
 
     @pl.when(live)
     def _update():
@@ -128,6 +128,8 @@ def _fwd_kernel(
         valid = k_pos < k_len  # tail padding of the K axis
         if causal:
             valid = valid & (k_off + k_pos <= q_pos)
+            if window is not None:
+                valid = valid & (q_pos - (k_off + k_pos) < window)
         s_t = jnp.where(valid, s_t, _NEG)
         m_prev = m_ref[...]  # [1, bq]
         m_cur = jnp.max(s_t, axis=0, keepdims=True)
@@ -158,14 +160,32 @@ def _fwd_kernel(
 # ---------------------------------------------------------------------------
 
 
-def _recompute_pt(q, k, lse_blk, *, causal, scale, q_pos, k_pos, k_len):
-    """Shared bwd score recomputation: p_t [bk, bq] from saved lse."""
+def _block_live(q_off, iq, block_q, k_off, k_base, block_k, causal, window):
+    """Whole-block skip predicate, shared by the forward and BOTH backward
+    kernels so the bound can never diverge between them: a block is dead
+    when (causal) even the LAST q row precedes the FIRST k row, or
+    (window) even the FIRST q row is past the LAST k row's window."""
+    if not causal:
+        return True
+    live = q_off + iq * block_q + block_q - 1 >= k_off + k_base
+    if window is not None:
+        live &= q_off + iq * block_q - (k_off + k_base + block_k - 1) < window
+    return live
+
+
+def _recompute_pt(q, k, lse_blk, *, causal, scale, q_pos, k_pos, k_len,
+                  window=None):
+    """Shared bwd score recomputation: p_t [bk, bq] from saved lse.
+    ``q_pos`` arrives with k_offset already subtracted, so the window
+    test is directly q_pos - k_pos."""
     s_t = jax.lax.dot_general(
         k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     valid = k_pos < k_len
     if causal:
         valid = valid & (k_pos + 0 <= q_pos)
+        if window is not None:
+            valid = valid & (q_pos - k_pos < window)
     # exp(s - lse): rows with lse=_NEG (fully masked) still produce 0
     # because s itself is masked to _NEG there as well
     s_t = jnp.where(valid, s_t, _NEG)
@@ -175,7 +195,7 @@ def _recompute_pt(q, k, lse_blk, *, causal, scale, q_pos, k_pos, k_len):
 
 def _bwd_dq_kernel(
     qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, c_ref, dq_ref,
-    acc_ref, *, causal, scale, nk, k_len, block_q, block_k,
+    acc_ref, *, causal, scale, nk, k_len, block_q, block_k, window,
 ):
     ik = pl.program_id(2)
 
@@ -186,10 +206,9 @@ def _bwd_dq_kernel(
     iq = pl.program_id(1)
     q_off = qo_ref[0, 0]
     k_off = ko_ref[0, 0]
-    if causal:
-        live = q_off + iq * block_q + block_q - 1 >= k_off + ik * block_k
-    else:
-        live = True
+    live = _block_live(
+        q_off, iq, block_q, k_off, ik * block_k, block_k, causal, window
+    )
 
     @pl.when(live)
     def _update():
@@ -205,7 +224,7 @@ def _bwd_dq_kernel(
         )
         p_t = _recompute_pt(
             q, k, lse_ref[...], causal=causal, scale=scale,
-            q_pos=q_pos, k_pos=k_pos, k_len=k_len,
+            q_pos=q_pos, k_pos=k_pos, k_len=k_len, window=window,
         )
         dp_t = jax.lax.dot_general(  # [bk, bq] = v . do^T
             v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -224,7 +243,7 @@ def _bwd_dq_kernel(
 def _bwd_dkv_kernel(
     qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, c_ref,
     dk_ref, dv_ref, dk_acc, dv_acc, *, causal, scale, nq, k_len,
-    block_q, block_k,
+    block_q, block_k, window,
 ):
     iq = pl.program_id(2)  # q innermost here
 
@@ -236,10 +255,9 @@ def _bwd_dkv_kernel(
     ik = pl.program_id(1)
     q_off = qo_ref[0, 0]
     k_off = ko_ref[0, 0]
-    if causal:
-        live = q_off + iq * block_q + block_q - 1 >= k_off + ik * block_k
-    else:
-        live = True
+    live = _block_live(
+        q_off, iq, block_q, k_off, ik * block_k, block_k, causal, window
+    )
 
     @pl.when(live)
     def _update():
@@ -255,7 +273,7 @@ def _bwd_dkv_kernel(
         )
         p_t = _recompute_pt(
             q, k, lse_ref[...], causal=causal, scale=scale,
-            q_pos=q_pos, k_pos=k_pos, k_len=k_len,
+            q_pos=q_pos, k_pos=k_pos, k_len=k_len, window=window,
         )
         dv_acc[...] += jax.lax.dot_general(  # [bk, D] += p_t . do
             p_t, do, (((1,), (0,)), ((), ())),
@@ -295,7 +313,7 @@ def _blocks(sq: int, sk: int, block_q: int, block_k: int):
 
 
 def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
-                interpret):
+                interpret, window):
     bh, sq, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
@@ -311,7 +329,7 @@ def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
     out_t, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, causal=causal, scale=scale, nk=nk, k_len=sk,
-            block_q=bq, block_k=bk,
+            block_q=bq, block_k=bk, window=window,
         ),
         grid=(bh, nq, nk),
         in_specs=[
@@ -341,7 +359,7 @@ def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
 
 
 def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
-                block_q, block_k, interpret):
+                block_q, block_k, interpret, window):
     bh, sq, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
@@ -370,7 +388,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
     dq_t = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, scale=scale, nk=nk, k_len=sk,
-            block_q=bq, block_k=bk,
+            block_q=bq, block_k=bk, window=window,
         ),
         grid=(bh, nq, nk),
         in_specs=[smem, smem, qspec, kspec, kspec, qspec, vec_q, vec_q],
@@ -386,7 +404,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale, nq=nq, k_len=sk,
-            block_q=bq, block_k=bk,
+            block_q=bq, block_k=bk, window=window,
         ),
         grid=(bh, nk, nq),
         in_specs=[smem, smem, qspec2, kspec2, kspec2, qspec2, vec_q2, vec_q2],
@@ -414,28 +432,32 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
 def _flash(q, k, v, q_offset, k_offset, causal, block_q, block_k,
-           use_pallas, interpret):
+           use_pallas, interpret, window):
     if use_pallas:
         return _fwd_pallas(
             q, k, v, q_offset, k_offset, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
-    return flash_attention_ref(q, k, v, q_offset, k_offset, causal=causal)
+    return flash_attention_ref(
+        q, k, v, q_offset, k_offset, causal=causal, window=window
+    )
 
 
 def _flash_fwd(q, k, v, q_offset, k_offset, causal, block_q, block_k,
-               use_pallas, interpret):
+               use_pallas, interpret, window):
     out, lse = _flash(
         q, k, v, q_offset, k_offset, causal, block_q, block_k,
-        use_pallas, interpret,
+        use_pallas, interpret, window,
     )
     return (out, lse), (q, k, v, out, lse, q_offset, k_offset)
 
 
-def _flash_bwd(causal, block_q, block_k, use_pallas, interpret, res, ct):
+def _flash_bwd(causal, block_q, block_k, use_pallas, interpret, window,
+               res, ct):
     q, k, v, out, lse, q_offset, k_offset = res
     do, dlse = ct
     do32 = do.astype(jnp.float32)
@@ -449,6 +471,7 @@ def _flash_bwd(causal, block_q, block_k, use_pallas, interpret, res, ct):
         dq, dk, dv = _bwd_pallas(
             q, k, v, do, lse, c, q_offset, k_offset, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
     else:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -458,7 +481,10 @@ def _flash_bwd(causal, block_q, block_k, use_pallas, interpret, res, ct):
         if causal:
             qp_ = q_offset + jnp.arange(q.shape[1])
             kp_ = k_offset + jnp.arange(k.shape[1])
-            s = jnp.where((qp_[:, None] >= kp_[None, :])[None], s, _NEG)
+            keep = qp_[:, None] >= kp_[None, :]
+            if window is not None:
+                keep &= (qp_[:, None] - kp_[None, :]) < window
+            s = jnp.where(keep[None], s, _NEG)
         p = jnp.exp(s - lse[..., None])
         p = jnp.where(s <= _NEG / 2, 0.0, p)
         dp = jnp.einsum("bqd,bkd->bqk", do32, v.astype(jnp.float32))
@@ -488,14 +514,25 @@ def flash_attention(
     use_pallas: Optional[bool] = None,
     interpret: Optional[bool] = None,
     with_lse: bool = False,
+    window: Optional[int] = None,
 ):
     """Blockwise exact attention over [BH, S, D] head-major arrays.
 
     ``q_offset``/``k_offset`` are the GLOBAL sequence positions of row 0
     (traced values allowed — ring attention passes ``axis_index``-derived
     offsets), so causal masking is correct on sequence-sharded chunks.
+    ``window`` (requires causal) restricts each query to the ``window``
+    most recent keys (0 <= q_pos - k_pos < window — sliding-window /
+    local attention); out-of-window BLOCKS are skipped entirely, so
+    compute per query is O(window), not O(S).
     Returns ``out`` or ``(out, lse)`` — lse is what chunk-merging needs.
     """
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding window)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
     if use_pallas is None:
         use_pallas = _use_pallas() and pl is not None
     if interpret is None:
@@ -504,7 +541,7 @@ def flash_attention(
     k_offset = jnp.asarray(k_offset, jnp.int32)
     out, lse = _flash(
         q, k, v, q_offset, k_offset, causal, block_q, block_k,
-        bool(use_pallas), bool(interpret),
+        bool(use_pallas), bool(interpret), window,
     )
     return (out, lse) if with_lse else out
 
@@ -520,6 +557,7 @@ def flash_mha(
     k_offset=0,
     use_pallas: Optional[bool] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Multi-head wrapper: [B, S, H] with H = n_heads * dh, like dense_mha."""
     b, sq, h = x_q.shape
@@ -536,7 +574,7 @@ def flash_mha(
     out = flash_attention(
         split(x_q, sq), split(x_k, sk), split(x_v, sk),
         causal=causal, q_offset=q_offset, k_offset=k_offset,
-        use_pallas=use_pallas, interpret=interpret,
+        use_pallas=use_pallas, interpret=interpret, window=window,
     )
     return (
         out.reshape(b, n_heads, sq, dh).transpose(0, 2, 1, 3).reshape(b, sq, h)

@@ -237,6 +237,93 @@ def test_zigzag_permutation_roundtrip_and_validation():
         zigzag_permutation(50, 3)
 
 
+def dense_swa(q, k, v, window):
+    """Dense sliding-window reference: causal + (q_pos - k_pos) < window."""
+    s = jnp.einsum("bqh,bkh->bqk", q, k) / jnp.sqrt(q.shape[-1])
+    n = q.shape[1]
+    pos = jnp.arange(n)
+    keep = (pos[:, None] >= pos[None, :]) & (
+        pos[:, None] - pos[None, :] < window
+    )
+    s = jnp.where(keep[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkh->bqh", p, v)
+
+
+@pytest.mark.parametrize("window", [1, 16, 100])
+def test_sliding_window_kernel_matches_dense(window):
+    bh, s, d = 2, 200, 48  # unaligned: exercises padding + block skip
+    q, k, v = _rand((bh, s, d), 1), _rand((bh, s, d), 2), _rand((bh, s, d), 3)
+    want = dense_swa(q, k, v, window)
+    for up in (False, True):
+        got = flash_attention(
+            q, k, v, causal=True, window=window, use_pallas=up, interpret=up
+        )
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_sliding_window_gradients():
+    bh, s, d = 2, 136, 32
+    q, k, v = _rand((bh, s, d), 1), _rand((bh, s, d), 2), _rand((bh, s, d), 3)
+    w = _rand((bh, s, d), 4)
+
+    def make_loss(up):
+        def loss(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=True, window=24, use_pallas=up, interpret=up
+            )
+            return jnp.sum(out * w)
+
+        return jax.grad(loss, argnums=(0, 1, 2))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_swa(q, k, v, 24) * w)
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for up in (False, True):
+        for a, b in zip(make_loss(up)(q, k, v), gd):
+            np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["flash", "zigzag"])
+def test_sliding_window_on_ring(impl):
+    from parameter_server_tpu.models.attention import zigzag_permutation
+
+    mesh = make_mesh(num_data=4, num_server=1)
+    b, s, h, window = 2, 128, 32, 40
+    q, k, v = _rand((b, s, h), 1), _rand((b, s, h), 2), _rand((b, s, h), 3)
+    want = np.asarray(dense_swa(q, k, v, window))
+    if impl == "zigzag":
+        perm = zigzag_permutation(s, 4)
+        got = np.asarray(
+            ring_attention(
+                q[:, perm], k[:, perm], v[:, perm], mesh=mesh, axis="data",
+                causal=True, impl="zigzag", window=window,
+            )
+        )[:, np.argsort(perm)]
+    else:
+        got = np.asarray(
+            ring_attention(
+                q, k, v, mesh=mesh, axis="data", causal=True, impl="flash",
+                window=window,
+            )
+        )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_window_validation():
+    x = _rand((1, 16, 8), 0)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(x, x, x, causal=False, window=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(x, x, x, causal=True, window=0)
+    mesh = make_mesh(num_data=2, num_server=1)
+    with pytest.raises(ValueError, match="flash"):
+        ring_attention(
+            x, x, x, mesh=mesh, axis="data", causal=True, window=4
+        )
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_flash_matches_dense(causal):
     from parameter_server_tpu.models.attention import ulysses_attention

@@ -301,6 +301,37 @@ class TestGenerate:
             np.abs(np.asarray(dec) - np.asarray(full)[:, :-1])
         ) < 0.05
 
+    def test_sliding_window_lm_decode_matches_forward(self, mesh8, params):
+        """LMConfig.window: the windowed forward and the windowed decode
+        must agree logit-for-logit (each masks its own way)."""
+        from parameter_server_tpu.models.transformer import lm_generate
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        cfg_w = LMConfig(
+            vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            attention="ring_flash", window=5,
+        )
+        rng = np.random.default_rng(9)
+        tokens = rng.integers(0, 32, (2, 16)).astype(np.int32)
+        _, dec = lm_generate(params, tokens, cfg_w, steps=0, return_logits=True)
+        mesh1 = meshlib.make_mesh(num_data=1, num_server=1)
+        full = lm_forward(
+            params, shard_tokens(tokens, mesh1), cfg_w, mesh1, "data"
+        )
+        np.testing.assert_allclose(
+            np.asarray(dec), np.asarray(full)[:, :-1], atol=2e-4, rtol=1e-4
+        )
+        # and the window genuinely changes the function vs full causal
+        cfg_f = dataclasses.replace(cfg_w, window=None)
+        full_nc = lm_forward(
+            params, shard_tokens(tokens, mesh1), cfg_f, mesh1, "data"
+        )
+        assert np.max(np.abs(np.asarray(full) - np.asarray(full_nc))) > 1e-3
+
+    def test_window_requires_flash_mode(self):
+        with pytest.raises(ValueError, match="flash"):
+            LMConfig(window=8)  # default attention="ring"
+
     def test_generate_rejects_moe(self, params):
         from parameter_server_tpu.models.transformer import lm_generate
 
