@@ -248,22 +248,47 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "return_logits"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "return_logits", "top_k"),
+)
 def lm_generate(
     params: Dict[str, jax.Array],
     prompt: jax.Array,  # [B, P] int32
     cfg: LMConfig,
     steps: int,
     return_logits: bool = False,
+    temperature=None,
+    top_k: "int | None" = None,
+    key: jax.Array = None,
 ) -> jax.Array:
-    """Greedy KV-cached decoding (the serving path — single device; the
+    """KV-cached decoding (the serving path — single device; the
     sharded-mesh schedules are the TRAINING story): teacher-forces the
-    prompt through one lax.scan, then extends it ``steps`` tokens by
-    argmax. Returns [B, P+steps]. Dense FFN layers only (the reference
-    has no serving path at all; MoE decode would need token routing with
-    batch-1 capacity, out of scope)."""
+    prompt through one lax.scan, then extends it ``steps`` tokens.
+    ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
+    softmax(logits/temperature), optionally truncated to the ``top_k``
+    most likely tokens (needs ``key``). temperature is a TRACED operand
+    — sweeping it does not recompile the decode scan. Returns
+    [B, P+steps]. Dense FFN layers only (the reference has no serving
+    path at all; MoE decode would need token routing with batch-1
+    capacity, out of scope)."""
     if cfg.moe_every > 0:
         raise ValueError("lm_generate supports dense FFN layers only")
+    greedy = temperature is None or (
+        isinstance(temperature, (int, float)) and temperature == 0
+    )
+    if isinstance(temperature, (int, float)) and temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not greedy and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by the greedy path
+    if greedy:
+        temperature = 1.0  # dead operand on the greedy trace
     b, p_len = prompt.shape
     total = p_len + steps
     nh, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
@@ -273,22 +298,32 @@ def lm_generate(
         [prompt.astype(jnp.int32), jnp.zeros((b, steps), jnp.int32)], axis=1
     )
 
+    def pick(logits, k_step):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        z = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(z, axis=-1)[:, -top_k][:, None]
+            z = jnp.where(z >= kth, z, -jnp.inf)
+        return jax.random.categorical(k_step, z, axis=-1).astype(jnp.int32)
+
     def body(carry, pos):
-        toks, kcache, vcache = carry
+        toks, kcache, vcache, key = carry
+        key, k_step = jax.random.split(key)
         tok = jax.lax.dynamic_index_in_dim(toks, pos, axis=1, keepdims=False)
         logits, kcache, vcache = _decode_step(
             params, cfg, tok, kcache, vcache, pos
         )
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = pick(logits, k_step)
         # within the prompt: keep the given token (teacher forcing);
-        # past it: write the greedy continuation
+        # past it: write the continuation
         cur = jax.lax.dynamic_index_in_dim(toks, pos + 1, 1, keepdims=False)
         write = jnp.where(pos + 1 < p_len, cur, nxt)
         toks = jax.lax.dynamic_update_index_in_dim(toks, write, pos + 1, axis=1)
-        return (toks, kcache, vcache), logits
+        return (toks, kcache, vcache, key), logits
 
-    (toks, _, _), logits = jax.lax.scan(
-        body, (toks, kcache, vcache), jnp.arange(total - 1)
+    (toks, _, _, _), logits = jax.lax.scan(
+        body, (toks, kcache, vcache, key), jnp.arange(total - 1)
     )
     if return_logits:
         # [T-1, B, vocab] -> [B, T-1, vocab]: logits[t] predicts token

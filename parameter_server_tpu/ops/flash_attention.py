@@ -558,21 +558,38 @@ def flash_mha(
     use_pallas: Optional[bool] = None,
     interpret: Optional[bool] = None,
     window: Optional[int] = None,
+    n_kv_heads: Optional[int] = None,
 ) -> jax.Array:
-    """Multi-head wrapper: [B, S, H] with H = n_heads * dh, like dense_mha."""
+    """Multi-head wrapper: [B, S, H] with H = n_heads * dh, like dense_mha.
+
+    ``n_kv_heads`` (grouped-query attention): K/V carry only that many
+    heads (``x_k``/``x_v`` are [B, S, n_kv_heads * dh]) and each K/V head
+    serves ``n_heads // n_kv_heads`` query heads — the KV-cache/bandwidth
+    reduction of GQA/MQA (n_kv_heads=1). The kernel itself is unchanged:
+    K/V heads are broadcast to the query-head grouping at the wrapper."""
     b, sq, h = x_q.shape
     sk = x_k.shape[1]
     dh = h // n_heads
+    kvh = n_kv_heads if n_kv_heads is not None else n_heads
+    if n_heads % kvh:
+        raise ValueError(f"n_heads={n_heads} must divide by n_kv_heads={kvh}")
 
-    def split(x, s):
+    def split(x, s, nh):
         return (
-            x.reshape(b, s, n_heads, dh)
+            x.reshape(b, s, nh, dh)
             .transpose(0, 2, 1, 3)
-            .reshape(b * n_heads, s, dh)
+            .reshape(b * nh, s, dh)
         )
 
+    def expand_kv(x):  # [B*kvh, S, dh] -> [B*n_heads, S, dh] (group repeat)
+        x = x.reshape(b, kvh, sk, dh)
+        x = jnp.repeat(x, n_heads // kvh, axis=1)
+        return x.reshape(b * n_heads, sk, dh)
+
     out = flash_attention(
-        split(x_q, sq), split(x_k, sk), split(x_v, sk),
+        split(x_q, sq, n_heads),
+        expand_kv(split(x_k, sk, kvh)),
+        expand_kv(split(x_v, sk, kvh)),
         causal=causal, q_offset=q_offset, k_offset=k_offset,
         use_pallas=use_pallas, interpret=interpret, window=window,
     )

@@ -324,6 +324,35 @@ def test_window_validation():
         )
 
 
+@pytest.mark.parametrize("n_kv_heads", [1, 2])
+def test_gqa_matches_expanded_dense(n_kv_heads):
+    # grouped-query attention == dense MHA with the K/V heads repeated
+    b, s, nh, dh = 2, 64, 4, 16
+    q = _rand((b, s, nh * dh), 1)
+    k = _rand((b, s, n_kv_heads * dh), 2)
+    v = _rand((b, s, n_kv_heads * dh), 3)
+    got = flash_mha(
+        q, k, v, nh, causal=True, n_kv_heads=n_kv_heads,
+        use_pallas=True, interpret=True,
+    )
+    # expand kv to full heads for the dense reference
+    rep = nh // n_kv_heads
+
+    def expand(x):
+        x = x.reshape(b, s, n_kv_heads, dh)
+        return np.repeat(np.asarray(x), rep, axis=2).reshape(b, s, nh * dh)
+
+    want = dense_mha(q, jnp.asarray(expand(k)), jnp.asarray(expand(v)),
+                     nh, causal=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+def test_gqa_rejects_nondivisible():
+    x = _rand((1, 16, 12), 0)
+    with pytest.raises(ValueError, match="divide"):
+        flash_mha(x, x, x, 4, n_kv_heads=3)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_flash_matches_dense(causal):
     from parameter_server_tpu.models.attention import ulysses_attention

@@ -332,6 +332,61 @@ class TestGenerate:
         with pytest.raises(ValueError, match="flash"):
             LMConfig(window=8)  # default attention="ring"
 
+    def test_sampling_modes(self, cfg, params):
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        prompt = np.asarray([[3, 1, 4, 1]], np.int32)
+        greedy = np.asarray(lm_generate(params, prompt, cfg, steps=6))
+        # top_k=1 sampling == greedy regardless of temperature/seed
+        topk1 = np.asarray(
+            lm_generate(
+                params, prompt, cfg, steps=6, temperature=2.0, top_k=1,
+                key=jax.random.PRNGKey(42),
+            )
+        )
+        np.testing.assert_array_equal(topk1, greedy)
+        # sampling: valid tokens, deterministic per seed
+        s1 = np.asarray(
+            lm_generate(
+                params, prompt, cfg, steps=6, temperature=1.0,
+                key=jax.random.PRNGKey(7),
+            )
+        )
+        s2 = np.asarray(
+            lm_generate(
+                params, prompt, cfg, steps=6, temperature=1.0,
+                key=jax.random.PRNGKey(7),
+            )
+        )
+        np.testing.assert_array_equal(s1, s2)
+        assert ((s1 >= 0) & (s1 < cfg.vocab)).all()
+        with pytest.raises(ValueError, match="PRNG key"):
+            lm_generate(params, prompt, cfg, steps=2, temperature=1.0)
+        with pytest.raises(ValueError, match="top_k"):
+            lm_generate(
+                params, prompt, cfg, steps=2, temperature=1.0, top_k=0,
+                key=jax.random.PRNGKey(0),
+            )
+
+    def test_top_k_truncation_restricts_support(self, cfg, params):
+        """top_k=3 samples must land in each step's 3 most likely tokens
+        (high temperature flattens the kept mass so an off-by-one in the
+        threshold would escape the set almost surely over many seeds)."""
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        prompt = np.asarray([[3, 1, 4, 1]], np.int32)
+        k = 3
+        for seed in range(8):
+            out, logits = lm_generate(
+                params, prompt, cfg, steps=8, temperature=50.0, top_k=k,
+                key=jax.random.PRNGKey(seed), return_logits=True,
+            )
+            out, logits = np.asarray(out), np.asarray(logits)
+            p_len = prompt.shape[1]
+            for t in range(p_len - 1, out.shape[1] - 1):
+                allowed = np.argsort(logits[0, t])[-k:]
+                assert out[0, t + 1] in allowed, (t, out[0, t + 1], allowed)
+
     def test_generate_rejects_moe(self, params):
         from parameter_server_tpu.models.transformer import lm_generate
 
