@@ -1,0 +1,1 @@
+"""Sequence-parallel language-model app (training + generation CLI)."""

@@ -293,7 +293,8 @@ def dense_mha(q, k, v, n_heads: int, causal: bool = False):
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "mesh", "axis", "n_heads", "causal", "impl", "use_pallas", "interpret",
+        "mesh", "axis", "n_heads", "causal", "impl", "use_pallas",
+        "interpret", "window",
     ),
 )
 def ulysses_attention(
@@ -308,6 +309,7 @@ def ulysses_attention(
     impl: str = "xla",
     use_pallas=None,
     interpret=None,
+    window=None,
 ) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: the
     complement of :func:`ring_attention` for long sequences.
@@ -334,6 +336,11 @@ def ulysses_attention(
             "use_pallas/interpret only apply to impl='flash'; the xla "
             "impl would silently ignore them"
         )
+    if window is not None and impl != "flash":
+        raise ValueError(
+            "window (sliding-window attention) is implemented by the "
+            "flash kernel — use impl='flash'"
+        )
 
     def local(q, k, v):
         b, s_loc, h = q.shape
@@ -358,7 +365,7 @@ def ulysses_attention(
 
             out = flash_attention(
                 to_bh(qh), to_bh(kh), to_bh(vh), causal=causal,
-                use_pallas=use_pallas, interpret=interpret,
+                use_pallas=use_pallas, interpret=interpret, window=window,
             )
             out = out.reshape(b, nh_loc, s_full, dh).transpose(0, 2, 1, 3)
         else:

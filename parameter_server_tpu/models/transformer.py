@@ -248,10 +248,6 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "steps", "return_logits", "top_k"),
-)
 def lm_generate(
     params: Dict[str, jax.Array],
     prompt: jax.Array,  # [B, P] int32
@@ -267,28 +263,53 @@ def lm_generate(
     prompt through one lax.scan, then extends it ``steps`` tokens.
     ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
     softmax(logits/temperature), optionally truncated to the ``top_k``
-    most likely tokens (needs ``key``). temperature is a TRACED operand
-    — sweeping it does not recompile the decode scan. Returns
-    [B, P+steps]. Dense FFN layers only (the reference has no serving
-    path at all; MoE decode would need token routing with batch-1
-    capacity, out of scope)."""
+    most likely tokens (needs ``key``). A non-zero temperature is a
+    TRACED operand of the jitted core — sweeping it does not recompile
+    the decode scan. Returns [B, P+steps]. Dense FFN layers only (the
+    reference has no serving path at all; MoE decode would need token
+    routing with batch-1 capacity, out of scope).
+
+    This wrapper is EAGER on purpose: argument validation (greedy
+    detection, sign/range checks) needs concrete Python values, which a
+    jitted body never sees — the heavy lifting lives in the jitted core
+    below."""
     if cfg.moe_every > 0:
         raise ValueError("lm_generate supports dense FFN layers only")
-    greedy = temperature is None or (
-        isinstance(temperature, (int, float)) and temperature == 0
-    )
-    if isinstance(temperature, (int, float)) and temperature < 0:
+    concrete = isinstance(temperature, (int, float))
+    greedy = temperature is None or (concrete and temperature == 0)
+    if concrete and temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if not greedy and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
-    if top_k is not None and not 1 <= top_k <= cfg.vocab:
-        raise ValueError(
-            f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}"
-        )
+    if top_k is not None:
+        if greedy:
+            raise ValueError(
+                "top_k requires sampling — pass temperature > 0 (greedy "
+                "argmax would silently ignore the truncation)"
+            )
+        if not 1 <= top_k <= cfg.vocab:
+            raise ValueError(
+                f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}"
+            )
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by the greedy path
     if greedy:
         temperature = 1.0  # dead operand on the greedy trace
+    return _lm_generate_jit(
+        params, prompt, jnp.asarray(temperature, jnp.float32), key,
+        cfg=cfg, steps=steps, return_logits=return_logits, top_k=top_k,
+        greedy=greedy,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "return_logits", "top_k", "greedy"),
+)
+def _lm_generate_jit(
+    params, prompt, temperature, key, *, cfg, steps, return_logits, top_k,
+    greedy,
+):
     b, p_len = prompt.shape
     total = p_len + steps
     nh, hd = cfg.n_heads, cfg.d_model // cfg.n_heads

@@ -393,6 +393,39 @@ def test_ulysses_flash_gradients_match_dense():
         np.testing.assert_allclose(a, b_, atol=5e-5, rtol=1e-4)
 
 
+def test_ulysses_flash_sliding_window():
+    from parameter_server_tpu.models.attention import ulysses_attention
+
+    mesh = make_mesh(num_data=2, num_server=1)
+    b, s, nh, h, window = 1, 64, 2, 16, 12
+    q, k, v = _rand((b, s, h), 1), _rand((b, s, h), 2), _rand((b, s, h), 3)
+    got = ulysses_attention(
+        q, k, v, mesh=mesh, axis="data", n_heads=nh, causal=True,
+        impl="flash", window=window,
+    )
+    # dense SWA per head
+    dh = h // nh
+    qh = np.asarray(q).reshape(b, s, nh, dh)
+    kh = np.asarray(k).reshape(b, s, nh, dh)
+    vh = np.asarray(v).reshape(b, s, nh, dh)
+    want = np.zeros_like(qh)
+    for hh in range(nh):
+        want[:, :, hh] = np.asarray(
+            dense_swa(
+                jnp.asarray(qh[:, :, hh]), jnp.asarray(kh[:, :, hh]),
+                jnp.asarray(vh[:, :, hh]), window,
+            )
+        )
+    np.testing.assert_allclose(
+        got, want.reshape(b, s, h), atol=2e-5, rtol=1e-5
+    )
+    with pytest.raises(ValueError, match="flash"):
+        ulysses_attention(
+            q, k, v, mesh=mesh, axis="data", n_heads=nh, causal=True,
+            window=window,
+        )
+
+
 def test_ulysses_rejects_bad_impl_and_stray_flags():
     from parameter_server_tpu.models.attention import ulysses_attention
 
