@@ -61,6 +61,13 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--report-every", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory (enables save/resume)")
+    ap.add_argument("--save-every", type=int, default=0,
+                    help="checkpoint every N steps (ref "
+                    "save_model_every_n_iter; needs --ckpt-dir)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the latest checkpoint in --ckpt-dir")
     ap.add_argument("--prompt", default=None,
                     help="generate after training from this text")
     ap.add_argument("--gen-tokens", type=int, default=64)
@@ -98,12 +105,49 @@ def main(argv=None) -> int:
     zig = args.attention == "ring_zigzag"
     if args.seq_len % (2 * n_dev if zig else n_dev):
         ap.error(f"--seq-len must divide by {2 * n_dev if zig else n_dev}")
+    if args.attention == "a2a" and args.n_heads % n_dev:
+        ap.error(
+            f"--attention a2a needs --n-heads divisible by the "
+            f"{n_dev}-device mesh axis (got {args.n_heads})"
+        )
+    # fail flag mistakes BEFORE the training loop, not after it
+    if args.temperature < 0:
+        ap.error(f"--temperature must be >= 0, got {args.temperature}")
+    if args.top_k is not None and args.temperature == 0:
+        ap.error("--top-k requires --temperature > 0 (sampling)")
 
     rng = np.random.default_rng(args.seed)
     corpus = _load_corpus(args.data, rng)
+    if corpus.size <= args.seq_len + 1:
+        ap.error(
+            f"corpus has {corpus.size} bytes but --seq-len {args.seq_len} "
+            "needs at least seq_len+2"
+        )
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
     tx = optax.adam(args.lr)
     opt = tx.init(params)
+
+    mgr = None
+    start_step = 0
+    if args.ckpt_dir:
+        from ...parameter.replica import CheckpointManager
+
+        mgr = CheckpointManager(args.ckpt_dir)
+        if args.resume:
+            latest = mgr.latest_step()
+            if latest is not None:
+                tree = mgr.restore(
+                    latest, like={"params": params, "opt": opt}
+                )
+                # host (uncommitted) arrays: restore pins leaves to one
+                # device, which clashes with the mesh-sharded tokens at
+                # the next jit; numpy leaves let jit re-place them
+                params = jax.tree.map(np.asarray, tree["params"])
+                opt = jax.tree.map(np.asarray, tree["opt"])
+                start_step = latest
+                print(f"resumed from step {latest}", flush=True)
+    elif args.save_every or args.resume:
+        ap.error("--save-every/--resume need --ckpt-dir")
 
     def sample_tokens():
         starts = rng.integers(0, corpus.size - args.seq_len - 1, args.batch)
@@ -132,7 +176,7 @@ def main(argv=None) -> int:
     print(f"devices={n_dev} attention={cfg.attention} "
           f"corpus={corpus.size} bytes")
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
-    for i in range(1, args.steps + 1):
+    for i in range(start_step + 1, args.steps + 1):
         toks = sample_tokens()
         if zig:
             tz, gz, wz = zigzag_lm_arrays(toks, n_dev)
@@ -145,6 +189,13 @@ def main(argv=None) -> int:
         if i % args.report_every == 0 or i == args.steps:
             ll = float(loss)
             print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}", flush=True)
+        if mgr is not None and (
+            i == args.steps
+            or (args.save_every and i % args.save_every == 0)
+        ):
+            # --ckpt-dir always saves the final step, so a later --resume
+            # has something to find even without --save-every
+            mgr.save(i, {"params": params, "opt": opt})
 
     if args.prompt is not None:
         if args.moe_every:

@@ -61,6 +61,27 @@ class CheckpointManager:
         if self._orbax is not None:
             ckptr = self._orbax.PyTreeCheckpointer()
             out = ckptr.restore(path)
+            if like is not None:
+                # orbax returns PLAIN containers (namedtuples come back
+                # as dicts keyed by field name); rebuild the template's
+                # structure from the leaves. Dict flatten order is sorted
+                # keys on both sides; for namedtuples this assumes field
+                # order == sorted order (true for optax's states — a
+                # custom node violating it should carry its own
+                # serialization)
+                loaded = jax.tree.leaves(out)
+                want = jax.tree.leaves(like)
+                if len(loaded) != len(want):
+                    raise ValueError(
+                        f"checkpoint at {path} has {len(loaded)} arrays "
+                        f"but the template expects {len(want)} — saved "
+                        "with a different model/optimizer config?"
+                    )
+                # leaf SHAPES are deliberately not compared: restoring
+                # onto a different server count legitimately changes the
+                # padded table shapes (the reshard path; callers like
+                # load_state_host re-fit rows afterwards)
+                out = jax.tree.unflatten(jax.tree.structure(like), loaded)
         else:
             data = np.load(os.path.join(path, "arrays.npz"))
             arrays = [data[k] for k in data.files if k != "__treedef__"]

@@ -53,6 +53,57 @@ def test_lm_cli_corpus_file(mesh8, capsys, tmp_path):
     assert losses[-1] < 0.7 * losses[0], losses
 
 
+def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path):
+    """Save, resume, and TRAIN ON (restored leaves must re-place onto
+    the sharded mesh — ref save_model_every_n_iter parity)."""
+    ck = str(tmp_path / "ck")
+    run_cli(capsys, "--ckpt-dir", ck)  # saves the final step (30)
+    rc = main(
+        [
+            "--steps", "40", "--seq-len", "64", "--batch", "4",
+            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+            "--report-every", "5", "--ckpt-dir", ck, "--resume",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resumed from step 30" in out
+    rows = [
+        line.split() for line in out.splitlines()
+        if line and line.split()[0].isdigit()
+    ]
+    # trains exactly the REMAINING steps (35, 40 reported)
+    assert [int(r[0]) for r in rows] == [35, 40], rows
+
+
+def test_lm_cli_a2a_mode(mesh8, capsys):
+    # a2a needs n_heads divisible by the 8-device axis
+    out, losses = run_cli(capsys, "--attention", "a2a", "--n-heads", "8")
+    assert losses[-1] < losses[0], losses
+
+
+def test_lm_cli_flag_mistakes_fail_fast(mesh8):
+    base = ["--steps", "5", "--seq-len", "64", "--batch", "2"]
+    with pytest.raises(SystemExit):  # a2a heads not divisible by devices
+        main([*base, "--attention", "a2a", "--n-heads", "2"])
+    with pytest.raises(SystemExit):  # top_k without sampling
+        main([*base, "--top-k", "3"])
+    with pytest.raises(SystemExit):  # negative temperature
+        main([*base, "--temperature", "-1"])
+
+
+def test_lm_cli_tiny_corpus_rejected(mesh8, tmp_path):
+    f = tmp_path / "tiny.txt"
+    f.write_bytes(b"x" * 32)
+    with pytest.raises(SystemExit):
+        main(["--steps", "2", "--seq-len", "64", "--data", str(f)])
+
+
+def test_lm_cli_save_needs_dir(mesh8):
+    with pytest.raises(SystemExit):
+        main(["--save-every", "5"])
+
+
 def test_lm_cli_rejects_bad_seq_len(mesh8):
     with pytest.raises(SystemExit):
         main(["--seq-len", "65"])  # not divisible by the 8-device axis
