@@ -4,7 +4,8 @@ file (or a built-in synthetic corpus) and generate from it:
     python -m parameter_server_tpu.apps.lm.main \
         [--data FILE] [--steps N] [--seq-len S] [--batch B] \
         [--attention ring|ring_flash|ring_zigzag|a2a] [--window W] \
-        [--remat] [--bf16] [--moe-every K] \
+        [--remat] [--bf16] [--moe-every K] [--num-servers T] \
+        [--ckpt-dir DIR] [--save-every N] [--resume] \
         [--prompt "text"] [--gen-tokens N] [--temperature T] [--top-k K]
 
 The model family's end-to-end surface, like apps/linear (conf CLI) and
@@ -58,6 +59,10 @@ def main(argv=None) -> int:
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 decoder activations")
     ap.add_argument("--moe-every", type=int, default=0)
+    ap.add_argument("--num-servers", type=int, default=1,
+                    help="tensor-parallel axis size: LM weights Megatron-"
+                    "split over a 'server' mesh axis (sp x tp on one 2-D "
+                    "mesh); must divide the device count")
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--report-every", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
@@ -88,13 +93,20 @@ def main(argv=None) -> int:
         lm_generate,
         lm_loss,
         lm_loss_with_targets,
+        shard_lm_params,
         shard_tokens,
         zigzag_lm_arrays,
     )
     from ...parallel import mesh as meshlib
 
     n_dev = len(jax.devices())
-    mesh = meshlib.make_mesh(num_data=n_dev, num_server=1)
+    if args.num_servers < 1 or n_dev % args.num_servers:
+        ap.error(
+            f"--num-servers {args.num_servers} must divide the device "
+            f"count ({n_dev})"
+        )
+    n_data = n_dev // args.num_servers
+    mesh = meshlib.make_mesh(num_data=n_data, num_server=args.num_servers)
     cfg = LMConfig(
         vocab=256, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, attention=args.attention,
@@ -103,18 +115,21 @@ def main(argv=None) -> int:
         moe_every=args.moe_every,
     )
     zig = args.attention == "ring_zigzag"
-    if args.seq_len % (2 * n_dev if zig else n_dev):
-        ap.error(f"--seq-len must divide by {2 * n_dev if zig else n_dev}")
-    if args.attention == "a2a" and args.n_heads % n_dev:
+    if args.seq_len % (2 * n_data if zig else n_data):
+        ap.error(f"--seq-len must divide by {2 * n_data if zig else n_data}")
+    if args.attention == "a2a" and args.n_heads % n_data:
         ap.error(
             f"--attention a2a needs --n-heads divisible by the "
-            f"{n_dev}-device mesh axis (got {args.n_heads})"
+            f"{n_data}-device data axis (got {args.n_heads})"
         )
     # fail flag mistakes BEFORE the training loop, not after it
     if args.temperature < 0:
         ap.error(f"--temperature must be >= 0, got {args.temperature}")
-    if args.top_k is not None and args.temperature == 0:
-        ap.error("--top-k requires --temperature > 0 (sampling)")
+    if args.top_k is not None:
+        if args.temperature == 0:
+            ap.error("--top-k requires --temperature > 0 (sampling)")
+        if not 1 <= args.top_k <= 256:
+            ap.error(f"--top-k must be in [1, 256], got {args.top_k}")
 
     rng = np.random.default_rng(args.seed)
     corpus = _load_corpus(args.data, rng)
@@ -123,9 +138,30 @@ def main(argv=None) -> int:
             f"corpus has {corpus.size} bytes but --seq-len {args.seq_len} "
             "needs at least seq_len+2"
         )
+    from jax.sharding import NamedSharding, PartitionSpec
+
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
+    if args.num_servers > 1:
+        # Megatron column/row placement; GSPMD inserts the psums and the
+        # adam update preserves the sharding
+        params = shard_lm_params(params, mesh, "server")
+    else:
+        # explicitly REPLICATED over the mesh (not an uncommitted
+        # single-device default): checkpoint restore places leaves onto
+        # the template's sharding, so the template must carry the real
+        # training placement or a resumed run would train mis-placed
+        params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
     tx = optax.adam(args.lr)
-    opt = tx.init(params)
+    opt = tx.init(params)  # zeros_like inherits each param's placement
+    # ...but freshly-created leaves (adam's step count) don't — pin any
+    # non-mesh-placed leaf replicated so the restore template is fully
+    # mesh-committed
+    opt = jax.tree.map(
+        lambda x: x
+        if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, NamedSharding(mesh, PartitionSpec())),
+        opt,
+    )
 
     mgr = None
     start_step = 0
@@ -139,11 +175,10 @@ def main(argv=None) -> int:
                 tree = mgr.restore(
                     latest, like={"params": params, "opt": opt}
                 )
-                # host (uncommitted) arrays: restore pins leaves to one
-                # device, which clashes with the mesh-sharded tokens at
-                # the next jit; numpy leaves let jit re-place them
-                params = jax.tree.map(np.asarray, tree["params"])
-                opt = jax.tree.map(np.asarray, tree["opt"])
+                # restore device_puts every leaf onto the template's
+                # sharding — which carries the real training placement
+                # (replicated, or Megatron-split under --num-servers)
+                params, opt = tree["params"], tree["opt"]
                 start_step = latest
                 print(f"resumed from step {latest}", flush=True)
     elif args.save_every or args.resume:
@@ -173,13 +208,13 @@ def main(argv=None) -> int:
             up, opt = tx.update(g, opt, p)
             return optax.apply_updates(p, up), opt, loss
 
-    print(f"devices={n_dev} attention={cfg.attention} "
-          f"corpus={corpus.size} bytes")
+    print(f"devices={n_dev} (data={n_data} x server={args.num_servers}) "
+          f"attention={cfg.attention} corpus={corpus.size} bytes")
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
     for i in range(start_step + 1, args.steps + 1):
         toks = sample_tokens()
         if zig:
-            tz, gz, wz = zigzag_lm_arrays(toks, n_dev)
+            tz, gz, wz = zigzag_lm_arrays(toks, n_data)
             params, opt, loss = step(
                 params, opt, shard_tokens(tz, mesh), shard_tokens(gz, mesh),
                 shard_tokens(wz, mesh),

@@ -76,6 +76,40 @@ def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path):
     assert [int(r[0]) for r in rows] == [35, 40], rows
 
 
+def test_lm_cli_tensor_parallel(mesh8, capsys):
+    # sp x tp on one 2-D mesh: 4 data x 2 server, flash attention
+    out, losses = run_cli(
+        capsys, "--num-servers", "2", "--attention", "ring_flash"
+    )
+    assert losses[-1] < losses[0], losses
+    assert "data=4 x server=2" in out
+    with pytest.raises(SystemExit):  # 3 does not divide 8
+        main(["--steps", "2", "--seq-len", "64", "--num-servers", "3"])
+
+
+def test_lm_cli_tensor_parallel_resume(mesh8, capsys, tmp_path):
+    """Resume under --num-servers must keep training (restore lands the
+    leaves on the template's Megatron placement, not one device)."""
+    ck = str(tmp_path / "ck")
+    run_cli(capsys, "--num-servers", "2", "--ckpt-dir", ck)
+    rc = main(
+        [
+            "--steps", "40", "--seq-len", "64", "--batch", "4",
+            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+            "--report-every", "5", "--num-servers", "2",
+            "--ckpt-dir", ck, "--resume",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resumed from step 30" in out
+    rows = [
+        line.split() for line in out.splitlines()
+        if line and line.split()[0].isdigit()
+    ]
+    assert [int(r[0]) for r in rows] == [35, 40], rows
+
+
 def test_lm_cli_a2a_mode(mesh8, capsys):
     # a2a needs n_heads divisible by the 8-device axis
     out, losses = run_cli(capsys, "--attention", "a2a", "--n-heads", "8")
