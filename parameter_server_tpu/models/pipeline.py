@@ -1,11 +1,13 @@
 """Pipeline parallelism: stage-sharded layers, microbatched fill-drain.
 
 The remaining parallelism mode (pp) beside dp / table-model / sp / ep:
-a deep stack of identical blocks is sharded over a mesh axis — device s
-holds stage s's parameters — and microbatches stream through the
-pipeline with activations hopping stage-to-stage over ``ppermute``
-(GPipe fill-drain schedule: M microbatches finish in M + n - 1 ticks,
-every tick running ALL stages in parallel on different microbatches).
+a deep stack of identical blocks is sharded over a mesh axis — device d
+holds a contiguous BLOCK of k = n_stages/n stages (k = 1 being one
+stage per device) — and microbatches stream through the pipeline with
+activations hopping device-to-device over ``ppermute`` (GPipe
+fill-drain schedule: M microbatches finish in M + n - 1 ticks, every
+tick running all DEVICES in parallel on different microbatches, each
+chaining its local stage block).
 
 Everything is a single jitted program: the schedule is a ``lax.scan``
 over ticks, stage selection is mask arithmetic (no data-dependent
@@ -33,24 +35,30 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "data",
 ):
-    """Run ``x`` through n pipeline stages sharded over ``axis``.
+    """Run ``x`` through the pipeline stages sharded over ``axis``.
 
-    ``stage_params``: pytree whose leaves have leading dim n (one slice
-    per stage), sharded over ``axis``. ``x``: [M, mb, ...] microbatches,
-    replicated. ``stage_fn(params_slice, x_mb) -> y_mb`` applies one
-    stage. Returns [M, mb, ...] outputs, replicated.
+    ``stage_params``: pytree whose leaves have leading dim n_stages (one
+    slice per stage), sharded over ``axis``; n_stages may be any MULTIPLE
+    of the axis size — device d holds the contiguous block of k =
+    n_stages/n stages starting at d*k and chains it per tick. ``x``:
+    [M, mb, ...] microbatches, replicated. ``stage_fn(params_slice,
+    x_mb) -> y_mb`` applies one stage. Returns [M, mb, ...] outputs,
+    replicated.
     """
     n = mesh.shape[axis]
     n_stages = jax.tree.leaves(stage_params)[0].shape[0]
-    assert n_stages == n, (
-        f"stage count {n_stages} must equal mesh axis {axis}={n} — a "
-        "multiple would silently shard several stages onto one device "
-        "and apply only the first"
-    )
+    if n_stages % n:
+        raise ValueError(
+            f"stage count {n_stages} must be a MULTIPLE of mesh axis "
+            f"{axis}={n} (each device holds one contiguous stage block)"
+        )
+    k = n_stages // n  # stages chained locally per device per tick
 
     def local(params, x):
-        # params leaves arrive as [1, ...] (this stage's slice)
-        p_local = jax.tree.map(lambda l: l[0], params)
+        # params leaves arrive as [k, ...] (this device's stage block);
+        # a tick runs the whole block in sequence — same fill-drain
+        # bubble as one-stage-per-device (the (n-1)-tick ramp just costs
+        # k stage-times per tick), so deep stacks need no extra devices
         m = x.shape[0]
         stage = jax.lax.axis_index(axis)
         is_first = stage == 0
@@ -62,8 +70,9 @@ def pipeline_apply(
             # stage 0 ingests microbatch t (while valid); others use the
             # activation handed over from the previous tick's ppermute
             feed = x[jnp.minimum(t, m - 1)]
-            inp = jnp.where(is_first, feed, held)
-            y = stage_fn(p_local, inp)
+            y = jnp.where(is_first, feed, held)
+            for j in range(k):
+                y = stage_fn(jax.tree.map(lambda l: l[j], params), y)
             # the last stage completed microbatch t - (n-1) this tick
             done_idx = jnp.maximum(t - (n - 1), 0)
             valid = is_last & (t - (n - 1) >= 0)

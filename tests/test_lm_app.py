@@ -53,16 +53,18 @@ def test_lm_cli_corpus_file(mesh8, capsys, tmp_path):
     assert losses[-1] < 0.7 * losses[0], losses
 
 
-def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path):
-    """Save, resume, and TRAIN ON (restored leaves must re-place onto
-    the sharded mesh — ref save_model_every_n_iter parity)."""
+@pytest.mark.parametrize("extra", [(), ("--num-servers", "2")])
+def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path, extra):
+    """Save, resume, and TRAIN ON (restored leaves must land on the
+    template's training placement — replicated, or Megatron-split under
+    --num-servers; ref save_model_every_n_iter parity)."""
     ck = str(tmp_path / "ck")
-    run_cli(capsys, "--ckpt-dir", ck)  # saves the final step (30)
+    run_cli(capsys, "--ckpt-dir", ck, *extra)  # saves the final step (30)
     rc = main(
         [
             "--steps", "40", "--seq-len", "64", "--batch", "4",
             "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
-            "--report-every", "5", "--ckpt-dir", ck, "--resume",
+            "--report-every", "5", "--ckpt-dir", ck, "--resume", *extra,
         ]
     )
     assert rc == 0
@@ -85,29 +87,6 @@ def test_lm_cli_tensor_parallel(mesh8, capsys):
     assert "data=4 x server=2" in out
     with pytest.raises(SystemExit):  # 3 does not divide 8
         main(["--steps", "2", "--seq-len", "64", "--num-servers", "3"])
-
-
-def test_lm_cli_tensor_parallel_resume(mesh8, capsys, tmp_path):
-    """Resume under --num-servers must keep training (restore lands the
-    leaves on the template's Megatron placement, not one device)."""
-    ck = str(tmp_path / "ck")
-    run_cli(capsys, "--num-servers", "2", "--ckpt-dir", ck)
-    rc = main(
-        [
-            "--steps", "40", "--seq-len", "64", "--batch", "4",
-            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
-            "--report-every", "5", "--num-servers", "2",
-            "--ckpt-dir", ck, "--resume",
-        ]
-    )
-    assert rc == 0
-    out = capsys.readouterr().out
-    assert "resumed from step 30" in out
-    rows = [
-        line.split() for line in out.splitlines()
-        if line and line.split()[0].isdigit()
-    ]
-    assert [int(r[0]) for r in rows] == [35, 40], rows
 
 
 def test_lm_cli_a2a_mode(mesh8, capsys):

@@ -335,6 +335,43 @@ class TestPipelineParallel:
                 np.asarray(gp[k]), np.asarray(gs[k]), atol=1e-4, err_msg=k
             )
 
+    @pytest.mark.parametrize("k", [2, 3])
+    def test_multiple_stages_per_device(self, mesh8, k):
+        """n_stages = k * axis: each device chains its k-stage block per
+        tick — deep stacks without more devices; fwd + grads exact."""
+        import jax as _jax
+
+        from parameter_server_tpu.models.pipeline import (
+            pipeline_apply,
+            sequential_apply,
+        )
+
+        n, d = 4 * k, 8  # mesh8 data axis = 4 devices
+        params = self._params(n, d, seed=6)
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(5, 3, d)).astype(np.float32))
+        fn = self._stage_fn()
+        out = pipeline_apply(fn, params, x, mesh=mesh8, axis="data")
+        want = sequential_apply(fn, params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-5)
+        gp = _jax.grad(
+            lambda p: jnp.sum(pipeline_apply(fn, p, x, mesh=mesh8, axis="data") ** 2)
+        )(params)
+        gs = _jax.grad(lambda p: jnp.sum(sequential_apply(fn, p, x) ** 2))(params)
+        for key in gp:
+            np.testing.assert_allclose(
+                np.asarray(gp[key]), np.asarray(gs[key]), atol=1e-4,
+                err_msg=key,
+            )
+
+    def test_non_multiple_stage_count_rejected(self, mesh8):
+        from parameter_server_tpu.models.pipeline import pipeline_apply
+
+        params = self._params(5, 8)  # 5 stages on a 4-device axis
+        x = jnp.zeros((2, 3, 8), jnp.float32)
+        with pytest.raises(ValueError, match="MULTIPLE"):
+            pipeline_apply(self._stage_fn(), params, x, mesh=mesh8, axis="data")
+
     def test_single_microbatch(self, mesh8):
         from parameter_server_tpu.models.pipeline import (
             pipeline_apply,
