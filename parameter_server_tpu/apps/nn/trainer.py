@@ -27,6 +27,7 @@ from ...models.convnet import cross_entropy
 from ...parallel import mesh as meshlib
 from ...parallel.mesh import DATA_AXIS
 from ...parameter.kv_layer import KVLayer
+from ...parameter.replica import Checkpointable
 from ...system.message import Task
 
 
@@ -49,7 +50,7 @@ class OptaxUpdater:
         return weight + updates[name]
 
 
-class NNTrainer:
+class NNTrainer(Checkpointable):
     def __init__(
         self,
         model,
@@ -129,6 +130,22 @@ class NNTrainer:
             )(params, opt_state, x, y)
 
         return step
+
+    def state_host(self) -> dict:
+        """Snapshot for checkpoint/restore AND live migration (the
+        Checkpointable/ElasticCoordinator hook pair)."""
+        return {
+            "params": self._pack(),
+            "opt": self.opt_state,
+            "steps_done": np.int64(self.steps_done),
+        }
+
+    def load_state_host(self, snap: dict) -> None:
+        self._unpack(snap["params"])
+        self.opt_state = snap["opt"]
+        self.steps_done = int(snap["steps_done"])
+
+    # checkpoint/restore: inherited from replica.Checkpointable
 
     def shard_batch(self, x: np.ndarray, y: np.ndarray):
         d = meshlib.num_workers(self.mesh)

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..data.stream_reader import StreamReader
 from ..filter.frequency import FrequencyFilter
+from ..parameter.replica import Checkpointable
 from ..system.customer import App
 from ..system.monitor import MonitorMaster, MonitorSlaver
 from ..utils.concurrent import ProducerConsumer
@@ -88,7 +89,7 @@ class ISGDScheduler(App):
         self.monitor.set_printer(self.show_progress, interval=1.0)
 
 
-class ISGDCompNode(App):
+class ISGDCompNode(App, Checkpointable):
     """ref ISGDCompNode: has a reporter to the scheduler's monitor.
 
     Also the single home of the worker-side progress plumbing shared by
@@ -160,13 +161,9 @@ class ISGDCompNode(App):
             self.collect(ts)
         return self.progress
 
-    def checkpoint(self, manager, step: int) -> str:
-        """Durably save the worker's full state via its ``state_host``
-        snapshot (a parameter.replica.CheckpointManager). Workers with
-        extra replay state (e.g. AsyncSGDWorker's seed counter) override.
-        ``state_host`` drains with pop=False, so metrics of steps in
-        flight at checkpoint time remain collectable afterwards."""
-        return manager.save(step, self.state_host())
+    # checkpoint/restore: inherited from replica.Checkpointable via the
+    # state_host/load_state_host hooks (state_host drains with
+    # pop=False, so metrics of in-flight steps remain collectable)
 
     def _prep_ell(self, batch):
         """Shared ELL prep for the embedding-table workers (FM, DeepCTR):
@@ -190,16 +187,6 @@ class ISGDCompNode(App):
             batch, self.directory, d, self._rows_pad, self.sgd.ell_lanes,
             self.num_slots,
         )
-
-    def restore(self, manager, step: Optional[int] = None) -> int:
-        """Restore from the latest (or given) checkpoint; placement goes
-        through ``load_state_host`` so every leaf lands back under its
-        proper sharding (table leaves server-sharded, dense replicated)."""
-        if step is None:
-            step = manager.latest_step()
-            assert step is not None, "no checkpoint found"
-        self.load_state_host(manager.restore(step, like=self.state_host()))
-        return step
 
 
 class MinibatchReader:

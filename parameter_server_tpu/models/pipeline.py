@@ -47,7 +47,7 @@ def pipeline_apply(
     """
     n = mesh.shape[axis]
     n_stages = jax.tree.leaves(stage_params)[0].shape[0]
-    if n_stages % n:
+    if n_stages == 0 or n_stages % n:
         raise ValueError(
             f"stage count {n_stages} must be a MULTIPLE of mesh axis "
             f"{axis}={n} (each device holds one contiguous stage block)"

@@ -23,6 +23,29 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+class Checkpointable:
+    """Mixin: durable checkpoint/restore for anything exposing the
+    ``state_host()`` / ``load_state_host(snapshot)`` hook pair (the same
+    hooks ElasticCoordinator uses for live migration). The SINGLE home
+    of the save/latest/restore-with-template flow — ISGDCompNode (and
+    through it every linear/FM/DeepCTR worker) and NNTrainer share it."""
+
+    def checkpoint(self, manager: "CheckpointManager", step: int) -> str:
+        """Durably save the full ``state_host`` snapshot. Workers with
+        extra replay state (e.g. AsyncSGDWorker's seed counter) override."""
+        return manager.save(step, self.state_host())
+
+    def restore(self, manager: "CheckpointManager", step: Optional[int] = None) -> int:
+        """Restore from the latest (or given) checkpoint; placement goes
+        through ``load_state_host`` so every leaf lands back under its
+        proper sharding."""
+        if step is None:
+            step = manager.latest_step()
+            assert step is not None, "no checkpoint found"
+        self.load_state_host(manager.restore(step, like=self.state_host()))
+        return step
+
+
 class CheckpointManager:
     """Save/restore pytrees of (possibly sharded) arrays."""
 
