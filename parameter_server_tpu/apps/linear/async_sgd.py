@@ -1608,14 +1608,8 @@ class AsyncSGDWorker(ISGDCompNode):
         self._replica_state = None
         self._seed_counter = int(snap["seed_counter"])
 
-    def checkpoint(self, manager, step: int) -> str:
-        """Durably save the full optimizer state (all server shards) plus
-        the worker's clock, via a parameter.replica.CheckpointManager."""
-        self.executor.wait_all(pop=False)  # keep in-flight metrics collectable
-        return manager.save(
-            step,
-            {"state": self.state, "seed_counter": np.int64(self._seed_counter)},
-        )
+    # checkpoint: inherited from Checkpointable — state_host already
+    # drains (pop=False) and carries the seed counter
 
     def restore(self, manager, step: Optional[int] = None) -> int:
         """Restore state from the latest (or given) checkpoint and return

@@ -132,17 +132,30 @@ class NNTrainer(Checkpointable):
         return step
 
     def state_host(self) -> dict:
-        """Snapshot for checkpoint/restore AND live migration (the
-        Checkpointable/ElasticCoordinator hook pair)."""
-        return {
-            "params": self._pack(),
-            "opt": self.opt_state,
-            "steps_done": np.int64(self.steps_done),
-        }
+        """HOST-ARRAY snapshot for checkpoint/restore and live migration
+        (the Checkpointable/ElasticCoordinator hook pair — same contract
+        as the linear/FM/DeepCTR workers: numpy out, resharded in)."""
+        return jax.tree.map(
+            np.asarray,
+            {
+                "params": self._pack(),
+                "opt": self.opt_state,
+                "steps_done": np.int64(self.steps_done),
+            },
+        )
 
     def load_state_host(self, snap: dict) -> None:
-        self._unpack(snap["params"])
-        self.opt_state = snap["opt"]
+        # params back onto the KVLayer's partition-threshold shardings;
+        # optimizer leaves as uncommitted host arrays (jit re-places them
+        # alongside the params on the next step)
+        placed = jax.tree.map(
+            lambda leaf: jax.device_put(
+                np.asarray(leaf), self.kv._sharding(np.shape(leaf))
+            ),
+            snap["params"],
+        )
+        self._unpack(placed)
+        self.opt_state = jax.tree.map(np.asarray, snap["opt"])
         self.steps_done = int(snap["steps_done"])
 
     # checkpoint/restore: inherited from replica.Checkpointable

@@ -24,15 +24,16 @@ def _to_host(tree: Any) -> Any:
 
 
 class Checkpointable:
-    """Mixin: durable checkpoint/restore for anything exposing the
-    ``state_host()`` / ``load_state_host(snapshot)`` hook pair (the same
-    hooks ElasticCoordinator uses for live migration). The SINGLE home
-    of the save/latest/restore-with-template flow — ISGDCompNode (and
-    through it every linear/FM/DeepCTR worker) and NNTrainer share it."""
+    """Durable checkpoint/restore mixin over the state_host hook pair.
+
+    Anything exposing ``state_host()`` / ``load_state_host(snapshot)``
+    (the same hooks ElasticCoordinator uses for live migration) inherits
+    the save/latest/restore-with-template flow from here — its SINGLE
+    home, shared by ISGDCompNode (and through it every linear/FM/DeepCTR
+    worker) and NNTrainer."""
 
     def checkpoint(self, manager: "CheckpointManager", step: int) -> str:
-        """Durably save the full ``state_host`` snapshot. Workers with
-        extra replay state (e.g. AsyncSGDWorker's seed counter) override."""
+        """Durably save the full ``state_host`` snapshot."""
         return manager.save(step, self.state_host())
 
     def restore(self, manager: "CheckpointManager", step: Optional[int] = None) -> int:

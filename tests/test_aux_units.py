@@ -1,0 +1,191 @@
+"""Direct unit coverage for modules previously tested only transitively:
+kv_store façade, model_evaluation, utils.concurrent, utils.resource_usage."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.system.postoffice import Postoffice
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+class TestKVStoreFacade:
+    def test_factory_returns_each_kind(self, mesh8):
+        from parameter_server_tpu.parameter.kv_layer import KVLayer
+        from parameter_server_tpu.parameter.kv_map import AddEntry, KVMap
+        from parameter_server_tpu.parameter.kv_store import kv_store
+        from parameter_server_tpu.parameter.kv_vector import KVVector
+
+        v = kv_store("vector", mesh=mesh8, k=2, num_slots=64, hashed=True)
+        assert isinstance(v, KVVector)
+        m = kv_store(
+            "map", entry=AddEntry(), mesh=mesh8, k=1, num_slots=32,
+            keys=np.array([1, 2]),
+        )
+        assert isinstance(m, KVMap)
+        l = kv_store("layer", mesh=mesh8)
+        assert isinstance(l, KVLayer)
+        with pytest.raises(ValueError, match="unknown"):
+            kv_store("tree")
+
+    def test_factory_vector_works_end_to_end(self, mesh8):
+        from parameter_server_tpu.parameter.kv_store import kv_store
+
+        kv = kv_store("vector", mesh=mesh8, k=2, num_slots=64, hashed=False)
+        keys = np.array([3, 17], dtype=np.int64)
+        kv.set_keys(0, keys)
+        vals = np.arange(4, dtype=np.float32).reshape(2, 2)
+        kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+        np.testing.assert_allclose(kv.values(0, keys), vals)
+
+
+class TestModelEvaluation:
+    def _libsvm(self, path, rows):
+        with open(path, "w") as f:
+            for y, feats in rows:
+                s = " ".join(f"{k}:{v}" for k, v in feats)
+                f.write(f"{y} {s}\n")
+
+    def test_manual_model_auc(self, tmp_path):
+        """Hand-built model + validation file: xw and AUC computed by the
+        same rules the reference's Run() uses."""
+        from parameter_server_tpu.apps.linear.config import Config, DataConfig
+        from parameter_server_tpu.apps.linear.model_evaluation import (
+            ModelEvaluation,
+        )
+
+        (tmp_path / "model_S0").write_text("1\t2.0\n3\t-1.5\n")
+        val = tmp_path / "val.libsvm"
+        # margins: row0 = 2.0 (key1), row1 = -1.5 (key3), row2 = 0.5
+        self._libsvm(
+            val,
+            [
+                (1, [(1, 1.0)]),
+                (-1, [(3, 1.0)]),
+                (1, [(1, 1.0), (3, 1.0)]),
+            ],
+        )
+        conf = Config()
+        conf.model_input = DataConfig(file=[str(tmp_path / "model_S*")])
+        conf.validation_data = DataConfig(
+            format="text", text="libsvm", file=[str(val)]
+        )
+        ev = ModelEvaluation(conf)
+        metrics = ev.run()
+        assert metrics["auc"] == 1.0  # positives strictly above the negative
+        assert metrics["accuracy"] == 1.0
+
+    def test_roundtrip_with_async_sgd_save_model(self, mesh8, tmp_path):
+        """Train -> save_model (hashed header, per-shard files) ->
+        ModelEvaluation must agree with the worker's own evaluate()."""
+        from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+        from parameter_server_tpu.apps.linear.config import (
+            Config,
+            DataConfig,
+            LearningRateConfig,
+            PenaltyConfig,
+            SGDConfig,
+        )
+        from parameter_server_tpu.apps.linear.model_evaluation import (
+            ModelEvaluation,
+        )
+        from parameter_server_tpu.utils.sparse import random_sparse
+
+        conf = Config()
+        conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+        conf.learning_rate = LearningRateConfig(
+            type="decay", alpha=0.5, beta=1.0
+        )
+        conf.async_sgd = SGDConfig(
+            algo="ftrl", minibatch=128, num_slots=256, max_delay=0
+        )
+        w = AsyncSGDWorker(conf, mesh=mesh8)
+        rng = np.random.default_rng(0)
+        for i in range(5):
+            b = random_sparse(128, 512, 4, seed=i, binary=True)
+            b.y = np.where(
+                (b.indices.reshape(128, -1) % 7 < 3).mean(1) > 0.4, 1.0, -1.0
+            ).astype(np.float32)
+            w.collect(w.process_minibatch(b))
+        val = random_sparse(200, 512, 4, seed=99, binary=True)
+        val.y = np.where(
+            (val.indices.reshape(200, -1) % 7 < 3).mean(1) > 0.4, 1.0, -1.0
+        ).astype(np.float32)
+        want = w.evaluate(val)
+
+        model = str(tmp_path / "model")
+        w.save_model(model)
+        vpath = tmp_path / "val.libsvm"
+        rows = []
+        for r in range(val.n):
+            ks = val.indices[val.indptr[r] : val.indptr[r + 1]]
+            rows.append((int(val.y[r]), [(int(k), 1) for k in ks]))
+        self._libsvm(vpath, rows)
+        conf2 = Config()
+        conf2.model_input = DataConfig(file=[model + "_S*"])
+        conf2.validation_data = DataConfig(
+            format="text", text="libsvm", file=[str(vpath)]
+        )
+        metrics = ModelEvaluation(conf2).run()
+        np.testing.assert_allclose(metrics["auc"], want["auc"], atol=1e-6)
+
+
+class TestConcurrent:
+    def test_threadsafe_queue(self):
+        from parameter_server_tpu.utils.concurrent import ThreadsafeQueue
+
+        q = ThreadsafeQueue()
+        q.push(1)
+        q.push(2)
+        assert q.wait_and_pop() == 1
+        assert q.try_pop() == 2
+        assert q.try_pop() is None
+        assert q.empty()
+
+    def test_producer_consumer_streams_in_order(self):
+        from parameter_server_tpu.utils.concurrent import ProducerConsumer
+
+        pc = ProducerConsumer(capacity=4)
+        it = iter(range(100))
+        pc.start_producer(lambda: next(it, None))
+        assert list(pc) == list(range(100))
+        # end-of-stream is sticky: later pops keep returning None
+        assert pc.pop() is None
+
+    def test_thread_pool_runs_everything(self):
+        import threading
+
+        from parameter_server_tpu.utils.concurrent import ThreadPool
+
+        done = []
+        lock = threading.Lock()
+
+        def work(i):
+            def run():
+                with lock:
+                    done.append(i)
+
+            return run
+
+        pool = ThreadPool(4)
+        for i in range(32):
+            pool.add(work(i))
+        pool.start_workers()  # blocks until all queued tasks ran
+        assert sorted(done) == list(range(32))
+
+
+class TestResourceUsage:
+    def test_sample_reads_proc(self):
+        from parameter_server_tpu.utils import resource_usage
+
+        u = resource_usage.sample()
+        assert u.rss_mb > 0
+        assert u.vm_mb >= u.rss_mb
+        # cpu percent needs a delta; a second sample must not crash
+        u2 = resource_usage.sample()
+        assert u2.rss_mb > 0
