@@ -1,14 +1,23 @@
-"""Ring attention: exact attention over sequence-sharded inputs.
+"""Sequence-parallel attention schedules over sharded inputs.
 
 Long-context support is first-class in this framework: sequences longer
 than one chip's memory are sharded over a mesh axis and attention runs
-blockwise, streaming K/V shards around the ICI ring (ppermute) while each
-device keeps a numerically-stable online-softmax accumulator (the
-flash/ring-attention recurrence). Exact — matches dense attention to float
-tolerance — with O(seq/n) memory per device.
+blockwise. Two schedules, each exact (matches dense attention to float
+tolerance):
 
-``ring_attention(q, k, v, mesh, axis)`` expects [B, S, H] arrays sharded on
-S over ``axis``; causal masking accounts for the global block offsets.
+- :func:`ring_attention` — K/V shards stream around the ICI ring
+  (ppermute) while each device keeps a numerically-stable online-softmax
+  accumulator; O(seq/n) memory per device. Chunk computes: ``impl="xla"``
+  (materialized score block), ``impl="flash"`` (Pallas kernel, O(block)
+  VMEM), ``impl="zigzag"`` (flash over the zigzag-permuted layout for
+  balanced causal work per hop — see :func:`zigzag_permutation`).
+- :func:`ulysses_attention` — all_to_all seq<->head reshard, dense (or
+  flash) per-head attention, two collectives total.
+
+Both accept ``window=`` (with the flash computes) for sliding-window
+attention. ``ring_attention(q, k, v, mesh, axis)`` expects [B, S, H]
+arrays sharded on S over ``axis``; causal masking accounts for the
+global block offsets.
 """
 
 from __future__ import annotations
