@@ -262,7 +262,83 @@ static inline const char* skip_ws(const char* p, const char* end) {
   return p;
 }
 
-// libsvm: "label idx:val idx:val ..." (ref data/text_parser.cc ParseLibsvm)
+// libsvm: "label idx:val idx:val ..." (ref data/text_parser.cc ParseLibsvm
+// + util/strtonum.h). Reference-STRICT: the label and every value must be
+// a full decimal-float token, every feature token needs ':', indices use
+// strtou64 semantics (sign wraps modulo 2^64, clamp at ULLONG_MAX) and
+// must be non-decreasing in uint64 order, and ANY malformed token drops
+// the WHOLE line (the reference returns false — no partial rows). An
+// empty value ("idx:") is 0.0 (strtof("") succeeds with 0). Deliberate
+// narrowing vs strtof, mirrored by the Python parser: hex floats / inf /
+// nan are rejected (a decimal-only grammar both paths implement
+// identically — real libsvm data never contains the exotic forms).
+
+// validate [s, e) as [+-]?(digits[.digits*]? | .digits)([eE][+-]?digits)?
+static int is_decfloat(const char* s, const char* e) {
+  if (s >= e) return 0;
+  if (*s == '+' || *s == '-') ++s;
+  int mant = 0;
+  while (s < e && *s >= '0' && *s <= '9') { ++s; mant = 1; }
+  if (s < e && *s == '.') {
+    ++s;
+    while (s < e && *s >= '0' && *s <= '9') { ++s; mant = 1; }
+  }
+  if (!mant) return 0;
+  if (s < e && (*s == 'e' || *s == 'E')) {
+    ++s;
+    if (s < e && (*s == '+' || *s == '-')) ++s;
+    int ex = 0;
+    while (s < e && *s >= '0' && *s <= '9') { ++s; ex = 1; }
+    if (!ex) return 0;
+  }
+  return s == e;
+}
+
+// parse a VALIDATED decimal-float token (bounded copy so strtod never
+// reads past the caller's buffer; tokens longer than the scratch are
+// treated as malformed — no real data has 63-char numbers)
+static int parse_decfloat(const char* s, const char* e, double* out) {
+  // fast path: plain short integers (the binary-feature ":1" case and
+  // small counts) — exact in double, no strtod call
+  if (e - s >= 1 && e - s <= 15) {
+    uint64_t acc = 0;
+    const char* q = s;
+    while (q < e && *q >= '0' && *q <= '9') acc = acc * 10 + (uint64_t)(*q++ - '0');
+    if (q == e) { *out = (double)acc; return 1; }
+  }
+  char tmp[64];
+  size_t n = (size_t)(e - s);
+  if (n == 0 || n >= sizeof(tmp) || !is_decfloat(s, e)) return 0;
+  memcpy(tmp, s, n);
+  tmp[n] = 0;
+  *out = strtod(tmp, NULL);
+  return 1;
+}
+
+// strtou64 semantics over [s, e): optional sign (negation wraps modulo
+// 2^64), clamp at ULLONG_MAX, all bytes must be consumed
+static int parse_u64_tok(const char* s, const char* e, uint64_t* out) {
+  int neg = 0;
+  if (s < e && (*s == '+' || *s == '-')) { neg = (*s == '-'); ++s; }
+  if (s >= e) return 0;
+  uint64_t v = 0;
+  int clamped = 0;
+  while (s < e) {
+    if (*s < '0' || *s > '9') return 0;
+    unsigned d = (unsigned)(*s++ - '0');
+    if (v > (0xFFFFFFFFFFFFFFFFull - d) / 10) clamped = 1;
+    v = v * 10 + d;
+  }
+  if (clamped) v = 0xFFFFFFFFFFFFFFFFull;
+  *out = neg ? (0ull - v) : v;
+  return 1;
+}
+
+static inline const char* tok_end(const char* p, const char* line_end) {
+  while (p < line_end && *p != ' ' && *p != '\t' && *p != '\r') ++p;
+  return p;
+}
+
 int64_t ps_parse_libsvm(const char* buf, int64_t len,
                         float* y, int64_t* indptr, uint64_t* indices,
                         float* values, int32_t* slots, int64_t max_rows,
@@ -274,95 +350,58 @@ int64_t ps_parse_libsvm(const char* buf, int64_t len,
   while (p < end && row < max_rows) {
     const char* line_end = (const char*)memchr(p, '\n', end - p);
     if (!line_end) line_end = end;
+    const char* next = line_end + 1;
     p = skip_ws(p, line_end);
-    if (p >= line_end) { p = line_end + 1; continue; }
-    char* q;
+    if (p >= line_end) { p = next; continue; }
+    // label: strict full token (fast path for the ubiquitous one-digit
+    // labels, identical grammar)
+    const char* te = tok_end(p, line_end);
     double label;
-    // fast path for the ubiquitous "+1"/"-1"/"0"/"1" labels
-    if ((*p == '+' || *p == '-') && p + 1 < line_end &&
-        p[1] >= '0' && p[1] <= '9' &&
-        (p + 2 >= line_end || p[2] == ' ' || p[2] == '\t')) {
-      label = (*p == '-') ? -(double)(p[1] - '0') : (double)(p[1] - '0');
-      q = (char*)p + 2;
-    } else if (*p >= '0' && *p <= '9' &&
-               (p + 1 >= line_end || p[1] == ' ' || p[1] == '\t')) {
+    if (te - p == 1 && *p >= '0' && *p <= '9') {
       label = (double)(*p - '0');
-      q = (char*)p + 1;
-    } else {
-      label = strtod(p, &q);
+    } else if (te - p == 2 && (*p == '+' || *p == '-') &&
+               p[1] >= '0' && p[1] <= '9') {
+      label = (*p == '-') ? -(double)(p[1] - '0') : (double)(p[1] - '0');
+    } else if (!parse_decfloat(p, te, &label)) {
+      p = next;  // ref: strtofloat(label) false -> drop line
+      continue;
     }
-    // q > line_end: strtod skipped the newline and took the NEXT
-    // line's number — the current line is whitespace-only garbage
-    if (q == p || q > line_end) { p = line_end + 1; continue; }
-    p = q;
+    p = te;
     int64_t row_start = nnz;
-    while (p < line_end) {
+    uint64_t last_idx = 0;
+    int ok = 1;
+    while (1) {
       p = skip_ws(p, line_end);
       if (p >= line_end) break;
-      // manual strtoull for the index: optional sign (negation wraps
-      // modulo 2^64, strtoull semantics — Python's int64 view agrees),
-      // digits with ULLONG_MAX clamping
-      const char* e1 = p;
-      int idx_neg = 0;
-      if (e1 < line_end && (*e1 == '+' || *e1 == '-')) {
-        idx_neg = (*e1 == '-');
-        ++e1;
+      te = tok_end(p, line_end);
+      const char* colon = p;
+      while (colon < te && *colon != ':') ++colon;
+      uint64_t idx;
+      if (colon >= te ||                       // no ':' in token
+          !parse_u64_tok(p, colon, &idx) ||    // bad index
+          last_idx > idx) {                    // unordered (uint64)
+        ok = 0;
+        break;
       }
-      const char* idx_digits = e1;
-      uint64_t idx = 0;
-      int idx_clamped = 0;
-      while (e1 < line_end && *e1 >= '0' && *e1 <= '9') {
-        unsigned d = (unsigned)(*e1++ - '0');
-        if (idx > (0xFFFFFFFFFFFFFFFFull - d) / 10) idx_clamped = 1;
-        idx = idx * 10 + d;
-      }
-      if (idx_clamped) idx = 0xFFFFFFFFFFFFFFFFull;
-      if (idx_neg) idx = 0ull - idx;
-      if (e1 == idx_digits || e1 >= line_end || *e1 != ':') break;
-      const char* vp = e1 + 1;
-      char* e2;
+      last_idx = idx;
       double val;
-      if (vp >= line_end || *vp == ' ' || *vp == '\t' || *vp == '\r') {
-        // empty value token ("idx:"): the reference parser defaults it
-        // to 1.0 — and an unbounded strtod here would skip the newline
-        // and steal the NEXT line's leading number
-        val = 1.0;
-        e2 = (char*)vp;
-        goto have_val;
+      if (colon + 1 == te) {
+        val = 0.0;  // ref: strtofloat("") succeeds with 0
+      } else if (!parse_decfloat(colon + 1, te, &val)) {
+        ok = 0;
+        break;
       }
-      // integer values (the binary-feature ":1" case) parse exactly
-      // without strtod as long as they fit double's integer range
-      {
-        const char* v = vp;
-        uint64_t acc = 0;
-        while (v < line_end && *v >= '0' && *v <= '9' &&
-               acc <= 0x1FFFFFFFFFFFFFull) {
-          acc = acc * 10 + (uint64_t)(*v - '0');
-          ++v;
-        }
-        int is_plain_int =
-            v > vp && acc <= 0x1FFFFFFFFFFFFFull &&
-            (v >= line_end || *v == ' ' || *v == '\t' || *v == '\r');
-        if (is_plain_int) {
-          val = (double)acc;
-          e2 = (char*)v;
-        } else {
-          val = strtod(vp, &e2);
-        }
-      }
-    have_val:
-      if (e2 == vp && !(vp >= line_end || *vp == ' ' || *vp == '\t' || *vp == '\r')) break;
-      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }  // capacity hit
+      if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
       indices[nnz] = idx;
       values[nnz] = (float)val;
       if (slots) slots[nnz] = 1;
       ++nnz;
-      p = e2;
+      p = te;
     }
+    if (!ok) { nnz = row_start; p = next; continue; }  // drop the WHOLE line
     y[row] = (float)(label <= 0 ? -1.0 : 1.0);
-    (void)row_start;
     indptr[++row] = nnz;
-    p = line_end + 1;
+    p = next;
   }
   *out_nnz = nnz;
   return row;
@@ -400,34 +439,34 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
     if (!line_end) line_end = end;
     if (p >= line_end) { p = line_end + 1; continue; }
     int64_t row_nnz_start = nnz;
-    char* q;
     double label;
-    if ((p[0] == '0' || p[0] == '1') && p + 1 < line_end && p[1] == '\t') {
+    const char* f = find_tab(p, line_end);
+    if (!f) { p = line_end + 1; continue; }
+    if (f == p + 1 && (p[0] == '0' || p[0] == '1')) {
       // the overwhelmingly common criteo case: a bare 0/1 label
       label = p[0] - '0';
-      q = (char*)p + 1;
     } else {
-      label = strtod(p, &q);
+      // ref strtofloat: leading spaces, then a full decimal-float
+      // field (same strict grammar as the libsvm paths)
+      const char* ls = p;
+      while (ls < f && *ls == ' ') ++ls;
+      if (!parse_decfloat(ls, f, &label)) { p = line_end + 1; continue; }
     }
-    const char* f = find_tab(p, line_end);
-    // q > line_end: strtod crossed the newline (tabs-only line) — drop
-    if (q == p || q > line_end || !f) { p = line_end + 1; continue; }
     p = f + 1;
     int ok = 1;
     for (int i = 0; i < 13; ++i) {  // integer count features
       f = find_tab(p, line_end);
       if (!f) { ok = 0; break; }  // ref: missing int tab drops the line
       if (f > p) {
-        // manual strtol (base 10): leading spaces + sign + digits,
-        // stopping at the first non-digit (strtol semantics for this
-        // field grammar)
+        // ref strtoi32 (strtonum.h): strtol must consume the WHOLE field
+        // (leading spaces ok, then sign + digits, nothing after — a
+        // partial parse like "4bb3f55c" SKIPS the field), the long
+        // clamps at +/-2^63-ish on overflow, and the int32 assignment
+        // truncates mod 2^32
         const char* e = p;
         while (e < f && *e == ' ') ++e;
         int neg = 0;
         if (e < f && (*e == '-' || *e == '+')) { neg = (*e == '-'); ++e; }
-        // accumulate unsigned (wrap is defined) and clamp like strtol's
-        // ERANGE semantics — a 20+-digit corrupt field must not hit
-        // signed-overflow UB
         unsigned long long acc = 0;
         int clamped = 0;
         const char* digits_start = e;
@@ -436,10 +475,11 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
           if (acc > (0x7FFFFFFFFFFFFFFFull - d) / 10) { clamped = 1; }
           acc = acc * 10 + d;
         }
-        if (e != digits_start) {
-          int64_t cnt;
-          if (clamped) cnt = neg ? (-0x7FFFFFFFFFFFFFFFll - 1) : 0x7FFFFFFFFFFFFFFFll;
-          else cnt = neg ? -(int64_t)acc : (int64_t)acc;
+        if (e != digits_start && e == f) {
+          int64_t cnt64;
+          if (clamped) cnt64 = neg ? (-0x7FFFFFFFFFFFFFFFll - 1) : 0x7FFFFFFFFFFFFFFFll;
+          else cnt64 = neg ? -(int64_t)acc : (int64_t)acc;
+          int64_t cnt = (int64_t)(int32_t)(uint32_t)(uint64_t)cnt64;
           if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
           indices[nnz] = kStripe * (uint64_t)i + (uint64_t)cnt;
           values[nnz] = 1.0f;

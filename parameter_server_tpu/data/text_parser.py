@@ -11,6 +11,7 @@ ps_parse_*) handles the two hot formats; NumPy/Python fallbacks cover all.
 
 from __future__ import annotations
 
+import re
 import ctypes
 from typing import List, Optional
 
@@ -59,27 +60,78 @@ def _batch_from_rows(
     )
 
 
+_DECFLOAT_RE = re.compile(r"[+-]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][+-]?\d+)?\Z")
+# the C++ fast path parses numeric tokens via a 64-byte scratch buffer:
+# longer tokens are malformed THERE, so they must be malformed HERE too
+_MAX_NUM_TOK = 63
+# C++ tokenization splits on space/tab/\r only (NOT \x0b/\x0c like
+# str.split) — same separator set on both paths
+_WS_SPLIT = re.compile(r"[ \t\r]+")
+
+
+def _decfloat_ok(tok: str) -> bool:
+    return len(tok) <= _MAX_NUM_TOK and _DECFLOAT_RE.match(tok) is not None
+_DECINT_RE = re.compile(r"([+-]?)(\d+)\Z")
+_U64_MASK = (1 << 64) - 1
+
+
+def _parse_u64(tok: str):
+    """Reference strtou64 semantics: optional sign (negation wraps modulo
+    2^64), clamp to ULLONG_MAX before negating, whole token must consume.
+    Returns the uint64 value or None."""
+    m = _DECINT_RE.match(tok)
+    if not m:
+        return None
+    mag = min(int(m.group(2)), _U64_MASK)
+    return (_U64_MASK + 1 - mag) & _U64_MASK if m.group(1) == "-" else mag
+
+
 def parse_libsvm(lines: List[str]) -> SparseBatch:
     """All libsvm features live in feature-group slot 1 (ref ParseLibsvm,
-    text_parser.cc: ``fea_slot->set_id(1)``; slot 0 holds the label)."""
+    text_parser.cc: ``fea_slot->set_id(1)``; slot 0 holds the label).
+
+    Reference-strict line validation (ParseLibsvm + strtonum.h): the
+    label and every value must be a FULL decimal-float token, every
+    feature token must contain ':', indices parse with strtou64
+    semantics, feature ids must be non-decreasing in uint64 order, and
+    ANY malformed token drops the WHOLE line (no partial rows). An empty
+    value ("idx:") is 0.0 — strtof("") succeeds with 0 in the reference.
+    Deliberate narrowing vs strtof: hex floats / inf / nan tokens are
+    rejected (real libsvm data never contains them, and the C++ fast
+    path must stay bit-exact with this grammar)."""
     labels, keys, vals, slots = [], [], [], []
     for line in lines:
-        parts = line.split()
+        parts = [t for t in _WS_SPLIT.split(line.rstrip("\n")) if t]
         if not parts:
             continue
-        try:
-            label = float(parts[0])
-        except ValueError:
+        if not _decfloat_ok(parts[0]):
+            continue  # ref: strtofloat(label) false -> drop line
+        label = float(parts[0])
+        k, v = [], []
+        last_idx = 0
+        ok = True
+        for tok in parts[1:]:
+            i, colon, x = tok.partition(":")
+            if not colon:
+                ok = False  # ref: token without ':' -> drop line
+                break
+            idx = _parse_u64(i)
+            if idx is None or last_idx > idx:
+                ok = False  # bad index / unordered -> drop line
+                break
+            last_idx = idx
+            if x == "":
+                val = 0.0  # ref: strtofloat("") succeeds with 0
+            elif _decfloat_ok(x):
+                val = float(x)
+            else:
+                ok = False
+                break
+            k.append(idx - (1 << 64) if idx > (1 << 63) - 1 else idx)
+            v.append(val)
+        if not ok:
             continue
         labels.append(1.0 if label > 0 else -1.0)
-        k, v = [], []
-        for tok in parts[1:]:
-            i, _, x = tok.partition(":")
-            try:
-                k.append(int(i))
-                v.append(float(x) if x else 1.0)
-            except ValueError:
-                continue
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
         slots.append(np.ones(len(k), dtype=np.int32))
@@ -88,6 +140,7 @@ def parse_libsvm(lines: List[str]) -> SparseBatch:
 
 _CRITEO_STRIPE = ((1 << 64) - 1) // 13  # ref: kMaxKey / 13
 _CRITEO_SEED = 512927377
+_CRITEO_INT_RE = re.compile(r" *([+-]?)(\d+)\Z")
 
 
 def parse_criteo(lines: List[str]) -> SparseBatch:
@@ -107,18 +160,24 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
         f = line.rstrip("\n").split("\t")
         if len(f) < 40:  # label + 13 ints + 26 cats; ref drops short lines
             continue
-        try:
-            label = float(f[0])
-        except ValueError:
-            continue
+        lbl_tok = f[0].lstrip(" ")
+        if not _decfloat_ok(lbl_tok):
+            continue  # ref strtofloat: strict full-field decimal float
+        label = float(lbl_tok)
         k, s = [], []
         for i, tok in enumerate(f[1:14]):
-            if not tok:
+            # ref strtoi32: leading spaces + sign + digits consuming the
+            # WHOLE field (partial parses skip the field), long clamp on
+            # overflow, then int32 truncation
+            m = _CRITEO_INT_RE.match(tok)
+            if not m:
                 continue
-            try:
-                cnt = int(tok)
-            except ValueError:
-                continue
+            raw = int(m.group(2))
+            if raw > (1 << 63) - 1:  # strtol ERANGE clamp
+                cnt64 = -(1 << 63) if m.group(1) == "-" else (1 << 63) - 1
+            else:
+                cnt64 = -raw if m.group(1) == "-" else raw
+            cnt = ((cnt64 & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
             k.append((_CRITEO_STRIPE * i + cnt) & ((1 << 64) - 1))
             s.append(i + 1)
         for i, tok in enumerate(f[14:40]):

@@ -146,9 +146,14 @@ class TestReaderPipeline:
                 dense = b.to_dense()
                 for i in range(b.n):
                     lo, hi = b.indptr[i], b.indptr[i + 1]
+                    # sorted ids: the parser is reference-strict and
+                    # drops lines with out-of-order feature ids
+                    order = np.argsort(b.indices[lo:hi], kind="stable")
                     feats = " ".join(
                         f"{int(k)}:{v:.4f}"
-                        for k, v in zip(b.indices[lo:hi], b.values[lo:hi])
+                        for k, v in zip(
+                            b.indices[lo:hi][order], b.values[lo:hi][order]
+                        )
                     )
                     f.write(f"{int(b.y[i])} {feats}\n")
         reader = MinibatchReader(files=[str(path)], minibatch_size=256)

@@ -123,7 +123,7 @@ class TestModelEvaluation:
         vpath = tmp_path / "val.libsvm"
         rows = []
         for r in range(val.n):
-            ks = val.indices[val.indptr[r] : val.indptr[r + 1]]
+            ks = np.sort(val.indices[val.indptr[r] : val.indptr[r + 1]])
             rows.append((int(val.y[r]), [(int(k), 1) for k in ks]))
         self._libsvm(vpath, rows)
         conf2 = Config()

@@ -174,6 +174,75 @@ class TestNativeParity:
         np.testing.assert_array_equal(a.slot_ids, b.slot_ids)
 
 
+class TestParserFuzz:
+    """Seeded mutation fuzz: the C++ fast paths must stay BIT-EXACT with
+    the Python parsers on mangled input, not just on well-formed lines —
+    truncations, garbage bytes, doubled separators, blank lines, and
+    spliced fragments (the classes behind every past parity bug)."""
+
+    def _mutate(self, rng, line: str) -> str:
+        ops = rng.integers(0, 6)
+        if ops == 0 and len(line) > 2:  # truncate anywhere
+            return line[: rng.integers(1, len(line))]
+        if ops == 1:  # inject a garbage byte
+            i = rng.integers(0, len(line) + 1)
+            ch = chr(rng.integers(33, 127))
+            return line[:i] + ch + line[i:]
+        if ops == 2 and line:  # double a separator
+            i = rng.integers(0, len(line))
+            return line[:i] + ("\t" if rng.random() < 0.5 else " ") + line[i:]
+        if ops == 3:  # blank/whitespace-only line
+            return " " * int(rng.integers(0, 4))
+        if ops == 4 and len(line) > 4:  # splice two halves of itself
+            i = rng.integers(1, len(line) - 1)
+            return line[i:] + line[:i]
+        return line
+
+    def _wellformed(self, rng, fmt: str) -> str:
+        if fmt == "criteo":
+            ints = "\t".join(str(rng.integers(0, 100)) for _ in range(13))
+            cats = "\t".join(
+                f"{rng.integers(0, 1 << 32):08x}" for _ in range(26)
+            )
+            return f"{rng.integers(0, 2)}\t{ints}\t{cats}"
+        # libsvm: ragged sparse rows, occasional explicit values
+        n = rng.integers(1, 6)
+        feats = " ".join(
+            f"{rng.integers(1, 1 << 20)}:{rng.integers(1, 5)}"
+            if rng.random() < 0.5
+            else f"{rng.integers(1, 1 << 20)}:1"
+            for _ in range(n)
+        )
+        return f"{(-1) ** rng.integers(0, 2)} {feats}"
+
+    @pytest.mark.parametrize("fmt", ["libsvm", "criteo"])
+    def test_mutated_lines_stay_bit_exact(self, fmt):
+        native = ExampleParser(fmt, use_native=True)
+        python = ExampleParser(fmt, use_native=False)
+        if not native.use_native:
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(0)
+        for trial in range(200):
+            lines = []
+            for _ in range(int(rng.integers(1, 8))):
+                line = self._wellformed(rng, fmt)
+                if rng.random() < 0.7:
+                    line = self._mutate(rng, line)
+                lines.append(line)
+            a = native.parse_lines(lines)
+            b = python.parse_lines(lines)
+            ctx = f"trial {trial}: {lines!r}"
+            np.testing.assert_array_equal(a.y, b.y, err_msg=ctx)
+            np.testing.assert_array_equal(a.indptr, b.indptr, err_msg=ctx)
+            np.testing.assert_array_equal(a.indices, b.indices, err_msg=ctx)
+            assert a.binary == b.binary, ctx
+            if not a.binary:
+                # BIT-exact, not approximately equal — a 1-ulp strtod/
+                # float() divergence is exactly what this test hunts
+                np.testing.assert_array_equal(a.values, b.values, err_msg=ctx)
+            np.testing.assert_array_equal(a.slot_ids, b.slot_ids, err_msg=ctx)
+
+
 class TestSlotIds:
     """Per-entry feature-group slots, matching the reference Example proto
     (text_parser.cc Slot.set_id: libsvm → 1; criteo int i → i+1, cat i →

@@ -188,11 +188,17 @@ class TestText2Record:
     def test_roundtrip(self, tmp_path):
         svm = tmp_path / "in.svm"
         b = random_sparse(100, 50, 4, seed=5)
+        exp_indices = []
         with open(svm, "w") as f:
             for r in range(b.n):
                 lo, hi = b.indptr[r], b.indptr[r + 1]
+                # rows must be written id-sorted: the parser is
+                # reference-strict and drops out-of-order lines
+                order = np.argsort(b.indices[lo:hi], kind="stable")
+                exp_indices.append(b.indices[lo:hi][order])
                 feats = " ".join(
-                    f"{int(k)}:{v:.5f}" for k, v in zip(b.indices[lo:hi], b.values[lo:hi])
+                    f"{int(k)}:{v:.5f}"
+                    for k, v in zip(b.indices[lo:hi][order], b.values[lo:hi][order])
                 )
                 f.write(f"{int(b.y[r])} {feats}\n")
         out = tmp_path / "out.rec"
@@ -201,7 +207,7 @@ class TestText2Record:
         back = StreamReader([str(out)], "record").read_all()
         assert back.n == 100
         np.testing.assert_array_equal(back.y, b.y)
-        np.testing.assert_array_equal(back.indices, b.indices)
+        np.testing.assert_array_equal(back.indices, np.concatenate(exp_indices))
 
 
 class TestCheckpointReplica:
