@@ -82,8 +82,24 @@ def _parse_u64(tok: str):
     m = _DECINT_RE.match(tok)
     if not m:
         return None
-    mag = min(int(m.group(2)), _U64_MASK)
+    digits = m.group(2)
+    # CPython 3.11+ caps int() at 4300 digits with a ValueError; any run
+    # past 20 digits clamps at ULLONG_MAX anyway (like the C++ path)
+    mag = _U64_MASK if len(digits) > 20 else min(int(digits), _U64_MASK)
     return (_U64_MASK + 1 - mag) & _U64_MASK if m.group(1) == "-" else mag
+
+
+def _wrap_i64(x: int) -> int:
+    """Fold an unbounded Python int into int64 two's-complement range so
+    np.int64 array construction can never raise OverflowError (corrupt
+    lines can carry arbitrarily long digit runs)."""
+    x &= _U64_MASK
+    return x - (1 << 64) if x > (1 << 63) - 1 else x
+
+
+def _wrap_i32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    return x - (1 << 32) if x > (1 << 31) - 1 else x
 
 
 def parse_libsvm(lines: List[str]) -> SparseBatch:
@@ -127,7 +143,7 @@ def parse_libsvm(lines: List[str]) -> SparseBatch:
             else:
                 ok = False
                 break
-            k.append(idx - (1 << 64) if idx > (1 << 63) - 1 else idx)
+            k.append(_wrap_i64(idx))
             v.append(val)
         if not ok:
             continue
@@ -172,12 +188,14 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
             m = _CRITEO_INT_RE.match(tok)
             if not m:
                 continue
-            raw = int(m.group(2))
+            digits = m.group(2)
+            # len guard first: CPython caps int() at 4300 digits
+            raw = (1 << 63) if len(digits) > 19 else int(digits)
             if raw > (1 << 63) - 1:  # strtol ERANGE clamp
                 cnt64 = -(1 << 63) if m.group(1) == "-" else (1 << 63) - 1
             else:
                 cnt64 = -raw if m.group(1) == "-" else raw
-            cnt = ((cnt64 & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
+            cnt = _wrap_i32(cnt64)
             k.append((_CRITEO_STRIPE * i + cnt) & ((1 << 64) - 1))
             s.append(i + 1)
         for i, tok in enumerate(f[14:40]):
@@ -215,8 +233,8 @@ def parse_adfea(lines: List[str]) -> SparseBatch:
                 g = int(pairs[j + 1])
             except ValueError:
                 continue
-            k.append(g * SLOT_SPACE + key % (SLOT_SPACE - 1))
-            s.append(g)
+            k.append(_wrap_i64(g * SLOT_SPACE + key % (SLOT_SPACE - 1)))
+            s.append(_wrap_i32(g))
         keys.append(np.asarray(k, dtype=np.int64))
         slots.append(np.asarray(s, dtype=np.int32))
     return _batch_from_rows(labels, keys, None, slots)
@@ -277,12 +295,16 @@ def parse_ps_sparse(lines: List[str]) -> SparseBatch:
                 continue
             for tok in toks[1:]:
                 i, _, x = tok.partition(":")
+                # parse BOTH halves before appending either — a bad
+                # value after a good key must not desync the arrays
                 try:
-                    k.append(gid * SLOT_SPACE + int(i))
-                    v.append(float(x) if x else 1.0)
-                    s.append(gid)
+                    key = _wrap_i64(gid * SLOT_SPACE + int(i))
+                    val = float(x) if x else 1.0
                 except ValueError:
                     continue
+                k.append(key)
+                v.append(val)
+                s.append(_wrap_i32(gid))
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
         slots.append(np.asarray(s, dtype=np.int32))
@@ -313,8 +335,8 @@ def parse_ps_sparse_binary(lines: List[str]) -> SparseBatch:
                 continue
             for tok in toks[1:]:
                 try:
-                    k.append(gid * SLOT_SPACE + int(tok))
-                    s.append(gid)
+                    k.append(_wrap_i64(gid * SLOT_SPACE + int(tok)))
+                    s.append(_wrap_i32(gid))
                 except ValueError:
                     continue
         keys.append(np.asarray(k, dtype=np.int64))
@@ -349,9 +371,9 @@ def parse_ps_dense(lines: List[str]) -> SparseBatch:
                     x = float(tok)
                 except ValueError:
                     continue
-                k.append(gid * SLOT_SPACE + pos)
+                k.append(_wrap_i64(gid * SLOT_SPACE + pos))
                 v.append(x)
-                s.append(gid)
+                s.append(_wrap_i32(gid))
         keys.append(np.asarray(k, dtype=np.int64))
         vals.append(np.asarray(v, dtype=np.float32))
         slots.append(np.asarray(s, dtype=np.int32))

@@ -205,13 +205,15 @@ class TestParserFuzz:
                 f"{rng.integers(0, 1 << 32):08x}" for _ in range(26)
             )
             return f"{rng.integers(0, 2)}\t{ints}\t{cats}"
-        # libsvm: ragged sparse rows, occasional explicit values
+        # libsvm: ragged sparse rows, occasional explicit values;
+        # indices SORTED — the strict parser drops unordered lines, and
+        # unsorted generation would leave the value-parity path barely
+        # exercised (mutations still cover the unordered-drop case)
         n = rng.integers(1, 6)
+        idxs = np.sort(rng.integers(1, 1 << 20, size=n))
         feats = " ".join(
-            f"{rng.integers(1, 1 << 20)}:{rng.integers(1, 5)}"
-            if rng.random() < 0.5
-            else f"{rng.integers(1, 1 << 20)}:1"
-            for _ in range(n)
+            f"{i}:{rng.integers(1, 5)}" if rng.random() < 0.5 else f"{i}:1"
+            for i in idxs
         )
         return f"{(-1) ** rng.integers(0, 2)} {feats}"
 
@@ -241,6 +243,67 @@ class TestParserFuzz:
                 # float() divergence is exactly what this test hunts
                 np.testing.assert_array_equal(a.values, b.values, err_msg=ctx)
             np.testing.assert_array_equal(a.slot_ids, b.slot_ids, err_msg=ctx)
+
+
+class TestPythonOnlyParserRobustness:
+    """adfea/terafea/ps_* have no native twin to diverge from, but they
+    must never RAISE on mangled input and must always emit a consistent
+    CSR (monotone indptr, matching array lengths)."""
+
+    def _check_csr(self, b):
+        assert b.indptr[0] == 0
+        assert (np.diff(b.indptr) >= 0).all()
+        assert b.indptr[-1] == len(b.indices)
+        assert len(b.y) == len(b.indptr) - 1
+        if b.values is not None:
+            assert len(b.values) == len(b.indices)
+        if b.slot_ids is not None:
+            assert len(b.slot_ids) == len(b.indices)
+
+    def test_mangled_lines_never_raise(self):
+        from parameter_server_tpu.data.text_parser import (
+            parse_ps_dense,
+            parse_ps_sparse,
+            parse_ps_sparse_binary,
+        )
+
+        parsers = {
+            "adfea": parse_adfea,
+            "terafea": parse_terafea,
+            "ps_sparse": parse_ps_sparse,
+            "ps_sparse_binary": parse_ps_sparse_binary,
+            "ps_dense": parse_ps_dense,
+        }
+        seeds = {
+            "adfea": "100 1 1 123:4 456:7",
+            "terafea": "1 1000 | 123 456",
+            "ps_sparse": "1;2 3:0.5 4:1.5;7 9:2;",
+            "ps_sparse_binary": "1;2 3 4;7 9;",
+            "ps_dense": "1;2 0.5 1.5 2.5;",
+        }
+        rng = np.random.default_rng(7)
+        for name, fn in parsers.items():
+            base = seeds[name]
+            for trial in range(200):
+                line = base
+                for _ in range(int(rng.integers(1, 4))):
+                    op = rng.integers(0, 5)
+                    if op == 0 and len(line) > 2:
+                        line = line[: rng.integers(1, len(line))]
+                    elif op == 1:
+                        i = rng.integers(0, len(line) + 1)
+                        line = line[:i] + chr(rng.integers(33, 127)) + line[i:]
+                    elif op == 2 and line:
+                        i = rng.integers(0, len(line))
+                        line = line[:i] + (";" if rng.random() < 0.5 else ":") + line[i:]
+                    elif op == 3:
+                        line = ""
+                    elif op == 4 and len(line) > 4:
+                        i = rng.integers(1, len(line) - 1)
+                        line = line[i:] + line[:i]
+                b = fn([line, base])  # mangled + a good line
+                self._check_csr(b)
+                assert b.n >= 1, (name, line)  # the good line always survives
 
 
 class TestSlotIds:
