@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench smoke clean
+.PHONY: all native test bench bench-all bench-watch smoke clean
 
 all: native
 
@@ -12,6 +12,18 @@ test: native
 
 bench: native
 	python bench.py
+
+# one-shot on-chip evidence suite: probe the device; if reachable run
+# every pending task (flash-kernel Mosaic validation, bench, bench
+# --real, component benches, LM tokens/s+MFU, table-scale probe) and
+# append results to BENCH_ONCHIP.md
+bench-all: native
+	python script/onchip.py --once
+
+# persistent tunnel watcher: retries bench-all whenever the device
+# becomes reachable (the tunnel wedges transiently — see README)
+bench-watch: native
+	python script/onchip.py --watch
 
 smoke: native
 	python bench.py --smoke
