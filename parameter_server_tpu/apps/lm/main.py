@@ -107,13 +107,18 @@ def main(argv=None) -> int:
         )
     n_data = n_dev // args.num_servers
     mesh = meshlib.make_mesh(num_data=n_data, num_server=args.num_servers)
-    cfg = LMConfig(
-        vocab=256, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=args.d_ff, attention=args.attention,
-        window=args.window, remat=args.remat,
-        compute_dtype="bfloat16" if args.bf16 else "float32",
-        moe_every=args.moe_every,
-    )
+    try:
+        cfg = LMConfig(
+            vocab=256, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff, attention=args.attention,
+            window=args.window, remat=args.remat,
+            compute_dtype="bfloat16" if args.bf16 else "float32",
+            moe_every=args.moe_every,
+        )
+    except ValueError as e:
+        # LMConfig rejects invalid combinations (e.g. --window with
+        # --attention a2a); surface them as flag errors, not tracebacks
+        ap.error(str(e))
     zig = args.attention == "ring_zigzag"
     if args.seq_len % (2 * n_data if zig else n_data):
         ap.error(f"--seq-len must divide by {2 * n_data if zig else n_data}")

@@ -44,6 +44,12 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint32, u8p,
     ]
     lib.ps_hash_slots_packbits.restype = None
+    lib.ps_lz_max_compressed.argtypes = [ctypes.c_uint64]
+    lib.ps_lz_max_compressed.restype = ctypes.c_uint64
+    lib.ps_lz_compress.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.ps_lz_compress.restype = ctypes.c_int64
+    lib.ps_lz_decompress.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]
+    lib.ps_lz_decompress.restype = ctypes.c_int64
     lib.ps_murmur3_x64_128.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32, u64p,
     ]

@@ -316,8 +316,12 @@ static int parse_decfloat(const char* s, const char* e, double* out) {
 }
 
 // strtou64 semantics over [s, e): optional sign (negation wraps modulo
-// 2^64), clamp at ULLONG_MAX, all bytes must be consumed
+// 2^64), clamp at ULLONG_MAX, all bytes must be consumed. An EMPTY
+// range succeeds with 0 — strtoull("") performs no conversion and
+// leaves end at the terminator, which strtonum.h counts as success
+// (so ":val" is feature id 0). A bare sign still fails (end != NUL).
 static int parse_u64_tok(const char* s, const char* e, uint64_t* out) {
+  if (s == e) { *out = 0; return 1; }
   int neg = 0;
   if (s < e && (*s == '+' || *s == '-')) { neg = (*s == '-'); ++s; }
   if (s >= e) return 0;
@@ -445,6 +449,10 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
     if (f == p + 1 && (p[0] == '0' || p[0] == '1')) {
       // the overwhelmingly common criteo case: a bare 0/1 label
       label = p[0] - '0';
+    } else if (f == p) {
+      // empty label field: strtofloat("") is a successful
+      // no-conversion in the reference -> label 0 (negative class)
+      label = 0.0;
     } else {
       // ref strtofloat: leading spaces, then a full decimal-float
       // field (same strict grammar as the libsvm paths)
@@ -457,7 +465,16 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
     for (int i = 0; i < 13; ++i) {  // integer count features
       f = find_tab(p, line_end);
       if (!f) { ok = 0; break; }  // ref: missing int tab drops the line
-      if (f > p) {
+      if (f == p) {
+        // EMPTY int field (how real criteo marks a missing value):
+        // strtoi32("") succeeds with 0 in the reference, so it emits
+        // key stripe*i + 0 — an empty field is NOT a skip
+        if (nnz >= max_nnz) { *out_nnz = indptr[row]; return -(row + 1); }
+        indices[nnz] = kStripe * (uint64_t)i;
+        values[nnz] = 1.0f;
+        if (slots) slots[nnz] = i + 1;
+        ++nnz;
+      } else {
         // ref strtoi32 (strtonum.h): strtol must consume the WHOLE field
         // (leading spaces ok, then sign + digits, nothing after — a
         // partial parse like "4bb3f55c" SKIPS the field), the long
@@ -514,5 +531,176 @@ int64_t ps_parse_criteo(const char* buf, int64_t len,
   *out_nnz = nnz;
   return row;
 }
+
+// ---------------------------------------------------------------------------
+// Fast byte-level LZ wire codec — the role of the reference's snappy
+// message compression (src/util/shared_array_inl.h:245 CompressTo /
+// UncompressFrom, used by src/filter/compressing.h on every filtered
+// message). snappy/LZ4 aren't in this environment, so this is an
+// LZ4-style block codec of our own: greedy 4-byte-hash matcher, 16-bit
+// offsets, token = (literal_len:4 | match_len-4:4) with 255-run length
+// extensions, stream ends with a literals-only tail. Both ends are this
+// library, so the format only needs to be self-consistent + safe: the
+// decompressor bounds-checks every read/write and rejects malformed
+// input with -1 (wire payloads are untrusted); -2 means the output
+// buffer is too small (retry with a bigger one — distinct from -1 so
+// callers never grow buffers for garbage input).
+
+static inline uint32_t lz_hash32(uint32_t v) {
+  return (v * 2654435761u) >> 19;  // 13-bit table index
+}
+
+uint64_t ps_lz_max_compressed(uint64_t n) {
+  // worst case: pure literals = n + one length-extension byte per 255
+  // literals + token + terminator slack
+  return n + n / 255 + 16;
+}
+
+int64_t ps_lz_compress(const uint8_t* src, uint64_t n,
+                       uint8_t* dst, uint64_t cap) {
+  const uint8_t* ip = src;
+  const uint8_t* iend = src + n;
+  const uint8_t* anchor = src;
+  // matches must leave >= 5 bytes of tail literals and stop match
+  // extension 5 bytes early (mirrors LZ4's endgame margins; keeps the
+  // decoder's overlap copy away from buffer ends)
+  const uint8_t* mflimit = (n > 12) ? iend - 12 : src;
+  const uint8_t* matchlimit = iend - 5;
+  uint8_t* op = dst;
+  uint8_t* oend = dst + cap;
+  uint32_t table[1u << 13];  // position+1 into src; 0 = empty
+  memset(table, 0, sizeof(table));
+
+  if (n > 12) {
+    // skip acceleration (the LZ4 trick): on incompressible stretches
+    // the step between probes grows, so pure-noise input costs ~1
+    // probe per 2 bytes instead of per byte
+    uint32_t miss = 0;
+    while (ip < mflimit) {
+      uint32_t seq;
+      memcpy(&seq, ip, 4);
+      uint32_t h = lz_hash32(seq);
+      uint32_t prev = table[h];
+      table[h] = (uint32_t)(ip - src) + 1;
+      uint32_t cand4;
+      if (prev && (uint64_t)(ip - src) + 1 - prev <= 0xFFFF &&
+          (memcpy(&cand4, src + prev - 1, 4), cand4 == seq)) {
+        miss = 0;
+        const uint8_t* match = src + prev - 1;
+        const uint8_t* q = ip + 4;
+        const uint8_t* m = match + 4;
+        while (q < matchlimit && *q == *m) { ++q; ++m; }
+        uint64_t mlen = (uint64_t)(q - ip) - 4;  // stored as len-4
+        uint64_t lit = (uint64_t)(ip - anchor);
+        // token + worst-case length extensions + literals + offset
+        if ((uint64_t)(oend - op) < 1 + lit + lit / 255 + 1 + 2 + mlen / 255 + 1)
+          return -1;
+        uint8_t* tok = op++;
+        if (lit >= 15) {
+          *tok = (uint8_t)(15u << 4);
+          uint64_t rest = lit - 15;
+          while (rest >= 255) { *op++ = 255; rest -= 255; }
+          *op++ = (uint8_t)rest;
+        } else {
+          *tok = (uint8_t)(lit << 4);
+        }
+        memcpy(op, anchor, lit);
+        op += lit;
+        uint32_t off = (uint32_t)(ip - match);
+        *op++ = (uint8_t)(off & 0xFF);
+        *op++ = (uint8_t)(off >> 8);
+        if (mlen >= 15) {
+          *tok |= 15;
+          uint64_t rest = mlen - 15;
+          while (rest >= 255) { *op++ = 255; rest -= 255; }
+          *op++ = (uint8_t)rest;
+        } else {
+          *tok |= (uint8_t)mlen;
+        }
+        ip += mlen + 4;
+        anchor = ip;
+      } else {
+        ip += 1 + (miss++ >> 6);
+      }
+    }
+  }
+  // literals-only tail
+  {
+    uint64_t lit = (uint64_t)(iend - anchor);
+    if ((uint64_t)(oend - op) < 1 + lit + lit / 255 + 1) return -1;
+    uint8_t* tok = op++;
+    if (lit >= 15) {
+      *tok = (uint8_t)(15u << 4);
+      uint64_t rest = lit - 15;
+      while (rest >= 255) { *op++ = 255; rest -= 255; }
+      *op++ = (uint8_t)rest;
+    } else {
+      *tok = (uint8_t)(lit << 4);
+    }
+    memcpy(op, anchor, lit);
+    op += lit;
+  }
+  return (int64_t)(op - dst);
+}
+
+int64_t ps_lz_decompress(const uint8_t* src, uint64_t n,
+                         uint8_t* dst, uint64_t cap) {
+  const uint8_t* ip = src;
+  const uint8_t* iend = src + n;
+  uint8_t* op = dst;
+  uint8_t* oend = dst + cap;
+  while (ip < iend) {
+    uint8_t tok = *ip++;
+    uint64_t lit = tok >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (lit > (uint64_t)(iend - ip)) return -1;
+    if (lit > (uint64_t)(oend - op)) return -2;
+    memcpy(op, ip, lit);
+    op += lit;
+    ip += lit;
+    if (ip >= iend) {
+      // literals-only tail: a match-nibble here would be malformed
+      if ((tok & 15) != 0) return -1;
+      break;
+    }
+    if ((uint64_t)(iend - ip) < 2) return -1;
+    uint32_t off = (uint32_t)ip[0] | ((uint32_t)ip[1] << 8);
+    ip += 2;
+    uint64_t mlen = (uint64_t)(tok & 15);
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += 4;
+    if (off == 0 || off > (uint64_t)(op - dst)) return -1;
+    if (mlen > (uint64_t)(oend - op)) return -2;
+    const uint8_t* m = op - off;
+    if (off >= mlen) {
+      memcpy(op, m, mlen);  // disjoint
+    } else if (off >= 8 && mlen + 8 <= (uint64_t)(oend - op)) {
+      // overlapping but period >= 8: 8-byte strided copies are safe
+      // (each copies bytes written >= 8 positions back); may write up
+      // to 7 bytes past mlen, bounded above
+      for (uint64_t i = 0; i < mlen; i += 8) memcpy(op + i, m + i, 8);
+    } else {
+      // short period (e.g. RLE, off=1): byte-wise is required
+      for (uint64_t i = 0; i < mlen; ++i) op[i] = m[i];
+    }
+    op += mlen;
+  }
+  return (int64_t)(op - dst);
+}
+
 
 }  // extern "C"

@@ -78,11 +78,18 @@ _U64_MASK = (1 << 64) - 1
 def _parse_u64(tok: str):
     """Reference strtou64 semantics: optional sign (negation wraps modulo
     2^64), clamp to ULLONG_MAX before negating, whole token must consume.
-    Returns the uint64 value or None."""
+    Returns the uint64 value or None. An EMPTY token is 0: strtoull("")
+    performs no conversion, leaves end at the terminator, and strtonum.h
+    treats that as success — so ":val" is feature id 0 in the reference."""
+    if tok == "":
+        return 0
     m = _DECINT_RE.match(tok)
     if not m:
         return None
-    digits = m.group(2)
+    # leading zeros don't contribute magnitude: strip them BEFORE the
+    # digit-count overflow guard, or '00…07' would clamp to ULLONG_MAX
+    # where strtoull accumulates to 7 (C++/reference parity)
+    digits = m.group(2).lstrip("0") or "0"
     # CPython 3.11+ caps int() at 4300 digits with a ValueError; any run
     # past 20 digits clamps at ULLONG_MAX anyway (like the C++ path)
     mag = _U64_MASK if len(digits) > 20 else min(int(digits), _U64_MASK)
@@ -110,8 +117,10 @@ def parse_libsvm(lines: List[str]) -> SparseBatch:
     label and every value must be a FULL decimal-float token, every
     feature token must contain ':', indices parse with strtou64
     semantics, feature ids must be non-decreasing in uint64 order, and
-    ANY malformed token drops the WHOLE line (no partial rows). An empty
-    value ("idx:") is 0.0 — strtof("") succeeds with 0 in the reference.
+    ANY malformed token drops the WHOLE line (no partial rows). Empty
+    sub-tokens are 0 like the reference: ":val" is feature id 0 and
+    "idx:" is value 0.0 (strtoull("")/strtof("") are successful
+    no-conversions under strtonum.h's end-of-string check).
     Deliberate narrowing vs strtof: hex floats / inf / nan tokens are
     rejected (real libsvm data never contains them, and the C++ fast
     path must stay bit-exact with this grammar)."""
@@ -177,18 +186,30 @@ def parse_criteo(lines: List[str]) -> SparseBatch:
         if len(f) < 40:  # label + 13 ints + 26 cats; ref drops short lines
             continue
         lbl_tok = f[0].lstrip(" ")
-        if not _decfloat_ok(lbl_tok):
+        if f[0] == "":
+            label = 0.0  # ref strtofloat(""): no conversion = success, 0
+        elif _decfloat_ok(lbl_tok):
+            label = float(lbl_tok)
+        else:
             continue  # ref strtofloat: strict full-field decimal float
-        label = float(lbl_tok)
         k, s = [], []
         for i, tok in enumerate(f[1:14]):
             # ref strtoi32: leading spaces + sign + digits consuming the
             # WHOLE field (partial parses skip the field), long clamp on
-            # overflow, then int32 truncation
+            # overflow, then int32 truncation. An EMPTY field is count 0
+            # (strtol("") is a successful no-conversion) — real criteo
+            # data marks missing ints with empty fields, so the
+            # reference emits key stripe*i+0 for them, not a skip
+            if tok == "":
+                k.append((_CRITEO_STRIPE * i) & ((1 << 64) - 1))
+                s.append(i + 1)
+                continue
             m = _CRITEO_INT_RE.match(tok)
             if not m:
                 continue
-            digits = m.group(2)
+            # strip leading zeros before the digit-count guard (strtol
+            # accumulates magnitude; '00…05' is 5, not ERANGE)
+            digits = m.group(2).lstrip("0") or "0"
             # len guard first: CPython caps int() at 4300 digits
             raw = (1 << 63) if len(digits) > 19 else int(digits)
             if raw > (1 << 63) - 1:  # strtol ERANGE clamp

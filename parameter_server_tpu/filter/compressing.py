@@ -1,20 +1,22 @@
 """Payload compression (ref ``src/filter/compressing.h``).
 
-The reference LZ4-compresses each value array on the wire. LZ4 isn't in
-this environment, so the host codec is zlib (level 1 — closest speed
-profile); arrays are restored to their original dtype/shape on decode. The
-device-path analog is dtype narrowing (bf16 pulls / int8 pushes) which the
-learners apply directly — compression of ICI traffic is a precision choice,
-not a byte codec.
+The reference snappy-compresses each value SArray on the wire
+(``shared_array_inl.h:245`` CompressTo). Here each value array goes
+through ``utils/codec.py``: the native LZ block codec in
+``cpp/psnative.cc`` (snappy-class; zlib-1 fallback without the native
+lib; frames are self-describing so mixed deployments interop, and
+incompressible payloads ride raw). Arrays are restored to their original
+dtype/shape on decode. The device-path analog is dtype narrowing (bf16
+pulls / int8 pushes) which the learners apply directly — compression of
+ICI traffic is a precision choice, not a byte codec.
 """
 
 from __future__ import annotations
 
-import zlib
-
 import numpy as np
 
 from ..system.message import FilterSpec, Message
+from ..utils import codec
 from .base import Filter, register
 
 
@@ -27,7 +29,7 @@ class CompressingFilter(Filter):
         out = []
         for v in msg.values:
             raw = np.ascontiguousarray(v)
-            blob = zlib.compress(raw.tobytes(), level=1)
+            blob = codec.compress(raw.tobytes())
             meta.append((str(raw.dtype), raw.shape))
             out.append(np.frombuffer(blob, dtype=np.uint8))
         spec.extra["meta"] = meta
@@ -40,7 +42,7 @@ class CompressingFilter(Filter):
             return msg
         out = []
         for v, (dtype, shape) in zip(msg.values, meta):
-            raw = zlib.decompress(v.tobytes())
+            raw = codec.decompress(v.tobytes())
             out.append(np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy())
         msg.values = out
         return msg

@@ -94,6 +94,41 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+# -- control-plane byte transport (ref van.cc ZMQ send/recv over DCN) --
+#
+# Host-to-host Messages ride the jax.distributed coordination service's
+# key-value store (the same gRPC channel that bootstrapped the cluster —
+# the reference reuses its scheduler connection for control traffic the
+# same way). This is for CONTROL-plane frames: workload grants, progress
+# reports, filtered parameter messages in tests; bulk tensor traffic
+# belongs to XLA collectives over ICI/DCN, never here.
+
+
+def _kv_client():
+    from jax._src import distributed as _dist
+
+    c = _dist.global_state.client
+    if c is None:
+        raise RuntimeError(
+            "no jax.distributed client — control-plane messaging needs a "
+            "multi-process rendezvous (PS_COORDINATOR_ADDRESS et al.)"
+        )
+    return c
+
+
+def post_bytes(tag: str, blob: bytes) -> None:
+    """Publish one control-plane frame under a UNIQUE tag (the store is
+    write-once per key: include sender/seq in the tag, e.g. "w0/3")."""
+    _kv_client().key_value_set_bytes(f"psmsg/{tag}", blob)
+
+
+def fetch_bytes(tag: str, timeout_ms: int = 120_000) -> bytes:
+    """Block until the frame tagged ``tag`` is published, return it."""
+    return _kv_client().blocking_key_value_get_bytes(
+        f"psmsg/{tag}", timeout_ms
+    )
+
+
 def local_data_shards(mesh: Mesh) -> int:
     """Number of data-axis rows whose devices belong to this process.
 

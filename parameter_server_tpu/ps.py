@@ -143,6 +143,16 @@ def submit(
                 sender=app.name,
                 recver=target.node.id,
             )
+            # the REAL send path, even for loopback delivery: the
+            # sender's per-peer filter chain encodes, the message
+            # serializes to wire bytes, and the receiver's chain decodes
+            # (ref remote_node.cc: filters apply on every send/recv; the
+            # reference serializes through ZMQ even between local
+            # processes). Filters with per-peer state — key_caching
+            # signatures, compression meta — therefore carry every ps.h
+            # RPC, and the RemoteNode wire counters measure real frames.
+            blob = app.remote_nodes.get(target.node.id).to_wire(req)
+            req = target.remote_nodes.get(app.name).from_wire(blob)
             # each node's receive path is serialized (the reference runs one
             # executor thread per customer), so hello-style apps may mutate
             # unlocked state in process_request

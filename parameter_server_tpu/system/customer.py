@@ -23,6 +23,11 @@ class Customer:
         self.name = name or f"customer_{self.id}"
         self.executor = Executor(name=self.name)
         self._last_response: Optional[Message] = None
+        # per-peer filter chains + wire byte counters (ref executor.h
+        # nodes_: every customer keeps its own RemoteNode per peer)
+        from .remote_node import RemoteNodeTable
+
+        self.remote_nodes = RemoteNodeTable()
         self.po.manager.add_customer(self)
 
     # -- communication (ref customer.h Submit/Wait/Reply) --

@@ -33,12 +33,21 @@ class Dashboard:
     def __init__(self) -> None:
         self._data: Dict[str, HeartbeatReport] = {}
         self._tasks: Dict[str, int] = {}
+        self._events: list = []  # cluster events (resizes, recoveries)
 
     def add_report(self, node_id: str, report: HeartbeatReport) -> None:
         self._data[node_id] = report
 
     def add_task(self, node_id: str, task_id: int) -> None:
         self._tasks[node_id] = task_id
+
+    def add_event(self, line: str, keep: int = 8) -> None:
+        """Record a cluster event (elastic resize with its measured
+        stop-the-world pause, recovery, ...) shown under the node table
+        — the reference's dashboard prints NodeChange notes the same
+        way (ref dashboard.cc)."""
+        self._events.append(line)
+        del self._events[:-keep]
 
     def title(self) -> str:
         return "  ".join(name.ljust(width) for name, width in _COLUMNS)
@@ -60,4 +69,5 @@ class Dashboard:
             lines.append(
                 "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
             )
+        lines.extend(f"event: {e}" for e in self._events)
         return "\n".join(lines)

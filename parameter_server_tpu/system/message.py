@@ -87,6 +87,64 @@ class Message:
             f"{self.sender}->{self.recver} keys={nk} vals={len(self.values)})"
         )
 
+    def to_bytes(self) -> bytes:
+        """Wire serialization (ref van.cc Van::Send: Task proto followed
+        by the key/value SArrays as raw buffers). The task — including
+        FilterSpec ``extra`` side-channels like compression meta and key
+        signatures — rides pickle, our stand-in for the reference's
+        protobuf on a trusted intra-cluster control plane; arrays go as
+        raw typed buffers. ``callback`` never crosses the wire."""
+        import pickle
+        import struct
+
+        arrays = ([] if self.key is None else [self.key]) + list(self.values)
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        header = {
+            "task": self.task,
+            "sender": self.sender,
+            "recver": self.recver,
+            "has_key": self.key is not None,
+            "dtypes": [str(a.dtype) for a in arrays],
+            "shapes": [a.shape for a in arrays],
+        }
+        hb = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+        parts = [struct.pack("<I", len(hb)), hb]
+        for a in arrays:
+            b = a.tobytes()
+            parts.append(struct.pack("<Q", len(b)))
+            parts.append(b)
+        return b"".join(parts)
+
+    @staticmethod
+    def from_bytes(blob: bytes) -> "Message":
+        """Inverse of :meth:`to_bytes` (ref van.cc Van::Recv)."""
+        import pickle
+        import struct
+
+        (hlen,) = struct.unpack_from("<I", blob, 0)
+        header = pickle.loads(blob[4 : 4 + hlen])
+        off = 4 + hlen
+        arrays = []
+        for dtype, shape in zip(header["dtypes"], header["shapes"]):
+            (n,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            dt = np.dtype(dtype)
+            arrays.append(
+                np.frombuffer(blob, dtype=dt, count=n // dt.itemsize,
+                              offset=off).reshape(shape).copy()
+                if n
+                else np.zeros(shape, dt)
+            )
+            off += n
+        key = arrays.pop(0) if header["has_key"] else None
+        return Message(
+            task=header["task"],
+            sender=header["sender"],
+            recver=header["recver"],
+            key=key,
+            values=arrays,
+        )
+
 
 def slice_message(msg: Message, key_ranges: Sequence[Range]) -> List[Message]:
     """Partition an ordered-key message by server key ranges.

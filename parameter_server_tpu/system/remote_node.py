@@ -21,6 +21,10 @@ class RemoteNode:
         self.chain = FilterChain()
         self.sent_bytes = 0
         self.recv_bytes = 0
+        # serialized frame sizes — the actual on-the-wire counters the
+        # reference keeps per peer (remote_node.cc sent_bytes_)
+        self.wire_sent_bytes = 0
+        self.wire_recv_bytes = 0
 
     def encode(self, msg: Message, specs: Optional[Sequence[FilterSpec]] = None) -> Message:
         out = self.chain.encode(msg, specs)
@@ -30,6 +34,18 @@ class RemoteNode:
     def decode(self, msg: Message, specs: Optional[Sequence[FilterSpec]] = None) -> Message:
         self.recv_bytes += sum(v.nbytes for v in msg.values)
         return self.chain.decode(msg, specs)
+
+    def to_wire(self, msg: Message, specs: Optional[Sequence[FilterSpec]] = None) -> bytes:
+        """Filter-encode then serialize — the full per-peer send path
+        (ref van.cc Send: RemoteNode filters, then the ZMQ frame)."""
+        blob = self.encode(msg, specs).to_bytes()
+        self.wire_sent_bytes += len(blob)
+        return blob
+
+    def from_wire(self, blob: bytes) -> Message:
+        """Deserialize then filter-decode (ref van.cc Recv)."""
+        self.wire_recv_bytes += len(blob)
+        return self.decode(Message.from_bytes(blob))
 
 
 class RemoteNodeTable:

@@ -76,6 +76,61 @@ def main() -> int:
     total = worker.progress.num_examples_processed
     expected = 64 * n_data * 5
     assert total == expected, f"examples {total} != {expected}"
+
+    # -- control-plane frames through the per-peer filter chain over the
+    # DCN transport (ref remote_node.cc: every send/recv runs the
+    # chain; compressing = shared_array_inl.h snappy, key_caching =
+    # key_caching.h signatures). Process 0 -> 1; byte reductions are
+    # ASSERTED, not assumed. --
+    if jax.process_index() in (0, 1):
+        from parameter_server_tpu.system.message import (
+            FilterSpec,
+            Message,
+            Task,
+        )
+        from parameter_server_tpu.system.remote_node import RemoteNode
+
+        filters = [
+            FilterSpec(type="key_caching"),
+            FilterSpec(type="compressing"),
+        ]
+        keys = np.arange(0, 1 << 15, 2, dtype=np.int64)  # 32K keys
+        vals = np.zeros(keys.size, np.float32)
+        vals[::13] = 1.5  # sparse values: compression must win big
+        raw_bytes = keys.nbytes + vals.nbytes
+        if jax.process_index() == 0:
+            rn = RemoteNode("host1")
+            for seq in range(2):  # same keys twice: 2nd hits the key cache
+                msg = Message(
+                    task=Task(filters=list(filters)),
+                    sender="host0", recver="host1",
+                    key=keys.copy(), values=[vals.copy()],
+                )
+                distributed.post_bytes(f"ctl/0to1/{seq}", rn.to_wire(msg))
+            # first frame: values compressed, keys present; second:
+            # keys dropped by signature + compressed values
+            assert rn.wire_sent_bytes < 2 * raw_bytes * 0.7
+            distributed.post_bytes(
+                "ctl/0to1/sent", str(rn.wire_sent_bytes).encode()
+            )
+        else:
+            rn = RemoteNode("host0")
+            sizes = []
+            for seq in range(2):
+                blob = distributed.fetch_bytes(f"ctl/0to1/{seq}")
+                sizes.append(len(blob))
+                m = rn.from_wire(blob)
+                np.testing.assert_array_equal(m.key, keys)
+                np.testing.assert_array_equal(m.values[0], vals)
+            sent = int(distributed.fetch_bytes("ctl/0to1/sent"))
+            assert sent == sum(sizes), (sent, sizes)
+            # the cached-key resend must be much smaller than the first
+            assert sizes[1] < sizes[0] * 0.5, sizes
+            # and both beat the raw payload
+            assert sizes[0] < raw_bytes, (sizes, raw_bytes)
+            print(f"PS_FILTER_OK {sizes[0]} {sizes[1]} raw {raw_bytes}",
+                  flush=True)
+
     print(f"PS_OK {total}", flush=True)
     return 0
 

@@ -58,18 +58,21 @@ class TestGoldenLines:
         assert np.uint64(b.indices[13]) == np.uint64(h0 ^ h1)
 
     def test_criteo_missing_fields(self):
-        # empty int fields skipped; short (<5 char) categorical tokens
-        # skipped; a line without the 13 int tabs is dropped entirely
+        # EMPTY int fields parse as count 0 (strtoi32("") is a
+        # successful no-conversion in the reference) -> key stripe*i+0;
+        # short (<5 char) categorical tokens skipped; a line without
+        # the 13 int tabs is dropped entirely
         ints = ["", "2"] + [""] * 11
         cats = ["abc"] + ["longtoken"] + [""] * 24
         b = parse_criteo(
             ["1\t" + "\t".join(ints) + "\t" + "\t".join(cats), "1\t2\t3"]
         )
-        assert b.n == 1 and b.nnz == 2
+        assert b.n == 1 and b.nnz == 14  # 13 int keys + 1 long cat
         from parameter_server_tpu.data.text_parser import _CRITEO_STRIPE
 
-        # the surviving int feature: slot i=1 (second field), count 2
-        assert np.uint64(b.indices[0]) == np.uint64(
+        # empty field 0 -> count 0; explicit "2" in slot i=1 -> count 2
+        assert np.uint64(b.indices[0]) == np.uint64(0)
+        assert np.uint64(b.indices[1]) == np.uint64(
             (_CRITEO_STRIPE * 1 + 2) & ((1 << 64) - 1)
         )
 
@@ -181,9 +184,18 @@ class TestParserFuzz:
     spliced fragments (the classes behind every past parity bug)."""
 
     def _mutate(self, rng, line: str) -> str:
-        ops = rng.integers(0, 6)
+        ops = rng.integers(0, 7)
         if ops == 0 and len(line) > 2:  # truncate anywhere
             return line[: rng.integers(1, len(line))]
+        if ops == 5 and "\t" in line:  # empty out one criteo field
+            f = line.split("\t")
+            f[int(rng.integers(0, len(f)))] = ""
+            return "\t".join(f)
+        if ops == 6 and line:  # long leading-zero run before a digit
+            # (strtoull/strtol accumulate magnitude — a digit-COUNT
+            # overflow guard must not clamp '00…07' to ULLONG_MAX)
+            i = rng.integers(0, len(line))
+            return line[:i] + "0" * int(rng.integers(15, 30)) + line[i:]
         if ops == 1:  # inject a garbage byte
             i = rng.integers(0, len(line) + 1)
             ch = chr(rng.integers(33, 127))
@@ -243,6 +255,70 @@ class TestParserFuzz:
                 # float() divergence is exactly what this test hunts
                 np.testing.assert_array_equal(a.values, b.values, err_msg=ctx)
             np.testing.assert_array_equal(a.slot_ids, b.slot_ids, err_msg=ctx)
+
+    def test_empty_tokens_parse_as_zero_like_reference(self):
+        """strtonum.h treats strtoull("")/strtof("")/strtol("") as
+        success with 0 (no conversion, end at the terminator). So
+        ":5" is feature id 0, "7:" is value 0, an empty criteo label
+        is class -1, and an EMPTY criteo int field emits key
+        stripe*i+0 (that's how real criteo marks missing ints)."""
+        for fmt in ("libsvm", "criteo"):
+            python = ExampleParser(fmt, use_native=False)
+            native = ExampleParser(fmt, use_native=True)
+            if fmt == "libsvm":
+                lines = ["1 :5 9:", "-1 :"]
+                a = python.parse_lines(lines)
+                assert a.y.tolist() == [1.0, -1.0]
+                assert a.indices.tolist() == [0, 9, 0]
+                assert a.values.tolist() == [5.0, 0.0, 0.0]
+            else:
+                ints = ["1"] * 13
+                ints[3] = ""          # missing int -> key stripe*3 + 0
+                cats = ["deadbeef"] * 26
+                lines = ["\t".join([""] + ints + cats)]  # empty label
+                a = python.parse_lines(lines)
+                assert a.y.tolist() == [-1.0]  # label 0 -> negative
+                from parameter_server_tpu.data.text_parser import (
+                    _CRITEO_STRIPE,
+                )
+                assert (_CRITEO_STRIPE * 3) in (
+                    np.asarray(a.indices, np.uint64).tolist()
+                )
+            if native.use_native:
+                b = native.parse_lines(lines)
+                np.testing.assert_array_equal(a.y, b.y)
+                np.testing.assert_array_equal(a.indices, b.indices)
+                np.testing.assert_array_equal(a.indptr, b.indptr)
+                if not a.binary:
+                    np.testing.assert_array_equal(a.values, b.values)
+
+    @pytest.mark.parametrize("fmt,lines,want_indices", [
+        # strtoull accumulates: 21 digits of mostly zeros is 7, not a
+        # clamp to ULLONG_MAX (which would also drop the line as
+        # unordered since ULLONG_MAX > 9 fails the sorted-ids check)
+        ("libsvm", ["1 000000000000000000007:1 9:1"], [7, 9]),
+        # criteo integer field: 20 zero-padded digits parse to key 5
+        # in slot 6 (stripe 5), not strtol-ERANGE
+        ("criteo", None, None),
+    ])
+    def test_leading_zero_runs_parse_by_magnitude(self, fmt, lines, want_indices):
+        python = ExampleParser(fmt, use_native=False)
+        native = ExampleParser(fmt, use_native=True)
+        if fmt == "criteo":
+            ints = ["1"] * 13
+            ints[5] = "00000000000000000005"
+            cats = ["00000000"] * 26
+            lines = ["0\t" + "\t".join(ints + cats)]
+        a = python.parse_lines(lines)
+        if fmt == "libsvm":
+            assert a.indices.tolist() == want_indices, a.indices
+        else:
+            from parameter_server_tpu.data.text_parser import _CRITEO_STRIPE
+            assert (_CRITEO_STRIPE * 5 + 5) in a.indices.tolist()
+        if native.use_native:
+            b = native.parse_lines(lines)
+            np.testing.assert_array_equal(a.indices, b.indices)
+            np.testing.assert_array_equal(a.indptr, b.indptr)
 
 
 class TestPythonOnlyParserRobustness:
@@ -493,13 +569,18 @@ class TestLibsvmFastPaths:
         np.testing.assert_array_equal(a.indices, c.indices)
         np.testing.assert_allclose(a.values, c.values, rtol=0)
 
-    def test_criteo_tabs_only_line_dropped(self):
-        """A tabs-only line must be dropped — not let strtod cross the
-        newline and steal the next line's label as a phantom row."""
+    def test_criteo_tabs_only_line_is_all_zero_row(self):
+        """A tabs-only line parses as a valid ALL-MISSING row in the
+        reference (strtofloat("")/strtoi32("") succeed with 0): label 0
+        -> class -1, 13 zero-count int keys, no cats. The parse must
+        still not let strtod cross the newline and steal the next
+        line's label."""
         tabs_only = "\t" * 39 + "\n"
         good = (
             "1\t" + "\t".join("2" for _ in range(13)) + "\t"
             + "\t".join("LONGTOK%d" % i for i in range(26)) + "\n"
         )
         b = ExampleParser("criteo").parse_text((tabs_only + good).encode())
-        assert b.n == 1 and float(b.y[0]) == 1.0
+        assert b.n == 2
+        assert b.y.tolist() == [-1.0, 1.0]  # "" label did NOT eat the 1
+        assert b.indptr[1] - b.indptr[0] == 13  # 13 empty-int keys

@@ -120,3 +120,15 @@ def test_lm_cli_save_needs_dir(mesh8):
 def test_lm_cli_rejects_bad_seq_len(mesh8):
     with pytest.raises(SystemExit):
         main(["--seq-len", "65"])  # not divisible by the 8-device axis
+
+
+@pytest.mark.parametrize("argv", [
+    ["--attention", "a2a", "--window", "8"],   # window needs a flash mode
+    ["--window", "0"],                         # window must be >= 1
+])
+def test_lm_cli_invalid_config_is_a_flag_error(mesh8, argv):
+    """LMConfig-rejected combinations surface as argparse errors
+    (SystemExit 2), not raw ValueError tracebacks."""
+    with pytest.raises(SystemExit) as e:
+        main(["--steps", "1", *argv])
+    assert e.value.code == 2

@@ -23,10 +23,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("nproc", [2])
-def test_local_sh_two_hosts(nproc):
-    """script/local.sh launches N federated processes; every one trains the
-    same global model and reports the psum'd example count."""
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_local_sh_n_hosts(nproc):
+    """script/local.sh launches N federated processes; every one trains
+    the same global model and reports the psum'd example count. nproc=4
+    exercises cross-host server sharding seams (2x2 data x server per
+    host pair) that 2 processes cannot; processes 0/1 additionally
+    exchange filter-chained control frames over the DCN transport and
+    assert the compression + key-cache byte reductions."""
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["PS_PORT"] = str(_free_port())
     env["PS_LOCAL_DEVICES"] = "2"
@@ -37,7 +41,7 @@ def test_local_sh_two_hosts(nproc):
         env=env,
         capture_output=True,
         text=True,
-        timeout=300,
+        timeout=600,
         cwd=REPO,
     )
     # processes share the pipe, so two PS_OK prints can interleave on one
@@ -49,3 +53,6 @@ def test_local_sh_two_hosts(nproc):
     assert len(oks) == nproc, proc.stdout[-2000:]
     # all processes agree on the global example count
     assert len(set(oks)) == 1
+    # the filtered control-plane exchange ran and its byte reductions
+    # held (asserted in the child; the marker proves it executed)
+    assert "PS_FILTER_OK" in proc.stdout, proc.stdout[-2000:]
