@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Run an N-container parameter_server_tpu job on ONE machine (ref
+# docker/local.sh: num_servers + num_workers containers wired to a
+# scheduler container over docker0).
+#
+# Containers join the jax.distributed rendezvous exactly like processes
+# launched by script/local.sh: container 0 is the coordinator (the
+# reference's scheduler) and the others dial it over the docker bridge
+# network. Roles (server/worker) are mesh axes inside the SPMD program,
+# so unlike the reference there is no per-role container — every
+# container runs the same command.
+#
+# usage: docker/local.sh <num_hosts> <command...>
+#   e.g. docker/local.sh 2 python -m parameter_server_tpu.apps.linear.main \
+#          configs/rcv1.conf --num-servers 2
+set -euo pipefail
+N=${1:?usage: docker/local.sh <num_hosts> <command...>}; shift
+IMAGE=${PS_IMAGE:-parameter-server-tpu}
+PORT=${PS_PORT:-29450}
+NET=${PS_NET:-psnet}
+DEVS=${PS_LOCAL_DEVICES:-2}
+
+docker network inspect "$NET" >/dev/null 2>&1 || docker network create "$NET"
+
+cids=()
+cleanup() { docker rm -f "${cids[@]}" >/dev/null 2>&1 || true; }
+trap cleanup INT TERM EXIT
+
+for ((i = N - 1; i >= 0; i--)); do
+  cids+=("$(docker run -d --network "$NET" --name "ps-node-$i" \
+    -e JAX_PLATFORMS=cpu \
+    -e XLA_FLAGS="--xla_force_host_platform_device_count=${DEVS}" \
+    -e PS_COORDINATOR_ADDRESS="ps-node-0:${PORT}" \
+    -e PS_NUM_PROCESSES="$N" \
+    -e PS_PROCESS_ID="$i" \
+    "$IMAGE" "$@")")
+done
+
+# stream the coordinator's output; fail if any container fails
+docker logs -f "ps-node-0" &
+rc=0
+for ((i = 0; i < N; i++)); do
+  r=$(docker wait "ps-node-$i")
+  if (( r != 0 && rc == 0 )); then rc=$r; docker logs "ps-node-$i" | tail -20; fi
+done
+exit "$rc"

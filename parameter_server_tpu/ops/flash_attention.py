@@ -38,6 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 _LANE = 128
+_SUBLANE = 8  # f32 sublane tile: stat vectors are stored [.., 8, S] because
+# Mosaic requires block shapes tileable to (8, 128) — row 0 carries the data
 _NEG = -1e30  # finite mask value: keeps exp/max arithmetic NaN-free
 
 
@@ -150,9 +152,10 @@ def _fwd_kernel(
         out_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(
             out_ref.dtype
         )
-        lse_ref[...] = jnp.where(
+        lse = jnp.where(
             l > 0, m_ref[...] + jnp.log(jnp.maximum(l, 1e-30)), _NEG
-        )
+        )  # [1, bq] -> broadcast over the sublane-tile dim
+        lse_ref[...] = jnp.broadcast_to(lse[None], lse_ref.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -223,13 +226,13 @@ def _bwd_dq_kernel(
             jnp.int32, (block_k, 1), 0
         )
         p_t = _recompute_pt(
-            q, k, lse_ref[...], causal=causal, scale=scale,
+            q, k, lse_ref[0][:1], causal=causal, scale=scale,
             q_pos=q_pos, k_pos=k_pos, k_len=k_len, window=window,
         )
         dp_t = jax.lax.dot_general(  # [bk, bq] = v . do^T
             v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds_t = p_t * (dp_t - c_ref[...]) * scale
+        ds_t = p_t * (dp_t - c_ref[0][:1]) * scale
         acc_ref[...] += jax.lax.dot_general(  # [D, bq] += k^T . ds_t
             k, ds_t, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -272,7 +275,7 @@ def _bwd_dkv_kernel(
             jnp.int32, (block_k, 1), 0
         )
         p_t = _recompute_pt(
-            q, k, lse_ref[...], causal=causal, scale=scale,
+            q, k, lse_ref[0][:1], causal=causal, scale=scale,
             q_pos=q_pos, k_pos=k_pos, k_len=k_len, window=window,
         )
         dv_acc[...] += jax.lax.dot_general(  # [bk, D] += p_t . do
@@ -282,7 +285,7 @@ def _bwd_dkv_kernel(
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds_t = p_t * (dp_t - c_ref[...]) * scale
+        ds_t = p_t * (dp_t - c_ref[0][:1]) * scale
         dk_acc[...] += jax.lax.dot_general(  # [bk, D] += ds_t . q
             ds_t, q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -341,11 +344,11 @@ def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
         ],
         out_specs=(
             pl.BlockSpec((1, dp_, bq), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, _SUBLANE, bq), lambda b, i, j: (b, 0, i)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, dp_, qp.shape[1]), q.dtype),
-            jax.ShapeDtypeStruct((bh, qp.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((bh, _SUBLANE, qp.shape[1]), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((dp_, bq), jnp.float32),
@@ -355,7 +358,7 @@ def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
         interpret=interpret,
     )(qo, ko, qp, kp, vp)
     out = jnp.swapaxes(out_t, 1, 2)[:, :sq, :d]
-    return out, lse[:, :sq]
+    return out, lse[:, 0, :sq]
 
 
 def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
@@ -377,6 +380,9 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
         )
         lsep = jnp.where(pad_rows, -_NEG, lsep)
     cp = _pad_to(c, 1, bq)
+    # stat vectors enter the kernels sublane-tiled: [BH, 8, Sq] (row 0 live)
+    lsep = jnp.broadcast_to(lsep[:, None, :], (bh, _SUBLANE, lsep.shape[1]))
+    cp = jnp.broadcast_to(cp[:, None, :], (bh, _SUBLANE, cp.shape[1]))
     dp_ = qp.shape[2]
     nq, nk = qp.shape[1] // bq, kp.shape[1] // bk
     qo = q_offset.astype(jnp.int32).reshape(1, 1)
@@ -384,7 +390,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((1, bq, dp_), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, dp_), lambda b, i, j: (b, j, 0))
-    vec_q = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    vec_q = pl.BlockSpec((1, _SUBLANE, bq), lambda b, i, j: (b, 0, i))
     dq_t = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, scale=scale, nk=nk, k_len=sk,
@@ -400,7 +406,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
     # dkv: k blocks outer (parallel), q blocks inner (accumulated)
     qspec2 = pl.BlockSpec((1, bq, dp_), lambda b, j, i: (b, i, 0))
     kspec2 = pl.BlockSpec((1, bk, dp_), lambda b, j, i: (b, j, 0))
-    vec_q2 = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    vec_q2 = pl.BlockSpec((1, _SUBLANE, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale, nq=nq, k_len=sk,

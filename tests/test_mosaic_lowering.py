@@ -1,0 +1,102 @@
+"""Real-Mosaic lowering regression tests (no chip needed).
+
+Round 2 shipped flash kernels validated only in Pallas interpret mode; on
+first contact with the chip they failed Mosaic's (8, 128) block-tiling
+check — exactly the class of bug the interpreter cannot catch.
+``jax.export`` with ``platforms=['tpu']`` runs the full Pallas->Mosaic
+lowering pipeline on the CPU host, so every kernel variant is lowered for
+TPU in CI. This does not execute anything on a TPU (backend compile/run
+is covered by script/onchip.py); it pins the lowering contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from parameter_server_tpu.ops.flash_attention import flash_attention, flash_mha
+from parameter_server_tpu.ops.ftrl import ftrl_update
+from parameter_server_tpu.ops.quantize import quantize
+
+
+def lower_tpu(fn, *args):
+    jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+def _fa(**kw):
+    def fn(q, k, v):
+        return flash_attention(q, k, v, use_pallas=True, interpret=False, **kw)
+
+    return fn
+
+
+def _fa_grad(**kw):
+    def fn(q, k, v):
+        return jax.grad(
+            lambda *a: _fa(**kw)(*a).astype(jnp.float32).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    return fn
+
+
+Z = jnp.zeros
+
+
+@pytest.mark.parametrize(
+    "shape,dtype,kw",
+    [
+        ((4, 1024, 64), jnp.float32, dict(causal=True)),
+        ((4, 1024, 64), jnp.float32, dict(causal=False)),
+        ((4, 1024, 64), jnp.bfloat16, dict(causal=True)),
+        ((4, 1024, 64), jnp.float32, dict(causal=True, window=256)),
+        ((2, 96, 40), jnp.float32, dict(causal=True)),  # sub-block, odd D
+        ((1, 384, 128), jnp.float32, dict(causal=True)),  # S % block != 0
+    ],
+    ids=["causal", "full", "bf16", "window", "small", "s384"],
+)
+def test_flash_fwd_and_bwd_lower(shape, dtype, kw):
+    q = Z(shape, dtype)
+    lower_tpu(_fa(**kw), q, q, q)
+    lower_tpu(_fa_grad(**kw), q, q, q)
+
+
+def test_flash_traced_offsets_lower():
+    q = Z((4, 512, 64), jnp.float32)
+
+    def fn(q, k, v, off):
+        return flash_attention(
+            q, k, v, causal=True, q_offset=off, k_offset=off,
+            use_pallas=True, interpret=False, with_lse=True,
+        )
+
+    lower_tpu(fn, q, q, q, jnp.int32(512))
+
+
+def test_flash_gqa_lowers():
+    x = Z((2, 512, 256), jnp.float32)
+    kv = Z((2, 512, 64), jnp.float32)
+
+    def fn(a, b, c):
+        return flash_mha(
+            a, b, c, 8, n_kv_heads=2, causal=True,
+            use_pallas=True, interpret=False,
+        )
+
+    lower_tpu(fn, x, kv, kv)
+
+
+def test_ftrl_kernel_lowers():
+    p = 1 << 14
+
+    def fn(z, n, g, t):
+        return ftrl_update(
+            z, n, g, t, alpha=0.1, beta=1.0, l1=1.0, l2=0.1, force_pallas=True
+        )
+
+    lower_tpu(fn, Z(p), Z(p), Z(p), Z(p, jnp.bool_))
+
+
+def test_quantize_kernel_lowers():
+    def fn(x, seed):
+        return quantize(x, seed, num_bytes=1, force_pallas=True)
+
+    lower_tpu(fn, Z((512, 256), jnp.float32), jnp.uint32(7))

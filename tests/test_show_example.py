@@ -1,0 +1,81 @@
+"""show_example inspection CLI (ref src/data/show_example.h)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.data.show_example import (
+    format_example,
+    main,
+    show_example,
+)
+from parameter_server_tpu.data.text2record import convert
+from parameter_server_tpu.utils.sparse import SparseBatch
+
+
+@pytest.fixture
+def libsvm_file(tmp_path):
+    p = tmp_path / "train.libsvm"
+    p.write_text("1 3:0.5 7:1.25\n-1 1:2 9:0.125\n1 2:1\n1 4:1\n")
+    return str(p)
+
+
+def test_text_first_n(libsvm_file, capsys):
+    shown = show_example(libsvm_file, "libsvm", 2)
+    assert shown == 2
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    # label slot id 0, features in slot id 1 (proto slot ids are 1-based)
+    assert lines[0] == (
+        "slot { id: 0 val: 1 } slot { id: 1 key: 3 key: 7 val: 0.5 val: 1.25 }"
+    )
+    assert "val: 2" in lines[1] and "key: 9" in lines[1]
+
+
+def test_n_beyond_file(libsvm_file, capsys):
+    assert show_example(libsvm_file, "libsvm", 100) == 4
+    assert len(capsys.readouterr().out.strip().splitlines()) == 4
+
+
+def test_recordio_roundtrip(libsvm_file, tmp_path, capsys):
+    rec = str(tmp_path / "train.rec")
+    convert([libsvm_file], "libsvm", rec)
+    assert show_example(rec, "recordio", 3) == 3
+    text_out = capsys.readouterr().out
+    # record path shows the same parsed examples as the text path
+    show_example(libsvm_file, "libsvm", 3)
+    assert capsys.readouterr().out == text_out
+
+
+def test_multislot_grouping():
+    # criteo-style: slot_ids group entries into distinct slots
+    batch = SparseBatch(
+        y=np.array([1.0], np.float32),
+        indptr=np.array([0, 3], np.int64),
+        indices=np.array([10, 20, 30], np.int64),
+        values=None,
+        slot_ids=np.array([1, 1, 5], np.int32),
+    )
+    line = format_example(batch, 0)
+    assert "slot { id: 1 key: 10 key: 20 }" in line
+    assert "slot { id: 5 key: 30 }" in line
+    assert "val:" not in line.split("}", 1)[1]  # binary: no feature vals
+
+
+def test_cli_reference_flags(libsvm_file, capsys):
+    # reference-style single-dash flags: -input -format -n
+    rc = main(["-input", libsvm_file, "-format", "libsvm", "-n", "1"])
+    assert rc == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) == 1
+
+
+def test_cli_empty_input(tmp_path, capsys):
+    p = tmp_path / "empty.libsvm"
+    p.write_text("")
+    assert main(["-input", str(p), "-format", "libsvm"]) == 1
+
+
+def test_cli_bad_n(libsvm_file):
+    with pytest.raises(SystemExit):
+        main(["-input", libsvm_file, "-n", "0"])
