@@ -42,7 +42,9 @@ class CompressingFilter(Filter):
             return msg
         out = []
         for v, (dtype, shape) in zip(msg.values, meta):
-            raw = codec.decompress(v.tobytes())
+            dt = np.dtype(dtype)
+            expected = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            raw = codec.decompress(v.tobytes(), expected_size=expected)
             out.append(np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy())
         msg.values = out
         return msg

@@ -138,8 +138,11 @@ def submit(
         # executor.cc AddNode: every node joins kLiveGroup and its role
         # group), so a broadcast delivers to self via loopback too
         for target in _group_apps(recver):
+            # fresh_copy: each target's encode chain mutates the filter
+            # specs' extra dicts (compression meta, key signatures) —
+            # sharing them across targets or with the caller's Task races
             req = Message(
-                task=dataclasses.replace(task),
+                task=task.fresh_copy(),
                 sender=app.name,
                 recver=target.node.id,
             )

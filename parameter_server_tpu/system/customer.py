@@ -8,6 +8,8 @@ unique id, exactly like the reference's ``Customer(id)`` +
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Any, Callable, Optional
 
 from .executor import Executor
@@ -55,6 +57,22 @@ class Customer:
         self.executor.tracker.finish(request.task.time)
         target = self.po.manager.find_customer_by_name(request.sender)
         if target is not None:
+            # responses ride the same per-peer filter chains and wire
+            # framing as requests (ref remote_node.cc: filters apply on
+            # every send AND recv — pull responses are the dominant
+            # server->worker traffic). Peer keys mirror the request path,
+            # so one RemoteNode per peer carries both directions. Encode
+            # a copy: the chain mutates the message in place (values ->
+            # compressed blobs, key stripped) and the caller keeps its
+            # response object.
+            wire_msg = dataclasses.replace(
+                response,
+                task=response.task.fresh_copy(),
+                values=list(response.values),
+                callback=None,
+            )
+            blob = self.remote_nodes.get(response.recver).to_wire(wire_msg)
+            response = target.remote_nodes.get(response.sender).from_wire(blob)
             target._last_response = response  # ref customer.h LastResponse()
             target.process_response(response)
         if request.callback is not None:

@@ -21,6 +21,34 @@ from ..utils.range import Range
 
 INVALID_TIME = -1
 
+# builtins reachable via pickle's find_class that are data, not code
+_SAFE_BUILTINS = {"complex", "range", "slice", "frozenset", "set", "bytearray"}
+
+
+def _restricted_loads(blob: bytes):
+    """Unpickle a wire header allowing only this package's types, numpy
+    array reconstruction, and plain-data builtins. Blocks the classic
+    ``__reduce__`` -> ``os.system`` escalation while keeping Task payloads
+    (our dataclasses, Ranges, numpy scalars/arrays) round-trippable."""
+    import io
+    import pickle
+
+    class _Unpickler(pickle.Unpickler):
+        def find_class(self, module: str, name: str):
+            if module.startswith("parameter_server_tpu."):
+                return super().find_class(module, name)
+            if module == "numpy" or module.startswith(("numpy.", "numpy._")):
+                return super().find_class(module, name)
+            if module == "collections" and name == "OrderedDict":
+                return super().find_class(module, name)
+            if module == "builtins" and name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+            raise pickle.UnpicklingError(
+                f"wire frame names forbidden global {module}.{name}"
+            )
+
+    return _Unpickler(io.BytesIO(blob)).load()
+
 
 class Command(enum.Enum):
     """Control commands (ref task.proto Control/ManageNode + sgd.proto
@@ -62,6 +90,20 @@ class Task:
     push: bool = False  # push vs pull for parameter tasks
     more: bool = False  # scheduler hint: more blocks coming (ref darlin)
     payload: Any = None  # app-specific (workload descriptors, progress, ...)
+
+    def fresh_copy(self) -> "Task":
+        """Per-send copy. Filter ``extra`` dicts are per-message side
+        channels the encode chain mutates (compression meta, key
+        signatures); sharing them across concurrent sends or group
+        targets races one send's meta into another's frame."""
+        return dataclasses.replace(
+            self,
+            wait_time=list(self.wait_time),
+            filters=[
+                dataclasses.replace(f, extra=dict(f.extra))
+                for f in self.filters
+            ],
+        )
 
 
 @dataclasses.dataclass
@@ -117,33 +159,45 @@ class Message:
 
     @staticmethod
     def from_bytes(blob: bytes) -> "Message":
-        """Inverse of :meth:`to_bytes` (ref van.cc Van::Recv)."""
-        import pickle
+        """Inverse of :meth:`to_bytes` (ref van.cc Van::Recv).
+
+        Malformed or truncated frames raise ``ValueError`` (matching the
+        codec layer's contract), and the header unpickler is restricted
+        to this package's types + numpy reconstruction — a frame from a
+        compromised peer cannot name arbitrary callables the way plain
+        ``pickle.loads`` would allow."""
         import struct
 
-        (hlen,) = struct.unpack_from("<I", blob, 0)
-        header = pickle.loads(blob[4 : 4 + hlen])
-        off = 4 + hlen
-        arrays = []
-        for dtype, shape in zip(header["dtypes"], header["shapes"]):
-            (n,) = struct.unpack_from("<Q", blob, off)
-            off += 8
-            dt = np.dtype(dtype)
-            arrays.append(
-                np.frombuffer(blob, dtype=dt, count=n // dt.itemsize,
-                              offset=off).reshape(shape).copy()
-                if n
-                else np.zeros(shape, dt)
+        try:
+            (hlen,) = struct.unpack_from("<I", blob, 0)
+            header = _restricted_loads(bytes(blob[4 : 4 + hlen]))
+            off = 4 + hlen
+            arrays = []
+            for dtype, shape in zip(header["dtypes"], header["shapes"]):
+                (n,) = struct.unpack_from("<Q", blob, off)
+                off += 8
+                dt = np.dtype(dtype)
+                if off + n > len(blob):
+                    raise ValueError("array payload exceeds frame")
+                arrays.append(
+                    np.frombuffer(blob, dtype=dt, count=n // dt.itemsize,
+                                  offset=off).reshape(shape).copy()
+                    if n
+                    else np.zeros(shape, dt)
+                )
+                off += n
+            key = arrays.pop(0) if header["has_key"] else None
+            return Message(
+                task=header["task"],
+                sender=header["sender"],
+                recver=header["recver"],
+                key=key,
+                values=arrays,
             )
-            off += n
-        key = arrays.pop(0) if header["has_key"] else None
-        return Message(
-            task=header["task"],
-            sender=header["sender"],
-            recver=header["recver"],
-            key=key,
-            values=arrays,
-        )
+        except ValueError:
+            raise
+        except Exception as e:  # struct.error, pickle errors, bad shapes...
+            raise ValueError(f"truncated or malformed wire frame: {e}") from e
 
 
 def slice_message(msg: Message, key_ranges: Sequence[Range]) -> List[Message]:

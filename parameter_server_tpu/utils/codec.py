@@ -52,9 +52,15 @@ def compress(data: bytes) -> bytes:
     return bytes([_RAW]) + data
 
 
-def decompress(frame: bytes, max_size: int = 1 << 31) -> bytes:
+def decompress(
+    frame: bytes, max_size: int = 1 << 31, expected_size: int = None
+) -> bytes:
     """Decode a frame from :func:`compress`. Raises ``ValueError`` on a
-    malformed frame (wire payloads are untrusted)."""
+    malformed frame (wire payloads are untrusted). ``expected_size`` (the
+    decoded byte count, when the caller's metadata implies it — the
+    compressing filter's dtype/shape) sizes the output buffer exactly so
+    the native decode is single-pass; without it the buffer grows
+    geometrically."""
     if len(frame) < 1:
         raise ValueError("empty codec frame")
     tag, body = frame[0], frame[1:]
@@ -70,10 +76,13 @@ def decompress(frame: bytes, max_size: int = 1 << 31) -> bytes:
         if lib is None:
             raise ValueError("native LZ frame but libpsnative unavailable")
         src = np.frombuffer(body, np.uint8)
-        # geometric growth: the frame doesn't carry the decoded size
-        # (the filter's dtype/shape meta implies it, but decode must
-        # stand alone); LZ output is bounded by 255x input per token run
-        cap = max(64, 4 * len(body))
+        # the frame doesn't carry the decoded size (decode must stand
+        # alone); callers that know it pass expected_size for a
+        # single-pass decode, else grow geometrically
+        if expected_size is not None:
+            cap = min(max(64, int(expected_size)), max_size)
+        else:
+            cap = max(64, 4 * len(body))
         while True:
             dst = np.empty(cap, np.uint8)
             got = lib.ps_lz_decompress(

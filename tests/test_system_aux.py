@@ -246,3 +246,57 @@ class TestCheckpointReplica:
         assert rm.recover(kv)
         np.testing.assert_allclose(kv.values(0, keys), np.ones((2, 1)))
         Postoffice.reset()
+
+
+class TestWireFrameSafety:
+    """Message.from_bytes on untrusted/corrupt frames (ref van.cc recv)."""
+
+    def _msg(self):
+        return Message(
+            task=Task(filters=[FilterSpec(type="compressing")]),
+            sender="W0",
+            recver="S0",
+            key=np.arange(4, dtype=np.int64),
+            values=[np.ones(3, np.float32)],
+        )
+
+    def test_roundtrip(self):
+        m = Message.from_bytes(self._msg().to_bytes())
+        assert m.sender == "W0" and m.task.filters[0].type == "compressing"
+        np.testing.assert_array_equal(m.key, np.arange(4))
+
+    def test_truncated_frame_is_value_error(self):
+        blob = self._msg().to_bytes()
+        for cut in (0, 2, len(blob) // 2, len(blob) - 1):
+            with pytest.raises(ValueError):
+                Message.from_bytes(blob[:cut])
+
+    def test_flipped_length_is_value_error(self):
+        blob = bytearray(self._msg().to_bytes())
+        blob[0] = 0xFF  # header length now exceeds the frame
+        with pytest.raises(ValueError):
+            Message.from_bytes(bytes(blob))
+
+    def test_forbidden_global_rejected(self):
+        import pickle
+        import struct
+
+        # a classic __reduce__ payload: pickle naming os.system
+        evil = pickle.dumps((__import__("os").system, ("true",)))
+        frame = struct.pack("<I", len(evil)) + evil
+        with pytest.raises(ValueError, match="forbidden global|malformed"):
+            Message.from_bytes(frame)
+
+    def test_task_payload_roundtrip(self):
+        # app payloads built from package types + numpy survive the
+        # restricted unpickler
+        m = Message(task=Task(payload={"r": Range(3, 9), "x": np.float64(2.5)}))
+        out = Message.from_bytes(m.to_bytes())
+        assert out.task.payload["r"] == Range(3, 9)
+        assert out.task.payload["x"] == 2.5
+
+    def test_fresh_copy_isolates_filter_extra(self):
+        t = Task(filters=[FilterSpec(type="compressing")])
+        c = t.fresh_copy()
+        c.filters[0].extra["meta"] = ["poison"]
+        assert "meta" not in t.filters[0].extra
