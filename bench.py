@@ -234,11 +234,20 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
     best = None
     swept = {}
     for t in ts:
-        sb = stack_supersteps(prep_parts, t)
-        staged = jax.device_put(sb)
-        # untimed: compile this T's scan program + settle the pipeline
-        worker.executor.wait(worker._submit_prepped(staged, with_aux=False))
-        flush(worker)
+        try:
+            sb = stack_supersteps(prep_parts, t)
+            staged = jax.device_put(sb)
+            # untimed: compile this T's scan program + settle the pipeline
+            worker.executor.wait(
+                worker._submit_prepped(staged, with_aux=False)
+            )
+            flush(worker)
+        except Exception as e:  # e.g. RESOURCE_EXHAUSTED at deep T
+            # the user-configured base_t already ran the e2e phases, so
+            # never let an oversized sweep depth zero the whole run —
+            # disclose the failed depth and stop (larger only gets worse)
+            swept[t] = f"failed: {type(e).__name__}"
+            break
         launches = max(3, 96 // t)
         pending = []
         t0 = time.perf_counter()
@@ -254,6 +263,8 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
         swept[t] = round(rate, 1)
         if best is None or rate > best[1]:
             best = (t, rate, sec / launches, sb)
+    if best is None:  # even base_t failed — phases before us ran it fine
+        raise RuntimeError(f"device_only_sweep: no depth succeeded ({swept})")
     return best + (swept,)
 
 

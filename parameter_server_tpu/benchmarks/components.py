@@ -192,7 +192,12 @@ def attention_perf(smoke: bool = False) -> None:
     """Flash-kernel vs XLA dense attention on one device (the per-chunk
     compute that ring/ulysses sequence parallelism schedules). Flushes by
     fetching a scalar — block_until_ready under-waits on the tunneled
-    backend (see bench.py's measurement note)."""
+    backend (see bench.py's measurement note). CHAIN attention calls run
+    inside one jitted lax.scan (each feeding its output back as the next
+    query, so nothing dead-codes): one launch per timed rep costs a
+    tunnel round trip that would otherwise swamp the kernel — the first
+    on-chip capture measured both paths at an identical 195 GFLOP/s,
+    i.e. pure dispatch latency."""
     import jax
 
     from ..ops.flash_attention import _use_pallas, flash_attention
@@ -200,31 +205,46 @@ def attention_perf(smoke: bool = False) -> None:
     bh = 4
     s = 512 if smoke else 4096
     d = 64
+    chain = 2 if smoke else 16
     rng = np.random.default_rng(0)
     q, k, v = (
         jax.device_put(rng.normal(size=(bh, s, d)).astype(np.float32))
         for _ in range(3)
     )
 
-    def make_run(use_pallas):
-        # jit the whole call so the XLA path is the FUSED program the
+    def make_run(use_pallas, dtype=np.float32):
+        # jit the whole chain so the XLA path is the FUSED program the
         # model paths embed, not an eager per-op chain
-        fn = jax.jit(
-            lambda q, k, v: flash_attention(
-                q, k, v, causal=True, use_pallas=use_pallas,
-                interpret=False if use_pallas else None,
-            )
-        )
+        @jax.jit
+        def fn(q0, kk, vv):
+            def body(qc, _):
+                o = flash_attention(
+                    qc, kk, vv, causal=True, use_pallas=use_pallas,
+                    interpret=False if use_pallas else None,
+                )
+                return o.astype(qc.dtype), None
+
+            qf, _ = jax.lax.scan(body, q0, None, length=chain)
+            return qf
+
+        args = [x.astype(dtype) for x in (q, k, v)]
 
         def run():
-            np.asarray(fn(q, k, v)[0, 0, 0])  # true device->host flush
+            np.asarray(fn(*args)[0, 0, 0], np.float32)  # true flush
 
         return run
 
-    flops = 4.0 * bh * s * s * d  # 2 matmuls, causal ~half but count full
+    # 2 matmuls, causal ~half but count full (the convention MFU tables use)
+    flops = 4.0 * bh * s * s * d * chain
     n = 2 if smoke else 10
     sec = timeit(make_run(False), n)
     report("attention_xla_gflops", flops / sec / 1e9, "GFLOP/s")
     if _use_pallas():  # Mosaic on TPU only (interpret is not a perf path)
         sec = timeit(make_run(True), n)
         report("attention_flash_gflops", flops / sec / 1e9, "GFLOP/s")
+        # bf16 inputs (fp32 accumulation in-kernel): the dtype the LM
+        # decoder actually feeds, and the MXU's native input width
+        sec = timeit(make_run(True, np.dtype("bfloat16")), n)
+        report("attention_flash_bf16_gflops", flops / sec / 1e9, "GFLOP/s")
+        sec = timeit(make_run(False, np.dtype("bfloat16")), n)
+        report("attention_xla_bf16_gflops", flops / sec / 1e9, "GFLOP/s")

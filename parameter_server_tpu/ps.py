@@ -153,9 +153,12 @@ def submit(
             # reference serializes through ZMQ even between local
             # processes). Filters with per-peer state — key_caching
             # signatures, compression meta — therefore carry every ps.h
-            # RPC, and the RemoteNode wire counters measure real frames.
-            blob = app.remote_nodes.get(target.node.id).to_wire(req)
-            req = target.remote_nodes.get(app.name).from_wire(blob)
+            # RPC, and the RemoteNode/Van counters measure real frames.
+            req = app.po.van.transfer(
+                app.remote_nodes.get(target.node.id),
+                target.remote_nodes.get(app.name),
+                req,
+            )
             # each node's receive path is serialized (the reference runs one
             # executor thread per customer), so hello-style apps may mutate
             # unlocked state in process_request

@@ -71,8 +71,11 @@ class Customer:
                 values=list(response.values),
                 callback=None,
             )
-            blob = self.remote_nodes.get(response.recver).to_wire(wire_msg)
-            response = target.remote_nodes.get(response.sender).from_wire(blob)
+            response = self.po.van.transfer(
+                self.remote_nodes.get(response.recver),
+                target.remote_nodes.get(response.sender),
+                wire_msg,
+            )
             target._last_response = response  # ref customer.h LastResponse()
             target.process_response(response)
         if request.callback is not None:

@@ -23,29 +23,69 @@ INVALID_TIME = -1
 
 # builtins reachable via pickle's find_class that are data, not code
 _SAFE_BUILTINS = {"complex", "range", "slice", "frozenset", "set", "bytearray"}
+# exact (module, name) pairs for the numpy machinery array/scalar pickles
+# actually use — NOT a module prefix: numpy also exports file writers
+# (numpy.save), dlopen helpers (ctypeslib.load_library) and classes with
+# side-effectful constructors (numpy.memmap)
+_SAFE_NUMPY = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+}
 
 
 def _restricted_loads(blob: bytes):
-    """Unpickle a wire header allowing only this package's types, numpy
-    array reconstruction, and plain-data builtins. Blocks the classic
-    ``__reduce__`` -> ``os.system`` escalation while keeping Task payloads
-    (our dataclasses, Ranges, numpy scalars/arrays) round-trippable."""
+    """Unpickle a wire header allowing only this package's classes, the
+    exact numpy reconstruction machinery, and plain-data builtins.
+
+    Defenses (each closes a demonstrated bypass class):
+    - names containing '.' are rejected outright — protocol-4
+      STACK_GLOBAL resolves dotted names by attribute traversal, so an
+      allowed module would otherwise reach e.g. ``cpp.subprocess.run``;
+    - package globals must resolve to a CLASS whose ``__module__`` is
+      inside the package — functions and re-exported stdlib/third-party
+      objects (``subprocess`` imported by a module, ``np``) are refused;
+    - numpy is a closed (module, name) set, not a prefix;
+    - numpy dtype classes (numpy 2 pickles dtypes as
+      ``numpy.dtypes.Float64DType``) are allowed as types only.
+    """
     import io
     import pickle
 
     class _Unpickler(pickle.Unpickler):
         def find_class(self, module: str, name: str):
+            def deny() -> None:
+                raise pickle.UnpicklingError(
+                    f"wire frame names forbidden global {module}.{name}"
+                )
+
+            if "." in name:  # STACK_GLOBAL attribute traversal
+                deny()
             if module.startswith("parameter_server_tpu."):
+                obj = super().find_class(module, name)
+                if not (
+                    isinstance(obj, type)
+                    and getattr(obj, "__module__", "").startswith(
+                        "parameter_server_tpu."
+                    )
+                ):
+                    deny()
+                return obj
+            if (module, name) in _SAFE_NUMPY:
                 return super().find_class(module, name)
-            if module == "numpy" or module.startswith(("numpy.", "numpy._")):
-                return super().find_class(module, name)
+            if module == "numpy.dtypes":
+                obj = super().find_class(module, name)
+                if not isinstance(obj, type):
+                    deny()
+                return obj
             if module == "collections" and name == "OrderedDict":
                 return super().find_class(module, name)
             if module == "builtins" and name in _SAFE_BUILTINS:
                 return super().find_class(module, name)
-            raise pickle.UnpicklingError(
-                f"wire frame names forbidden global {module}.{name}"
-            )
+            deny()
 
     return _Unpickler(io.BytesIO(blob)).load()
 

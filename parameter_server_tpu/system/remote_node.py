@@ -59,6 +59,10 @@ class RemoteNodeTable:
             self._nodes[node_id] = RemoteNode(node_id)
         return self._nodes[node_id]
 
+    def nodes(self) -> list:
+        """All per-peer endpoints (for counter aggregation/diagnostics)."""
+        return list(self._nodes.values())
+
     def remove(self, node_id: str) -> None:
         self._nodes.pop(node_id, None)
 

@@ -53,7 +53,22 @@ class Van:
         self.sent_bytes += arr.nbytes
         return out
 
-    # -- host filter chain (control plane) --
+    # -- host wire (control plane) --
+
+    def transfer(self, sender, recver, msg: Message) -> Message:
+        """The full host wire path between two per-peer endpoints (ref
+        van.cc Send then Recv): the sender's RemoteNode filter-encodes
+        and serializes, the frame crosses the "wire" (loopback within a
+        process, the jax.distributed KV transport across hosts), and the
+        receiver's RemoteNode deserializes and decodes. Van keeps the
+        process-level byte counters (ref Van send_bytes_/recv_bytes_);
+        the per-peer counters live on the RemoteNodes.
+
+        Every ps.py group RPC — request AND response — crosses here."""
+        blob = sender.to_wire(msg)
+        self.sent_bytes += len(blob)
+        self.recv_bytes += len(blob)
+        return recver.from_wire(blob)
 
     def send(self, msg: Message, filters: Optional[Sequence] = None) -> Message:
         from ..filter.base import encode_chain

@@ -75,6 +75,22 @@ def test_hello_world_roundtrip():
     assert len(cbs) == 2
     assert {e[0] for e in reqs} == {"S0", "S1"}
     assert {e[0] for e in ress} == {"W0", "W1"}
+    # every request AND response crossed the Van's wire path (ref van.cc
+    # process-level send/recv counters). Cross-check the van totals
+    # against the per-peer RemoteNode counters — a path that bypassed
+    # the van (or dropped the response direction) breaks these.
+    van = apps[0].po.van
+    rn_sent = sum(
+        rn.wire_sent_bytes for a in apps for rn in a.remote_nodes.nodes()
+    )
+    rn_recv = sum(
+        rn.wire_recv_bytes for a in apps for rn in a.remote_nodes.nodes()
+    )
+    assert van.sent_bytes == rn_sent > 0
+    assert van.recv_bytes == rn_recv > 0
+    # responses really crossed: each WORKER decoded frames from servers
+    for w in (a for a in apps if a.node.id.startswith("W")):
+        assert any(rn.wire_recv_bytes > 0 for rn in w.remote_nodes.nodes())
 
 
 def test_node_identity_helpers():

@@ -1,0 +1,97 @@
+"""Sharded big-table correctness on the virtual 8-mesh.
+
+BASELINE.json's north star is Criteo-1TB (~800M keys ≈ 2^29.6). One v5e
+chip holds a 2^28-2^29-slot FTRL table (2 f32/slot; measured on-chip by
+script/onchip.py's `scale` task); this file proves the SHARDED paths are
+correct at that slot count — key routing, push aggregation, pull
+assembly, and a real training step — on the 8-device CPU mesh, where
+round-2 coverage stopped at 2^26.
+
+The 2^29 case allocates ~4.3 GB of table state; it is skipped unless
+PS_BIG_TABLE=1 so CI stays light (run manually / by the onchip watcher's
+host; results recorded in doc/ROUND3_NOTES.md). A 2^24 case runs always
+to keep the code path exercised.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.parameter.kv_vector import KVVector
+from parameter_server_tpu.system.postoffice import Postoffice
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def _roundtrip(mesh8, num_slots: int) -> None:
+    kv = KVVector(mesh=mesh8, k=1, num_slots=num_slots, hashed=True)
+    rng = np.random.default_rng(0)
+    keys = np.unique(rng.integers(0, 1 << 62, 1 << 14).astype(np.int64))
+    # distinct keys may share a hashed slot (expected ~(n^2/2)/num_slots
+    # of them); exact roundtrip only holds for collision-free keys, so
+    # assert on those — slot ROUTING correctness is what's under test
+    slots = kv.slots(0, keys)
+    _, first_idx, counts = np.unique(
+        np.asarray(slots), return_index=True, return_counts=True
+    )
+    keys = keys[np.sort(first_idx[counts == 1])]
+    assert len(keys) > (1 << 13)  # collisions must stay rare
+    vals = rng.normal(size=(len(keys), 1)).astype(np.float32)
+    kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+    got = kv.values(0, keys)
+    np.testing.assert_allclose(got, vals, rtol=1e-6)
+    # second push aggregates (PLUS semantics, ref aggregation_ps.cc)
+    kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+    np.testing.assert_allclose(kv.values(0, keys), 2 * vals, rtol=1e-6)
+
+
+def test_sharded_table_2e24(mesh8):
+    _roundtrip(mesh8, 1 << 24)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PS_BIG_TABLE"),
+    reason="~4.3 GB table state; set PS_BIG_TABLE=1 to run",
+)
+def test_sharded_table_2e29(mesh8):
+    _roundtrip(mesh8, 1 << 29)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PS_BIG_TABLE"),
+    reason="~2+ GB FTRL state; set PS_BIG_TABLE=1 to run",
+)
+def test_training_step_2e28(mesh8):
+    """One fused async-SGD step against a 2^28-slot sharded FTRL table:
+    the full pull->grad->push->update wire at north-star slot counts."""
+    from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+    from parameter_server_tpu.apps.linear.config import (
+        Config,
+        LearningRateConfig,
+        PenaltyConfig,
+        SGDConfig,
+    )
+    from parameter_server_tpu.utils.sparse import random_sparse
+
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[0.01])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=0.5, beta=1.0)
+    conf.async_sgd = SGDConfig(
+        algo="ftrl", ada_grad=True, minibatch=256, num_slots=1 << 28,
+        max_delay=0,
+    )
+    worker = AsyncSGDWorker(conf, mesh=mesh8)
+    rng = np.random.default_rng(1)
+    w_true = (rng.normal(size=512) * (rng.random(512) < 0.2)).astype(np.float32)
+    prog = worker.train(
+        random_sparse(256, 512, 8, seed=i, w_true=w_true) for i in range(8)
+    )
+    ev = worker.evaluate(random_sparse(1000, 512, 8, seed=99, w_true=w_true))
+    assert np.isfinite(ev["logloss"])
+    assert ev["auc"] > 0.6  # it actually learns against the 2^28 table

@@ -300,3 +300,33 @@ class TestWireFrameSafety:
         c = t.fresh_copy()
         c.filters[0].extra["meta"] = ["poison"]
         assert "meta" not in t.filters[0].extra
+
+    @pytest.mark.parametrize(
+        "module,name",
+        [
+            ("os", "system"),
+            # STACK_GLOBAL dotted traversal through an allowed module
+            ("parameter_server_tpu.cpp", "subprocess.run"),
+            # function (not class) re-exported by an allowed module
+            ("parameter_server_tpu.cpp", "native"),
+            # numpy escapes: file write, dlopen, side-effectful ctor
+            ("numpy", "save"),
+            ("numpy.ctypeslib", "load_library"),
+            ("numpy", "memmap"),
+        ],
+    )
+    def test_unpickler_bypasses_rejected(self, module, name):
+        import pickle
+        import struct
+
+        # hand-build a protocol-4 STACK_GLOBAL pickle naming module.name
+        frame = (
+            pickle.PROTO + bytes([4])
+            + pickle.SHORT_BINUNICODE + bytes([len(module)]) + module.encode()
+            + pickle.SHORT_BINUNICODE + bytes([len(name)]) + name.encode()
+            + pickle.STACK_GLOBAL
+            + pickle.STOP
+        )
+        blob = struct.pack("<I", len(frame)) + frame
+        with pytest.raises(ValueError, match="forbidden|malformed"):
+            Message.from_bytes(blob)
