@@ -242,23 +242,27 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
                 worker._submit_prepped(staged, with_aux=False)
             )
             flush(worker)
-        except Exception as e:  # e.g. RESOURCE_EXHAUSTED at deep T
-            # the user-configured base_t already ran the e2e phases, so
-            # never let an oversized sweep depth zero the whole run —
-            # disclose the failed depth and stop (larger only gets worse)
+            launches = max(3, 96 // t)
+            pending = []
+            t0 = time.perf_counter()
+            for _ in range(launches):
+                pending.append(
+                    worker._submit_prepped(staged, with_aux=False)
+                )
+                if len(pending) > 2:
+                    worker.executor.wait(pending.pop(0))
+            while pending:
+                worker.executor.wait(pending.pop(0))
+            flush(worker)
+            sec = time.perf_counter() - t0
+        except Exception as e:  # e.g. RESOURCE_EXHAUSTED at deep T —
+            # possibly only once >2 launches are in flight, so the timed
+            # loop is inside the guard too. The user-configured base_t
+            # already ran the e2e phases; never let an oversized sweep
+            # depth zero the whole run — disclose and stop (larger only
+            # gets worse)
             swept[t] = f"failed: {type(e).__name__}"
             break
-        launches = max(3, 96 // t)
-        pending = []
-        t0 = time.perf_counter()
-        for _ in range(launches):
-            pending.append(worker._submit_prepped(staged, with_aux=False))
-            if len(pending) > 2:
-                worker.executor.wait(pending.pop(0))
-        while pending:
-            worker.executor.wait(pending.pop(0))
-        flush(worker)
-        sec = time.perf_counter() - t0
         rate = t * minibatch * launches / sec
         swept[t] = round(rate, 1)
         if best is None or rate > best[1]:

@@ -315,6 +315,21 @@ def _blocks(sq: int, sk: int, block_q: int, block_k: int):
     return bq, bk
 
 
+def _grid_params(interpret: bool):
+    """Grid semantics for Mosaic: batch*heads and the outer block axis
+    are parallel (independent accumulator streams — Mosaic may pipeline
+    and reorder them); the innermost axis is 'arbitrary' (sequential:
+    it carries the online-softmax / accumulator recurrence across
+    iterations). Interpret mode takes no compiler params."""
+    if interpret:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    }
+
+
 def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
                 interpret, window):
     bh, sq, d = q.shape
@@ -356,6 +371,7 @@ def _fwd_pallas(q, k, v, q_offset, k_offset, *, causal, block_q, block_k,
             pltpu.VMEM((1, bq), jnp.float32),
         ],
         interpret=interpret,
+        **_grid_params(interpret),
     )(qo, ko, qp, kp, vp)
     out = jnp.swapaxes(out_t, 1, 2)[:, :sq, :d]
     return out, lse[:, 0, :sq]
@@ -402,6 +418,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
         out_shape=jax.ShapeDtypeStruct((bh, dp_, qp.shape[1]), q.dtype),
         scratch_shapes=[pltpu.VMEM((dp_, bq), jnp.float32)],
         interpret=interpret,
+        **_grid_params(interpret),
     )(qo, ko, qp, kp, vp, dop, lsep, cp)
     # dkv: k blocks outer (parallel), q blocks inner (accumulated)
     qspec2 = pl.BlockSpec((1, bq, dp_), lambda b, j, i: (b, i, 0))
@@ -427,6 +444,7 @@ def _bwd_pallas(q, k, v, do, lse, c, q_offset, k_offset, *, causal,
             pltpu.VMEM((bk, dp_), jnp.float32),
         ],
         interpret=interpret,
+        **_grid_params(interpret),
     )(qo, ko, qp, kp, vp, dop, lsep, cp)
     dq = jnp.swapaxes(dq_t, 1, 2)[:, :sq, :d]
     return dq, dk[:, :sk, :d], dv[:, :sk, :d]

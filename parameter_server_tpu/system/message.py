@@ -35,6 +35,17 @@ _SAFE_NUMPY = {
     ("numpy.core.multiarray", "scalar"),
     ("numpy._core.multiarray", "scalar"),
 }
+# the closed set of package types that legitimately ride a wire header
+# (the Task graph). NOT "any package class": instantiating e.g. Customer
+# registers phantom customers with the receiver's postoffice — package
+# constructors can carry side effects even when no stdlib code is
+# reachable. Extend this set when a new type genuinely joins the wire.
+_SAFE_PACKAGE = {
+    ("parameter_server_tpu.system.message", "Task"),
+    ("parameter_server_tpu.system.message", "FilterSpec"),
+    ("parameter_server_tpu.system.message", "Command"),
+    ("parameter_server_tpu.utils.range", "Range"),
+}
 
 
 def _restricted_loads(blob: bytes):
@@ -45,9 +56,9 @@ def _restricted_loads(blob: bytes):
     - names containing '.' are rejected outright — protocol-4
       STACK_GLOBAL resolves dotted names by attribute traversal, so an
       allowed module would otherwise reach e.g. ``cpp.subprocess.run``;
-    - package globals must resolve to a CLASS whose ``__module__`` is
-      inside the package — functions and re-exported stdlib/third-party
-      objects (``subprocess`` imported by a module, ``np``) are refused;
+    - the package allowance is the closed ``_SAFE_PACKAGE`` set of wire
+      dataclasses, not "any package class" — constructors like
+      ``Customer()`` mutate receiver state (postoffice registration);
     - numpy is a closed (module, name) set, not a prefix;
     - numpy dtype classes (numpy 2 pickles dtypes as
       ``numpy.dtypes.Float64DType``) are allowed as types only.
@@ -64,16 +75,10 @@ def _restricted_loads(blob: bytes):
 
             if "." in name:  # STACK_GLOBAL attribute traversal
                 deny()
+            if (module, name) in _SAFE_PACKAGE:
+                return super().find_class(module, name)
             if module.startswith("parameter_server_tpu."):
-                obj = super().find_class(module, name)
-                if not (
-                    isinstance(obj, type)
-                    and getattr(obj, "__module__", "").startswith(
-                        "parameter_server_tpu."
-                    )
-                ):
-                    deny()
-                return obj
+                deny()
             if (module, name) in _SAFE_NUMPY:
                 return super().find_class(module, name)
             if module == "numpy.dtypes":

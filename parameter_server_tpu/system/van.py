@@ -31,8 +31,13 @@ from .message import Message
 class Van:
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
-        self.sent_bytes = 0  # statistic parity with ref Van send/recv counters
+        self.sent_bytes = 0  # device placement volume (put_* below)
         self.recv_bytes = 0
+        # serialized host frames through transfer() — kept separate from
+        # placement bytes so each counter means ONE thing (ref van.cc
+        # send_bytes_/recv_bytes_ count wire frames)
+        self.wire_sent_bytes = 0
+        self.wire_recv_bytes = 0
 
     # -- placement (addressing) --
 
@@ -66,8 +71,8 @@ class Van:
 
         Every ps.py group RPC — request AND response — crosses here."""
         blob = sender.to_wire(msg)
-        self.sent_bytes += len(blob)
-        self.recv_bytes += len(blob)
+        self.wire_sent_bytes += len(blob)
+        self.wire_recv_bytes += len(blob)
         return recver.from_wire(blob)
 
     def send(self, msg: Message, filters: Optional[Sequence] = None) -> Message:

@@ -245,11 +245,13 @@ def task_flash() -> int:
     # perf: fwd and train (fwd+bwd) GFLOP/s, flash vs the jitted XLA path
     dev_kind = jax.devices()[0].device_kind
     peak = PEAK_BF16.get(dev_kind)
-    for s_len in (4096, 8192):
+    for s_len, dtype in ((4096, jnp.float32), (8192, jnp.float32),
+                         (8192, jnp.bfloat16)):
         bh2 = 8
-        qq, kk, vv = (rand(bh2, s_len, d) for _ in range(3))
+        qq, kk, vv = (rand(bh2, s_len, d).astype(dtype) for _ in range(3))
         fwd_flops = 4.0 * bh2 * s_len * s_len * d / 2  # causal half
-        rec = {"metric": f"flash_perf_s{s_len}", "unit": "GFLOP/s",
+        tag = "" if dtype == jnp.float32 else "_bf16"
+        rec = {"metric": f"flash_perf_s{s_len}{tag}", "unit": "GFLOP/s",
                "bh": bh2, "d": d, "causal": True, "device_kind": dev_kind}
         for label, up in (("xla", False), ("flash", True)):
             fn = jax.jit(

@@ -86,8 +86,8 @@ def test_hello_world_roundtrip():
     rn_recv = sum(
         rn.wire_recv_bytes for a in apps for rn in a.remote_nodes.nodes()
     )
-    assert van.sent_bytes == rn_sent > 0
-    assert van.recv_bytes == rn_recv > 0
+    assert van.wire_sent_bytes == rn_sent > 0
+    assert van.wire_recv_bytes == rn_recv > 0
     # responses really crossed: each WORKER decoded frames from servers
     for w in (a for a in apps if a.node.id.startswith("W")):
         assert any(rn.wire_recv_bytes > 0 for rn in w.remote_nodes.nodes())

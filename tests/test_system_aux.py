@@ -313,6 +313,9 @@ class TestWireFrameSafety:
             ("numpy", "save"),
             ("numpy.ctypeslib", "load_library"),
             ("numpy", "memmap"),
+            # package class OUTSIDE the closed wire set: constructing it
+            # would register a phantom customer with the postoffice
+            ("parameter_server_tpu.system.customer", "Customer"),
         ],
     )
     def test_unpickler_bypasses_rejected(self, module, name):
