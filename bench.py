@@ -461,13 +461,16 @@ def run_real(args) -> int:
     T = max(1, args.steps_per_launch)
     multi_core = (os.cpu_count() or 1) > 2
 
-    # untimed warmup: compile the scan superstep before the clock starts
+    # untimed warmup: compile the scan superstep before the clock starts.
+    # TWO launches: the first is a snapshot step, the second (when
+    # T < max_delay) a delayed step — a separately-jitted program since
+    # the donation split; both must compile outside the timed window
     warm = stack_supersteps(
         [worker.prep(b, device_put=False) for b in kept], T
     )
-    worker.executor.wait(
-        worker._submit_prepped(jax.device_put(warm), with_aux=False)
-    )
+    warm = jax.device_put(warm)
+    worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
+    worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
     flush(worker)
 
     def prepped_stream():

@@ -24,6 +24,7 @@ and the host executor additionally pipelines up to τ+1 steps in flight.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -618,6 +619,44 @@ def _progress_metrics(loss, y, xw, mask, with_aux: bool):
     return metrics
 
 
+def _donation_variants(step_impl):
+    """Wrap a traced ``(live, pull, batch, seed) -> (new_state, metrics)``
+    step with input-buffer donation where it is legal.
+
+    Donating the live table lets XLA alias input->output: the update
+    writes every slot anyway, and aliasing removes the extra whole-table
+    output buffer — at 2^28+ slots that buffer is the difference between
+    a table fitting on one chip or not. Legality depends on aliasing at
+    CALL time (donating a buffer also passed as another argument is a
+    runtime error — ``f(donate(a), a)``):
+
+    - ``pull is live`` (a snapshot step) and the caller says the snapshot
+      never outlives the call (``donate_ok``, i.e. max_delay == 0): a
+      single-argument donated program.
+    - ``pull is live`` otherwise: a single-argument non-donated program —
+      the snapshot buffer must survive for future delayed steps.
+    - distinct buffers (delayed step): donate live, pull is safe.
+    """
+    step_delay = functools.partial(jax.jit, donate_argnums=(0,))(step_impl)
+
+    def snap_impl(live_state, batch, seed):
+        return step_impl(live_state, live_state, batch, seed)
+
+    step_snap = jax.jit(snap_impl)
+    step_snap_donate = functools.partial(
+        jax.jit, donate_argnums=(0,)
+    )(snap_impl)
+
+    def step(live_state, pull_state, batch, seed=np.uint32(0),
+             donate_ok: bool = False):
+        if pull_state is live_state:
+            fn = step_snap_donate if donate_ok else step_snap
+            return fn(live_state, batch, seed)
+        return step_delay(live_state, pull_state, batch, seed)
+
+    return step
+
+
 def make_train_step_ell(
     updater,
     loss,
@@ -678,8 +717,7 @@ def make_train_step_ell(
             lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
         )
 
-    @jax.jit
-    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         slots = batch.slots_u24 if packed else batch.slots
         # binary batches carry no vals; pass slots as an unused placeholder
@@ -693,7 +731,7 @@ def make_train_step_ell(
             check_vma=False,
         )(live_state, pull_state, seed, batch.y, batch.mask, slots, vals)
 
-    return step
+    return _donation_variants(step_impl)
 
 
 def _make_bits_mini_step(
@@ -771,8 +809,7 @@ def make_train_step_ell_bits(
     def local_step(live, pulled, seed, y_bits, counts, words):
         return mini_step(live, pulled, seed, y_bits[0], counts[0], words[0])
 
-    @jax.jit
-    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = _bits_state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(3))
         return shard_map(
@@ -784,7 +821,7 @@ def make_train_step_ell_bits(
         )(live_state, pull_state, seed, batch.y_bits, batch.counts,
           batch.slots_words)
 
-    return step
+    return _donation_variants(step_impl)
 
 
 def make_train_step_ell_bits_scan(
@@ -840,8 +877,7 @@ def make_train_step_ell_bits_scan(
             }
         return new_state, metrics
 
-    @jax.jit
-    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = _bits_state_spec(live_state)
         batch_specs = tuple(P(None, DATA_AXIS) for _ in range(3))
         return shard_map(
@@ -853,7 +889,7 @@ def make_train_step_ell_bits_scan(
         )(live_state, pull_state, seed, batch.y_bits, batch.counts,
           batch.slots_words)
 
-    return step
+    return _donation_variants(step_impl)
 
 
 def make_train_step_hashed(
@@ -898,8 +934,7 @@ def make_train_step_hashed(
             lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
         )
 
-    @jax.jit
-    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(5))
         return shard_map(
@@ -919,7 +954,7 @@ def make_train_step_hashed(
             batch.vals,
         )
 
-    return step
+    return _donation_variants(step_impl)
 
 
 def make_train_step(
@@ -973,8 +1008,7 @@ def make_train_step(
             lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
         )
 
-    @jax.jit
-    def step(live_state, pull_state, batch, seed=np.uint32(0)):
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
         batch_specs = tuple(P(DATA_AXIS) for _ in range(7))
         return shard_map(
@@ -996,7 +1030,7 @@ def make_train_step(
             batch.umask,
         )
 
-    return step
+    return _donation_variants(step_impl)
 
 
 _SUPPORTED_FILTERS = (
@@ -1363,8 +1397,21 @@ class AsyncSGDWorker(ISGDCompNode):
         def step():
             if do_snapshot:
                 self._pull_state = self.state
-            new_state, metrics = step_fn(self.state, self._pull_state, prepped, seed)
+            # donate_ok: with max_delay == 0 every step snapshots, so the
+            # pull snapshot never outlives this call and the live table
+            # can be donated (halves table HBM footprint)
+            donated = tau <= 0
+            new_state, metrics = step_fn(
+                self.state, self._pull_state, prepped, seed,
+                donate_ok=donated,
+            )
             self.state = new_state
+            if donated:
+                # the donated call consumed the buffer _pull_state points
+                # at; re-anchor the snapshot on the newest state so a
+                # LATER max_delay change never reads a deleted buffer
+                # (staleness 0 satisfies any future bound)
+                self._pull_state = new_state
             if self._replicate_fn is not None:
                 self._steps_since_replica += n_steps
                 if (

@@ -31,8 +31,7 @@ from .message import Message
 class Van:
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
-        self.sent_bytes = 0  # device placement volume (put_* below)
-        self.recv_bytes = 0
+        self.placed_bytes = 0  # device placement volume (put_* below)
         # serialized host frames through transfer() — kept separate from
         # placement bytes so each counter means ONE thing (ref van.cc
         # send_bytes_/recv_bytes_ count wire frames)
@@ -44,18 +43,18 @@ class Van:
     def put_table(self, arr) -> jax.Array:
         """Place a parameter table sharded by key range over servers."""
         out = jax.device_put(arr, meshlib.table_sharding(self.mesh))
-        self.sent_bytes += arr.nbytes
+        self.placed_bytes += arr.nbytes
         return out
 
     def put_batch(self, arr) -> jax.Array:
         """Place a batch sharded over the data (worker) axis."""
         out = jax.device_put(arr, meshlib.batch_sharding(self.mesh))
-        self.sent_bytes += arr.nbytes
+        self.placed_bytes += arr.nbytes
         return out
 
     def put_replicated(self, arr) -> jax.Array:
         out = jax.device_put(arr, meshlib.replicated(self.mesh))
-        self.sent_bytes += arr.nbytes
+        self.placed_bytes += arr.nbytes
         return out
 
     # -- host wire (control plane) --

@@ -402,7 +402,10 @@ def task_scale() -> int:
     from parameter_server_tpu.utils.sparse import random_sparse
 
     dev = jax.devices()[0]
-    for log2 in (16, 17) if SMOKE else (28, 29):
+    # max_delay=0 rides the donated-step path: ONE live table buffer
+    # (input aliased to output) instead of live+snapshot+output, which is
+    # what lets 2^29-2^30 (>= the 800M-key north star) fit one chip
+    for log2 in (16, 17) if SMOKE else (28, 29, 30):
         num_slots = 1 << log2
         try:
             Postoffice.reset()
@@ -414,7 +417,7 @@ def task_scale() -> int:
             )
             conf.async_sgd = SGDConfig(
                 algo="ftrl", minibatch=16384, num_slots=num_slots,
-                max_delay=4, ell_lanes=39, wire="bits",
+                max_delay=0, ell_lanes=39, wire="bits",
             )
             worker = AsyncSGDWorker(conf, mesh=po.mesh)
             raw = [
