@@ -461,17 +461,27 @@ def run_real(args) -> int:
     T = max(1, args.steps_per_launch)
     multi_core = (os.cpu_count() or 1) > 2
 
-    # untimed warmup: compile the scan superstep before the clock starts.
-    # TWO launches: the first is a snapshot step, the second (when
-    # T < max_delay) a delayed step — a separately-jitted program since
-    # the donation split; both must compile outside the timed window
+    # untimed warmup: compile BOTH step programs before the clock starts
+    # (the donation split jits the snapshot and delayed paths
+    # separately, and which one a launch takes depends on the snapshot
+    # counter — the timed stream must never pay a compile). One normal
+    # launch compiles the snapshot program; a direct call with copied
+    # buffers compiles the delayed program (jitted steps are pure — the
+    # discarded result mutates nothing, and copies keep donation away
+    # from the live table).
     warm = stack_supersteps(
         [worker.prep(b, device_put=False) for b in kept], T
     )
     warm = jax.device_put(warm)
     worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
-    worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
     flush(worker)
+    step_fn = worker._get_step(warm, False)
+    live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    jax.block_until_ready(
+        step_fn(live_copy, pull_copy, warm, np.uint32(0))[1]["num_ex"]
+    )
+    del live_copy, pull_copy
 
     def prepped_stream():
         if multi_core:
@@ -668,6 +678,21 @@ def main() -> int:
     for ts in pending:
         worker.executor.wait(ts)
     flush(worker)
+    # compile the delayed-step program too (see run_real's warmup note):
+    # with T < max_delay the snapshot counter decides mid-stream which
+    # jitted variant runs, and the timed windows must never pay a compile
+    warm_sb = jax.device_put(
+        stack_supersteps(
+            [worker.prep(raw[j % len(raw)], device_put=False) for j in range(T)], T
+        )
+    )
+    step_fn = worker._get_step(warm_sb, False)
+    live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    jax.block_until_ready(
+        step_fn(live_copy, pull_copy, warm_sb, np.uint32(0))[1]["num_ex"]
+    )
+    del live_copy, pull_copy, warm_sb
 
     # The host→device tunnel's bandwidth drifts by several x over minutes
     # (shared link), so a single long average is hostage to one throttled

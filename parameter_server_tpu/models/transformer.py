@@ -403,15 +403,22 @@ def zigzag_lm_arrays(tokens: np.ndarray, n: int):
     return tokens[:, perm], tgt[:, perm], weights[:, perm]
 
 
-def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3):
-    """SGD train step; tokens must be placed sharded P(None, axis)."""
+def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data",
+                       lr: float = 0.3, donate: bool = False):
+    """SGD train step; tokens must be placed sharded P(None, axis).
+
+    ``donate=True`` donates the incoming params (input/output aliasing —
+    halves param HBM footprint). Opt-in: a donated call consumes the
+    caller's buffers, which breaks patterns like stepping two configs
+    from the SAME initial params; enable it in owned training loops that
+    always rebind (``params, loss = step(params, toks)``)."""
     if cfg.attention == "ring_zigzag":
         raise ValueError(
             "the zigzag layout needs explicit targets — use "
             "make_lm_train_step_with_targets (+ zigzag_lm_arrays)"
         )
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(params, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg, mesh, axis)
         new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
@@ -421,13 +428,15 @@ def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float 
 
 
 def make_lm_train_step_with_targets(
-    cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3
+    cfg: LMConfig, mesh: Mesh, axis: str = "data", lr: float = 0.3,
+    donate: bool = False,
 ):
     """SGD train step on (tokens, targets, weights) — the layout-agnostic
     factory: works for any attention mode, and is the sanctioned one for
-    ``ring_zigzag`` (feed it ``zigzag_lm_arrays`` outputs)."""
+    ``ring_zigzag`` (feed it ``zigzag_lm_arrays`` outputs). ``donate``:
+    see make_lm_train_step."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(params, tokens, targets, weights):
         loss, grads = jax.value_and_grad(lm_loss_with_targets)(
             params, tokens, targets, weights, cfg, mesh, axis

@@ -341,7 +341,8 @@ def task_lm() -> int:
     for name, cfg in modes:
         try:
             params = init_lm(jax.random.PRNGKey(0), cfg)
-            step = make_lm_train_step(cfg, mesh)
+            # donate: this loop always rebinds params (halves footprint)
+            step = make_lm_train_step(cfg, mesh, donate=True)
             toks = shard_tokens(tokens, mesh)
             t0 = time.perf_counter()
             params, loss = step(params, toks)
@@ -382,6 +383,59 @@ def task_lm() -> int:
             emit(rec)
         except Exception as e:  # keep going: one mode failing is evidence too
             emit({"metric": f"lm_train_{name}", "error": repr(e)[:500]})
+
+    # KV-cached decode throughput (the serving path): prefill a prompt,
+    # then time pure generation tokens/s. Decode is bandwidth-bound
+    # (weights re-read per token), so report achieved GB/s vs HBM peak
+    # alongside raw tokens/s.
+    try:
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        cfg = modes[0][1]  # dense config, default attention
+        params = init_lm(jax.random.PRNGKey(0), cfg)
+        b, prefill, steps = (2, 32, 16) if SMOKE else (8, 2048, 256)
+        prompt = jnp.asarray(
+            rng.integers(0, 256, (b, prefill), np.int32)
+        )
+        t0 = time.perf_counter()
+        out = lm_generate(params, prompt, cfg, steps=steps)
+        _flush(out)
+        compile_s = time.perf_counter() - t0
+        n = 3
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = lm_generate(params, prompt, cfg, steps=steps)
+        _flush(out)
+        sec = (time.perf_counter() - t0) / n
+        # the decode scan processes prefill TOKEN-BY-TOKEN exactly like
+        # generated tokens (teacher-forced single-token iterations), so
+        # every one of prefill+steps-? iterations is identical per-token
+        # decode work — count them all, or the rate understates ~9x.
+        # (A batched-prefill serving fast path would change this; noted
+        # in doc/ROUND3_NOTES.md as future work.)
+        iters = prefill + steps - 1
+        decode_tok_s = b * iters / sec
+        param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        # each decode iteration re-reads the weights once, at their
+        # STORED width (f32 master params, cast per use)
+        hbm_gb_s = param_bytes * iters / sec / 1e9
+        emit({
+            "metric": "lm_decode_tokens_per_sec",
+            "value": round(decode_tok_s, 1),
+            "unit": "tokens/sec",
+            "batch": b, "prefill": prefill, "steps": steps,
+            "decode_iters": iters,
+            "n_params": int(n_params),
+            "param_bytes": int(param_bytes),
+            "weights_gb_s": round(hbm_gb_s, 2),
+            "compile_s": round(compile_s, 1),
+            "device_kind": dev.device_kind,
+        })
+    except Exception as e:
+        emit({"metric": "lm_decode_tokens_per_sec", "error": repr(e)[:500]})
     return 0
 
 
