@@ -47,6 +47,11 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument(
+        "--n-kv-heads", type=int, default=None,
+        help="grouped-query attention: K/V heads (< n-heads shrinks the "
+        "decode KV cache by the group factor; 1 = MQA; default: n-heads)",
+    )
     ap.add_argument("--n-layers", type=int, default=2)
     ap.add_argument("--d-ff", type=int, default=128)
     ap.add_argument(
@@ -114,7 +119,7 @@ def main(argv=None) -> int:
             n_layers=args.n_layers, d_ff=args.d_ff, attention=args.attention,
             window=args.window, remat=args.remat,
             compute_dtype="bfloat16" if args.bf16 else "float32",
-            moe_every=args.moe_every,
+            moe_every=args.moe_every, n_kv_heads=args.n_kv_heads,
         )
     except ValueError as e:
         # LMConfig rejects invalid combinations (e.g. --window with

@@ -64,6 +64,11 @@ class LMConfig:
     # kernels (out-of-window blocks are skipped — O(window)/query), so
     # it requires a flash attention mode; None = full causal attention
     window: "int | None" = None
+    # grouped-query attention: K/V carry only this many heads, each
+    # serving n_heads/n_kv_heads query heads (1 = MQA). Shrinks wk/wv
+    # params AND the decode KV cache by the group factor — the cache is
+    # the dominant serving HBM traffic. None = n_heads (standard MHA)
+    n_kv_heads: "int | None" = None
 
     def __post_init__(self):
         if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
@@ -88,6 +93,22 @@ class LMConfig:
                 raise ValueError(
                     f"LMConfig.window must be >= 1, got {self.window}"
                 )
+        if self.n_kv_heads is not None:
+            if not 1 <= self.n_kv_heads <= self.n_heads:
+                raise ValueError(
+                    f"LMConfig.n_kv_heads must be in [1, n_heads="
+                    f"{self.n_heads}], got {self.n_kv_heads}"
+                )
+            if self.n_heads % self.n_kv_heads:
+                raise ValueError(
+                    f"n_heads={self.n_heads} must be a multiple of "
+                    f"n_kv_heads={self.n_kv_heads} (each K/V head serves "
+                    "an equal group of query heads)"
+                )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
 
 
 def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
@@ -107,6 +128,10 @@ def init_lm(key: jax.Array, cfg: LMConfig) -> Dict[str, jax.Array]:
         # puts K across two shards and forces per-layer reshards)
         wqkv = s * jax.random.normal(k1, (cfg.d_model, 3 * cfg.d_model))
         p[f"l{i}/wq"], p[f"l{i}/wk"], p[f"l{i}/wv"] = jnp.split(wqkv, 3, axis=1)
+        if cfg.kv_heads != cfg.n_heads:  # GQA: narrow K/V projections
+            kv_w = cfg.kv_heads * (cfg.d_model // cfg.n_heads)
+            p[f"l{i}/wk"] = p[f"l{i}/wk"][:, :kv_w]
+            p[f"l{i}/wv"] = p[f"l{i}/wv"][:, :kv_w]
         p[f"l{i}/wo"] = s * jax.random.normal(k2, (cfg.d_model, cfg.d_model))
         if _is_moe_layer(cfg, i):
             moe = init_moe(k3, cfg.d_model, cfg.d_ff, cfg.n_experts)
@@ -155,6 +180,20 @@ def lm_forward(
         q = h @ cast("wq")
         k = h @ cast("wk")
         v = h @ cast("wv")
+        if cfg.kv_heads != cfg.n_heads:
+            # GQA: broadcast each K/V head over its query-head group up
+            # front; every attention schedule below then sees full-width
+            # [B, S, d] (training keeps the PARAM saving; the cache
+            # saving is the decode path's, which stays grouped)
+            def expand(t):
+                t = t.reshape(b, s, cfg.kv_heads, 1, hd)
+                t = jnp.broadcast_to(
+                    t, (b, s, cfg.kv_heads, cfg.n_heads // cfg.kv_heads, hd)
+                )
+                return t.reshape(b, s, cfg.d_model)
+
+            k = expand(k)
+            v = expand(v)
 
         def heads(t):  # [B, S, d] -> [B*nh, S, hd]
             t = t.reshape(b, s, cfg.n_heads, hd)
@@ -208,12 +247,16 @@ def lm_forward(
 
 
 def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
-    """One KV-cached decoder step. tok [B]; caches [L, B, nh, T, hd];
-    pos scalar int32. Returns (logits [B, vocab], new caches). Runs in
-    ``cfg.compute_dtype`` like the training forward (softmax and logits
-    in f32), so decode matches training numerics dtype for dtype."""
+    """One KV-cached decoder step. tok [B]; caches [L, B, kvh, T, hd]
+    (kvh = cfg.kv_heads — under GQA the cache carries only the K/V
+    heads, the serving-side point of GQA); pos scalar int32. Returns
+    (logits [B, vocab], new caches). Runs in ``cfg.compute_dtype`` like
+    the training forward (softmax and logits in f32), so decode matches
+    training numerics dtype for dtype."""
     b = tok.shape[0]
     nh = cfg.n_heads
+    kvh = cfg.kv_heads
+    g = nh // kvh  # query heads per K/V head (1 = MHA)
     hd = cfg.d_model // nh
     t_max = kcache.shape[3]
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
@@ -222,22 +265,22 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     keep = t_range <= pos
     if cfg.window is not None:  # sliding window, mirroring lm_forward
         keep &= (pos - t_range) < cfg.window
-    mask = keep[None, None, :]  # [1, 1, T]
+    mask = keep[None, None, None, :]  # [1, 1, 1, T]
     for i in range(cfg.n_layers):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))
-        q = (h @ cast("wq")).reshape(b, nh, hd)
-        k = (h @ cast("wk")).reshape(b, nh, hd)
-        v = (h @ cast("wv")).reshape(b, nh, hd)
+        q = (h @ cast("wq")).reshape(b, kvh, g, hd)
+        k = (h @ cast("wk")).reshape(b, kvh, hd)
+        v = (h @ cast("wv")).reshape(b, kvh, hd)
         kcache = kcache.at[i, :, :, pos].set(k.astype(kcache.dtype))
         vcache = vcache.at[i, :, :, pos].set(v.astype(vcache.dtype))
         s = jnp.einsum(
-            "bnd,bntd->bnt", q.astype(jnp.float32), kcache[i]
+            "bkgd,bktd->bkgt", q.astype(jnp.float32), kcache[i]
         ) / np.sqrt(hd)
         s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = (
-            jnp.einsum("bnt,bntd->bnd", p, vcache[i])
+            jnp.einsum("bkgt,bktd->bkgd", p, vcache[i])
             .reshape(b, cfg.d_model)
             .astype(dtype)
         )
@@ -249,12 +292,15 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
 
 
 def _chunked_causal_attn(q, k, v, window, chunk: int = 256):
-    """Causal attention [B, P, nh, hd] -> [B, P, nh*hd] scanned over
-    query blocks: transient memory is ONE [B, nh, chunk, P] score block
-    instead of the full [B, nh, P, P] tensor (which at batch 8, 8 heads,
-    P=2048 would be >1 GB f32 per layer). Keys/values stay whole —
-    prefill writes them to the cache anyway."""
+    """Causal attention, q [B, P, nh, hd] x k/v [B, P, kvh, hd] ->
+    [B, P, nh*hd], scanned over query blocks: transient memory is ONE
+    [B, kvh, g, chunk, P] score block instead of the full [B, nh, P, P]
+    tensor (which at batch 8, 8 heads, P=2048 would be >1 GB f32 per
+    layer). K/V stay at their NARROW head count (kvh <= nh, GQA) — the
+    grouped einsums never materialize the broadcast."""
     b, p_len, nh, hd = q.shape
+    kvh = k.shape[2]
+    g = nh // kvh  # query heads per K/V head (1 = MHA)
     c = min(chunk, p_len)
     pad = (-p_len) % c
     qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
@@ -264,17 +310,17 @@ def _chunked_causal_attn(q, k, v, window, chunk: int = 256):
     kpos = jnp.arange(p_len)
 
     def body(_, inp):
-        ci, qblk = inp  # qblk [B, c, nh, hd]
+        ci, qblk = inp  # qblk [B, c, nh, hd] -> grouped [B, c, kvh, g, hd]
+        qg = qblk.astype(jnp.float32).reshape(b, c, kvh, g, hd)
         qpos = ci * c + jnp.arange(c)
         keep = qpos[:, None] >= kpos[None, :]
         if window is not None:  # sliding window, mirroring _decode_step
             keep &= (qpos[:, None] - kpos[None, :]) < window
-        s = jnp.einsum(
-            "bqnd,bknd->bnqk", qblk.astype(jnp.float32), k32
-        ) / np.sqrt(hd)
-        s = jnp.where(keep[None, None], s, -1e30)
+        s = jnp.einsum("bqhgd,bthd->bhgqt", qg, k32) / np.sqrt(hd)
+        s = jnp.where(keep[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        return None, jnp.einsum("bnqk,bknd->bqnd", p, v32)
+        att = jnp.einsum("bhgqt,bthd->bqhgd", p, v32)
+        return None, att.reshape(b, c, nh * hd)
 
     _, out = jax.lax.scan(
         body, None,
@@ -291,10 +337,12 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
     iterations (for a 2048-token prompt that is the serving-latency
     difference between one batched pass and 2048 scan steps). Numerics
     mirror ``_decode_step`` op for op: compute in ``cfg.compute_dtype``,
-    scores/softmax/logits in f32, caches stored f32; attention runs in
+    scores/softmax/logits in f32, caches stored in the caller's cache
+    dtype (the compute dtype — bf16 under bfloat16); attention runs in
     query chunks so transient memory stays bounded."""
     b, p_len = prompt.shape
     nh = cfg.n_heads
+    kvh = cfg.kv_heads
     hd = cfg.d_model // nh
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     x = (params["emb"][prompt] * np.sqrt(cfg.d_model)).astype(dtype)
@@ -302,8 +350,8 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))
         q = (h @ cast("wq")).reshape(b, p_len, nh, hd)
-        k = (h @ cast("wk")).reshape(b, p_len, nh, hd)
-        v = (h @ cast("wv")).reshape(b, p_len, nh, hd)
+        k = (h @ cast("wk")).reshape(b, p_len, kvh, hd)
+        v = (h @ cast("wv")).reshape(b, p_len, kvh, hd)
         kcache = kcache.at[i, :, :, :p_len].set(
             jnp.swapaxes(k, 1, 2).astype(kcache.dtype)
         )
@@ -387,8 +435,19 @@ def _lm_generate_jit(
 ):
     b, p_len = prompt.shape
     total = p_len + steps
-    nh, hd = cfg.n_heads, cfg.d_model // cfg.n_heads
-    kcache = jnp.zeros((cfg.n_layers, b, nh, total, hd), jnp.float32)
+    hd = cfg.d_model // cfg.n_heads
+    # caches live in the COMPUTE dtype: under bf16 that halves the
+    # per-token cache streaming (the dominant decode HBM traffic) and
+    # matches training numerics, which also attends against bf16 K/V;
+    # scores/softmax still accumulate f32 in _decode_step
+    cache_dtype = (
+        jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    )
+    # cfg.kv_heads, not n_heads: under GQA the cache shrinks by the
+    # query-group factor — the point of GQA at serving time
+    kcache = jnp.zeros(
+        (cfg.n_layers, b, cfg.kv_heads, total, hd), cache_dtype
+    )
     vcache = jnp.zeros_like(kcache)
     toks = jnp.concatenate(
         [prompt.astype(jnp.int32), jnp.zeros((b, steps), jnp.int32)], axis=1

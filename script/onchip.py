@@ -425,12 +425,16 @@ def task_lm() -> int:
         param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
         n_params = sum(x.size for x in jax.tree.leaves(params))
         # per decode iteration the chip re-reads the weights (STORED
-        # width: f32 master params, cast per use) AND streams the full
-        # f32 KV caches — at this config the cache traffic dominates by
-        # >10x, so counting only weights would understate utilization
+        # width: f32 master params, cast per use) AND streams the KV
+        # caches (stored in the compute dtype, kv_heads wide) — cache
+        # traffic dominates weights here, so counting only weights
+        # would understate utilization
         hd = cfg.d_model // cfg.n_heads
         total_len = prefill + steps
-        cache_bytes = 2 * cfg.n_layers * b * cfg.n_heads * total_len * hd * 4
+        cache_width = 2 if cfg.compute_dtype == "bfloat16" else 4
+        cache_bytes = (
+            2 * cfg.n_layers * b * cfg.kv_heads * total_len * hd * cache_width
+        )
         hbm_gb_s = (
             (param_bytes + cache_bytes) * (steps - 1) / decode_sec / 1e9
         )

@@ -502,3 +502,102 @@ class TestTensorParallel:
         # weights kept their tp sharding (the SPEC, not just the mesh)
         # through the jitted update steps
         assert "server" in str(params["l0/wq"].sharding.spec)
+
+
+class TestGQA:
+    """Grouped-query attention through the LM stack (LMConfig.n_kv_heads):
+    narrow K/V params, group-broadcast training forward, grouped decode
+    cache. Extension row 56g (flash_mha n_kv_heads is the kernel-level
+    half; this is the LM/decode half)."""
+
+    def _cfg(self, kvh):
+        return LMConfig(
+            vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            n_kv_heads=kvh,
+        )
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="multiple"):
+            self._cfg(3)
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            self._cfg(8)
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            self._cfg(0)
+        assert self._cfg(2).kv_heads == 2
+        assert self._cfg(None).kv_heads == 4
+
+    def test_param_shapes(self):
+        from parameter_server_tpu.models.transformer import init_lm
+
+        params = init_lm(jax.random.PRNGKey(0), self._cfg(1))  # MQA
+        assert params["l0/wk"].shape == (32, 8)  # kvh * hd = 1 * 8
+        assert params["l0/wv"].shape == (32, 8)
+        assert params["l0/wq"].shape == (32, 32)
+
+    @pytest.mark.parametrize("kvh", [1, 2])
+    def test_decode_matches_forward(self, kvh):
+        """The grouped decode cache and the group-broadcast training
+        forward must agree logit-for-logit."""
+        from parameter_server_tpu.models.transformer import (
+            init_lm,
+            lm_forward,
+            lm_generate,
+            shard_tokens,
+        )
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        cfg = self._cfg(kvh)
+        params = init_lm(jax.random.PRNGKey(1), cfg)
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, 32, (2, 16)).astype(np.int32)
+        _, dec = lm_generate(params, tokens, cfg, steps=4, return_logits=True)
+        mesh1 = meshlib.make_mesh(num_data=1, num_server=1)
+        full = lm_forward(
+            params, shard_tokens(tokens, mesh1), cfg, mesh1, "data"
+        )
+        # prompt positions: decode rows [0, 15) vs forward rows [0, 15)
+        np.testing.assert_allclose(
+            np.asarray(dec)[:, : tokens.shape[1] - 1],
+            np.asarray(full)[:, :-1],
+            atol=2e-4, rtol=1e-4,
+        )
+
+    def test_cache_shrinks_by_group_factor(self):
+        from parameter_server_tpu.models.transformer import (
+            _prefill,
+            init_lm,
+        )
+
+        cfg = self._cfg(2)
+        params = init_lm(jax.random.PRNGKey(0), cfg)
+        import jax.numpy as jnp
+
+        b, p = 2, 8
+        hd = cfg.d_model // cfg.n_heads
+        kcache = jnp.zeros((cfg.n_layers, b, cfg.kv_heads, p, hd))
+        logits, kcache, _ = _prefill(
+            params, cfg, jnp.zeros((b, p), jnp.int32), kcache,
+            jnp.zeros_like(kcache),
+        )
+        assert kcache.shape[2] == 2  # kv heads, not 4 query heads
+        assert logits.shape == (b, p, cfg.vocab)
+
+    def test_gqa_trains(self, mesh8):
+        from parameter_server_tpu.models.transformer import (
+            init_lm,
+            make_lm_train_step,
+            shard_tokens,
+        )
+
+        cfg = self._cfg(2)
+        params = init_lm(jax.random.PRNGKey(0), cfg)
+        step = make_lm_train_step(cfg, mesh8, lr=0.5)
+        rng = np.random.default_rng(0)
+        toks = shard_tokens(
+            rng.integers(0, 32, (2, 32)).astype(np.int32), mesh8
+        )
+        losses = []
+        for _ in range(6):
+            params, loss = step(params, toks)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # learns with narrow K/V
