@@ -98,17 +98,53 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: floa
 
 
 def emit_device_error(diagnosis: str) -> int:
-    print(
-        json.dumps(
-            {
-                "metric": "criteo_sparse_lr_examples_per_sec",
-                "value": 0,
-                "unit": "examples/sec",
-                "vs_baseline": 0,
-                "error": f"accelerator unreachable: {diagnosis}",
-            }
-        )
-    )
+    """Explicit failure record — with a POINTER to the most recent
+    on-chip capture (BENCH_ONCHIP.md, written by script/onchip.py when
+    the tunnel was last up). The cached fields are diagnostics for the
+    reader, clearly labeled; ``value`` stays 0 because no live
+    measurement happened in THIS run."""
+    rec = {
+        "metric": "criteo_sparse_lr_examples_per_sec",
+        "value": 0,
+        "unit": "examples/sec",
+        "vs_baseline": 0,
+        "error": f"accelerator unreachable: {diagnosis}",
+    }
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_ONCHIP.md")
+        stamp = None
+        by_metric = {}
+        with open(path) as f:
+            for ln in f:
+                if ln.startswith("## "):  # any heading resets attribution
+                    stamp = (
+                        ln[3:].split(" — ")[0].strip()
+                        if (" — bench " in ln or " — bench_real " in ln)
+                        else None
+                    )
+                elif stamp and ln.startswith('{"metric"'):
+                    cached = json.loads(ln)
+                    if cached.get("value") and "metric" in cached:
+                        line = {k: cached[k] for k in
+                                ("metric", "value", "unit", "vs_baseline")
+                                if k in cached}
+                        line["captured_at"] = stamp
+                        by_metric[cached["metric"]] = line  # latest wins
+                    stamp = None
+        line = by_metric.get(  # prefer the headline metric's capture
+            "criteo_sparse_lr_examples_per_sec"
+        ) or next(iter(by_metric.values()), None)
+        if line is not None:
+            rec["last_onchip_capture"] = line
+            rec["note"] = (
+                "last_onchip_capture is a PRIOR run's on-chip result "
+                "(see BENCH_ONCHIP.md), shown for diagnosis only"
+            )
+    except (OSError, ValueError, KeyError):
+        # a half-written log line must never break the failure record
+        pass
+    print(json.dumps(rec))
     return 1
 
 

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -157,7 +159,10 @@ def make_deep_ctr_step(
             "b_ss": P(),
         }
 
-    @jax.jit
+    # donate the sharded tables: the update writes them anyway and
+    # the worker always rebinds (self.state = new_state); aliasing
+    # input->output halves the table HBM footprint (as in async_sgd)
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, batch_y, batch_mask, batch_slots):
         specs = state_spec(state)
         return shard_map(

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -129,7 +131,10 @@ def make_fm_step(
             lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
         )
 
-    @jax.jit
+    # donate the sharded tables: the update writes them anyway and
+    # the worker always rebinds (self.state = new_state); aliasing
+    # input->output halves the table HBM footprint (as in async_sgd)
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def step(state, batch_y, batch_mask, batch_slots):
         specs = state_spec(state)
         return shard_map(
