@@ -124,7 +124,10 @@ def emit_device_error(diagnosis: str) -> int:
                         else None
                     )
                 elif stamp and ln.startswith('{"metric"'):
-                    cached = json.loads(ln)
+                    try:
+                        cached = json.loads(ln)
+                    except ValueError:
+                        continue  # half-written line: keep earlier finds
                     if cached.get("value") and "metric" in cached:
                         line = {k: cached[k] for k in
                                 ("metric", "value", "unit", "vs_baseline")

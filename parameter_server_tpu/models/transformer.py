@@ -330,6 +330,32 @@ def _chunked_causal_attn(q, k, v, window, chunk: int = 256):
     return out[:, :p_len]
 
 
+def _prefill_attention(q, k, v, window, use_flash=None, interpret=None):
+    """Prefill attention dispatch: q [B, P, nh, hd], k/v [B, P, kvh, hd]
+    -> [B, P, nh*hd]. On TPU backends the Pallas flash kernel does the
+    O(P^2) work (MXU-shaped matmuls, O(block) VMEM, window blocks
+    skipped); elsewhere the chunked XLA path bounds transient memory.
+    ``use_flash=None`` auto-selects by backend; tests force the flash
+    path in interpret mode and compare against the chunked path."""
+    from ..ops.flash_attention import _use_pallas, flash_mha
+
+    if use_flash is None:
+        use_flash = _use_pallas()
+    if not use_flash:
+        return _chunked_causal_attn(q, k, v, window)
+    b, p_len, nh, hd = q.shape
+    kvh = k.shape[2]
+    # flash_mha owns the head fold + GQA group-broadcast (one home for
+    # the kv-major head-order convention, shared with training)
+    return flash_mha(
+        q.reshape(b, p_len, nh * hd),
+        k.reshape(b, p_len, kvh * hd),
+        v.reshape(b, p_len, kvh * hd),
+        nh, n_kv_heads=kvh, causal=True, window=window,
+        use_pallas=True, interpret=interpret,
+    )
+
+
 def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
     """Batched prompt ingestion: ONE causal forward over [B, P] writes
     cache slots [0, P) for every layer and returns all prompt logits
@@ -358,7 +384,7 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
         vcache = vcache.at[i, :, :, :p_len].set(
             jnp.swapaxes(v, 1, 2).astype(vcache.dtype)
         )
-        att = _chunked_causal_attn(q, k, v, cfg.window).astype(dtype)
+        att = _prefill_attention(q, k, v, cfg.window).astype(dtype)
         x = x + att @ cast("wo")
         h2 = _ln(x, cast("ln2"))
         x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")

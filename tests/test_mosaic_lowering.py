@@ -100,3 +100,19 @@ def test_quantize_kernel_lowers():
         return quantize(x, seed, num_bytes=1, force_pallas=True)
 
     lower_tpu(fn, Z((512, 256), jnp.float32), jnp.uint32(7))
+
+
+def test_prefill_flash_attention_lowers():
+    # the generate path's prefill uses the flash kernel on TPU backends,
+    # folded/broadcast from GQA-narrow K/V — lower that exact plumbing
+    from parameter_server_tpu.models.transformer import _prefill_attention
+
+    q = Z((2, 256, 4, 64), jnp.float32)
+    kv = Z((2, 256, 2, 64), jnp.float32)
+
+    def fn(q, k, v):
+        return _prefill_attention(
+            q, k, v, None, use_flash=True, interpret=False
+        )
+
+    lower_tpu(fn, q, kv, kv)
