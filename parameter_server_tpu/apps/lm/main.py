@@ -6,7 +6,8 @@ file (or a built-in synthetic corpus) and generate from it:
         [--attention ring|ring_flash|ring_zigzag|a2a] [--window W] \
         [--remat] [--bf16] [--moe-every K] [--num-servers T] \
         [--ckpt-dir DIR] [--save-every N] [--resume] \
-        [--prompt "text"] [--gen-tokens N] [--temperature T] [--top-k K]
+        [--prompt "text"] [--gen-tokens N] [--temperature T] [--top-k K] \
+        [--top-p P] [--n-kv-heads G]
 
 The model family's end-to-end surface, like apps/linear (conf CLI) and
 apps/nn: tokens are raw bytes (vocab 256, no tokenizer dependency), the
@@ -84,6 +85,11 @@ def main(argv=None) -> int:
     ap.add_argument("--gen-tokens", type=int, default=64)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument(
+        "--top-p", type=float, default=None,
+        help="nucleus sampling: keep the smallest probability mass >= "
+        "top-p (composes with --top-k; needs --temperature > 0)",
+    )
     args = ap.parse_args(argv)
 
     from ...parallel.mesh import honor_jax_platforms
@@ -141,6 +147,11 @@ def main(argv=None) -> int:
             ap.error("--top-k requires --temperature > 0 (sampling)")
         if not 1 <= args.top_k <= 256:
             ap.error(f"--top-k must be in [1, 256], got {args.top_k}")
+    if args.top_p is not None:
+        if args.temperature == 0:
+            ap.error("--top-p requires --temperature > 0 (sampling)")
+        if not 0.0 < args.top_p <= 1.0:
+            ap.error(f"--top-p must be in (0, 1], got {args.top_p}")
 
     rng = np.random.default_rng(args.seed)
     corpus = _load_corpus(args.data, rng)
@@ -258,6 +269,7 @@ def main(argv=None) -> int:
             lm_generate(
                 params, prompt, cfg, steps=args.gen_tokens,
                 temperature=args.temperature, top_k=args.top_k,
+                top_p=args.top_p,
                 key=jax.random.PRNGKey(args.seed + 1),
             )
         )[0]

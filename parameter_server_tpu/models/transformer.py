@@ -401,6 +401,7 @@ def lm_generate(
     return_logits: bool = False,
     temperature=None,
     top_k: "int | None" = None,
+    top_p: "float | None" = None,
     key: jax.Array = None,
 ) -> jax.Array:
     """KV-cached decoding (the serving path — single device; the
@@ -412,7 +413,10 @@ def lm_generate(
     prompt walk is gone).
     ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
     softmax(logits/temperature), optionally truncated to the ``top_k``
-    most likely tokens (needs ``key``). A non-zero temperature is a
+    most likely tokens and/or the nucleus holding ``top_p`` probability
+    mass (smallest prefix of the sorted distribution with cumulative
+    probability >= top_p; both filters compose — k-truncate, then
+    nucleus). Sampling needs ``key``. A non-zero temperature is a
     TRACED operand of the jitted core — sweeping it does not recompile
     the decode scan. Returns [B, P+steps]. Dense FFN layers only (the
     reference has no serving path at all; MoE decode would need token
@@ -440,24 +444,38 @@ def lm_generate(
             raise ValueError(
                 f"top_k must be in [1, vocab={cfg.vocab}], got {top_k}"
             )
+    if top_p is not None:
+        if greedy:
+            raise ValueError(
+                "top_p requires sampling — pass temperature > 0 (greedy "
+                "argmax would silently ignore the truncation)"
+            )
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by the greedy path
     if greedy:
         temperature = 1.0  # dead operand on the greedy trace
+    # top_p rides as a TRACED operand (sweeping it must not recompile,
+    # same contract as temperature); only its PRESENCE is static, so the
+    # disabled path pays no sort/cumsum
     return _lm_generate_jit(
-        params, prompt, jnp.asarray(temperature, jnp.float32), key,
+        params, prompt, jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32), key,
         cfg=cfg, steps=steps, return_logits=return_logits, top_k=top_k,
-        greedy=greedy,
+        has_top_p=top_p is not None, greedy=greedy,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "return_logits", "top_k", "greedy"),
+    static_argnames=(
+        "cfg", "steps", "return_logits", "top_k", "has_top_p", "greedy"
+    ),
 )
 def _lm_generate_jit(
-    params, prompt, temperature, key, *, cfg, steps, return_logits, top_k,
-    greedy,
+    params, prompt, temperature, top_p, key, *, cfg, steps, return_logits,
+    top_k, has_top_p, greedy,
 ):
     b, p_len = prompt.shape
     total = p_len + steps
@@ -486,6 +504,17 @@ def _lm_generate_jit(
         if top_k is not None:
             kth = jnp.sort(z, axis=-1)[:, -top_k][:, None]
             z = jnp.where(z >= kth, z, -jnp.inf)
+        if has_top_p:
+            # nucleus: keep the smallest sorted prefix with cumulative
+            # probability >= top_p. A token stays iff the cumulative mass
+            # STRICTLY BEFORE it (descending order) is < top_p — the
+            # argmax token always survives (cum-before = 0 < top_p)
+            zs = jnp.sort(z, axis=-1)[:, ::-1]  # descending
+            ps = jax.nn.softmax(zs, axis=-1)
+            before = jnp.cumsum(ps, axis=-1) - ps
+            zs_masked = jnp.where(before < top_p, zs, jnp.inf)
+            cutoff = jnp.min(zs_masked, axis=-1, keepdims=True)
+            z = jnp.where(z >= cutoff, z, -jnp.inf)
         return jax.random.categorical(k_step, z, axis=-1).astype(jnp.int32)
 
     # batched prefill: one causal forward ingests the whole prompt; the

@@ -627,3 +627,55 @@ class TestPrefillAttention:
         np.testing.assert_allclose(
             np.asarray(flash), np.asarray(chunked), atol=2e-5, rtol=1e-5
         )
+
+
+class TestTopP:
+    """Nucleus sampling: composes with top_k; a vanishing nucleus
+    degenerates to greedy; validation mirrors top_k's."""
+
+    def _setup(self):
+        from parameter_server_tpu.models.transformer import (
+            init_lm,
+            lm_generate,
+        )
+
+        import jax.numpy as jnp
+
+        cfg = LMConfig(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64)
+        params = init_lm(jax.random.PRNGKey(0), cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 16), np.int32)
+        )
+        return cfg, params, prompt, lm_generate
+
+    def test_tiny_nucleus_is_greedy(self):
+        cfg, params, prompt, gen = self._setup()
+        got = gen(params, prompt, cfg, steps=8, temperature=0.9,
+                  top_p=1e-9, key=jax.random.PRNGKey(1))
+        greedy = gen(params, prompt, cfg, steps=8)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(greedy))
+
+    def test_full_nucleus_matches_plain_sampling(self):
+        cfg, params, prompt, gen = self._setup()
+        # top_p=1.0 keeps everything: identical to plain temperature
+        # sampling under the same key
+        a = gen(params, prompt, cfg, steps=8, temperature=0.8,
+                top_p=1.0, key=jax.random.PRNGKey(2))
+        b = gen(params, prompt, cfg, steps=8, temperature=0.8,
+                key=jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_composes_with_top_k(self):
+        cfg, params, prompt, gen = self._setup()
+        out = gen(params, prompt, cfg, steps=8, temperature=0.9,
+                  top_k=8, top_p=0.9, key=jax.random.PRNGKey(3))
+        assert out.shape == (2, 24)
+        assert (np.asarray(out) < 64).all()
+
+    def test_validation(self):
+        cfg, params, prompt, gen = self._setup()
+        with pytest.raises(ValueError, match="sampling"):
+            gen(params, prompt, cfg, steps=2, top_p=0.5)
+        with pytest.raises(ValueError, match="top_p"):
+            gen(params, prompt, cfg, steps=2, temperature=0.9, top_p=1.5,
+                key=jax.random.PRNGKey(0))
