@@ -398,6 +398,8 @@ def lm_generate(
     prompt: jax.Array,  # [B, P] int32
     cfg: LMConfig,
     steps: int,
+    *,  # options are keyword-only: inserting new ones can never silently
+    # rebind a positional caller's arguments
     return_logits: bool = False,
     temperature=None,
     top_k: "int | None" = None,

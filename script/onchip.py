@@ -388,72 +388,89 @@ def task_lm() -> int:
     # then time pure generation tokens/s. Decode is bandwidth-bound
     # (weights re-read per token), so report achieved GB/s vs HBM peak
     # alongside raw tokens/s.
-    try:
-        import jax.numpy as jnp
+    import dataclasses as _dc
 
-        from parameter_server_tpu.models.transformer import lm_generate
+    import jax.numpy as jnp
 
-        cfg = modes[0][1]  # dense config, default attention
-        params = init_lm(jax.random.PRNGKey(0), cfg)
-        b, prefill, steps = (2, 32, 16) if SMOKE else (8, 2048, 256)
-        prompt = jnp.asarray(
-            rng.integers(0, 256, (b, prefill), np.int32)
-        )
+    from parameter_server_tpu.models.transformer import lm_generate
 
-        def timed(s):  # compile untimed, then median-free simple mean
-            t0 = time.perf_counter()
-            _flush(lm_generate(params, prompt, cfg, steps=s))
-            comp = time.perf_counter() - t0
-            n = 3
-            t0 = time.perf_counter()
-            for _ in range(n):
-                out = lm_generate(params, prompt, cfg, steps=s)
-            _flush(out)
-            return (time.perf_counter() - t0) / n, comp
+    b, prefill, steps = (2, 32, 16) if SMOKE else (8, 2048, 256)
+    # "" = the base (MHA) config; the grouped variant shrinks the KV
+    # cache (quartered when n_heads allows, else MQA) — its decode
+    # speedup vs base is the on-chip evidence for GQA serving
+    base_cfg = modes[0][1]
+    kvh = base_cfg.n_heads // 4 if base_cfg.n_heads % 4 == 0 else 1
+    decode_cfgs = [
+        ("", base_cfg),
+        (f"_kv{kvh}", _dc.replace(base_cfg, n_kv_heads=kvh)),
+    ]
+    for tag, cfg in decode_cfgs:
+        try:
+            params = init_lm(jax.random.PRNGKey(0), cfg)
+            prompt = jnp.asarray(
+                rng.integers(0, 256, (b, prefill), np.int32)
+            )
 
-        # generation is batched-prefill (one causal forward) + a scan of
-        # single-token decode iterations; differencing two step counts
-        # isolates PURE decode, and the steps~=1 run is the
-        # time-to-first-token serving latency
-        sec_short, comp_short = timed(1)
-        sec_long, comp_long = timed(steps)
-        decode_sec = sec_long - sec_short
-        diff_noisy = decode_sec < 0.2 * sec_long  # below the noise floor
-        if diff_noisy:  # conservative fallback: charge the whole call
-            decode_sec = sec_long
-        decode_tok_s = b * (steps - 1) / decode_sec
-        param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
-        n_params = sum(x.size for x in jax.tree.leaves(params))
-        # per decode iteration the chip re-reads the weights (STORED
-        # width: f32 master params, cast per use) AND streams the KV
-        # caches (stored in the compute dtype, kv_heads wide) — cache
-        # traffic dominates weights here, so counting only weights
-        # would understate utilization
-        hd = cfg.d_model // cfg.n_heads
-        total_len = prefill + steps
-        cache_width = 2 if cfg.compute_dtype == "bfloat16" else 4
-        cache_bytes = (
-            2 * cfg.n_layers * b * cfg.kv_heads * total_len * hd * cache_width
-        )
-        hbm_gb_s = (
-            (param_bytes + cache_bytes) * (steps - 1) / decode_sec / 1e9
-        )
-        emit({
-            "metric": "lm_decode_tokens_per_sec",
-            "value": round(decode_tok_s, 1),
-            "unit": "tokens/sec",
-            "batch": b, "prefill": prefill, "steps": steps,
-            "prefill_plus_first_token_ms": round(sec_short * 1e3, 1),
-            "diff_noisy": diff_noisy,
-            "n_params": int(n_params),
-            "param_bytes": int(param_bytes),
-            "kv_cache_bytes": int(cache_bytes),
-            "hbm_gb_s": round(hbm_gb_s, 2),
-            "compile_s": round(comp_short + comp_long, 1),
-            "device_kind": dev.device_kind,
-        })
-    except Exception as e:
-        emit({"metric": "lm_decode_tokens_per_sec", "error": repr(e)[:500]})
+            def timed(s, params=params, prompt=prompt, cfg=cfg):
+                # compile untimed, then a simple mean of flushed runs
+                t0 = time.perf_counter()
+                _flush(lm_generate(params, prompt, cfg, steps=s))
+                comp = time.perf_counter() - t0
+                n = 3
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = lm_generate(params, prompt, cfg, steps=s)
+                _flush(out)
+                return (time.perf_counter() - t0) / n, comp
+
+            # generation is batched-prefill (one causal forward) + a
+            # scan of single-token decode iterations; differencing two
+            # step counts isolates PURE decode, and the steps~=1 run is
+            # the time-to-first-token serving latency
+            sec_short, comp_short = timed(1)
+            sec_long, comp_long = timed(steps)
+            decode_sec = sec_long - sec_short
+            diff_noisy = decode_sec < 0.2 * sec_long  # noise floor
+            if diff_noisy:  # conservative: charge the whole call
+                decode_sec = sec_long
+            decode_tok_s = b * (steps - 1) / decode_sec
+            param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+            n_params = sum(x.size for x in jax.tree.leaves(params))
+            # per decode iteration the chip re-reads the weights (STORED
+            # width: f32 master params, cast per use) AND streams the KV
+            # caches (stored in the compute dtype, kv_heads wide) —
+            # cache traffic dominates weights here, so counting only
+            # weights would understate utilization
+            hd = cfg.d_model // cfg.n_heads
+            total_len = prefill + steps
+            cache_width = 2 if cfg.compute_dtype == "bfloat16" else 4
+            cache_bytes = (
+                2 * cfg.n_layers * b * cfg.kv_heads * total_len * hd
+                * cache_width
+            )
+            hbm_gb_s = (
+                (param_bytes + cache_bytes) * (steps - 1) / decode_sec / 1e9
+            )
+            emit({
+                "metric": f"lm_decode_tokens_per_sec{tag}",
+                "value": round(decode_tok_s, 1),
+                "unit": "tokens/sec",
+                "batch": b, "prefill": prefill, "steps": steps,
+                "n_kv_heads": cfg.kv_heads,
+                "prefill_plus_first_token_ms": round(sec_short * 1e3, 1),
+                "diff_noisy": diff_noisy,
+                "n_params": int(n_params),
+                "param_bytes": int(param_bytes),
+                "kv_cache_bytes": int(cache_bytes),
+                "hbm_gb_s": round(hbm_gb_s, 2),
+                "compile_s": round(comp_short + comp_long, 1),
+                "device_kind": dev.device_kind,
+            })
+        except Exception as e:
+            emit({
+                "metric": f"lm_decode_tokens_per_sec{tag}",
+                "error": repr(e)[:500],
+            })
     return 0
 
 
