@@ -50,15 +50,17 @@ LOG_MD = os.path.join(REPO, "BENCH_ONCHIP.md")
 STATE = os.path.join(REPO, "doc", "onchip_state.json")
 WATCH_LOG = os.path.join(REPO, "doc", "onchip_watch.log")
 
-# (name, argv-or-None(=internal), timeout_s)
+# (name, argv-or-None(=internal), timeout_s) — PRIORITY order: a short
+# tunnel window should capture the flagship evidence (flash kernels,
+# headline bench, LM, scale) before the component microbenches
 TASKS = [
     ("link", None, 600),
     ("flash", None, 2400),
     ("bench", [sys.executable, "bench.py"], 2400),
     ("bench_real", [sys.executable, "bench.py", "--real"], 5400),
-    ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 2400),
     ("lm", None, 3600),
     ("scale", None, 2400),
+    ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 2400),
 ]
 
 # bf16 peak matmul FLOP/s by device_kind (public spec sheets); MFU is
