@@ -679,3 +679,29 @@ class TestTopP:
         with pytest.raises(ValueError, match="top_p"):
             gen(params, prompt, cfg, steps=2, temperature=0.9, top_p=1.5,
                 key=jax.random.PRNGKey(0))
+
+
+def test_tp_composes_with_gqa(mesh8):
+    """Megatron placement of GQA-narrow wk/wv (kvh*hd columns over the
+    server axis) must reproduce the replicated logits exactly."""
+    from parameter_server_tpu.models.transformer import (
+        LMConfig,
+        init_lm,
+        lm_forward,
+        shard_lm_params,
+        shard_tokens,
+    )
+
+    cfg = LMConfig(
+        vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64, n_kv_heads=2
+    )
+    params = init_lm(jax.random.PRNGKey(1), cfg)
+    assert params["l0/wk"].shape == (32, 16)  # narrow K/V
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 32, (2, 64)).astype(np.int32)
+    td = shard_tokens(tokens, mesh8)
+    base = lm_forward(params, td, cfg, mesh8, "data")
+    tp_params = shard_lm_params(params, mesh8, "server")
+    tp = lm_forward(tp_params, td, cfg, mesh8, "data")
+    np.testing.assert_allclose(np.asarray(tp), np.asarray(base), atol=2e-4)
+    assert "server" in str(tp_params["l0/wk"].sharding.spec)
