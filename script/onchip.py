@@ -173,6 +173,16 @@ def task_flash() -> int:
     bh, s, d = (4, 256, 64) if SMOKE else (4, 1024, 64)
     q, k, v = rand(bh, s, d), rand(bh, s, d), rand(bh, s, d)
 
+    # Forward-output tolerance. In interpret mode both paths are exact
+    # f32 and agree to ~1e-5. On the real chip the MXU truncates matmul
+    # inputs to bf16 under default precision, and the two paths
+    # accumulate P·V in different orders (flash: chunked online-softmax
+    # rescaling; XLA: one matmul over the full row), so the honest
+    # numerical floor is bf16-truncation scale: ~1e-3 relative, observed
+    # 1.4e-4..2.6e-4 absolute at these magnitudes. The softmax stats
+    # (lse, ~8e-6) and every gradient (≤5e-5) pin the math itself.
+    ftol = 2e-5 if interp else 5e-4
+
     def run(use_pallas, **kw):
         return flash_attention(
             q, k, v, use_pallas=use_pallas,
@@ -191,7 +201,7 @@ def task_flash() -> int:
     ]:
         o_p, l_p = run(True, with_lse=True, **kw)
         o_x, l_x = run(False, with_lse=True, **kw)
-        check(name, o_p, o_x, 2e-5)
+        check(name, o_p, o_x, ftol)
         check(name + "_lse", jnp.where(jnp.isneginf(l_x), 0, l_p),
               jnp.where(jnp.isneginf(l_x), 0, l_x), 2e-4)
     compile_fwd_s = time.perf_counter() - t0
@@ -226,7 +236,7 @@ def task_flash() -> int:
                     use_pallas=True, interpret=interp)
     o_x = flash_mha(xq, xk, xv, nh, causal=True, n_kv_heads=2,
                     use_pallas=False)
-    check("gqa_mha", o_p, o_x, 2e-5)
+    check("gqa_mha", o_p, o_x, ftol)
 
     emit(
         {

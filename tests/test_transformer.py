@@ -13,6 +13,7 @@ from parameter_server_tpu.models.transformer import (
     lm_forward,
     lm_loss,
     make_lm_train_step,
+    shard_lm_params,
     shard_tokens,
 )
 
@@ -684,14 +685,6 @@ class TestTopP:
 def test_tp_composes_with_gqa(mesh8):
     """Megatron placement of GQA-narrow wk/wv (kvh*hd columns over the
     server axis) must reproduce the replicated logits exactly."""
-    from parameter_server_tpu.models.transformer import (
-        LMConfig,
-        init_lm,
-        lm_forward,
-        shard_lm_params,
-        shard_tokens,
-    )
-
     cfg = LMConfig(
         vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64, n_kv_heads=2
     )
