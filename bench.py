@@ -31,11 +31,118 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
 
 REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
+
+
+class Watchdog:
+    """Emit the best-so-far record instead of hanging when the tunnel
+    wedges MID-run.
+
+    ``probe_device`` catches a relay that is already down, but a wedge
+    can also strike between two device operations of a healthy run
+    (observed 2026-07-31: bench blocked in a device wait for 40 minutes
+    — 23s of CPU time over a 22-minute stretch — until the outer
+    timeout killed it, losing every number the run had already
+    measured). Every phase of the bench calls :meth:`beat`; a daemon
+    thread watches the heartbeat and, after ``stall_s`` of silence,
+    prints ONE JSON line built from the staged partial fields and
+    hard-exits (``os._exit`` — the main thread is unkillably blocked in
+    a C-level wait).
+
+    Exit semantics: if the headline phase already landed (``value`` is
+    staged), the record is a valid measurement with the wedge disclosed
+    in ``wedged`` — exit 0 so the driver keeps it. Otherwise it is a
+    failure record (value 0, ``error``) — exit 2.
+
+    The final record goes through :meth:`finish`, which prints under
+    the same lock the firing path holds — a run that recovers from a
+    near-stall and completes cannot race the watchdog into printing
+    two records (whichever takes the lock first wins; the loser either
+    sees ``_done`` or the process is already gone)."""
+
+    def __init__(self, metric: str, stall_s: float = 300.0,
+                 poll_s: float = 2.0):
+        self.metric = metric
+        self.stall_s = stall_s
+        self.poll_s = poll_s
+        self._last = time.monotonic()
+        self._phase = "init"
+        self._partial: dict = {}
+        self._done = False
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def beat(self, phase: str | None = None, **fields) -> None:
+        """Mark liveness; optionally advance the phase label and stage
+        already-measured fields for the partial record."""
+        with self._lock:
+            self._last = time.monotonic()
+            if phase is not None:
+                self._phase = phase
+            self._partial.update(fields)
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._done = True
+
+    def finish(self, rec: dict) -> None:
+        """Atomically retire the watchdog and print the final record."""
+        with self._lock:
+            self._done = True
+            print(json.dumps(rec), flush=True)
+
+    def _run(self) -> None:
+        while True:
+            time.sleep(self.poll_s)
+            with self._lock:
+                if self._done:
+                    return
+                idle = time.monotonic() - self._last
+                if idle <= self.stall_s:
+                    continue
+                # fire — still under the lock, so finish() cannot
+                # interleave a second record
+                phase = self._phase
+                partial = dict(self._partial)
+                wedge = (
+                    f"no progress for {idle:.0f}s in phase '{phase}' "
+                    "(tunnel wedged mid-run?)"
+                )
+                if partial.get("value"):
+                    rec = {"metric": self.metric, "unit": "examples/sec"}
+                    rec.update(partial)
+                    rec["wedged"] = wedge
+                    rec["note"] = (
+                        partial.get("note", "")
+                        + " | RUN CUT SHORT by a mid-run tunnel wedge: "
+                        "fields after the wedge point are absent; the "
+                        "headline device-only phase completed before it"
+                    ).lstrip(" |")
+                    print(json.dumps(rec), flush=True)
+                    os._exit(0)
+                rec = {
+                    "metric": self.metric,
+                    "value": 0,
+                    "unit": "examples/sec",
+                    "vs_baseline": 0,
+                    "error": f"accelerator wedged: {wedge}",
+                }
+                print(json.dumps(rec), flush=True)
+                os._exit(2)
+
+
+_WATCHDOG: "Watchdog | None" = None
+
+
+def _beat(phase: str | None = None, **fields) -> None:
+    if _WATCHDOG is not None:
+        _WATCHDOG.beat(phase, **fields)
 
 
 # ---------------------------------------------------------------------------
@@ -185,6 +292,7 @@ def measure_upload_mb_s(prepped, reps: int = 3) -> float:
     nbytes = tree_host_nbytes(prepped)
     obs = []
     for _ in range(reps):
+        _beat()
         t0 = time.perf_counter()
         dev = jax.device_put(prepped)
         # fetch one element of EVERY leaf: device_put is async and
@@ -274,6 +382,7 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
     swept = {}
     for t in ts:
         try:
+            _beat()
             sb = stack_supersteps(prep_parts, t)
             staged = jax.device_put(sb)
             # untimed: compile this T's scan program + settle the pipeline
@@ -281,6 +390,7 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
                 worker._submit_prepped(staged, with_aux=False)
             )
             flush(worker)
+            _beat()
             launches = max(3, 96 // t)
             pending = []
             t0 = time.perf_counter()
@@ -290,25 +400,77 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
                 )
                 if len(pending) > 2:
                     worker.executor.wait(pending.pop(0))
+                    _beat()
             while pending:
                 worker.executor.wait(pending.pop(0))
             flush(worker)
             sec = time.perf_counter() - t0
         except Exception as e:  # e.g. RESOURCE_EXHAUSTED at deep T —
             # possibly only once >2 launches are in flight, so the timed
-            # loop is inside the guard too. The user-configured base_t
-            # already ran the e2e phases; never let an oversized sweep
-            # depth zero the whole run — disclose and stop (larger only
-            # gets worse)
+            # loop is inside the guard too. The warmup already ran the
+            # user-configured base_t; never let an oversized sweep depth
+            # zero the whole run — disclose and stop (larger only gets
+            # worse)
             swept[t] = f"failed: {type(e).__name__}"
             break
         rate = t * minibatch * launches / sec
         swept[t] = round(rate, 1)
         if best is None or rate > best[1]:
             best = (t, rate, sec / launches, sb)
-    if best is None:  # even base_t failed — phases before us ran it fine
+    if best is None:
+        # even base_t failed (warmup ran it, so this is in-flight
+        # pressure, not shape trouble) — callers catch this and continue
+        # with the e2e phase so the run still produces a record
         raise RuntimeError(f"device_only_sweep: no depth succeeded ({swept})")
     return best + (swept,)
+
+
+def headline_phase(worker, prep_parts, base_t: int, minibatch: int,
+                   smoke: bool, num_slots: int, note: str,
+                   extra: dict | None = None) -> dict:
+    """The device-only headline, measured BEFORE the long e2e phase so a
+    mid-run tunnel wedge cannot take it (the watchdog emits whatever is
+    staged here). Shared by both bench modes: sweep → headline fields →
+    HBM stats → roofline, staging partials at each step. On total sweep
+    failure the run continues to the e2e phase with value 0 and the
+    failure disclosed."""
+    import jax
+
+    _beat("device_only_sweep")
+    try:
+        best_t, dev_rate, dev_sec, staged_host, swept = device_only_sweep(
+            worker, prep_parts, base_t, minibatch, smoke
+        )
+    except RuntimeError as e:
+        headline = {
+            "value": 0,
+            "vs_baseline": 0,
+            "sweep_error": str(e),
+            "note": note,
+        }
+        headline.update(extra or {})
+        _beat("e2e", **headline)
+        return headline
+    headline = {
+        "value": round(dev_rate, 1),
+        "vs_baseline": round(dev_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
+        "steps_per_launch_best": best_t,
+        "steps_per_launch_swept": swept,
+        "note": note,
+    }
+    headline.update(extra or {})
+    _beat("roofline", **headline)
+    hbm = jax.devices()[0].memory_stats() or {}
+    if hbm.get("bytes_in_use") is not None:
+        headline["hbm_bytes_in_use"] = hbm["bytes_in_use"]
+        headline["hbm_bytes_limit"] = hbm.get("bytes_limit")
+    headline.update(
+        roofline_fields(staged_host, num_slots, dev_sec, best_t * minibatch)
+    )
+    del staged_host  # up to base_t*16 minibatches of host memory: release
+    # before the e2e phase it would otherwise sit under
+    _beat("e2e", **headline)
+    return headline
 
 
 _HEXD = np.frombuffer(b"0123456789abcdef", np.uint8)
@@ -356,6 +518,7 @@ def ensure_criteo_file(path: str, target_mb: int, p_cat: int = 1 << 24) -> str:
     t0 = time.perf_counter()
     with open(path + ".tmp", "wb") as f:
         while rows_left > 0:
+            _beat()
             n = min(rows_left, 1 << 18)
             _write_criteo_chunk(f, rng, n, w_true)
             rows_left -= n
@@ -465,7 +628,9 @@ def run_real(args) -> int:
     dev_obj = orc_obj = parity_ex = 0.0
     batches = stream()
     kept = []
+    _beat("parity")
     for i in range(parity_steps):
+        _beat()
         b = next(batches)
         if b.n < args.minibatch:
             break
@@ -508,12 +673,14 @@ def run_real(args) -> int:
     # buffers compiles the delayed program (jitted steps are pure — the
     # discarded result mutates nothing, and copies keep donation away
     # from the live table).
+    _beat("warmup")
     warm = stack_supersteps(
         [worker.prep(b, device_put=False) for b in kept], T
     )
     warm = jax.device_put(warm)
     worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
     flush(worker)
+    _beat()
     step_fn = worker._get_step(warm, False)
     live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
     pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
@@ -521,6 +688,19 @@ def run_real(args) -> int:
         step_fn(live_copy, pull_copy, warm, np.uint32(0))[1]["num_ex"]
     )
     del live_copy, pull_copy
+
+    headline = headline_phase(
+        worker, [worker.prep(b, device_put=False) for b in kept],
+        T, args.minibatch, args.smoke, num_slots,
+        note="value = device-only rate (pre-staged, no parsing; best "
+        "scan depth of the disclosed sweep); "
+        "e2e_stream = disk->parse->localize->upload->step",
+        extra={
+            "logloss_device": round(ll_dev, 5),
+            "logloss_oracle": round(ll_orc, 5),
+            "parity_ok": parity_ok,
+        },
+    )
 
     def prepped_stream():
         if multi_core:
@@ -557,6 +737,7 @@ def run_real(args) -> int:
         prepped = stack_supersteps(parts, T)
         parts = []
         done_ex += int(prepped.num_examples)
+        _beat()
         pending.append(
             worker._submit_prepped(jax.device_put(prepped), with_aux=False)
         )
@@ -571,41 +752,20 @@ def run_real(args) -> int:
     dt = time.perf_counter() - t0
     e2e_rate = done_ex / dt
 
-    # -- phase 3: device-only rate on pre-staged (already parsed+packed)
-    # supersteps — isolates the fused step from host parsing. Swept over
-    # scan depth to amortize the per-launch tunnel round trip --
-    best_t, dev_rate, dev_sec, staged_host, swept = device_only_sweep(
-        worker, [worker.prep(b, device_put=False) for b in kept],
-        T, args.minibatch, args.smoke,
-    )
-
     rec = {
         "metric": "criteo_real_examples_per_sec",
-        "value": round(dev_rate, 1),
         "unit": "examples/sec",
-        "vs_baseline": round(dev_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
         "e2e_stream": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
-        "logloss_device": round(ll_dev, 5),
-        "logloss_oracle": round(ll_orc, 5),
-        "parity_ok": parity_ok,
         "file_mb": os.path.getsize(path) >> 20,
         "file_rows": int(file_rows),
         "skipped_tail_rows": int(skipped_tail),
-        "steps_per_launch_best": best_t,
-        "steps_per_launch_swept": swept,
-        "note": "value = device-only rate (pre-staged, no parsing; best "
-        "scan depth of the disclosed sweep); "
-        "e2e_stream = disk->parse->localize->upload->step",
     }
-    hbm = jax.devices()[0].memory_stats() or {}
-    if hbm.get("bytes_in_use") is not None:
-        rec["hbm_bytes_in_use"] = hbm["bytes_in_use"]
-        rec["hbm_bytes_limit"] = hbm.get("bytes_limit")
-    rec.update(
-        roofline_fields(staged_host, num_slots, dev_sec, best_t * args.minibatch)
-    )
-    print(json.dumps(rec))
+    rec.update(headline)
+    if _WATCHDOG is not None:
+        _WATCHDOG.finish(rec)
+    else:
+        print(json.dumps(rec))
     return 0
 
 
@@ -635,6 +795,13 @@ def main() -> int:
         help="minibatches scanned per device launch (ELLBitsSuperBatch); "
         "amortizes the tunnel round trip",
     )
+    ap.add_argument(
+        "--stall-timeout",
+        type=float,
+        default=300.0,
+        help="seconds of mid-run silence before the watchdog emits the "
+        "best-so-far record and exits (tunnel wedge guard)",
+    )
     args = ap.parse_args()
     if args.smoke:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
@@ -643,6 +810,13 @@ def main() -> int:
     diagnosis = probe_device()
     if diagnosis is not None:
         return emit_device_error(diagnosis)
+    global _WATCHDOG
+    _WATCHDOG = Watchdog(
+        "criteo_real_examples_per_sec"
+        if args.real
+        else "criteo_sparse_lr_examples_per_sec",
+        stall_s=args.stall_timeout,
+    )
     if args.real:
         return run_real(args)
 
@@ -711,11 +885,13 @@ def main() -> int:
         )
 
     # warmup (compile)
+    _beat("warmup")
     pending = []
     for i in range(max(1, args.warmup // T)):
         pending.append(prep_upload_submit(i * T))
     for ts in pending:
         worker.executor.wait(ts)
+        _beat()
     flush(worker)
     # compile the delayed-step program too (see run_real's warmup note):
     # with T < max_delay the snapshot counter decides mid-stream which
@@ -732,6 +908,15 @@ def main() -> int:
         step_fn(live_copy, pull_copy, warm_sb, np.uint32(0))[1]["num_ex"]
     )
     del live_copy, pull_copy, warm_sb
+
+    headline = headline_phase(
+        worker,
+        [worker.prep(raw[j % len(raw)], device_put=False) for j in range(T)],
+        T, args.minibatch, args.smoke, args.num_slots,
+        note="value = device-only rate (pre-staged batches; best scan "
+        "depth of the disclosed sweep); "
+        "e2e_median_window = prep+upload+step through the tunnel",
+    )
 
     # The host→device tunnel's bandwidth drifts by several x over minutes
     # (shared link), so a single long average is hostage to one throttled
@@ -753,6 +938,7 @@ def main() -> int:
         pending.append(prep_upload_submit(done * T))
         done += 1
         win_done += 1
+        _beat()
         if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
         if win_done >= window:
@@ -771,35 +957,19 @@ def main() -> int:
     avg_rate = done * args.minibatch / dt
     e2e_rate = float(np.median(rates)) if rates else avg_rate
 
-    # -- device-only phase: pre-staged superbatch, no upload in the
-    # loop — the machine's rate with the link factored out, swept over
-    # scan depth to amortize the per-launch round trip. This is the
-    # HEADLINE (the e2e number tracks tunnel weather; see README). --
-    best_t, dev_rate, dev_sec, staged_host, swept = device_only_sweep(
-        worker,
-        [worker.prep(raw[j % len(raw)], device_put=False) for j in range(T)],
-        T, args.minibatch, args.smoke,
-    )
-
     rec = {
         "metric": "criteo_sparse_lr_examples_per_sec",
-        "value": round(dev_rate, 1),
         "unit": "examples/sec",
-        "vs_baseline": round(dev_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
         "e2e_median_window": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
         "avg": round(avg_rate, 1),
         "best": round(max(rates), 1) if rates else None,
-        "steps_per_launch_best": best_t,
-        "steps_per_launch_swept": swept,
-        "note": "value = device-only rate (pre-staged batches; best scan "
-        "depth of the disclosed sweep); "
-        "e2e_median_window = prep+upload+step through the tunnel",
     }
-    rec.update(
-        roofline_fields(staged_host, args.num_slots, dev_sec, best_t * args.minibatch)
-    )
-    print(json.dumps(rec))
+    rec.update(headline)
+    if _WATCHDOG is not None:
+        _WATCHDOG.finish(rec)
+    else:
+        print(json.dumps(rec))
     return 0
 
 

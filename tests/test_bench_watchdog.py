@@ -1,0 +1,91 @@
+"""Watchdog: a mid-run tunnel wedge must yield the best-so-far JSON
+record, not a hang (observed 2026-07-31: bench blocked 40 minutes in a
+device wait, losing the already-measured phases).
+
+Runs bench.Watchdog in a subprocess because it exits via os._exit.
+"""
+
+import json
+import subprocess
+import sys
+
+REPO_SNIPPET = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from bench import Watchdog
+wd = Watchdog({metric!r}, stall_s=0.5, poll_s=0.1)
+{body}
+"""
+
+
+def _run(body: str, metric: str = "criteo_sparse_lr_examples_per_sec"):
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, "-c",
+         REPO_SNIPPET.format(repo=repo, metric=metric, body=body)],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_wedge_after_headline_emits_partial_record_rc0():
+    r = _run(
+        "wd.beat('e2e', value=123456.0, vs_baseline=0.25, note='n')\n"
+        "time.sleep(30)\n"
+        "print('UNREACHED')\n"
+    )
+    assert r.returncode == 0
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 123456.0
+    assert rec["vs_baseline"] == 0.25
+    assert "e2e" in rec["wedged"]
+    assert "CUT SHORT" in rec["note"]
+    assert "UNREACHED" not in r.stdout
+
+
+def test_wedge_before_headline_emits_error_record_rc2():
+    r = _run("wd.beat('warmup')\ntime.sleep(30)\n")
+    assert r.returncode == 2
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0
+    assert "warmup" in rec["error"]
+
+
+def test_cancel_stops_the_watchdog():
+    # sleep far past stall_s + several polls: only a WORKING cancel()
+    # keeps the watchdog from firing during the wait
+    r = _run(
+        "wd.beat('e2e', value=1.0)\nwd.cancel()\ntime.sleep(2.0)\n"
+        "print('SURVIVED')\n"
+    )
+    assert r.returncode == 0
+    assert "SURVIVED" in r.stdout
+    assert "wedged" not in r.stdout
+
+
+def test_beats_keep_it_alive():
+    # total wall time ~2s = many poll cycles past stall_s; only the
+    # beats hold the idle clock below 0.5s
+    r = _run(
+        "for _ in range(10):\n"
+        "    time.sleep(0.2)\n"
+        "    wd.beat()\n"
+        "wd.cancel()\nprint('ALIVE')\n"
+    )
+    assert r.returncode == 0
+    assert "ALIVE" in r.stdout
+    assert "wedged" not in r.stdout
+
+
+def test_finish_is_atomic_and_prints_once():
+    r = _run(
+        "import json\n"
+        "wd.beat('e2e', value=7.0)\n"
+        "wd.finish({'metric': 'm', 'value': 7.0})\n"
+        "time.sleep(2.0)\n"
+    )
+    assert r.returncode == 0
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["value"] == 7.0
