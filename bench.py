@@ -331,20 +331,28 @@ def measure_upload_mb_s(prepped, reps: int = 3) -> float:
 
 
 def roofline_fields(prepped, num_slots: int, device_step_sec: float,
-                    examples_per_launch: int) -> dict:
+                    examples_per_launch: int, t_mb: int | None = None) -> dict:
     """The measurement VERDICT r2 asked for: separate the machine from
     the link. Reports wire bytes/example, observed upload MB/s, and the
     FTRL table pass's HBM traffic vs chip peak (the dense update reads+
     writes z and sqrt_n: 16 B/slot/minibatch — the dominant HBM term at
-    2^26+; gathers add O(nnz) on top, ignored here as <2%)."""
+    2^26+; gathers add O(nnz) on top, ignored here as <2%).
+
+    ``prepped`` should be a SMALL representative batch (one minibatch):
+    bytes/example, MB/s and the link-bound ceiling are all size-invariant
+    ratios, and probing bandwidth with a deep-T superbatch would move GBs
+    through a possibly-throttled tunnel for no informational gain. Pass
+    ``t_mb`` explicitly when ``device_step_sec`` covers more minibatches
+    than ``prepped`` holds (the sweep's winning launch depth)."""
     import jax
 
     dev = jax.devices()[0]
     wire_bytes = tree_host_nbytes(prepped)
     up_mb_s = measure_upload_mb_s(prepped)
-    # device_step_sec covers T minibatches (one launch); the table is
+    # device_step_sec covers t_mb minibatches (one launch); the table is
     # touched once per MINIBATCH by the scan superstep
-    t_mb = getattr(prepped, "steps", 1)
+    if t_mb is None:
+        t_mb = getattr(prepped, "steps", 1)
     hbm_bytes = 16.0 * num_slots * t_mb
     hbm_gb_s = hbm_bytes / device_step_sec / 1e9 if device_step_sec else None
     out = {
@@ -406,8 +414,10 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
     configuration (the e2e phases run the configured T), and the full
     sweep is disclosed next to the winner.
 
-    Returns ``(best_t, best_rate, best_sec_per_launch, best_staged_host,
-    swept)`` where swept maps T -> rate."""
+    Returns ``(best_t, best_rate, best_sec_per_launch, swept)`` where
+    swept maps T -> rate. (The staged superbatch is deliberately NOT
+    returned: at T=512 it is ~GB-scale, and the roofline probe only
+    needs a single-minibatch representative.)"""
     import jax
 
     best = None
@@ -451,7 +461,7 @@ def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
         rate = t * minibatch * launches / sec
         swept[t] = round(rate, 1)
         if best is None or rate > best[1]:
-            best = (t, rate, sec / launches, sb)
+            best = (t, rate, sec / launches)
         if smoke or t >= 512:
             break
         if prev_rate is not None and rate < prev_rate * 1.1:
@@ -479,7 +489,7 @@ def headline_phase(worker, prep_parts, base_t: int, minibatch: int,
 
     _beat("device_only_sweep")
     try:
-        best_t, dev_rate, dev_sec, staged_host, swept = device_only_sweep(
+        best_t, dev_rate, dev_sec, swept = device_only_sweep(
             worker, prep_parts, base_t, minibatch, smoke
         )
     except RuntimeError as e:
@@ -505,11 +515,13 @@ def headline_phase(worker, prep_parts, base_t: int, minibatch: int,
     if hbm.get("bytes_in_use") is not None:
         headline["hbm_bytes_in_use"] = hbm["bytes_in_use"]
         headline["hbm_bytes_limit"] = hbm.get("bytes_limit")
+    # bandwidth/bytes ratios are size-invariant: probe with ONE minibatch
+    # (a deep-T superbatch would re-move GBs through the tunnel); the HBM
+    # accounting still uses the winning launch depth via t_mb
     headline.update(
-        roofline_fields(staged_host, num_slots, dev_sec, best_t * minibatch)
+        roofline_fields(prep_parts[0], num_slots, dev_sec,
+                        minibatch, t_mb=best_t)
     )
-    del staged_host  # up to base_t*16 minibatches of host memory: release
-    # before the e2e phase it would otherwise sit under
     _beat("e2e", **headline)
     return headline
 
@@ -717,6 +729,7 @@ def run_real(args) -> int:
     _beat("warmup")
     prep_parts = [worker.prep(b, device_put=False) for b in kept]
     warm = stack_supersteps(prep_parts, T)
+    _grace_for_transfer(tree_host_nbytes(warm))
     warm = jax.device_put(warm)
     worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
     flush(worker)
@@ -778,6 +791,7 @@ def run_real(args) -> int:
         parts = []
         done_ex += int(prepped.num_examples)
         _beat()
+        _grace_for_transfer(tree_host_nbytes(prepped))
         pending.append(
             worker._submit_prepped(jax.device_put(prepped), with_aux=False)
         )
@@ -917,9 +931,9 @@ def main() -> int:
             worker.prep(raw[(i + j) % len(raw)], device_put=False)
             for j in range(T)
         ]
-        return worker._submit_prepped(
-            jax.device_put(stack_supersteps(parts, T)), with_aux=False
-        )
+        sb = stack_supersteps(parts, T)
+        _grace_for_transfer(tree_host_nbytes(sb))
+        return worker._submit_prepped(jax.device_put(sb), with_aux=False)
 
     # warmup (compile)
     _beat("warmup")
@@ -936,7 +950,10 @@ def main() -> int:
     prep_parts = [
         worker.prep(raw[j % len(raw)], device_put=False) for j in range(T)
     ]
-    warm_sb = jax.device_put(stack_supersteps(prep_parts, T))
+    warm_host = stack_supersteps(prep_parts, T)
+    _grace_for_transfer(tree_host_nbytes(warm_host))
+    warm_sb = jax.device_put(warm_host)
+    del warm_host
     step_fn = worker._get_step(warm_sb, False)
     live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
     pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)

@@ -120,9 +120,14 @@ def _fwd_kernel(
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
+        # dot OPERANDS stay in the input dtype (bf16 runs the MXU in one
+        # pass; an f32 upcast would force multi-pass emulation) while
+        # every dot ACCUMULATES in f32 via preferred_element_type and
+        # all softmax/statistics math is f32 — the FlashAttention-on-TPU
+        # standard precision recipe. For f32 inputs nothing changes.
+        q = q_ref[0]  # [bq, D]
+        k = k_ref[0]  # [bk, D]
+        v = v_ref[0]
         s_t = jax.lax.dot_general(  # [bk, bq]: K sublanes, Q lanes
             k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -141,7 +146,7 @@ def _fwd_kernel(
         corr = jnp.exp(m_prev - m_new)  # [1, bq]
         l_ref[...] = l_ref[...] * corr + jnp.sum(p_t, axis=0, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            v, p_t, (((0,), (0,)), ((), ())),  # [D, bq]
+            v, p_t.astype(v.dtype), (((0,), (0,)), ((), ())),  # [D, bq]
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = m_new
@@ -215,10 +220,14 @@ def _bwd_dq_kernel(
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)  # [bq, D]
+        # native-dtype dot operands, f32 accumulation + f32 softmax math
+        # (see _fwd_kernel's precision note); ds is cast back to the
+        # input dtype for the dk/dq matmuls, as in the reference TPU
+        # flash kernels
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]  # [bq, D]
         q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1
         ) - k_off
@@ -234,7 +243,7 @@ def _bwd_dq_kernel(
         )
         ds_t = p_t * (dp_t - c_ref[0][:1]) * scale
         acc_ref[...] += jax.lax.dot_general(  # [D, bq] += k^T . ds_t
-            k, ds_t, (((0,), (0,)), ((), ())),
+            k, ds_t.astype(k.dtype), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -264,10 +273,12 @@ def _bwd_dkv_kernel(
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype dot operands, f32 accumulation + f32 softmax math
+        # (see _fwd_kernel's precision note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         q_pos = q_off + iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_q), 1
         ) - k_off
@@ -279,7 +290,7 @@ def _bwd_dkv_kernel(
             q_pos=q_pos, k_pos=k_pos, k_len=k_len, window=window,
         )
         dv_acc[...] += jax.lax.dot_general(  # [bk, D] += p_t . do
-            p_t, do, (((1,), (0,)), ((), ())),
+            p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp_t = jax.lax.dot_general(
@@ -287,7 +298,7 @@ def _bwd_dkv_kernel(
         )
         ds_t = p_t * (dp_t - c_ref[0][:1]) * scale
         dk_acc[...] += jax.lax.dot_general(  # [bk, D] += ds_t . q
-            ds_t, q, (((1,), (0,)), ((), ())),
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 

@@ -64,6 +64,39 @@ def test_cancel_stops_the_watchdog():
     assert "wedged" not in r.stdout
 
 
+def test_grace_defers_firing():
+    # grace(10) pushes the idle clock past the whole 2s sleep (stall 0.5,
+    # poll 0.1): a broken grace() would fire the error record mid-sleep
+    r = _run("wd.grace(10)\ntime.sleep(2.0)\nwd.cancel()\nprint('HELD')\n")
+    assert r.returncode == 0
+    assert "HELD" in r.stdout
+    assert "wedged" not in r.stdout
+
+
+def test_beat_snaps_grace_back():
+    # a beat after grace restores normal patience: the subsequent silence
+    # must fire even though a 100s grace was granted earlier
+    r = _run("wd.grace(100)\nwd.beat('late')\ntime.sleep(2.0)\n")
+    assert r.returncode == 2
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "late" in rec["error"]
+
+
+def test_error_record_keeps_staged_diagnostics():
+    # a wedge before the headline must still carry already-measured
+    # fields (sweep_error, parity results), with value forced to 0
+    r = _run(
+        "wd.beat('e2e', sweep_error='boom', parity_ok=True)\n"
+        "time.sleep(2.0)\n"
+    )
+    assert r.returncode == 2
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0 and rec["vs_baseline"] == 0
+    assert rec["sweep_error"] == "boom"
+    assert rec["parity_ok"] is True
+    assert "wedged" in rec["error"]
+
+
 def test_beats_keep_it_alive():
     # total wall time ~2s = many poll cycles past stall_s; only the
     # beats hold the idle clock below 0.5s
