@@ -71,6 +71,13 @@ def main(argv=None) -> int:
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
                     "mesh); must divide the device count")
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument(
+        "--steps-per-launch", type=int, default=1,
+        help="fuse N sequential optimizer steps into one compiled launch "
+        "(lax.scan carries params+opt; identical training trajectory, "
+        "N-1 fewer dispatch round trips — the lever for high-latency "
+        "links); must divide --steps and --save-every",
+    )
     ap.add_argument("--report-every", type=int, default=10)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None,
@@ -152,6 +159,19 @@ def main(argv=None) -> int:
             ap.error("--top-p requires --temperature > 0 (sampling)")
         if not 0.0 < args.top_p <= 1.0:
             ap.error(f"--top-p must be in (0, 1], got {args.top_p}")
+    spl = args.steps_per_launch
+    if spl < 1:
+        ap.error(f"--steps-per-launch must be >= 1, got {spl}")
+    if spl > 1:
+        if args.steps % spl:
+            ap.error(
+                f"--steps-per-launch {spl} must divide --steps {args.steps}"
+            )
+        if args.save_every and args.save_every % spl:
+            ap.error(
+                f"--steps-per-launch {spl} must divide --save-every "
+                f"{args.save_every} (checkpoints land on launch boundaries)"
+            )
 
     rng = np.random.default_rng(args.seed)
     corpus = _load_corpus(args.data, rng)
@@ -212,41 +232,59 @@ def main(argv=None) -> int:
             [corpus[s : s + args.seq_len] for s in starts]
         ).astype(np.int32)
 
+    if spl > 1 and (args.steps - start_step) % spl:
+        ap.error(
+            f"resumed at step {start_step}: the remaining "
+            f"{args.steps - start_step} steps must divide by "
+            f"--steps-per-launch {spl}"
+        )
+
     # donate params + opt state: this loop always rebinds both, and the
     # aliasing halves the model-state HBM footprint (params + Adam
-    # moments are the dominant buffers at scale)
-    if zig:
-
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(p, opt, toks, tgts, wts):
+    # moments are the dominant buffers at scale). One optimizer step:
+    def one(p, opt, *data):
+        if zig:
             loss, g = jax.value_and_grad(lm_loss_with_targets)(
-                p, toks, tgts, wts, cfg, mesh, "data"
+                p, *data, cfg, mesh, "data"
             )
-            up, opt = tx.update(g, opt, p)
-            return optax.apply_updates(p, up), opt, loss
+        else:
+            loss, g = jax.value_and_grad(lm_loss)(p, *data, cfg, mesh, "data")
+        up, opt = tx.update(g, opt, p)
+        return optax.apply_updates(p, up), opt, loss
 
+    if spl == 1:
+        step = jax.jit(one, donate_argnums=(0, 1))
     else:
-
+        # launch = spl sequential steps in one program (scan carries
+        # params+opt; each data array gains a leading [spl] dim) —
+        # identical trajectory, spl-1 fewer dispatch round trips
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(p, opt, toks):
-            loss, g = jax.value_and_grad(lm_loss)(p, toks, cfg, mesh, "data")
-            up, opt = tx.update(g, opt, p)
-            return optax.apply_updates(p, up), opt, loss
+        def step(p, opt, *stacks):
+            def body(carry, xs):
+                p2, opt2, loss = one(*carry, *xs)
+                return (p2, opt2), loss
+            (p, opt), losses = jax.lax.scan(body, (p, opt), stacks)
+            return p, opt, losses[-1]
+
+    def launch_data():
+        """Sharded device arrays for one launch ([spl, ...] when fused)."""
+        batches = [sample_tokens() for _ in range(spl)]
+        if zig:
+            arrs = [zigzag_lm_arrays(t, n_data) for t in batches]
+            grouped = list(zip(*arrs))  # (toks), (tgts), (wts)
+        else:
+            grouped = [batches]
+        return tuple(
+            shard_tokens(g[0] if spl == 1 else np.stack(g), mesh)
+            for g in grouped
+        )
 
     print(f"devices={n_dev} (data={n_data} x server={args.num_servers}) "
           f"attention={cfg.attention} corpus={corpus.size} bytes")
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
-    for i in range(start_step + 1, args.steps + 1):
-        toks = sample_tokens()
-        if zig:
-            tz, gz, wz = zigzag_lm_arrays(toks, n_data)
-            params, opt, loss = step(
-                params, opt, shard_tokens(tz, mesh), shard_tokens(gz, mesh),
-                shard_tokens(wz, mesh),
-            )
-        else:
-            params, opt, loss = step(params, opt, shard_tokens(toks, mesh))
-        if i % args.report_every == 0 or i == args.steps:
+    for i in range(start_step + spl, args.steps + 1, spl):
+        params, opt, loss = step(params, opt, *launch_data())
+        if i % args.report_every < spl or i == args.steps:
             ll = float(loss)
             print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}", flush=True)
         if mgr is not None and (

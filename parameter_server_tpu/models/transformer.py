@@ -608,25 +608,43 @@ def zigzag_lm_arrays(tokens: np.ndarray, n: int):
 
 
 def make_lm_train_step(cfg: LMConfig, mesh: Mesh, axis: str = "data",
-                       lr: float = 0.3, donate: bool = False):
-    """SGD train step; tokens must be placed sharded P(None, axis).
+                       lr: float = 0.3, donate: bool = False,
+                       steps_per_launch: int = 1):
+    """SGD train step; tokens must be placed sharded P(..., axis).
 
     ``donate=True`` donates the incoming params (input/output aliasing —
     halves param HBM footprint). Opt-in: a donated call consumes the
     caller's buffers, which breaks patterns like stepping two configs
     from the SAME initial params; enable it in owned training loops that
-    always rebind (``params, loss = step(params, toks)``)."""
+    always rebind (``params, loss = step(params, toks)``).
+
+    ``steps_per_launch > 1`` fuses that many sequential SGD steps into
+    ONE compiled program via ``lax.scan`` (the LM analogue of the linear
+    app's ELL supersteps): ``step(params, tokens)`` then takes a stacked
+    ``[T, B, S]`` batch, consumes one ``[B, S]`` slice per scan step with
+    the params carried through, and returns ``(params, losses[T])`` —
+    bit-identical training semantics to T separate calls, minus T-1
+    dispatch round trips (dominant on high-latency links). Activations
+    live one step at a time, so peak memory matches a single step."""
     if cfg.attention == "ring_zigzag":
         raise ValueError(
             "the zigzag layout needs explicit targets — use "
             "make_lm_train_step_with_targets (+ zigzag_lm_arrays)"
         )
+    if steps_per_launch < 1:
+        raise ValueError(f"steps_per_launch must be >= 1, got {steps_per_launch}")
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def step(params, tokens):
+    def one(params, tokens):
         loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg, mesh, axis)
         new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
         return new, loss
+
+    if steps_per_launch == 1:
+        return jax.jit(one, donate_argnums=(0,) if donate else ())
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(params, tokens_stack):
+        return jax.lax.scan(one, params, tokens_stack)
 
     return step
 
@@ -652,7 +670,10 @@ def make_lm_train_step_with_targets(
 
 
 def shard_tokens(tokens: np.ndarray, mesh: Mesh, axis: str = "data") -> jax.Array:
-    return jax.device_put(tokens, NamedSharding(mesh, P(None, axis)))
+    """Place ``[B, S]`` (or a stacked ``[T, B, S]`` superbatch) with the
+    sequence dimension sharded over ``axis``."""
+    spec = P(*([None] * (tokens.ndim - 1)), axis)
+    return jax.device_put(tokens, NamedSharding(mesh, spec))
 
 
 def shard_lm_params(

@@ -103,6 +103,23 @@ def test_lm_cli_flag_mistakes_fail_fast(mesh8):
         main([*base, "--top-k", "3"])
     with pytest.raises(SystemExit):  # negative temperature
         main([*base, "--temperature", "-1"])
+    with pytest.raises(SystemExit):  # launch must divide the step budget
+        main([*base, "--steps-per-launch", "3"])
+    with pytest.raises(SystemExit):  # ...and the checkpoint cadence
+        main(
+            [*base, "--steps", "6", "--steps-per-launch", "3",
+             "--save-every", "4", "--ckpt-dir", "/tmp/unused-lm-ckpt"]
+        )
+
+
+@pytest.mark.parametrize("extra", [(), ("--attention", "ring_zigzag")])
+def test_lm_cli_scanned_supersteps(mesh8, capsys, extra):
+    """--steps-per-launch fuses optimizer steps into scanned launches
+    (plain and zigzag three-array layouts): training still converges
+    and reports land on launch boundaries."""
+    out, losses = run_cli(capsys, "--steps-per-launch", "5", *extra)
+    assert losses[-1] < losses[0], losses
+    assert "--- generation" in out
 
 
 def test_lm_cli_tiny_corpus_rejected(mesh8, tmp_path):

@@ -124,6 +124,33 @@ class TestSeqParallelLM:
         losses, _ = run_copy_training(mesh8, params, cfg_f, steps=30)
         assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
 
+    def test_scanned_supersteps_match_sequential(self, mesh8, cfg, params):
+        """steps_per_launch=T fuses T sequential SGD steps into one
+        program (lax.scan carries the params): identical training
+        trajectory to T separate step() calls."""
+        rng = np.random.default_rng(3)
+        stack = rng.integers(0, cfg.vocab, (3, 2, 64)).astype(np.int32)
+
+        seq_step = make_lm_train_step(cfg, mesh8, "data", lr=0.2)
+        p_seq = params
+        seq_losses = []
+        for i in range(3):
+            p_seq, loss = seq_step(p_seq, shard_tokens(stack[i], mesh8))
+            seq_losses.append(float(loss))
+
+        fused = make_lm_train_step(
+            cfg, mesh8, "data", lr=0.2, steps_per_launch=3
+        )
+        p_fused, losses = fused(params, shard_tokens(stack, mesh8))
+        np.testing.assert_allclose(
+            np.asarray(losses), seq_losses, rtol=1e-5
+        )
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p_fused[k]), np.asarray(p_seq[k]), atol=1e-5,
+                err_msg=k,
+            )
+
     def test_lm_zigzag_forward_matches_ring_permuted(self, mesh8, cfg, params):
         """No positional encoding + per-position layers: the zigzag-layout
         logits must equal the natural-layout logits permuted."""
