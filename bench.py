@@ -33,6 +33,7 @@ import os
 import sys
 import threading
 import time
+import traceback
 
 import numpy as np
 
@@ -105,6 +106,48 @@ class Watchdog:
             self._done = True
             print(json.dumps(rec), flush=True)
 
+    def _partial_record(self, wedge: str) -> "tuple[dict, int]":
+        """Build the best record the staged fields support (caller holds
+        the lock). Returns (record, exit_code): a valid measurement with
+        the wedge disclosed when the headline already landed, else a
+        failure record carrying whatever diagnostics were staged."""
+        partial = dict(self._partial)
+        if partial.get("value"):
+            rec = {"metric": self.metric, "unit": "examples/sec"}
+            rec.update(partial)
+            rec["wedged"] = wedge
+            rec["note"] = (
+                partial.get("note", "")
+                + " | RUN CUT SHORT by a mid-run tunnel wedge: "
+                "fields after the wedge point are absent; the "
+                "headline device-only phase completed before it"
+            ).lstrip(" |")
+            return rec, 0
+        rec = {"metric": self.metric, "unit": "examples/sec"}
+        rec.update(partial)
+        rec["value"] = 0
+        rec["vs_baseline"] = 0
+        rec["error"] = f"accelerator wedged: {wedge}"
+        return rec, 2
+
+    def abort(self, reason: str) -> int:
+        """Synchronous twin of the stall branch, for mid-run EXCEPTIONS:
+        a dying backend raises (e.g. ``UNAVAILABLE: TPU backend
+        setup/compile error`` from a device_put — observed 2026-07-31
+        01:30, which turned 26 minutes of measurement into a bare
+        traceback with no JSON). Emits the best-so-far record and
+        returns the exit code instead of letting the traceback eat the
+        evidence."""
+        with self._lock:
+            if self._done:  # a final record already printed
+                return 0
+            self._done = True
+            rec, code = self._partial_record(
+                f"exception in phase '{self._phase}': {reason}"
+            )
+            print(json.dumps(rec), flush=True)
+            return code
+
     def _run(self) -> None:
         while True:
             time.sleep(self.poll_s)
@@ -116,33 +159,12 @@ class Watchdog:
                     continue
                 # fire — still under the lock, so finish() cannot
                 # interleave a second record
-                phase = self._phase
-                partial = dict(self._partial)
-                wedge = (
-                    f"no progress for {idle:.0f}s in phase '{phase}' "
-                    "(tunnel wedged mid-run?)"
+                rec, code = self._partial_record(
+                    f"no progress for {idle:.0f}s in phase "
+                    f"'{self._phase}' (tunnel wedged mid-run?)"
                 )
-                if partial.get("value"):
-                    rec = {"metric": self.metric, "unit": "examples/sec"}
-                    rec.update(partial)
-                    rec["wedged"] = wedge
-                    rec["note"] = (
-                        partial.get("note", "")
-                        + " | RUN CUT SHORT by a mid-run tunnel wedge: "
-                        "fields after the wedge point are absent; the "
-                        "headline device-only phase completed before it"
-                    ).lstrip(" |")
-                    print(json.dumps(rec), flush=True)
-                    os._exit(0)
-                # no headline yet: an error record — but keep whatever
-                # diagnostics were staged (sweep_error, parity fields)
-                rec = {"metric": self.metric, "unit": "examples/sec"}
-                rec.update(partial)
-                rec["value"] = 0
-                rec["vs_baseline"] = 0
-                rec["error"] = f"accelerator wedged: {wedge}"
                 print(json.dumps(rec), flush=True)
-                os._exit(2)
+                os._exit(code)
 
 
 _WATCHDOG: "Watchdog | None" = None
@@ -868,9 +890,19 @@ def main() -> int:
         else "criteo_sparse_lr_examples_per_sec",
         stall_s=args.stall_timeout,
     )
-    if args.real:
-        return run_real(args)
+    try:
+        if args.real:
+            return run_real(args)
+        return run_synthetic(args)
+    except Exception as e:  # backend death raises instead of stalling
+        # full traceback to stderr (the JSON contract owns stdout): a
+        # programming error must stay diagnosable from the log even
+        # though the record discloses only the truncated message
+        traceback.print_exc()
+        return _WATCHDOG.abort(f"{type(e).__name__}: {str(e)[:300]}")
 
+
+def run_synthetic(args) -> int:
     import jax
 
     from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker

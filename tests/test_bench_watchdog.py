@@ -111,6 +111,50 @@ def test_beats_keep_it_alive():
     assert "wedged" not in r.stdout
 
 
+def test_abort_after_headline_emits_partial_record_rc0():
+    # a mid-run EXCEPTION (backend death raises instead of stalling —
+    # observed: UNAVAILABLE from device_put 26 minutes into a healthy
+    # run) must keep the already-landed headline, exit code 0
+    r = _run(
+        "wd.beat('e2e', value=42.0, vs_baseline=0.1, note='n')\n"
+        "code = wd.abort('JaxRuntimeError: UNAVAILABLE')\n"
+        "sys.exit(code)\n"
+    )
+    assert r.returncode == 0
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 42.0
+    assert "UNAVAILABLE" in rec["wedged"]
+    assert "CUT SHORT" in rec["note"]
+
+
+def test_abort_before_headline_emits_error_record_rc2():
+    r = _run(
+        "wd.beat('warmup', sweep_error='boom')\n"
+        "code = wd.abort('JaxRuntimeError: UNAVAILABLE')\n"
+        "sys.exit(code)\n"
+    )
+    assert r.returncode == 2
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0
+    assert rec["sweep_error"] == "boom"
+    assert "UNAVAILABLE" in rec["error"] and "warmup" in rec["error"]
+
+
+def test_abort_after_finish_is_a_noop():
+    # the exception handler may run after a final record already
+    # printed: abort must not emit a second one
+    r = _run(
+        "import json\n"
+        "wd.finish({'metric': 'm', 'value': 1.0})\n"
+        "code = wd.abort('late')\n"
+        "assert code == 0\n"
+        "time.sleep(0.5)\n"
+    )
+    assert r.returncode == 0
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+
+
 def test_finish_is_atomic_and_prints_once():
     r = _run(
         "import json\n"
