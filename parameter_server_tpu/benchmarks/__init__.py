@@ -63,4 +63,6 @@ def timeit(fn, n: int, warmup: int = 3, budget_s: float = 90.0) -> float:
         times.append((time.perf_counter() - w0) / n_eff)
         if time.perf_counter() - t_meas > budget_s:
             break
-    return sorted(times)[len(times) // 2]
+    # lower median: with 2 windows (budget break) this picks the FASTER
+    # one — a wedge-spiked window must not become the reported rate
+    return sorted(times)[(len(times) - 1) // 2]

@@ -42,7 +42,15 @@ class LMConfig:
     # HBM), "ring_zigzag" (flash over the zigzag-permuted layout for
     # balanced causal work per hop; train via zigzag_lm_arrays +
     # lm_loss_with_targets), or "a2a" (Ulysses: all_to_all seq<->head
-    # reshard, dense per-head matmuls; needs n_heads % mesh-axis == 0)
+    # reshard, dense per-head matmuls; needs n_heads % mesh-axis == 0).
+    # "ring" is the MEASURED training default on one v5e chip: at
+    # s=8192/bf16 the XLA chunk path trains at 19.4k tok/s vs
+    # ring_flash's 14.6k (BENCH_ONCHIP.md 2026-07-31 lm task) — XLA
+    # saves the per-chunk P matrices and pays HBM instead of the flash
+    # bwd's recompute FLOPs, a winning trade while they fit. Flash wins
+    # the FORWARD (1.29x at s=8192/bf16) and owns decode prefill +
+    # sliding-window; prefer ring_flash when bwd memory, not speed,
+    # binds (very long S where saved P chunks blow HBM).
     attention: str = "ring"
     # >0: every moe_every-th layer's FFN is an expert-parallel MoE
     # (models/moe.py) with n_experts switch-routed experts
