@@ -43,15 +43,15 @@ class LMConfig:
     # balanced causal work per hop; train via zigzag_lm_arrays +
     # lm_loss_with_targets), or "a2a" (Ulysses: all_to_all seq<->head
     # reshard, dense per-head matmuls; needs n_heads % mesh-axis == 0).
-    # "ring" is the MEASURED training default on one v5e chip: at
-    # s=8192/bf16 the XLA chunk path trains at 19.4k tok/s vs
-    # ring_flash's 14.6k (BENCH_ONCHIP.md 2026-07-31 lm task) — XLA
-    # saves the per-chunk P matrices and pays HBM instead of the flash
-    # bwd's recompute FLOPs, a winning trade while they fit. Flash wins
-    # the FORWARD (1.29x at s=8192/bf16) and owns decode prefill +
-    # sliding-window; prefer ring_flash when bwd memory, not speed,
-    # binds (very long S where saved P chunks blow HBM).
-    attention: str = "ring"
+    # "ring_flash" is the MEASURED training default on one v5e chip
+    # (BENCH_ONCHIP.md 2026-07-31 04:27/04:30): with the swept 512x512
+    # kernel blocking, flash trains the s=8192/bf16 LM at 30.6k tok/s
+    # vs the XLA chunk path's 21.1k (1.45x; kernel-level fwd+bwd 19.8k
+    # vs 8.8k GFLOP/s, 2.2x) AND keeps O(block) memory where XLA saves
+    # per-chunk P matrices. With the original 128x128 blocking this
+    # comparison went the OTHER way (14.6k vs 19.4k) — the default
+    # follows the measurement, not the architecture diagram.
+    attention: str = "ring_flash"
     # >0: every moe_every-th layer's FFN is an expert-parallel MoE
     # (models/moe.py) with n_experts switch-routed experts
     moe_every: int = 0
@@ -77,6 +77,18 @@ class LMConfig:
     # params AND the decode KV cache by the group factor — the cache is
     # the dominant serving HBM traffic. None = n_heads (standard MHA)
     n_kv_heads: "int | None" = None
+    # rotary position embedding (RoFormer, Su et al. 2021): q/k head
+    # vectors are rotated by position-dependent angles before attention,
+    # so scores depend only on RELATIVE offsets — parameter-free and
+    # length-extrapolating, vs the default NoPE (causal masking alone
+    # carries order). Composes with every schedule here: the training
+    # forward rotates on the GLOBAL [B, S] view (GSPMD partitions the
+    # position iota with the sequence; zigzag uses its permutation as
+    # the position ids), the decode path rotates at the absolute cache
+    # slot, and window/GQA are unaffected (rotation acts per head-dim
+    # pair before any masking/grouping)
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     def __post_init__(self):
         if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
@@ -113,6 +125,11 @@ class LMConfig:
                     f"n_kv_heads={self.n_kv_heads} (each K/V head serves "
                     "an equal group of query heads)"
                 )
+        if self.rope and (self.d_model // self.n_heads) % 2:
+            raise ValueError(
+                f"LMConfig.rope pairs head dimensions: head_dim="
+                f"{self.d_model // self.n_heads} must be even"
+            )
 
     @property
     def kv_heads(self) -> int:
@@ -162,6 +179,52 @@ def _ln(x, scale):
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
 
 
+def _rope_tables(positions, head_dim: int, theta: float):
+    """cos/sin rotation tables (f32) for ``apply_rope``: angles are
+    pos * theta^(-i/half). Computed in f32 regardless of the activation
+    dtype — bf16 positions lose integer precision past 256. Hoist these
+    out of per-layer code: they depend only on positions and theta, and
+    inside a ``jax.checkpoint`` region they would be recomputed in every
+    layer's backward pass."""
+    half = head_dim // 2
+    inv = theta ** (jnp.arange(half, dtype=jnp.float32) / -half)
+    ang = jnp.asarray(positions, jnp.float32)[..., None] * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rotate(x: jax.Array, cos, sin) -> jax.Array:
+    """Apply precomputed rotation tables in ``x.dtype``."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], -1)
+
+
+def apply_rope(x: jax.Array, positions, theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (RoFormer, Su et al. 2021), GPT-NeoX
+    half-split pairing: dimension i of the first half pairs with
+    dimension i of the second, each pair rotated by pos * theta^(-i/half).
+
+    ``x`` is [..., head_dim] (head_dim even); ``positions`` is an int
+    array broadcastable to ``x.shape[:-1]`` (a scalar for single-slot
+    decode, ``[1, S, 1]`` for a [B, S, heads, hd] batch)."""
+    cos, sin = _rope_tables(positions, x.shape[-1], theta)
+    return _rotate(x, cos, sin)
+
+
+def _rope_position_ids(cfg: LMConfig, s: int, mesh: Mesh, axis: str):
+    """Global position ids for the training forward: natural order, or
+    the zigzag permutation when the sequence is laid out zigzag (token
+    at layout index j sits at global position perm[j])."""
+    if cfg.attention == "ring_zigzag":
+        from .attention import zigzag_permutation
+
+        return jnp.asarray(
+            zigzag_permutation(s, mesh.shape[axis]), jnp.int32
+        )
+    return jnp.arange(s, dtype=jnp.int32)
+
+
 def _layer_params(params: Dict[str, jax.Array], i: int) -> Dict[str, jax.Array]:
     """The i-th decoder layer's parameter sub-dict (explicit argument so
     jax.checkpoint sees them as inputs and differentiates through)."""
@@ -182,12 +245,32 @@ def lm_forward(
     hd = cfg.d_model // cfg.n_heads
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
 
+    # RoPE tables, computed ONCE on the GLOBAL sequence view (GSPMD
+    # shards them with the tokens; zigzag's position ids are its
+    # permutation) and closed over by every layer — under remat they
+    # enter jax.checkpoint as inputs, not per-layer recomputation
+    rope_cs = (
+        _rope_tables(
+            _rope_position_ids(cfg, s, mesh, axis)[None, :, None],
+            hd, cfg.rope_theta,
+        )
+        if cfg.rope
+        else None
+    )
+
     def layer(x, lp, is_moe):
         cast = lambda k: lp[k].astype(dtype)  # noqa: E731
         h = _ln(x, cast("ln1"))
         q = h @ cast("wq")
         k = h @ cast("wk")
         v = h @ cast("wv")
+        if cfg.rope:  # rotate BEFORE the GQA broadcast: k is still narrow
+            q = _rotate(
+                q.reshape(b, s, cfg.n_heads, hd), *rope_cs
+            ).reshape(b, s, cfg.d_model)
+            k = _rotate(
+                k.reshape(b, s, cfg.kv_heads, hd), *rope_cs
+            ).reshape(b, s, cfg.kv_heads * hd)
         if cfg.kv_heads != cfg.n_heads:
             # GQA: broadcast each K/V head over its query-head group up
             # front; every attention schedule below then sees full-width
@@ -274,12 +357,19 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
     if cfg.window is not None:  # sliding window, mirroring lm_forward
         keep &= (pos - t_range) < cfg.window
     mask = keep[None, None, None, :]  # [1, 1, 1, T]
+    rope_cs = (
+        _rope_tables(pos, hd, cfg.rope_theta) if cfg.rope else None
+    )
     for i in range(cfg.n_layers):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))
         q = (h @ cast("wq")).reshape(b, kvh, g, hd)
         k = (h @ cast("wk")).reshape(b, kvh, hd)
         v = (h @ cast("wv")).reshape(b, kvh, hd)
+        if cfg.rope:  # rotate at the absolute slot; the cache stores
+            # ROTATED k, matching the prefill/training convention
+            q = _rotate(q, *rope_cs)
+            k = _rotate(k, *rope_cs)
         kcache = kcache.at[i, :, :, pos].set(k.astype(kcache.dtype))
         vcache = vcache.at[i, :, :, pos].set(v.astype(vcache.dtype))
         s = jnp.einsum(
@@ -380,12 +470,22 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
     hd = cfg.d_model // nh
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     x = (params["emb"][prompt] * np.sqrt(cfg.d_model)).astype(dtype)
+    rope_cs = (
+        _rope_tables(
+            jnp.arange(p_len)[None, :, None], hd, cfg.rope_theta
+        )
+        if cfg.rope
+        else None
+    )
     for i in range(cfg.n_layers):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))
         q = (h @ cast("wq")).reshape(b, p_len, nh, hd)
         k = (h @ cast("wk")).reshape(b, p_len, kvh, hd)
         v = (h @ cast("wv")).reshape(b, p_len, kvh, hd)
+        if cfg.rope:
+            q = _rotate(q, *rope_cs)
+            k = _rotate(k, *rope_cs)
         kcache = kcache.at[i, :, :, :p_len].set(
             jnp.swapaxes(k, 1, 2).astype(kcache.dtype)
         )

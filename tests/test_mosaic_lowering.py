@@ -50,8 +50,11 @@ Z = jnp.zeros
         ((4, 1024, 64), jnp.float32, dict(causal=True, window=256)),
         ((2, 96, 40), jnp.float32, dict(causal=True)),  # sub-block, odd D
         ((1, 384, 128), jnp.float32, dict(causal=True)),  # S % block != 0
+        # the 512x512 default blocking with a wide head dim: the largest
+        # VMEM tile shape the model paths can request
+        ((2, 1024, 128), jnp.bfloat16, dict(causal=True)),
     ],
-    ids=["causal", "full", "bf16", "window", "small", "s384"],
+    ids=["causal", "full", "bf16", "window", "small", "s384", "d128"],
 )
 def test_flash_fwd_and_bwd_lower(shape, dtype, kw):
     q = Z(shape, dtype)

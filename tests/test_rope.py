@@ -1,0 +1,221 @@
+"""Rotary position embeddings (LMConfig.rope): math vs a complex-number
+reference, the relative-offset property, and parity of every schedule
+(ring/flash/zigzag/a2a/GQA/decode) against the dense single-shard model
+with rotation on. RoPE is the repo's positional scheme beyond NoPE; the
+reference framework has no LM at all, so these are extension tests."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from parameter_server_tpu.models.transformer import (
+    LMConfig,
+    apply_rope,
+    init_lm,
+    lm_forward,
+    lm_generate,
+    shard_tokens,
+)
+
+
+class TestRopeMath:
+    def test_matches_complex_rotation(self):
+        """GPT-NeoX half-split RoPE is elementwise complex multiplication
+        by e^(i * pos * theta^(-j/half)) on pairs (x[j], x[j+half])."""
+        rng = np.random.default_rng(0)
+        hd, s = 8, 16
+        x = rng.normal(size=(s, hd)).astype(np.float32)
+        pos = np.arange(s)
+        got = np.asarray(apply_rope(x, pos))
+        half = hd // 2
+        inv = 10000.0 ** (-np.arange(half) / half)
+        ang = pos[:, None] * inv[None, :]
+        z = x[:, :half] + 1j * x[:, half:]
+        rot = z * np.exp(1j * ang)
+        want = np.concatenate([rot.real, rot.imag], -1).astype(np.float32)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_scores_depend_on_relative_offset_only(self):
+        """(R_i q) . (R_j k) == (R_{i+c} q) . (R_{j+c} k): the whole point
+        of rotary embeddings."""
+        rng = np.random.default_rng(1)
+        hd = 32
+        q = rng.normal(size=(hd,)).astype(np.float32)
+        k = rng.normal(size=(hd,)).astype(np.float32)
+
+        def score(i, j):
+            qi = np.asarray(apply_rope(q, np.int32(i)))
+            kj = np.asarray(apply_rope(k, np.int32(j)))
+            return float(qi @ kj)
+
+        for i, j, c in [(3, 1, 40), (7, 7, 100), (12, 2, 1000)]:
+            np.testing.assert_allclose(
+                score(i, j), score(i + c, j + c), rtol=1e-4
+            )
+
+    def test_position_zero_is_identity(self):
+        x = np.random.default_rng(2).normal(size=(4, 16)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(apply_rope(x, np.zeros(4, np.int32))), x, atol=1e-6
+        )
+
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            LMConfig(vocab=8, d_model=6, n_heads=2, n_layers=1, d_ff=8,
+                     rope=True)
+
+
+@pytest.fixture(scope="module")
+def rcfg():
+    return LMConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                    rope=True)
+
+
+@pytest.fixture(scope="module")
+def rparams(rcfg):
+    return init_lm(jax.random.PRNGKey(0), rcfg)
+
+
+def _dense_ref(params, tokens, cfg):
+    """Single-shard forward = the dense reference for every schedule."""
+    from parameter_server_tpu.parallel import mesh as meshlib
+
+    mesh1 = meshlib.make_mesh(num_data=1, num_server=1)
+    return np.asarray(
+        lm_forward(params, shard_tokens(tokens, mesh1), cfg, mesh1, "data")
+    )
+
+
+class TestRopeSchedules:
+    def test_rope_changes_the_forward(self, mesh8, rcfg, rparams):
+        """Guard against a silently-ignored flag. At the 0.02 init scale
+        attention scores are ~1e-4 and near-uniform, so rotation barely
+        moves the softmax; sharpen attention by scaling wq/wk."""
+        sharp = {
+            k: v * 50.0 if k.endswith(("wq", "wk")) else v
+            for k, v in rparams.items()
+        }
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, rcfg.vocab, (2, 64)).astype(np.int32)
+        nope = dataclasses.replace(rcfg, rope=False)
+        a = _dense_ref(sharp, tokens, rcfg)
+        b = _dense_ref(sharp, tokens, nope)
+        assert np.abs(a - b).max() > 1e-3
+
+    @pytest.mark.parametrize("attention", ["ring", "ring_flash"])
+    def test_sharded_matches_dense(self, mesh8, rcfg, rparams, attention):
+        """Sequence sharding must not change rotated attention: the
+        position iota partitions with the tokens under GSPMD."""
+        cfg = dataclasses.replace(rcfg, attention=attention)
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32)
+        got = np.asarray(
+            lm_forward(rparams, shard_tokens(tokens, mesh8), cfg, mesh8,
+                       "data")
+        )
+        np.testing.assert_allclose(
+            got, _dense_ref(rparams, tokens, cfg), atol=2e-4
+        )
+
+    def test_a2a_sharded_matches_dense(self, mesh8):
+        """Ulysses reshards seq<->head; rope rotates before the a2a, so
+        the head split must not disturb the rotation. Needs n_heads
+        divisible by the data axis."""
+        cfg = LMConfig(vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                       rope=True, attention="a2a")
+        params = init_lm(jax.random.PRNGKey(4), cfg)
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32)
+        got = np.asarray(
+            lm_forward(params, shard_tokens(tokens, mesh8), cfg, mesh8,
+                       "data")
+        )
+        np.testing.assert_allclose(
+            got, _dense_ref(params, tokens, cfg), atol=2e-4
+        )
+
+    def test_zigzag_matches_dense_through_permutation(self, mesh8, rcfg,
+                                                      rparams):
+        """Zigzag layout: logits come back permuted but must equal the
+        natural-order dense forward gathered through the permutation —
+        proving the zigzag position ids are the permutation itself."""
+        from parameter_server_tpu.models.attention import zigzag_permutation
+
+        n = mesh8.shape["data"]
+        cfg = dataclasses.replace(rcfg, attention="ring_zigzag")
+        rng = np.random.default_rng(4)
+        tokens = rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32)
+        perm = zigzag_permutation(64, n)
+        got = np.asarray(
+            lm_forward(rparams, shard_tokens(tokens[:, perm], mesh8), cfg,
+                       mesh8, "data")
+        )
+        want = _dense_ref(rparams, tokens, dataclasses.replace(rcfg))
+        np.testing.assert_allclose(got, want[:, perm], atol=2e-4)
+
+    def test_gqa_rope_sharded_matches_dense(self, mesh8):
+        cfg = LMConfig(vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                       rope=True, n_kv_heads=2)
+        params = init_lm(jax.random.PRNGKey(1), cfg)
+        rng = np.random.default_rng(5)
+        tokens = rng.integers(0, cfg.vocab, (2, 64)).astype(np.int32)
+        got = np.asarray(
+            lm_forward(params, shard_tokens(tokens, mesh8), cfg, mesh8,
+                       "data")
+        )
+        np.testing.assert_allclose(
+            got, _dense_ref(params, tokens, cfg), atol=2e-4
+        )
+
+    @pytest.mark.parametrize("kvh", [None, 1])
+    def test_decode_matches_forward(self, rcfg, kvh):
+        """KV-cached decode (rotate at the absolute slot, cache stores
+        rotated k) must reproduce the training forward's logits."""
+        cfg = dataclasses.replace(rcfg, n_kv_heads=kvh)
+        params = init_lm(jax.random.PRNGKey(2), cfg)
+        rng = np.random.default_rng(6)
+        prompt = rng.integers(0, cfg.vocab, (2, 7)).astype(np.int32)
+        steps = 5
+        toks, logits = lm_generate(
+            params, prompt, cfg, steps=steps, return_logits=True
+        )
+        # toks is [B, P+steps] (prompt included); logits covers every
+        # position that predicts a next token: rows [0, P+steps-2]
+        want = _dense_ref(params, np.asarray(toks), cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits), want[:, :-1], atol=2e-4, rtol=1e-4
+        )
+
+    def test_remat_gradients_match_with_rope(self, mesh8, rcfg, rparams):
+        """The hoisted cos/sin tables enter jax.checkpoint as inputs;
+        remat must stay gradient-identical with rotation on."""
+        from parameter_server_tpu.models.transformer import lm_loss
+
+        rng = np.random.default_rng(7)
+        tokens = shard_tokens(
+            rng.integers(0, rcfg.vocab, (2, 64)).astype(np.int32), mesh8
+        )
+        g0 = jax.grad(lm_loss)(rparams, tokens, rcfg, mesh8, "data")
+        g1 = jax.grad(lm_loss)(
+            rparams, tokens, dataclasses.replace(rcfg, remat=True),
+            mesh8, "data",
+        )
+        for k in g0:
+            np.testing.assert_allclose(
+                np.asarray(g0[k]), np.asarray(g1[k]), atol=1e-5,
+                err_msg=k,
+            )
+
+    def test_rope_lm_learns_position_task(self, mesh8):
+        """A task NoPE cannot express at distance: predict a token that
+        depends on absolute phase (alternating pair pattern ABAB...);
+        rope should drive the loss far below the 2-way uniform."""
+        from tests.test_transformer import run_copy_training
+
+        cfg = LMConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+                       rope=True)
+        params = init_lm(jax.random.PRNGKey(3), cfg)
+        losses, _ = run_copy_training(mesh8, params, cfg, steps=60)
+        assert losses[-1] < 0.3 * np.log(cfg.vocab), losses[-1]

@@ -357,8 +357,11 @@ class TestGenerate:
         assert np.max(np.abs(np.asarray(full) - np.asarray(full_nc))) > 1e-3
 
     def test_window_requires_flash_mode(self):
+        # the default attention is now ring_flash (measured), so the
+        # non-flash mode must be named explicitly to trip the guard
         with pytest.raises(ValueError, match="flash"):
-            LMConfig(window=8)  # default attention="ring"
+            LMConfig(window=8, attention="ring")
+        LMConfig(window=8)  # flash default: valid
 
     def test_sampling_modes(self, cfg, params):
         from parameter_server_tpu.models.transformer import lm_generate
