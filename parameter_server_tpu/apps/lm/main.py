@@ -72,6 +72,10 @@ def main(argv=None) -> int:
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 decoder activations")
     ap.add_argument("--moe-every", type=int, default=0)
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1: shard Adam moments over the data axis "
+                    "(per-device optimizer memory / n_data; composes "
+                    "with --num-servers tensor parallelism)")
     ap.add_argument("--num-servers", type=int, default=1,
                     help="tensor-parallel axis size: LM weights Megatron-"
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
@@ -202,15 +206,21 @@ def main(argv=None) -> int:
         params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
     tx = optax.adam(args.lr)
     opt = tx.init(params)  # zeros_like inherits each param's placement
-    # ...but freshly-created leaves (adam's step count) don't — pin any
-    # non-mesh-placed leaf replicated so the restore template is fully
-    # mesh-committed
-    opt = jax.tree.map(
-        lambda x: x
-        if isinstance(getattr(x, "sharding", None), NamedSharding)
-        else jax.device_put(x, NamedSharding(mesh, PartitionSpec())),
-        opt,
-    )
+    if args.zero1:
+        from ...models.transformer import zero1_shard_opt_state
+
+        # ZeRO-1: moments sharded over the data axis (every leaf comes
+        # back mesh-committed, scalars replicated)
+        opt = zero1_shard_opt_state(opt, mesh, "data")
+    else:
+        # freshly-created leaves (adam's step count) aren't mesh-placed —
+        # pin them replicated so the restore template is fully committed
+        opt = jax.tree.map(
+            lambda x: x
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else jax.device_put(x, NamedSharding(mesh, PartitionSpec())),
+            opt,
+        )
 
     mgr = None
     start_step = 0

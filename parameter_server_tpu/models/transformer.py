@@ -805,3 +805,48 @@ def shard_lm_params(
         return jax.device_put(v, NamedSharding(mesh, spec))
 
     return {k: place(k, v) for k, v in params.items()}
+
+
+def zero1_shard_opt_state(opt_state, mesh: Mesh, axis: str = "data"):
+    """ZeRO-1 optimizer-state sharding (Rajbhandari et al. 2020) by
+    placement: every state leaf is split over the ``axis`` mesh axis on
+    its largest free dimension divisible by the axis size. Params stay
+    however the caller placed them (replicated, or Megatron-split via
+    :func:`shard_lm_params`) — under jit, GSPMD partitions the
+    elementwise moment update to match the state sharding and
+    all-gathers only the final parameter delta, so the per-device
+    optimizer footprint drops by the data-axis size at the cost of one
+    gather of the update. Composes with tensor parallelism: a leaf
+    already sharded over the server axis keeps that placement and gains
+    the data axis on another dimension. Scalar leaves (adam's step
+    count) and leaves with no divisible free dimension are pinned
+    replicated, so the whole tree is mesh-committed (the checkpoint
+    restore template relies on that)."""
+    n = mesh.shape[axis]
+
+    def place(x):
+        if (
+            not hasattr(x, "shape") or x.ndim == 0 or n == 1
+        ):
+            # nothing to split: keep an existing mesh placement (a
+            # tensor-parallel moment must NOT be gathered back to
+            # replicated just because the data axis is trivial), pin
+            # anything unplaced replicated so the tree stays committed
+            if isinstance(getattr(x, "sharding", None), NamedSharding):
+                return x
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        cur = getattr(x, "sharding", None)
+        spec = (
+            list(cur.spec) + [None] * (x.ndim - len(cur.spec))
+            if isinstance(cur, NamedSharding)
+            else [None] * x.ndim
+        )
+        if axis in spec:  # already data-sharded; keep as is
+            return x
+        for d in sorted(range(x.ndim), key=lambda d: -x.shape[d]):
+            if spec[d] is None and x.shape[d] % n == 0:
+                spec[d] = axis
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree.map(place, opt_state)

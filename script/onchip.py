@@ -575,9 +575,21 @@ def task_scale() -> int:
     dev = jax.devices()[0]
     # max_delay=0 rides the donated-step path: ONE live table buffer
     # (input aliased to output) instead of live+snapshot+output, which is
-    # what lets 2^29-2^30 (>= the 800M-key north star) fit one chip
-    for log2 in (16, 17) if SMOKE else (28, 29, 30):
-        num_slots = 1 << log2
+    # what lets 2^29-2^30 (>= the 800M-key north star) fit one chip.
+    # 800M is BASELINE.json's Criteo-1TB key count, named directly so the
+    # north star is demonstrated even while 2^30 trips the tunnel's
+    # remote-compile helper (HTTP 500, 04:04+04:14 captures)
+    sizes = (
+        [("2e16", 1 << 16), ("2e17", 1 << 17)]
+        if SMOKE
+        else [
+            ("2e28", 1 << 28),
+            ("2e29", 1 << 29),
+            ("800M", 800_000_000),
+            ("2e30", 1 << 30),
+        ]
+    )
+    for label, num_slots in sizes:
         try:
             Postoffice.reset()
             po = Postoffice.instance().start()
@@ -631,7 +643,7 @@ def task_scale() -> int:
             stats = dev.memory_stats() or {}
             emit(
                 {
-                    "metric": f"ftrl_table_2e{log2}",
+                    "metric": f"ftrl_table_{label}",
                     "value": round(16384 / sec, 1),
                     "unit": "examples/sec",
                     "num_slots": num_slots,
@@ -642,7 +654,7 @@ def task_scale() -> int:
                 }
             )
         except Exception as e:
-            emit({"metric": f"ftrl_table_2e{log2}", "error": repr(e)[:500]})
+            emit({"metric": f"ftrl_table_{label}", "error": repr(e)[:500]})
     return 0
 
 

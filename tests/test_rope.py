@@ -209,13 +209,32 @@ class TestRopeSchedules:
             )
 
     def test_rope_lm_learns_position_task(self, mesh8):
-        """A task NoPE cannot express at distance: predict a token that
-        depends on absolute phase (alternating pair pattern ABAB...);
-        rope should drive the loss far below the 2-way uniform."""
-        from tests.test_transformer import run_copy_training
+        """A genuinely position-dependent task: the period-4 pattern
+        A B A C — the successor of A is B at even phase and C at odd
+        phase, so a bigram (position-blind) predictor bottoms out at
+        (2 ln 2)/4 ~ 0.347 nats/token. Driving the loss clearly below
+        that floor requires using position, which RoPE provides."""
+        import optax
 
-        cfg = LMConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        from parameter_server_tpu.models.transformer import lm_loss
+
+        cfg = LMConfig(vocab=8, d_model=32, n_heads=2, n_layers=2, d_ff=64,
                        rope=True)
         params = init_lm(jax.random.PRNGKey(3), cfg)
-        losses, _ = run_copy_training(mesh8, params, cfg, steps=60)
-        assert losses[-1] < 0.3 * np.log(cfg.vocab), losses[-1]
+        tx = optax.adam(3e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(p, opt, toks):
+            loss, g = jax.value_and_grad(lm_loss)(p, toks, cfg, mesh8, "data")
+            up, opt = tx.update(g, opt, p)
+            return optax.apply_updates(p, up), opt, loss
+
+        tokens = np.tile(np.array([1, 2, 1, 3], np.int32), (4, 16))
+        toks = shard_tokens(tokens, mesh8)
+        loss = None
+        for _ in range(200):
+            params, opt, loss = step(params, opt, toks)
+            loss.block_until_ready()  # throttle async dispatch
+        bigram_floor = 2 * np.log(2) / 4  # ~0.347
+        assert float(loss) < 0.6 * bigram_floor, (float(loss), bigram_floor)

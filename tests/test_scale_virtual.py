@@ -65,6 +65,19 @@ def test_sharded_table_2e29(mesh8):
 
 @pytest.mark.skipif(
     not os.environ.get("PS_BIG_TABLE"),
+    reason="~6.4 GB table state; set PS_BIG_TABLE=1 to run",
+)
+def test_sharded_table_800m(mesh8):
+    """The north-star key count itself (BASELINE.json: Criteo-1TB ~800M
+    keys), sharded over the 8-mesh: one chip tops out at 2^29 slots
+    under the tunnel's compile helper (BENCH_ONCHIP.md scale task), so
+    800M is precisely the table that NEEDS the server axis — the same
+    argument as the reference's multi-server sharding."""
+    _roundtrip(mesh8, 800_000_000)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PS_BIG_TABLE"),
     reason="~2+ GB FTRL state; set PS_BIG_TABLE=1 to run",
 )
 def test_training_step_2e28(mesh8):
