@@ -81,6 +81,21 @@ def main(argv=None) -> int:
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
                     "mesh); must divide the device count")
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--warmup", type=int, default=0,
+                    help="linear LR warmup steps, then cosine decay to "
+                    "10%% of --lr by --steps (0 = constant LR)")
+    ap.add_argument("--clip-norm", type=float, default=None,
+                    help="global-norm gradient clipping")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="average N microbatch gradients per optimizer "
+                    "step (optax.MultiSteps); effective batch = "
+                    "--batch * N with unchanged memory per forward")
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="evaluate held-out loss every N steps (holds "
+                    "out the corpus tail; see --eval-frac)")
+    ap.add_argument("--eval-frac", type=float, default=0.1,
+                    help="fraction of the corpus tail held out for "
+                    "--eval-every (never trained on)")
     ap.add_argument(
         "--steps-per-launch", type=int, default=1,
         help="fuse N sequential optimizer steps into one compiled launch "
@@ -191,6 +206,32 @@ def main(argv=None) -> int:
             f"corpus has {corpus.size} bytes but --seq-len {args.seq_len} "
             "needs at least seq_len+2"
         )
+    if args.grad_accum < 1:
+        ap.error(f"--grad-accum must be >= 1, got {args.grad_accum}")
+    if args.grad_accum > args.steps:
+        ap.error(
+            f"--grad-accum {args.grad_accum} exceeds --steps "
+            f"{args.steps}: no accumulation window would ever complete, "
+            "so the model would never update"
+        )
+    if args.warmup and args.warmup >= args.steps:
+        ap.error(
+            f"--warmup {args.warmup} must be < --steps {args.steps}"
+        )
+    if args.eval_every < 0:
+        ap.error(f"--eval-every must be >= 0, got {args.eval_every}")
+    eval_corpus = None
+    if args.eval_every:
+        if not 0.0 < args.eval_frac < 1.0:
+            ap.error(f"--eval-frac must be in (0, 1), got {args.eval_frac}")
+        split = int(corpus.size * (1.0 - args.eval_frac))
+        corpus, eval_corpus = corpus[:split], corpus[split:]
+        if min(corpus.size, eval_corpus.size) <= args.seq_len + 1:
+            ap.error(
+                f"--eval-frac {args.eval_frac} leaves a split too small "
+                f"for --seq-len {args.seq_len} "
+                f"(train {corpus.size} / eval {eval_corpus.size} bytes)"
+            )
     from jax.sharding import NamedSharding, PartitionSpec
 
     params = init_lm(jax.random.PRNGKey(args.seed), cfg)
@@ -204,7 +245,28 @@ def main(argv=None) -> int:
         # the template's sharding, so the template must carry the real
         # training placement or a resumed run would train mis-placed
         params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
-    tx = optax.adam(args.lr)
+    # LR schedule -> clip -> adam -> (optional) microbatch accumulation.
+    # The schedule/accumulation counters live in the optimizer state, so
+    # checkpoint resume continues the schedule where it left off.
+    lr_sched = (
+        optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=max(1, args.warmup // args.grad_accum),
+            decay_steps=max(2, args.steps // args.grad_accum),
+            end_value=0.1 * args.lr,
+        )
+        if args.warmup
+        else args.lr
+    )
+    chain = []
+    if args.clip_norm:
+        chain.append(optax.clip_by_global_norm(args.clip_norm))
+    chain.append(optax.adam(lr_sched))
+    tx = optax.chain(*chain)
+    if args.grad_accum > 1:
+        # each CLI "step" is one microbatch; the inner optimizer (and
+        # its schedule) advances every grad_accum-th
+        tx = optax.MultiSteps(tx, every_k_schedule=args.grad_accum)
     opt = tx.init(params)  # zeros_like inherits each param's placement
     if args.zero1:
         from ...models.transformer import zero1_shard_opt_state
@@ -296,14 +358,62 @@ def main(argv=None) -> int:
             for g in grouped
         )
 
+    eval_fn = None
+    if args.eval_every:
+        # fixed held-out batches (never trained on), scored with the
+        # same loss the training step uses — zigzag included
+        erng = np.random.default_rng(args.seed + 7)
+        raw_eval = []
+        for _ in range(4):
+            starts = erng.integers(
+                0, eval_corpus.size - args.seq_len - 1, args.batch
+            )
+            raw_eval.append(
+                np.stack(
+                    [eval_corpus[s : s + args.seq_len] for s in starts]
+                ).astype(np.int32)
+            )
+        if zig:
+            ev_jit = jax.jit(
+                lambda p, t, g, w: lm_loss_with_targets(
+                    p, t, g, w, cfg, mesh, "data"
+                )
+            )
+            fixed_eval = [
+                tuple(
+                    shard_tokens(a, mesh)
+                    for a in zigzag_lm_arrays(t, n_data)
+                )
+                for t in raw_eval
+            ]
+            eval_fn = lambda p: float(  # noqa: E731
+                np.mean([float(ev_jit(p, *tpl)) for tpl in fixed_eval])
+            )
+        else:
+            ev_jit = jax.jit(lambda p, t: lm_loss(p, t, cfg, mesh, "data"))
+            fixed_eval = [shard_tokens(t, mesh) for t in raw_eval]
+            eval_fn = lambda p: float(  # noqa: E731
+                np.mean([float(ev_jit(p, t)) for t in fixed_eval])
+            )
+
     print(f"devices={n_dev} (data={n_data} x server={args.num_servers}) "
-          f"attention={cfg.attention} corpus={corpus.size} bytes")
+          f"attention={cfg.attention} corpus={corpus.size} bytes"
+          + (f" (+{eval_corpus.size} held out)" if eval_corpus is not None
+             else ""))
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
     for i in range(start_step + spl, args.steps + 1, spl):
         params, opt, loss = step(params, opt, *launch_data())
         if i % args.report_every < spl or i == args.steps:
             ll = float(loss)
             print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}", flush=True)
+        if eval_fn is not None and (
+            i % args.eval_every < spl or i == args.steps
+        ):
+            el = eval_fn(params)
+            print(
+                f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
+                flush=True,
+            )
         if mgr is not None and (
             i == args.steps
             or (args.save_every and i % args.save_every == 0)

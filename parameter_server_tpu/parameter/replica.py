@@ -47,6 +47,50 @@ class Checkpointable:
         return step
 
 
+def _rebuild_like(tmpl, data, path: str):
+    """Rebuild ``data`` (orbax's plain containers: namedtuples as dicts
+    keyed by field name, tuples as lists) into ``tmpl``'s structure,
+    matching namedtuple fields by NAME. Leaves pass through unchecked."""
+    if isinstance(tmpl, tuple) and hasattr(tmpl, "_fields"):  # namedtuple
+        if not tmpl._fields:  # e.g. optax EmptyState: orbax stores None
+            return type(tmpl)()
+        if not isinstance(data, dict):
+            # older orbax / roundtripped namedtuple: positional fallback
+            data = dict(zip(tmpl._fields, data or ()))
+        missing = [f for f in tmpl._fields if f not in data]
+        extra = [f for f in data if f not in tmpl._fields]
+        if missing or extra:
+            raise ValueError(
+                f"checkpoint at {path} mismatches {type(tmpl).__name__}: "
+                f"missing fields {missing}, unexpected {extra} — saved "
+                "with a different optimizer config?"
+            )
+        return type(tmpl)(
+            **{f: _rebuild_like(getattr(tmpl, f), data[f], path)
+               for f in tmpl._fields}
+        )
+    if isinstance(tmpl, dict):
+        missing = [k for k in tmpl if k not in data]
+        extra = [k for k in data if k not in tmpl]
+        if missing or extra:
+            raise ValueError(
+                f"checkpoint at {path} mismatches the template: missing "
+                f"keys {missing}, unexpected {extra} — saved with a "
+                "different model/optimizer config?"
+            )
+        return {k: _rebuild_like(v, data[k], path) for k, v in tmpl.items()}
+    if isinstance(tmpl, (list, tuple)):
+        if len(tmpl) != len(data):
+            raise ValueError(
+                f"checkpoint at {path} has {len(data)} entries where the "
+                f"template expects {len(tmpl)}"
+            )
+        return type(tmpl)(
+            _rebuild_like(t, d, path) for t, d in zip(tmpl, data)
+        )
+    return data  # leaf
+
+
 class CheckpointManager:
     """Save/restore pytrees of (possibly sharded) arrays."""
 
@@ -86,26 +130,20 @@ class CheckpointManager:
             ckptr = self._orbax.PyTreeCheckpointer()
             out = ckptr.restore(path)
             if like is not None:
-                # orbax returns PLAIN containers (namedtuples come back
-                # as dicts keyed by field name); rebuild the template's
-                # structure from the leaves. Dict flatten order is sorted
-                # keys on both sides; for namedtuples this assumes field
-                # order == sorted order (true for optax's states — a
-                # custom node violating it should carry its own
-                # serialization)
-                loaded = jax.tree.leaves(out)
-                want = jax.tree.leaves(like)
-                if len(loaded) != len(want):
-                    raise ValueError(
-                        f"checkpoint at {path} has {len(loaded)} arrays "
-                        f"but the template expects {len(want)} — saved "
-                        "with a different model/optimizer config?"
-                    )
-                # leaf SHAPES are deliberately not compared: restoring
+                # orbax returns PLAIN containers: namedtuples come back
+                # as dicts keyed by FIELD NAME, tuples as lists. Rebuild
+                # the template's structure by walking both trees and
+                # matching namedtuple fields BY NAME — a sorted-leaf
+                # reorder would silently mispair states whose field
+                # order differs from sorted order (optax MultiStepsState:
+                # mini_step/gradient_step/inner_opt_state/... sorts to
+                # acc_grads first, which cross-wired adam moments with
+                # accumulator slots before this walk existed).
+                # Leaf SHAPES are deliberately not compared: restoring
                 # onto a different server count legitimately changes the
                 # padded table shapes (the reshard path; callers like
-                # load_state_host re-fit rows afterwards)
-                out = jax.tree.unflatten(jax.tree.structure(like), loaded)
+                # load_state_host re-fit rows afterwards).
+                out = _rebuild_like(like, out, path)
         else:
             data = np.load(os.path.join(path, "arrays.npz"))
             arrays = [data[k] for k in data.files if k != "__treedef__"]

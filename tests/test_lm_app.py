@@ -95,6 +95,53 @@ def test_lm_cli_a2a_mode(mesh8, capsys):
     assert losses[-1] < losses[0], losses
 
 
+def test_lm_cli_training_hygiene_flags(mesh8, capsys):
+    """Warmup-cosine LR, global-norm clipping, and microbatch gradient
+    accumulation run together and still train."""
+    out, losses = run_cli(
+        capsys, "--warmup", "5", "--clip-norm", "1.0", "--grad-accum", "2",
+    )
+    assert losses[-1] < losses[0], losses
+    assert "--- generation" in out
+
+
+def test_lm_cli_eval_holdout(mesh8, capsys, tmp_path):
+    """--eval-every scores fixed held-out batches the model never
+    trains on, printed alongside the train rows."""
+    f = tmp_path / "corpus.txt"
+    f.write_bytes(b"abcdefgh" * 4096)
+    out, losses = run_cli(
+        capsys, "--data", str(f), "--eval-every", "10",
+    )
+    assert "held out" in out
+    evals = [
+        float(line.split()[1])
+        for line in out.splitlines()
+        if line.strip().startswith("eval@")
+    ]
+    assert len(evals) >= 3, out
+    assert all(np.isfinite(e) for e in evals)
+    # periodic text: held-out loss must drop along with train loss
+    assert evals[-1] < evals[0], evals
+
+
+def test_lm_cli_resume_with_schedule_and_accum(mesh8, capsys, tmp_path):
+    """The LR-schedule and accumulation counters live in the optimizer
+    state: a resumed run must rebuild the same tx and restore onto it."""
+    ck = str(tmp_path / "ck")
+    hygiene = ["--warmup", "5", "--clip-norm", "1.0", "--grad-accum", "2"]
+    run_cli(capsys, "--ckpt-dir", ck, *hygiene)
+    rc = main(
+        [
+            "--steps", "40", "--seq-len", "64", "--batch", "4",
+            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+            "--report-every", "5", "--ckpt-dir", ck, "--resume", *hygiene,
+        ]
+    )
+    assert rc == 0
+    assert "resumed from step 30" in capsys.readouterr().out
+
+
 def test_lm_cli_flag_mistakes_fail_fast(mesh8):
     base = ["--steps", "5", "--seq-len", "64", "--batch", "2"]
     with pytest.raises(SystemExit):  # a2a heads not divisible by devices
@@ -105,6 +152,16 @@ def test_lm_cli_flag_mistakes_fail_fast(mesh8):
         main([*base, "--temperature", "-1"])
     with pytest.raises(SystemExit):  # launch must divide the step budget
         main([*base, "--steps-per-launch", "3"])
+    with pytest.raises(SystemExit):  # warmup must fit inside the run
+        main([*base, "--warmup", "5"])
+    with pytest.raises(SystemExit):  # accumulation must be positive
+        main([*base, "--grad-accum", "0"])
+    with pytest.raises(SystemExit):  # ...and fit inside the run
+        main([*base, "--grad-accum", "10"])
+    with pytest.raises(SystemExit):  # eval fraction out of range
+        main([*base, "--eval-every", "2", "--eval-frac", "1.5"])
+    with pytest.raises(SystemExit):  # negative eval cadence
+        main([*base, "--eval-every", "-10"])
     with pytest.raises(SystemExit):  # ...and the checkpoint cadence
         main(
             [*base, "--steps", "6", "--steps-per-launch", "3",

@@ -43,6 +43,51 @@ def test_kv_vector_reshards_2_to_4_servers(mesh8):
     np.testing.assert_allclose(kv_b.values(0, keys), 2 * vals)
 
 
+def test_restore_matches_namedtuple_fields_by_name(tmp_path, mesh8):
+    """Orbax returns namedtuples as field-name dicts; the restore walk
+    must pair them BY NAME. optax's MultiStepsState is the regression:
+    its field order (mini_step, gradient_step, inner_opt_state,
+    acc_grads, skip_state) differs from sorted order, so the old
+    sorted-leaf reorder cross-wired adam moments with accumulator
+    slots (caught as a shape error mid-update after a CLI resume)."""
+    import jax
+    import optax
+
+    from parameter_server_tpu.parameter.replica import CheckpointManager
+
+    params = {
+        "emb": np.arange(12, dtype=np.float32).reshape(4, 3),
+        "w1": np.ones((3, 5), np.float32),
+    }
+    tx = optax.MultiSteps(
+        optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-2)),
+        every_k_schedule=2,
+    )
+    opt = tx.init(params)
+    # advance one microbatch so every counter/accumulator is nonzero
+    grads = jax.tree.map(lambda x: 0.5 * np.ones_like(x), params)
+    _, opt = tx.update(grads, opt, params)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, {"params": params, "opt": opt})
+    got = mgr.restore(3, like={"params": params, "opt": tx.init(params)})
+    for a, b in zip(
+        jax.tree.leaves(got["opt"], is_leaf=lambda x: x is None),
+        jax.tree.leaves(opt, is_leaf=lambda x: x is None),
+    ):
+        if b is None:
+            assert a is None
+        else:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # structure (not just leaves) survives: same namedtuple types
+    assert jax.tree.structure(got["opt"]) == jax.tree.structure(opt)
+    # a SMALLER template must refuse the checkpoint (extra keys are a
+    # config mismatch, not something to silently drop)
+    with pytest.raises(ValueError, match="unexpected"):
+        mgr.restore(3, like={"params": {"emb": params["emb"]},
+                             "opt": tx.init(params)})
+
+
 def test_worker_checkpoint_restores_across_server_counts(tmp_path, mesh8):
     from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
     from parameter_server_tpu.apps.linear.config import (
