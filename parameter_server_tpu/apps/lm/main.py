@@ -214,6 +214,14 @@ def main(argv=None) -> int:
             f"{args.steps}: no accumulation window would ever complete, "
             "so the model would never update"
         )
+    if args.steps % args.grad_accum:
+        ap.error(
+            f"--grad-accum {args.grad_accum} must divide --steps "
+            f"{args.steps}: a trailing partial window would compute "
+            "gradients that never reach the optimizer"
+        )
+    if args.clip_norm is not None and args.clip_norm <= 0:
+        ap.error(f"--clip-norm must be > 0, got {args.clip_norm}")
     if args.warmup and args.warmup >= args.steps:
         ap.error(
             f"--warmup {args.warmup} must be < --steps {args.steps}"

@@ -399,12 +399,18 @@ def task_lm() -> int:
     )
     if SMOKE:
         base.update(d_model=64, n_heads=2, n_layers=2, d_ff=128)
+    big = dict(base)
+    if not SMOKE:  # ~100M params: MFU at a size where matmuls dominate
+        big.update(d_model=1024, n_layers=12, d_ff=4096)
     modes = [
         ("ring", LMConfig(attention="ring", **base)),
         ("ring_flash", LMConfig(attention="ring_flash", **base)),
+        ("ring_flash_rope",
+         LMConfig(attention="ring_flash", rope=True, **base)),
         ("ring_flash_w1024",
          LMConfig(attention="ring_flash",
                   window=64 if SMOKE else 1024, **base)),
+        ("ring_flash_d1024", LMConfig(attention="ring_flash", **big)),
     ]
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, 256, (spl, batch, seq), np.int32)

@@ -158,6 +158,10 @@ def test_lm_cli_flag_mistakes_fail_fast(mesh8):
         main([*base, "--grad-accum", "0"])
     with pytest.raises(SystemExit):  # ...and fit inside the run
         main([*base, "--grad-accum", "10"])
+    with pytest.raises(SystemExit):  # ...and divide it (no partial window)
+        main([*base, "--grad-accum", "2"])
+    with pytest.raises(SystemExit):  # negative clip flips gradients
+        main([*base, "--clip-norm", "-1"])
     with pytest.raises(SystemExit):  # eval fraction out of range
         main([*base, "--eval-every", "2", "--eval-frac", "1.5"])
     with pytest.raises(SystemExit):  # negative eval cadence
