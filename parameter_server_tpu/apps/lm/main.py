@@ -76,6 +76,13 @@ def main(argv=None) -> int:
                     help="ZeRO-1: shard Adam moments over the data axis "
                     "(per-device optimizer memory / n_data; composes "
                     "with --num-servers tensor parallelism)")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="FSDP/ZeRO-3: shard the parameters themselves "
+                    "over the data axis (grads and Adam moments inherit "
+                    "it) — per-device param+grad+optimizer memory / "
+                    "n_data; GSPMD all-gathers weights at use and "
+                    "reduce-scatters grads; composes with --num-servers "
+                    "and --zero1 is implied for the moments")
     ap.add_argument("--num-servers", type=int, default=1,
                     help="tensor-parallel axis size: LM weights Megatron-"
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
@@ -253,6 +260,13 @@ def main(argv=None) -> int:
         # the template's sharding, so the template must carry the real
         # training placement or a resumed run would train mis-placed
         params = jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
+    if args.fsdp:
+        from ...models.transformer import fsdp_shard_lm_params
+
+        # ZeRO-3: params (and, via tx.init inheritance, grads + moments)
+        # sharded over the data axis; composes with --num-servers (TP
+        # leaves keep their server dim and gain the data axis elsewhere)
+        params = fsdp_shard_lm_params(params, mesh, "data")
     # LR schedule -> clip -> adam -> (optional) microbatch accumulation.
     # The schedule/accumulation counters live in the optimizer state, so
     # checkpoint resume continues the schedule where it left off.

@@ -89,6 +89,27 @@ def test_lm_cli_tensor_parallel(mesh8, capsys):
         main(["--steps", "2", "--seq-len", "64", "--num-servers", "3"])
 
 
+def test_lm_cli_fsdp(mesh8, capsys, tmp_path):
+    """--fsdp through the CLI surface: trains, composes with --zero1 and
+    --num-servers (the sharded params serve as the checkpoint restore
+    template), and resume trains on from FSDP-placed leaves."""
+    out, losses = run_cli(capsys, "--fsdp", "--zero1")
+    assert losses[-1] < losses[0], losses
+    ck = str(tmp_path / "ck")
+    run_cli(capsys, "--fsdp", "--num-servers", "2", "--ckpt-dir", ck)
+    rc = main(
+        [
+            "--steps", "40", "--seq-len", "64", "--batch", "4",
+            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+            "--report-every", "5", "--ckpt-dir", ck, "--resume",
+            "--fsdp", "--num-servers", "2",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "resumed from step 30" in out
+
+
 def test_lm_cli_a2a_mode(mesh8, capsys):
     # a2a needs n_heads divisible by the 8-device axis
     out, losses = run_cli(capsys, "--attention", "a2a", "--n-heads", "8")
