@@ -257,14 +257,12 @@ def task_flash() -> int:
     # perf: fwd and train (fwd+bwd) GFLOP/s, flash vs the jitted XLA path
     dev_kind = jax.devices()[0].device_kind
     peak = PEAK_BF16.get(dev_kind)
-    for s_len, dtype in ((4096, jnp.float32), (8192, jnp.float32),
-                         (8192, jnp.bfloat16)):
-        bh2 = 8
-        qq, kk, vv = (rand(bh2, s_len, d).astype(dtype) for _ in range(3))
-        fwd_flops = 4.0 * bh2 * s_len * s_len * d / 2  # causal half
-        tag = "" if dtype == jnp.float32 else "_bf16"
-        rec = {"metric": f"flash_perf_s{s_len}{tag}", "unit": "GFLOP/s",
-               "bh": bh2, "d": d, "causal": True, "device_kind": dev_kind}
+
+    def bench_pair(rec, qq, kk, vv, fwd_flops):
+        """Time fwd and train (fwd+bwd, 3.5x factor: bwd ~2.5x — dq +
+        dkv recompute) for both paths into ``rec``. n=10: lower rep
+        counts under-amortize the ~30-90ms dispatch round trip (the
+        04:27 sweep-deflation finding)."""
         for label, up in (("xla", False), ("flash", True)):
             fn = jax.jit(
                 lambda q, k, v, up=up: flash_attention(
@@ -299,14 +297,54 @@ def task_flash() -> int:
                 g = gfn(qq, kk, vv)
             _flush(g)
             sec = (time.perf_counter() - t0) / n
-            # bwd ~ 2.5x fwd flops (dq + dkv recompute)
-            rec[f"{label}_train_gflops"] = round(3.5 * fwd_flops / sec / 1e9, 1)
+            rec[f"{label}_train_gflops"] = round(
+                3.5 * fwd_flops / sec / 1e9, 1
+            )
         if peak:
             rec["flash_fwd_mfu_vs_bf16_peak"] = round(
                 rec["flash_fwd_gflops"] * 1e9 / peak, 4
             )
         rec["value"] = rec["flash_fwd_gflops"]
         emit(rec)
+        return rec
+
+    for s_len, dtype in ((4096, jnp.float32), (8192, jnp.float32),
+                         (8192, jnp.bfloat16)):
+        bh2 = 8
+        qq, kk, vv = (rand(bh2, s_len, d).astype(dtype) for _ in range(3))
+        fwd_flops = 4.0 * bh2 * s_len * s_len * d / 2  # causal half
+        tag = "" if dtype == jnp.float32 else "_bf16"
+        rec = bench_pair(
+            {"metric": f"flash_perf_s{s_len}{tag}", "unit": "GFLOP/s",
+             "bh": bh2, "d": d, "causal": True, "device_kind": dev_kind},
+            qq, kk, vv, fwd_flops,
+        )
+
+    # the block sweep below seeds its default point from the s=8192
+    # bf16 d=64 record — capture it before the d_head loop rebinds rec
+    seed_train_gflops = rec["flash_train_gflops"]
+
+    # d_head sweep (bf16, s=8192, constant total work bh*d): q·kᵀ
+    # reduces over d, so d=64 only half-fills the MXU's 128-deep
+    # reduction — deeper heads should lift kernel efficiency at the
+    # same FLOP count (the LM task's ring_flash_h4 mode is the
+    # end-to-end consumer of this answer). Per-config guard: d>=128
+    # with 512x512 blocks is an unmeasured VMEM regime, and a failure
+    # here must not cost the block-sweep record below.
+    for bh3, d3 in ((4, 128), (2, 256)):
+        try:
+            qq, kk, vv = (
+                rand(bh3, 8192, d3).astype(jnp.bfloat16) for _ in range(3)
+            )
+            bench_pair(
+                {"metric": f"flash_perf_s8192_bf16_d{d3}",
+                 "unit": "GFLOP/s", "bh": bh3, "d": d3, "causal": True,
+                 "device_kind": dev_kind},
+                qq, kk, vv, 4.0 * bh3 * 8192 * 8192 * d3 / 2,
+            )
+        except Exception as e:
+            emit({"metric": f"flash_perf_s8192_bf16_d{d3}",
+                  "error": repr(e)[:300]})
 
     # bwd block-size sweep (bf16, s=8192): grid-step count and MXU
     # occupancy both move with block shape, so measure the candidates
@@ -322,7 +360,7 @@ def task_flash() -> int:
     # future default flip cannot mislabel the seeded point
     kwd = flash_attention.__kwdefaults__
     dkey = f"{kwd['block_q']}x{kwd['block_k']} (seeded default)"
-    swept = {dkey: rec["flash_train_gflops"]}
+    swept = {dkey: seed_train_gflops}
     for bq, bk in ((128, 128), (256, 128), (128, 256), (256, 256),
                    (512, 128), (128, 512), (512, 512)):
         if f"{bq}x{bk}" in dkey:
@@ -412,6 +450,13 @@ def task_lm() -> int:
                   window=64 if SMOKE else 1024, **base)),
     ]
     if not SMOKE:  # big == base under SMOKE: skip the duplicate metric
+        # h4: same d_model/params, d_head 128 instead of 64 — the
+        # end-to-end readout of the flash task's d_head sweep (deeper
+        # MXU reduction per head)
+        modes.append(
+            ("ring_flash_h4",
+             LMConfig(attention="ring_flash", **{**base, "n_heads": 4}))
+        )
         modes.append(
             ("ring_flash_d1024", LMConfig(attention="ring_flash", **big))
         )
