@@ -423,26 +423,38 @@ def main(argv=None) -> int:
           + (f" (+{eval_corpus.size} held out)" if eval_corpus is not None
              else ""))
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
-    for i in range(start_step + spl, args.steps + 1, spl):
-        params, opt, loss = step(params, opt, *launch_data())
-        if i % args.report_every < spl or i == args.steps:
-            ll = float(loss)
-            print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}", flush=True)
-        if eval_fn is not None and (
-            i % args.eval_every < spl or i == args.steps
-        ):
-            el = eval_fn(params)
-            print(
-                f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
-                flush=True,
-            )
-        if mgr is not None and (
-            i == args.steps
-            or (args.save_every and i % args.save_every == 0)
-        ):
-            # --ckpt-dir always saves the final step, so a later --resume
-            # has something to find even without --save-every
-            mgr.save(i, {"params": params, "opt": opt})
+    try:
+        for i in range(start_step + spl, args.steps + 1, spl):
+            params, opt, loss = step(params, opt, *launch_data())
+            if i % args.report_every < spl or i == args.steps:
+                ll = float(loss)
+                print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}",
+                      flush=True)
+            if eval_fn is not None and (
+                i % args.eval_every < spl or i == args.steps
+            ):
+                el = eval_fn(params)
+                print(
+                    f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
+                    flush=True,
+                )
+            if mgr is not None and (
+                i == args.steps
+                or (args.save_every and i % args.save_every == 0)
+            ):
+                # --ckpt-dir always saves the final step, so a later
+                # --resume has something to find even without
+                # --save-every. Async: the host snapshot is copied here
+                # (donation-safe), the disk write overlaps the next
+                # training steps.
+                mgr.save_async(i, {"params": params, "opt": opt})
+    finally:
+        if mgr is not None:
+            # drain even when the loop raises: the daemon writer thread
+            # would otherwise be killed at interpreter exit (the atomic
+            # rename in _write means a kill can only ever leave a .tmp
+            # dir, but a completed save beats a discarded one)
+            mgr.wait()
 
     if args.prompt is not None:
         if args.moe_every:

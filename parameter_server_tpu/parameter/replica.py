@@ -13,6 +13,7 @@ NumPy fallback writer for environments without orbax.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -21,6 +22,15 @@ import numpy as np
 
 def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _to_host_copy(tree: Any) -> Any:
+    """Owned host copies. np.asarray is a zero-copy passthrough for
+    numpy leaves (and can view CPU-backend jax buffers), which is fine
+    when the write completes before returning (sync save) but NOT when
+    a background thread will serialize the buffer while the caller
+    mutates or donates it — the async path must own its snapshot."""
+    return jax.tree.map(lambda x: np.array(x), tree)
 
 
 class Checkpointable:
@@ -98,6 +108,8 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._orbax = None
+        self._pending: Optional[threading.Thread] = None
+        self._async_error: Optional[BaseException] = None
         if use_orbax:
             try:
                 import orbax.checkpoint as ocp
@@ -109,22 +121,82 @@ class CheckpointManager:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:010d}")
 
-    def save(self, step: int, tree: Dict[str, Any]) -> str:
-        path = self._step_dir(step)
+    def _write(self, path: str, host_tree: Any) -> None:
+        # write under a .tmp name, then atomically rename: a crash (or
+        # a daemon writer thread killed at interpreter exit) can only
+        # leave a step_*.tmp dir, which latest_step's int() parse skips
+        # — never a half-written dir that a later --resume would pick
+        tmp = path + ".tmp"
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
         if self._orbax is not None:
             ckptr = self._orbax.PyTreeCheckpointer()
-            ckptr.save(path, _to_host(tree), force=True)
+            ckptr.save(tmp, host_tree, force=True)
         else:
-            os.makedirs(path, exist_ok=True)
-            flat, treedef = jax.tree.flatten(_to_host(tree))
+            os.makedirs(tmp, exist_ok=True)
+            flat, treedef = jax.tree.flatten(host_tree)
             np.savez(
-                os.path.join(path, "arrays.npz"),
+                os.path.join(tmp, "arrays.npz"),
                 *flat,
                 __treedef__=np.frombuffer(repr(treedef).encode(), dtype=np.uint8),
             )
+        if os.path.exists(path):
+            import shutil
+
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+
+    def save(self, step: int, tree: Dict[str, Any]) -> str:
+        self.wait()  # serialize behind any in-flight async save
+        path = self._step_dir(step)
+        self._write(path, _to_host(tree))
         return path
 
+    def save_async(self, step: int, tree: Dict[str, Any]) -> str:
+        """Non-blocking save: the device→host snapshot happens NOW
+        (synchronously — safe under buffer donation, since the caller's
+        arrays may be consumed by the very next step), then the disk
+        write runs on a background thread while training continues.
+        Saves serialize: a new save (sync or async) first drains the
+        previous one. A failed background write re-raises from the next
+        ``save``/``save_async``/``wait`` call — call :meth:`wait` after
+        the training loop so the last checkpoint is durable before the
+        process exits. Ref save_model_every_n_iter semantics; overlap
+        is the TPU-side improvement (the reference's SaveModel blocks
+        its server loop)."""
+        self.wait()
+        path = self._step_dir(step)
+        host_tree = _to_host_copy(tree)  # owned snapshot, synchronous
+        t = threading.Thread(
+            target=self._write_guarded, args=(path, host_tree),
+            name=f"ckpt-save-{step}", daemon=True,
+        )
+        self._pending = t
+        t.start()
+        return path
+
+    def _write_guarded(self, path: str, host_tree: Any) -> None:
+        try:
+            self._write(path, host_tree)
+        except BaseException as e:  # surfaced by the next wait()
+            self._async_error = e
+
+    def wait(self) -> None:
+        """Drain the in-flight async save, re-raising its failure."""
+        t, self._pending = self._pending, None
+        if t is not None:
+            t.join()
+        if self._async_error is not None:
+            e, self._async_error = self._async_error, None
+            raise RuntimeError(
+                "async checkpoint save failed (the checkpoint at the "
+                "failed step is incomplete on disk)"
+            ) from e
+
     def restore(self, step: int, like: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        self.wait()  # an in-flight async save may be writing this step
         path = self._step_dir(step)
         if self._orbax is not None:
             ckptr = self._orbax.PyTreeCheckpointer()
@@ -161,6 +233,7 @@ class CheckpointManager:
         return out
 
     def latest_step(self) -> Optional[int]:
+        self.wait()  # a half-written async step dir must not be listed
         steps = []
         for name in os.listdir(self.directory):
             if name.startswith("step_"):

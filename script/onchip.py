@@ -321,7 +321,8 @@ def task_flash() -> int:
         )
 
     # the block sweep below seeds its default point from the s=8192
-    # bf16 d=64 record — capture it before the d_head loop rebinds rec
+    # bf16 d=64 record; name it now rather than relying on `rec` still
+    # holding that record after the intervening sweep loops
     seed_train_gflops = rec["flash_train_gflops"]
 
     # d_head sweep (bf16, s=8192, constant total work bh*d): q·kᵀ
