@@ -83,6 +83,10 @@ def main(argv=None) -> int:
                     "n_data; GSPMD all-gathers weights at use and "
                     "reduce-scatters grads; composes with --num-servers "
                     "and --zero1 is implied for the moments")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler device trace of the "
+                    "training loop into DIR (TensorBoard profile / "
+                    "Perfetto format)")
     ap.add_argument("--num-servers", type=int, default=1,
                     help="tensor-parallel axis size: LM weights Megatron-"
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
@@ -423,38 +427,50 @@ def main(argv=None) -> int:
           + (f" (+{eval_corpus.size} held out)" if eval_corpus is not None
              else ""))
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
+    from ...utils.profiling import device_trace
+
     try:
-        for i in range(start_step + spl, args.steps + 1, spl):
-            params, opt, loss = step(params, opt, *launch_data())
-            if i % args.report_every < spl or i == args.steps:
-                ll = float(loss)
-                print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}",
-                      flush=True)
-            if eval_fn is not None and (
-                i % args.eval_every < spl or i == args.steps
-            ):
-                el = eval_fn(params)
-                print(
-                    f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
-                    flush=True,
-                )
-            if mgr is not None and (
-                i == args.steps
-                or (args.save_every and i % args.save_every == 0)
-            ):
-                # --ckpt-dir always saves the final step, so a later
-                # --resume has something to find even without
-                # --save-every. Async: the host snapshot is copied here
-                # (donation-safe), the disk write overlaps the next
-                # training steps.
-                mgr.save_async(i, {"params": params, "opt": opt})
+        with device_trace(args.profile):
+            for i in range(start_step + spl, args.steps + 1, spl):
+                params, opt, loss = step(params, opt, *launch_data())
+                if i % args.report_every < spl or i == args.steps:
+                    ll = float(loss)
+                    print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}",
+                          flush=True)
+                if eval_fn is not None and (
+                    i % args.eval_every < spl or i == args.steps
+                ):
+                    el = eval_fn(params)
+                    print(
+                        f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
+                        flush=True,
+                    )
+                if mgr is not None and (
+                    i == args.steps
+                    or (args.save_every and i % args.save_every == 0)
+                ):
+                    # --ckpt-dir always saves the final step, so a later
+                    # --resume has something to find even without
+                    # --save-every. Async: the host snapshot is copied
+                    # here (donation-safe), the disk write overlaps the
+                    # next training steps.
+                    mgr.save_async(i, {"params": params, "opt": opt})
     finally:
         if mgr is not None:
             # drain even when the loop raises: the daemon writer thread
             # would otherwise be killed at interpreter exit (the atomic
             # rename in _write means a kill can only ever leave a .tmp
             # dir, but a completed save beats a discarded one)
-            mgr.wait()
+            try:
+                mgr.wait()
+            except RuntimeError as e:
+                # an async-save failure is the primary error only when
+                # the loop exited cleanly — never mask the loop's own
+                # exception (or a Ctrl-C) with the drain's
+                if sys.exc_info()[0] is None:
+                    raise
+                print(f"async checkpoint failure during shutdown: {e}",
+                      file=sys.stderr)
 
     if args.prompt is not None:
         if args.moe_every:

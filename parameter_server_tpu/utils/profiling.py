@@ -1,0 +1,55 @@
+"""Device-level tracing (§5 tracing/monitoring aux subsystem).
+
+The reference's tracing story is host-side counters
+(``src/util/resource_usage.h``, heartbeat/dashboard tables); on TPU the
+equivalent visibility tool is an XLA device trace — per-op device
+timelines, HBM traffic, and fusion boundaries — captured with
+``jax.profiler`` and viewed in TensorBoard's profile plugin or
+Perfetto. This module wraps it behind a no-op-on-failure surface so
+profiling can be wired into production CLIs (LM ``--profile``) without
+making the profiler a hard dependency of training.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | None) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed block into
+    ``log_dir`` (TensorBoard/Perfetto format). ``None`` is a no-op, so
+    callers can pass an optional CLI flag straight through. A profiler
+    that fails to start (unsupported backend, double-start) degrades to
+    a warning, never a crashed training run."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        import warnings
+
+        warnings.warn(f"device trace not started: {e!r}")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover
+            import warnings
+
+            warnings.warn(f"device trace not stopped cleanly: {e!r}")
+
+
+def annotate(name: str):
+    """Named region inside a capture (shows as a track annotation).
+    Usable as a context manager: ``with annotate("push"): ...``"""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -110,6 +110,18 @@ def test_lm_cli_fsdp(mesh8, capsys, tmp_path):
     assert "resumed from step 30" in out
 
 
+def test_lm_cli_profile_trace(mesh8, capsys, tmp_path):
+    """--profile captures a device trace of the training loop (works on
+    the CPU backend too — the capture machinery is backend-agnostic)."""
+    prof = tmp_path / "trace"
+    out, losses = run_cli(capsys, "--profile", str(prof))
+    assert losses[-1] < losses[0], losses
+    captured = [
+        p for p in prof.rglob("*") if p.is_file()
+    ]
+    assert captured, "no trace artifacts written"
+
+
 def test_lm_cli_a2a_mode(mesh8, capsys):
     # a2a needs n_heads divisible by the 8-device axis
     out, losses = run_cli(capsys, "--attention", "a2a", "--n-heads", "8")
