@@ -31,9 +31,13 @@ def main(argv=None) -> int:
         help="seconds without a heartbeat before a node is declared dead "
         "(ref manager.cc dead-node flow)",
     )
+    ap.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="capture a jax.profiler device trace of the app run into "
+        "DIR (TensorBoard profile / Perfetto format)",
+    )
     args = ap.parse_args(argv)
 
-    from ...learner.sgd import MinibatchReader
     from ...system.postoffice import Postoffice
     from .config import parse_conf
 
@@ -50,6 +54,21 @@ def main(argv=None) -> int:
         check_interval=max(0.2, args.heartbeat_timeout / 5),
         dashboard_interval=args.report_interval,
     )
+
+    from ...utils.profiling import device_trace
+
+    with device_trace(args.profile):
+        rc = _run_app(conf, aux, args)
+    if rc:
+        return rc
+    if args.verbose or args.report_interval > 0:
+        print(aux.dashboard.report())
+    po.stop()
+    return 0
+
+
+def _run_app(conf, aux, args) -> int:
+    from ...learner.sgd import MinibatchReader
 
     if conf.darlin is not None:
         from .darlin import DarlinScheduler
@@ -114,9 +133,6 @@ def main(argv=None) -> int:
     else:
         print("config selects no app", file=sys.stderr)
         return 2
-    if args.verbose or args.report_interval > 0:
-        print(aux.dashboard.report())
-    po.stop()
     return 0
 
 

@@ -46,6 +46,13 @@ class Checkpointable:
         """Durably save the full ``state_host`` snapshot."""
         return manager.save(step, self.state_host())
 
+    def checkpoint_async(self, manager: "CheckpointManager", step: int) -> str:
+        """Non-blocking save of the ``state_host`` snapshot (an owned
+        copy is taken before returning — see
+        :meth:`CheckpointManager.save_async`); training continues while
+        the disk write runs. Call ``manager.wait()`` before exit."""
+        return manager.save_async(step, self.state_host())
+
     def restore(self, manager: "CheckpointManager", step: Optional[int] = None) -> int:
         """Restore from the latest (or given) checkpoint; placement goes
         through ``load_state_host`` so every leaf lands back under its

@@ -18,11 +18,12 @@ from typing import Iterator
 
 @contextlib.contextmanager
 def device_trace(log_dir: str | None) -> Iterator[None]:
-    """Capture a jax.profiler trace of the enclosed block into
-    ``log_dir`` (TensorBoard/Perfetto format). ``None`` is a no-op, so
-    callers can pass an optional CLI flag straight through. A profiler
-    that fails to start (unsupported backend, double-start) degrades to
-    a warning, never a crashed training run."""
+    """Capture a device trace of the enclosed block into ``log_dir``.
+
+    Output is TensorBoard-profile/Perfetto format. ``None`` is a no-op,
+    so callers can pass an optional CLI flag straight through. A
+    profiler that fails to start (unsupported backend, double-start)
+    degrades to a warning, never a crashed training run."""
     if not log_dir:
         yield
         return
