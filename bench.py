@@ -880,26 +880,43 @@ def main() -> int:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
         args.num_slots = 1 << 16
         args.real_mb = min(args.real_mb, 8)
-    diagnosis = probe_device()
-    if diagnosis is not None:
-        return emit_device_error(diagnosis)
-    global _WATCHDOG
-    _WATCHDOG = Watchdog(
-        "criteo_real_examples_per_sec"
-        if args.real
-        else "criteo_sparse_lr_examples_per_sec",
-        stall_s=args.stall_timeout,
+    # one tunneled chip, one client at a time: wait for a concurrent
+    # holder — e.g. the evidence watcher mid-task — instead of
+    # colliding with it. The wait bound exceeds the longest legitimate
+    # hold (see device_lock), so in practice this only ever waits, it
+    # never proceeds into a collision. Smoke runs are CPU-bound and
+    # skip the lock entirely; a holder's child skips via
+    # PS_DEVICE_LOCK_HELD.
+    import contextlib
+
+    from parameter_server_tpu.utils.device_lock import device_lock
+
+    lock = (
+        contextlib.nullcontext(True) if args.smoke  # CPU-bound: no lock
+        else device_lock()
     )
-    try:
-        if args.real:
-            return run_real(args)
-        return run_synthetic(args)
-    except Exception as e:  # backend death raises instead of stalling
-        # full traceback to stderr (the JSON contract owns stdout): a
-        # programming error must stay diagnosable from the log even
-        # though the record discloses only the truncated message
-        traceback.print_exc()
-        return _WATCHDOG.abort(f"{type(e).__name__}: {str(e)[:300]}")
+    with lock:
+        diagnosis = probe_device()
+        if diagnosis is not None:
+            return emit_device_error(diagnosis)
+        global _WATCHDOG
+        _WATCHDOG = Watchdog(
+            "criteo_real_examples_per_sec"
+            if args.real
+            else "criteo_sparse_lr_examples_per_sec",
+            stall_s=args.stall_timeout,
+        )
+        try:
+            if args.real:
+                return run_real(args)
+            return run_synthetic(args)
+        except Exception as e:  # backend death raises instead of stalling
+            # full traceback to stderr (the JSON contract owns stdout):
+            # a programming error must stay diagnosable from the log
+            # even though the record discloses only the truncated
+            # message
+            traceback.print_exc()
+            return _WATCHDOG.abort(f"{type(e).__name__}: {str(e)[:300]}")
 
 
 def run_synthetic(args) -> int:

@@ -1,0 +1,114 @@
+"""Advisory inter-process lock for the (single) tunneled TPU device.
+
+Two processes opening the tunneled backend concurrently wedge or fail
+each other (one chip, one client at a time) — the realistic collision
+is the evidence watcher (``script/onchip.py --watch``) holding the
+device when an interactive ``bench.py`` run (or the round driver's)
+starts. Both sides take this flock around device use: flock is
+released by the kernel when the holder dies, so a crashed holder can
+never leave a stale lock — a held lock always means a LIVE holder.
+
+Every legitimate holder has a bounded lifetime (watcher tasks are
+killed by their subprocess timeout, max 5400s; bench runs have their
+own watchdog), so waiters use a timeout ABOVE the longest legitimate
+hold: waiting that long guarantees progress without ever proceeding
+into a collision. A wait that still times out means something outside
+the framework holds the lock; the waiter then proceeds with a stderr
+warning (a possible collision beats never running at all).
+
+Children spawned BY a lock holder must not re-acquire — holders export
+``PS_DEVICE_LOCK_HELD=1`` (via :func:`held_env`) and ``device_lock``
+becomes a no-op under it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import os
+import sys
+import time
+from typing import Iterator
+
+LOCK_ENV = "PS_DEVICE_LOCK"
+HELD_ENV = "PS_DEVICE_LOCK_HELD"
+
+#: above the longest legitimate hold (watcher bench_real task: 5400s)
+WAIT_ABOVE_LONGEST_HOLD_S = 5700.0
+
+
+def _open_lock_file() -> int:
+    """Open (creating if needed) the lock file. The shared /tmp path is
+    chmod'd world-writable so a second user can take the same lock; if
+    another user's umask already made it unwritable for us, fall back
+    to a per-uid path (loses cross-user exclusion, never crashes the
+    caller's JSON contract)."""
+    path = os.environ.get(LOCK_ENV, "/tmp/ps_tpu_device.lock")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+        with contextlib.suppress(OSError):
+            os.chmod(path, 0o666)  # defeat the creator's umask
+        return fd
+    except OSError:
+        fallback = f"{path}.{os.getuid()}"
+        return os.open(fallback, os.O_CREAT | os.O_RDWR, 0o666)
+
+
+@contextlib.contextmanager
+def device_lock(
+    timeout_s: float = WAIT_ABOVE_LONGEST_HOLD_S, poll_s: float = 5.0
+) -> Iterator[bool]:
+    """Hold the device flock for the enclosed block.
+
+    Yields True when the lock was acquired, False when the wait timed
+    out (the block still runs — see module docstring) or when the
+    parent already holds it (``PS_DEVICE_LOCK_HELD``)."""
+    if os.environ.get(HELD_ENV):
+        yield True
+        return
+    import fcntl
+
+    fd = _open_lock_file()
+    got = False
+    t0 = time.monotonic()
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                got = True
+                break
+            except OSError as e:
+                if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
+                                   errno.EACCES):
+                    # flock unsupported here (e.g. ENOLCK on NFS):
+                    # exclusion is impossible — say so once, don't spin
+                    print(
+                        f"device_lock: flock unavailable ({e}); "
+                        "proceeding without exclusion",
+                        file=sys.stderr,
+                    )
+                    break
+                if time.monotonic() - t0 >= timeout_s:
+                    if timeout_s > 0:
+                        print(
+                            f"device_lock: holder outlived the "
+                            f"{timeout_s:.0f}s wait (not a framework "
+                            "process?); proceeding without exclusion",
+                            file=sys.stderr,
+                        )
+                    break
+                time.sleep(poll_s)
+        yield got
+    finally:
+        try:
+            if got:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
+
+
+def held_env() -> dict:
+    """Environment for children of a lock holder (no re-acquire)."""
+    env = dict(os.environ)
+    env[HELD_ENV] = "1"
+    return env

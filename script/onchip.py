@@ -774,22 +774,31 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
     """(ok, diagnosis). A nonzero exit is a deterministic CRASH (bad
     install/env — retrying won't help, surface the stderr tail); a
     timeout is the tunnel wedge (transient, keep retrying)."""
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC], timeout=timeout_s,
-            capture_output=True, cwd=REPO,
-        )
-        if r.returncode == 0:
-            return True, "ok"
-        tail = " | ".join(
-            r.stderr.decode(errors="replace").strip().splitlines()[-3:]
-        )
-        return False, f"device init CRASHED (not a wedge): {tail}"
-    except subprocess.TimeoutExpired:
-        return False, f"device init hang >{timeout_s:.0f}s (tunnel wedge?)"
+    from parameter_server_tpu.utils.device_lock import device_lock, held_env
+
+    with device_lock(timeout_s=0) as got:
+        if not got:
+            # another process (a driver/interactive bench) is on the
+            # device — that is not a wedge, just not our turn
+            return False, "device busy (another process holds the lock)"
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", PROBE_SRC], timeout=timeout_s,
+                capture_output=True, cwd=REPO, env=held_env(),
+            )
+            if r.returncode == 0:
+                return True, "ok"
+            tail = " | ".join(
+                r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+            )
+            return False, f"device init CRASHED (not a wedge): {tail}"
+        except subprocess.TimeoutExpired:
+            return False, f"device init hang >{timeout_s:.0f}s (tunnel wedge?)"
 
 
 def run_task(name: str, argv, timeout_s: int) -> bool:
+    from parameter_server_tpu.utils.device_lock import device_lock, held_env
+
     if argv is None:
         argv = [sys.executable, os.path.abspath(__file__), "--task", name]
     elif SMOKE:
@@ -797,9 +806,16 @@ def run_task(name: str, argv, timeout_s: int) -> bool:
     _wlog(f"task {name}: starting ({' '.join(argv)})")
     t0 = time.perf_counter()
     try:
-        r = subprocess.run(
-            argv, timeout=timeout_s, capture_output=True, text=True, cwd=REPO
-        )
+        # hold the device flock for the child's whole run so a driver
+        # bench starting mid-task waits instead of colliding; the child
+        # sees PS_DEVICE_LOCK_HELD and does not re-acquire. Default
+        # wait bound: above the longest legitimate hold, so a live
+        # driver bench is waited out, never collided with.
+        with device_lock():
+            r = subprocess.run(
+                argv, timeout=timeout_s, capture_output=True, text=True,
+                cwd=REPO, env=held_env(),
+            )
         out, rc = r.stdout, r.returncode
         err_tail = "\n".join(r.stderr.strip().splitlines()[-4:])
     except subprocess.TimeoutExpired as e:

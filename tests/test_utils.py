@@ -309,3 +309,51 @@ class TestMurmur3:
         assert a == murmur3_x64_128(b"token", 512927377)
         assert a != murmur3_x64_128(b"token", 1)
         assert a != murmur3_x64_128(b"tokeN", 512927377)
+
+
+class TestDeviceLock:
+    """Advisory device flock (utils/device_lock.py): exclusivity with
+    bounded-wait fallback, and the holder-child no-op contract that
+    keeps onchip.py's task children from deadlocking on their parent."""
+
+    def test_exclusive_then_timeout_proceeds(self, tmp_path, monkeypatch):
+        import os
+        import subprocess
+        import sys
+
+        from parameter_server_tpu.utils.device_lock import device_lock
+
+        lock = str(tmp_path / "dev.lock")
+        monkeypatch.setenv("PS_DEVICE_LOCK", lock)
+        # hermetic even when pytest itself runs under a lock holder
+        monkeypatch.delenv("PS_DEVICE_LOCK_HELD", raising=False)
+        child_env = {
+            k: v for k, v in os.environ.items()
+            if k != "PS_DEVICE_LOCK_HELD"
+        }
+        child = (
+            "import os, sys; sys.path.insert(0, %r); "
+            "os.environ['PS_DEVICE_LOCK'] = %r; "
+            "from parameter_server_tpu.utils.device_lock import device_lock; "
+            "ok = None\n"
+            "with device_lock(timeout_s=0.1, poll_s=0.05) as got: ok = got\n"
+            "sys.exit(0 if not ok else 3)"
+        ) % (str(__import__('pathlib').Path(__file__).parents[1]), lock)
+        with device_lock() as got:
+            assert got
+            r = subprocess.run(
+                [sys.executable, "-c", child], timeout=60, env=child_env
+            )
+            # contender times out, reports not-acquired, still proceeds
+            assert r.returncode == 0
+        with device_lock(timeout_s=0) as got2:  # free again after release
+            assert got2
+
+    def test_held_env_skips_acquisition(self, tmp_path, monkeypatch):
+        from parameter_server_tpu.utils.device_lock import device_lock
+
+        monkeypatch.setenv("PS_DEVICE_LOCK", str(tmp_path / "dev.lock"))
+        monkeypatch.setenv("PS_DEVICE_LOCK_HELD", "1")
+        # nested use under a holding parent: no flock call, reports held
+        with device_lock(timeout_s=0) as a, device_lock(timeout_s=0) as b:
+            assert a and b
