@@ -882,11 +882,12 @@ def main() -> int:
         args.real_mb = min(args.real_mb, 8)
     # one tunneled chip, one client at a time: wait for a concurrent
     # holder — e.g. the evidence watcher mid-task — instead of
-    # colliding with it. The wait bound exceeds the longest legitimate
-    # hold (see device_lock), so in practice this only ever waits, it
-    # never proceeds into a collision. Smoke runs are CPU-bound and
-    # skip the lock entirely; a holder's child skips via
-    # PS_DEVICE_LOCK_HELD.
+    # colliding with it. The wait bound exceeds every WATCHER-side
+    # hold (task subprocess timeouts, max 5400s), so the watcher is
+    # always waited out; only another interactive bench can outlive
+    # the bound, and that timeout is disclosed on stderr before
+    # proceeding. Smoke runs are CPU-bound and skip the lock
+    # entirely; a holder's child skips via PS_DEVICE_LOCK_HELD.
     import contextlib
 
     from parameter_server_tpu.utils.device_lock import device_lock

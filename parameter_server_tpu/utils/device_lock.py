@@ -8,13 +8,20 @@ starts. Both sides take this flock around device use: flock is
 released by the kernel when the holder dies, so a crashed holder can
 never leave a stale lock — a held lock always means a LIVE holder.
 
-Every legitimate holder has a bounded lifetime (watcher tasks are
-killed by their subprocess timeout, max 5400s; bench runs have their
-own watchdog), so waiters use a timeout ABOVE the longest legitimate
-hold: waiting that long guarantees progress without ever proceeding
-into a collision. A wait that still times out means something outside
-the framework holds the lock; the waiter then proceeds with a stderr
-warning (a possible collision beats never running at all).
+Watcher-side holds are bounded (each task child is killed by its
+subprocess timeout, max 5400s), so a bench waiting
+``WAIT_ABOVE_LONGEST_HOLD_S`` always outlasts the watcher. The
+converse is NOT bounded — an interactive ``bench.py --real`` on a slow
+link can legitimately hold for hours under a healthy progress
+watchdog — so the two sides use different timeout policies: bench
+proceeds after its bound (with a stderr disclosure; only another
+bench can outlive it), while the watcher treats a timeout as "device
+busy, defer" and never collides (see the callers).
+
+The yielded :class:`LockResult` is truthy when the lock (or a
+parent's) is held and carries a ``reason`` so callers can tell
+"busy" (a live holder) from "unsupported" (flock impossible here —
+exclusion cannot exist, proceed).
 
 Children spawned BY a lock holder must not re-acquire — holders export
 ``PS_DEVICE_LOCK_HELD=1`` (via :func:`held_env`) and ``device_lock``
@@ -33,8 +40,26 @@ from typing import Iterator
 LOCK_ENV = "PS_DEVICE_LOCK"
 HELD_ENV = "PS_DEVICE_LOCK_HELD"
 
-#: above the longest legitimate hold (watcher bench_real task: 5400s)
+#: above the longest WATCHER-side hold (bench_real task timeout: 5400s)
 WAIT_ABOVE_LONGEST_HOLD_S = 5700.0
+
+
+class LockResult:
+    """Truthy iff the device is exclusively ours (or a parent's).
+
+    ``reason``: "acquired" | "held-by-parent" | "busy" (live holder
+    outlasted the wait) | "unsupported" (flock impossible on this
+    filesystem — no exclusion exists to wait for)."""
+
+    def __init__(self, acquired: bool, reason: str):
+        self.acquired = acquired
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.acquired
+
+    def __repr__(self) -> str:
+        return f"LockResult({self.acquired}, {self.reason!r})"
 
 
 def _open_lock_file() -> int:
@@ -57,25 +82,27 @@ def _open_lock_file() -> int:
 @contextlib.contextmanager
 def device_lock(
     timeout_s: float = WAIT_ABOVE_LONGEST_HOLD_S, poll_s: float = 5.0
-) -> Iterator[bool]:
+) -> Iterator[LockResult]:
     """Hold the device flock for the enclosed block.
 
-    Yields True when the lock was acquired, False when the wait timed
-    out (the block still runs — see module docstring) or when the
-    parent already holds it (``PS_DEVICE_LOCK_HELD``)."""
+    Yields a truthy :class:`LockResult` when the lock was acquired (or
+    a parent holds it); falsy with ``reason`` "busy"/"unsupported"
+    otherwise — the block still runs either way, callers choose their
+    policy from the reason (see module docstring)."""
     if os.environ.get(HELD_ENV):
-        yield True
+        yield LockResult(True, "held-by-parent")
         return
     import fcntl
 
     fd = _open_lock_file()
-    got = False
+    res = LockResult(False, "busy")
     t0 = time.monotonic()
+    warned_wait = False
     try:
         while True:
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                got = True
+                res = LockResult(True, "acquired")
                 break
             except OSError as e:
                 if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN,
@@ -84,24 +111,33 @@ def device_lock(
                     # exclusion is impossible — say so once, don't spin
                     print(
                         f"device_lock: flock unavailable ({e}); "
-                        "proceeding without exclusion",
+                        "no exclusion possible",
                         file=sys.stderr,
                     )
+                    res = LockResult(False, "unsupported")
                     break
                 if time.monotonic() - t0 >= timeout_s:
                     if timeout_s > 0:
                         print(
                             f"device_lock: holder outlived the "
-                            f"{timeout_s:.0f}s wait (not a framework "
-                            "process?); proceeding without exclusion",
+                            f"{timeout_s:.0f}s wait",
                             file=sys.stderr,
                         )
                     break
+                if not warned_wait:
+                    # a silent multi-minute block is indistinguishable
+                    # from a wedge — say what we're doing, once
+                    print(
+                        "device_lock: device held by another process; "
+                        f"waiting up to {timeout_s:.0f}s",
+                        file=sys.stderr,
+                    )
+                    warned_wait = True
                 time.sleep(poll_s)
-        yield got
+        yield res
     finally:
         try:
-            if got:
+            if res.acquired:
                 fcntl.flock(fd, fcntl.LOCK_UN)
         finally:
             os.close(fd)

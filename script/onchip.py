@@ -777,10 +777,11 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
     from parameter_server_tpu.utils.device_lock import device_lock, held_env
 
     with device_lock(timeout_s=0) as got:
-        if not got:
+        if not got and got.reason == "busy":
             # another process (a driver/interactive bench) is on the
             # device — that is not a wedge, just not our turn
             return False, "device busy (another process holds the lock)"
+        # "unsupported": no exclusion exists to wait for — probe anyway
         try:
             r = subprocess.run(
                 [sys.executable, "-c", PROBE_SRC], timeout=timeout_s,
@@ -796,34 +797,43 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
             return False, f"device init hang >{timeout_s:.0f}s (tunnel wedge?)"
 
 
-def run_task(name: str, argv, timeout_s: int) -> bool:
+def run_task(name: str, argv, timeout_s: int) -> "bool | None":
+    """True = ok, False = failed, None = deferred (device busy — does
+    not consume an attempt; a live bench may hold the device for
+    hours, and the watcher's job is to wait its turn, never collide)."""
     from parameter_server_tpu.utils.device_lock import device_lock, held_env
 
     if argv is None:
         argv = [sys.executable, os.path.abspath(__file__), "--task", name]
     elif SMOKE:
         argv = argv + ["--smoke"]
-    _wlog(f"task {name}: starting ({' '.join(argv)})")
-    t0 = time.perf_counter()
-    try:
-        # hold the device flock for the child's whole run so a driver
-        # bench starting mid-task waits instead of colliding; the child
-        # sees PS_DEVICE_LOCK_HELD and does not re-acquire. Default
-        # wait bound: above the longest legitimate hold, so a live
-        # driver bench is waited out, never collided with.
-        with device_lock():
+    # hold the device flock for the child's whole run so a driver
+    # bench starting mid-task waits instead of colliding; the child
+    # sees PS_DEVICE_LOCK_HELD and does not re-acquire
+    wait0 = time.perf_counter()
+    with device_lock(timeout_s=600) as lock:
+        if not lock and lock.reason == "busy":
+            _wlog(f"task {name}: deferred (device busy after "
+                  f"{time.perf_counter() - wait0:.0f}s wait)")
+            return None
+        waited = time.perf_counter() - wait0
+        if waited > 10:
+            _wlog(f"task {name}: lock acquired after {waited:.0f}s wait")
+        _wlog(f"task {name}: starting ({' '.join(argv)})")
+        t0 = time.perf_counter()
+        try:
             r = subprocess.run(
                 argv, timeout=timeout_s, capture_output=True, text=True,
                 cwd=REPO, env=held_env(),
             )
-        out, rc = r.stdout, r.returncode
-        err_tail = "\n".join(r.stderr.strip().splitlines()[-4:])
-    except subprocess.TimeoutExpired as e:
-        out = (e.stdout or b"").decode(errors="replace") if isinstance(
-            e.stdout, bytes) else (e.stdout or "")
-        rc = -1
-        err_tail = f"TIMEOUT after {timeout_s}s"
-    dt = time.perf_counter() - t0
+            out, rc = r.stdout, r.returncode
+            err_tail = "\n".join(r.stderr.strip().splitlines()[-4:])
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"").decode(errors="replace") if isinstance(
+                e.stdout, bytes) else (e.stdout or "")
+            rc = -1
+            err_tail = f"TIMEOUT after {timeout_s}s"
+        dt = time.perf_counter() - t0
     lines = [f"\n## {_now()} — {name} (rc={rc}, {dt:.0f}s)", "```"]
     json_lines = [
         ln for ln in out.splitlines() if ln.startswith("{")
@@ -885,6 +895,12 @@ def watch(args) -> int:
             ok = run_task(name, argv, to)
             st = _load_state()
             st.setdefault(name, {"attempts": rec["attempts"]})
+            if ok is None:
+                # deferred: device busy — not an attempt against this
+                # task; back off and let the holder finish
+                st[name]["attempts"] = rec["attempts"] - 1
+                _save_state(st)
+                break
             st[name]["status"] = "ok" if ok else "fail"
             _save_state(st)
             if not ok and not probe(args.probe_timeout)[0]:
@@ -923,6 +939,10 @@ def main() -> int:
             if st.get(name, {}).get("status") == "ok":
                 continue
             ok = run_task(name, argv, to)
+            if ok is None:  # device busy: not an attempt, stop the pass
+                print(f"{name}: deferred (device busy)", file=sys.stderr)
+                rc |= 1
+                break
             st.setdefault(name, {"attempts": 0})
             st[name]["attempts"] = st[name].get("attempts", 0) + 1
             st[name]["status"] = "ok" if ok else "fail"

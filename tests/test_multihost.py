@@ -56,3 +56,9 @@ def test_local_sh_n_hosts(nproc):
     # the filtered control-plane exchange ran and its byte reductions
     # held (asserted in the child; the marker proves it executed)
     assert "PS_FILTER_OK" in proc.stdout, proc.stdout[-2000:]
+    # the LM segment ran on every process (seq-sharded + FSDP over the
+    # same multi-process mesh) and all processes agree on the
+    # replicated loss to the printed precision
+    lm = re.findall(r"PS_LM_OK ([0-9.]+)", proc.stdout)
+    assert len(lm) == nproc, proc.stdout[-2000:]
+    assert len(set(lm)) == 1, lm
