@@ -83,6 +83,12 @@ def main(argv=None) -> int:
                     "n_data; GSPMD all-gathers weights at use and "
                     "reduce-scatters grads; composes with --num-servers "
                     "and --zero1 is implied for the moments")
+    ap.add_argument("--kv-cache", choices=("auto", "int8"), default="auto",
+                    help="decode KV-cache storage: auto = the compute "
+                    "dtype; int8 = per-token quantized cache (half of "
+                    "bf16's traffic again; decode is cache-bandwidth-"
+                    "bound under GQA). Generation only — training is "
+                    "unaffected")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler device trace of the "
                     "training loop into DIR (TensorBoard profile / "
@@ -170,6 +176,7 @@ def main(argv=None) -> int:
             compute_dtype="bfloat16" if args.bf16 else "float32",
             moe_every=args.moe_every, n_kv_heads=args.n_kv_heads,
             rope=args.rope, rope_theta=args.rope_theta,
+            kv_cache_dtype=None if args.kv_cache == "auto" else args.kv_cache,
         )
     except ValueError as e:
         # LMConfig rejects invalid combinations (e.g. --window with

@@ -89,8 +89,23 @@ class LMConfig:
     # pair before any masking/grouping)
     rope: bool = False
     rope_theta: float = 10000.0
+    # decode KV-cache storage: None = the compute dtype (bf16 under
+    # bfloat16 — the existing behavior); "int8" = per-token-per-head
+    # symmetric int8 quantization (one f32 scale per [layer, batch,
+    # kv-head, position] row: 4/head_dim = 6.25% over the int8 payload
+    # at head_dim 64, i.e. ~0.53x of the bf16 cache it replaces). Decode is
+    # cache-bandwidth-bound once GQA narrows the weights (measured:
+    # BENCH_ONCHIP.md kv2 decode), so int8 halves the remaining bf16
+    # cache traffic; dequantization fuses into the attention einsum.
+    # Scores/softmax still accumulate f32. Training is unaffected.
+    kv_cache_dtype: "str | None" = None
 
     def __post_init__(self):
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"LMConfig.kv_cache_dtype must be None or 'int8', got "
+                f"{self.kv_cache_dtype!r}"
+            )
         if self.attention not in ("ring", "ring_flash", "ring_zigzag", "a2a"):
             raise ValueError(
                 f"LMConfig.attention must be 'ring', 'ring_flash', "
@@ -337,19 +352,55 @@ def lm_forward(
     return _ln(x32, params["ln_f"]) @ params["emb"].T
 
 
+def _quant_kv_i8(x):
+    """Symmetric per-row int8: x [..., hd] -> (int8 rows, f32 scale per
+    row). scale = max|x|/127 so the row's peak maps to ±127; an all-zero
+    row gets scale 0 and quantizes to zeros (dequant is exact there)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = amax / 127.0
+    q = jnp.round(
+        x.astype(jnp.float32) / jnp.maximum(scale, 1e-30)[..., None]
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _cache_write(cache, idx, val):
+    """Write ``val`` [..., hd] into a cache pytree at slice ``idx``.
+    Cache is ``(data, scale)``: scale None = plain dtype cast (the
+    existing path); scale array = int8 data + per-row scales. ``idx``
+    indexes [layer, :, :, position(s)] on both arrays."""
+    data, scale = cache
+    if scale is None:
+        return (data.at[idx].set(val.astype(data.dtype)), None)
+    q, s = _quant_kv_i8(val)
+    return (data.at[idx].set(q), scale.at[idx].set(s))
+
+
+def _cache_layer(cache, i):
+    """Layer ``i`` of a cache pytree as f32 [B, kvh, T, hd] — for int8
+    the per-row dequant multiply fuses into the consuming einsum (the
+    HBM read stays 1 byte/element + scales)."""
+    data, scale = cache
+    full = data[i].astype(jnp.float32)
+    if scale is not None:
+        full = full * scale[i][..., None]
+    return full
+
+
 def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
-    """One KV-cached decoder step. tok [B]; caches [L, B, kvh, T, hd]
-    (kvh = cfg.kv_heads — under GQA the cache carries only the K/V
-    heads, the serving-side point of GQA); pos scalar int32. Returns
-    (logits [B, vocab], new caches). Runs in ``cfg.compute_dtype`` like
-    the training forward (softmax and logits in f32), so decode matches
-    training numerics dtype for dtype."""
+    """One KV-cached decoder step. tok [B]; caches are ``(data, scale)``
+    pytrees with data [L, B, kvh, T, hd] (kvh = cfg.kv_heads — under
+    GQA the cache carries only the K/V heads, the serving-side point of
+    GQA) and scale None or [L, B, kvh, T] (int8 cache); pos scalar
+    int32. Returns (logits [B, vocab], new caches). Runs in
+    ``cfg.compute_dtype`` like the training forward (softmax and logits
+    in f32), so decode matches training numerics dtype for dtype."""
     b = tok.shape[0]
     nh = cfg.n_heads
     kvh = cfg.kv_heads
     g = nh // kvh  # query heads per K/V head (1 = MHA)
     hd = cfg.d_model // nh
-    t_max = kcache.shape[3]
+    t_max = kcache[0].shape[3]
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     x = (params["emb"][tok] * np.sqrt(cfg.d_model)).astype(dtype)  # [B, d]
     t_range = jnp.arange(t_max)
@@ -370,15 +421,15 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
             # ROTATED k, matching the prefill/training convention
             q = _rotate(q, *rope_cs)
             k = _rotate(k, *rope_cs)
-        kcache = kcache.at[i, :, :, pos].set(k.astype(kcache.dtype))
-        vcache = vcache.at[i, :, :, pos].set(v.astype(vcache.dtype))
+        kcache = _cache_write(kcache, (i, slice(None), slice(None), pos), k)
+        vcache = _cache_write(vcache, (i, slice(None), slice(None), pos), v)
         s = jnp.einsum(
-            "bkgd,bktd->bkgt", q.astype(jnp.float32), kcache[i]
+            "bkgd,bktd->bkgt", q.astype(jnp.float32), _cache_layer(kcache, i)
         ) / np.sqrt(hd)
         s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = (
-            jnp.einsum("bkgt,bktd->bkgd", p, vcache[i])
+            jnp.einsum("bkgt,bktd->bkgd", p, _cache_layer(vcache, i))
             .reshape(b, cfg.d_model)
             .astype(dtype)
         )
@@ -486,12 +537,9 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
         if cfg.rope:
             q = _rotate(q, *rope_cs)
             k = _rotate(k, *rope_cs)
-        kcache = kcache.at[i, :, :, :p_len].set(
-            jnp.swapaxes(k, 1, 2).astype(kcache.dtype)
-        )
-        vcache = vcache.at[i, :, :, :p_len].set(
-            jnp.swapaxes(v, 1, 2).astype(vcache.dtype)
-        )
+        idx = (i, slice(None), slice(None), slice(None, p_len))
+        kcache = _cache_write(kcache, idx, jnp.swapaxes(k, 1, 2))
+        vcache = _cache_write(vcache, idx, jnp.swapaxes(v, 1, 2))
         att = _prefill_attention(q, k, v, cfg.window).astype(dtype)
         x = x + att @ cast("wo")
         h2 = _ln(x, cast("ln2"))
@@ -593,16 +641,23 @@ def _lm_generate_jit(
     # caches live in the COMPUTE dtype: under bf16 that halves the
     # per-token cache streaming (the dominant decode HBM traffic) and
     # matches training numerics, which also attends against bf16 K/V;
-    # scores/softmax still accumulate f32 in _decode_step
+    # scores/softmax still accumulate f32 in _decode_step. With
+    # kv_cache_dtype="int8" the cache is (int8 data, f32 per-row
+    # scales) — half of bf16 again, dequant fused into the einsums
     cache_dtype = (
         jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     )
     # cfg.kv_heads, not n_heads: under GQA the cache shrinks by the
     # query-group factor — the point of GQA at serving time
-    kcache = jnp.zeros(
-        (cfg.n_layers, b, cfg.kv_heads, total, hd), cache_dtype
-    )
-    vcache = jnp.zeros_like(kcache)
+    shape = (cfg.n_layers, b, cfg.kv_heads, total, hd)
+    if cfg.kv_cache_dtype == "int8":
+        kcache = (
+            jnp.zeros(shape, jnp.int8),
+            jnp.zeros(shape[:-1], jnp.float32),
+        )
+    else:
+        kcache = (jnp.zeros(shape, cache_dtype), None)
+    vcache = jax.tree.map(jnp.zeros_like, kcache)
     toks = jnp.concatenate(
         [prompt.astype(jnp.int32), jnp.zeros((b, steps), jnp.int32)], axis=1
     )

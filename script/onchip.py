@@ -540,6 +540,11 @@ def task_lm() -> int:
     decode_cfgs = [
         ("", base_cfg),
         (f"_kv{kvh}", _dc.replace(base_cfg, n_kv_heads=kvh)),
+        # int8 cache on top of GQA: the cache is the dominant decode
+        # traffic once GQA narrows the weights, so quantizing it is the
+        # next serving lever — measure it where it matters
+        (f"_kv{kvh}_i8",
+         _dc.replace(base_cfg, n_kv_heads=kvh, kv_cache_dtype="int8")),
     ]
     for tag, cfg in decode_cfgs:
         try:
@@ -580,7 +585,13 @@ def task_lm() -> int:
             # weights would understate utilization
             hd = cfg.d_model // cfg.n_heads
             total_len = prefill + steps
-            cache_width = 2 if cfg.compute_dtype == "bfloat16" else 4
+            if cfg.kv_cache_dtype == "int8":
+                # 1 byte/element + one f32 scale per hd-row
+                cache_width = 1.0 + 4.0 / hd
+            elif cfg.compute_dtype == "bfloat16":
+                cache_width = 2.0
+            else:
+                cache_width = 4.0
             cache_bytes = (
                 2 * cfg.n_layers * b * cfg.kv_heads * total_len * hd
                 * cache_width

@@ -429,6 +429,79 @@ class TestGenerate:
             lm_generate(params, np.zeros((1, 4), np.int32), cfg_m, steps=1)
 
 
+class TestInt8KVCache:
+    """kv_cache_dtype="int8": per-token symmetric int8 cache storage.
+    The quant error budget: scale = rowmax/127, so |dequant - x| <=
+    scale/2 per element — attention scores shift by well under 1%
+    relative, which must not change a trained model's decisions and
+    must keep logits close on a random one."""
+
+    def test_quant_roundtrip_bound(self):
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.models.transformer import _quant_kv_i8
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 3, 64)).astype(np.float32))
+        q, s = _quant_kv_i8(x)
+        assert q.dtype == jnp.int8 and s.shape == (4, 3)
+        deq = np.asarray(q, np.float32) * np.asarray(s)[..., None]
+        bound = np.asarray(s)[..., None] * 0.5 + 1e-7
+        assert (np.abs(deq - np.asarray(x)) <= bound).all()
+        # all-zero row: scale 0, exact zeros back
+        qz, sz = _quant_kv_i8(jnp.zeros((1, 2, 8)))
+        assert float(np.abs(np.asarray(qz)).max()) == 0.0
+        assert float(np.asarray(sz).max()) == 0.0
+
+    def test_int8_decode_logits_track_unquantized(self, cfg, params):
+        """Same prompt, steps>0 (the generated rows READ the quantized
+        cache): int8-cache logits must track the plain-cache run within
+        the quant error budget, for MHA and for GQA+rope+window+bf16."""
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        variants = [
+            cfg,
+            LMConfig(
+                vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                n_kv_heads=2, rope=True, window=8,
+                attention="ring_flash", compute_dtype="bfloat16",
+            ),
+        ]
+        rng = np.random.default_rng(11)
+        for base in variants:
+            pv = (
+                params if base is cfg
+                else init_lm(jax.random.PRNGKey(1), base)
+            )
+            prompt = rng.integers(0, 32, (2, 12)).astype(np.int32)
+            _, ref = lm_generate(
+                pv, prompt, base, steps=6, return_logits=True
+            )
+            cfg_i8 = dataclasses.replace(base, kv_cache_dtype="int8")
+            _, got = lm_generate(
+                pv, prompt, cfg_i8, steps=6, return_logits=True
+            )
+            err = np.max(np.abs(np.asarray(got) - np.asarray(ref)))
+            assert err < 0.08, (base.compute_dtype, err)
+
+    def test_int8_cache_greedy_output_survives_training(self, mesh8, cfg,
+                                                        params):
+        """On a trained copy task the quantized cache must not flip a
+        single greedy decision."""
+        from parameter_server_tpu.models.transformer import lm_generate
+
+        losses, p = run_copy_training(mesh8, params, cfg, steps=60)
+        assert losses[-1] < 0.5, losses[-1]
+        cfg_i8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        prompt = np.full((2, 8), 7, np.int32)
+        out = np.asarray(lm_generate(p, prompt, cfg_i8, steps=12))
+        assert (out[:, 8:] == 7).all(), out
+
+    def test_bad_cache_dtype_rejected(self):
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            LMConfig(kv_cache_dtype="int4")
+
+
 class TestAttentionModes:
     def test_a2a_equals_ring(self, mesh8, params):
         """Both sp schedules compute EXACT attention — the same model
