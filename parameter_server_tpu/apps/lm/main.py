@@ -97,6 +97,12 @@ def main(argv=None) -> int:
                     help="tensor-parallel axis size: LM weights Megatron-"
                     "split over a 'server' mesh axis (sp x tp on one 2-D "
                     "mesh); must divide the device count")
+    ap.add_argument("--optimizer", choices=("adam", "adafactor", "lion"),
+                    default="adam",
+                    help="adam (default; 2 f32 moments/param), adafactor "
+                    "(factored second moment — rows+cols instead of a "
+                    "full moment tensor, the low-memory choice beside "
+                    "--zero1/--fsdp), or lion (sign momentum, 1 moment)")
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--warmup", type=int, default=0,
                     help="linear LR warmup steps, then cosine decay to "
@@ -294,7 +300,14 @@ def main(argv=None) -> int:
     chain = []
     if args.clip_norm:
         chain.append(optax.clip_by_global_norm(args.clip_norm))
-    chain.append(optax.adam(lr_sched))
+    if args.optimizer == "adafactor":
+        # factored second moment: the per-param optimizer state is
+        # O(rows+cols), the low-memory choice beside --zero1/--fsdp
+        chain.append(optax.adafactor(learning_rate=lr_sched))
+    elif args.optimizer == "lion":
+        chain.append(optax.lion(lr_sched))
+    else:
+        chain.append(optax.adam(lr_sched))
     tx = optax.chain(*chain)
     if args.grad_accum > 1:
         # each CLI "step" is one microbatch; the inner optimizer (and

@@ -26,6 +26,21 @@ def run_cli(capsys, *extra):
     return out, losses
 
 
+def resume_cli(capsys, ck, *extra):
+    """Resume from a run_cli checkpoint (same base hyperparameters —
+    repeated flags in ``extra`` override, argparse last-wins) and train
+    to step 40."""
+    rc = main(
+        [
+            "--steps", "40", "--seq-len", "64", "--batch", "4",
+            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+            "--report-every", "5", "--ckpt-dir", ck, "--resume", *extra,
+        ]
+    )
+    assert rc == 0
+    return capsys.readouterr().out
+
+
 def test_lm_cli_trains_and_generates(mesh8, capsys):
     out, losses = run_cli(capsys)
     assert losses[-1] < losses[0], losses
@@ -60,15 +75,7 @@ def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path, extra):
     --num-servers; ref save_model_every_n_iter parity)."""
     ck = str(tmp_path / "ck")
     run_cli(capsys, "--ckpt-dir", ck, *extra)  # saves the final step (30)
-    rc = main(
-        [
-            "--steps", "40", "--seq-len", "64", "--batch", "4",
-            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
-            "--report-every", "5", "--ckpt-dir", ck, "--resume", *extra,
-        ]
-    )
-    assert rc == 0
-    out = capsys.readouterr().out
+    out = resume_cli(capsys, ck, *extra)
     assert "resumed from step 30" in out
     rows = [
         line.split() for line in out.splitlines()
@@ -97,16 +104,7 @@ def test_lm_cli_fsdp(mesh8, capsys, tmp_path):
     assert losses[-1] < losses[0], losses
     ck = str(tmp_path / "ck")
     run_cli(capsys, "--fsdp", "--num-servers", "2", "--ckpt-dir", ck)
-    rc = main(
-        [
-            "--steps", "40", "--seq-len", "64", "--batch", "4",
-            "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
-            "--report-every", "5", "--ckpt-dir", ck, "--resume",
-            "--fsdp", "--num-servers", "2",
-        ]
-    )
-    assert rc == 0
-    out = capsys.readouterr().out
+    out = resume_cli(capsys, ck, "--fsdp", "--num-servers", "2")
     assert "resumed from step 30" in out
 
 
@@ -120,6 +118,29 @@ def test_lm_cli_profile_trace(mesh8, capsys, tmp_path):
         p for p in prof.rglob("*") if p.is_file()
     ]
     assert captured, "no trace artifacts written"
+
+
+@pytest.mark.parametrize(
+    "opt,extra",
+    [
+        # d-model 128: optax.adafactor only factors dims >= its
+        # min_dim_size_to_factor (128), so the emb [256, 128] creates
+        # the real v_row/v_col factored state — the point of the flag —
+        # and resume round-trips it
+        ("adafactor", ("--d-model", "128")),
+        ("lion", ()),
+    ],
+)
+def test_lm_cli_optimizer_choice(mesh8, capsys, tmp_path, opt, extra):
+    """--optimizer variants train AND resume (their state trees differ
+    from adam's — the checkpoint template walk must rebuild each)."""
+    ck = str(tmp_path / "ck")
+    out, losses = run_cli(
+        capsys, "--optimizer", opt, "--ckpt-dir", ck, *extra
+    )
+    assert losses[-1] < losses[0], (opt, losses)
+    out = resume_cli(capsys, ck, "--optimizer", opt, *extra)
+    assert "resumed from step 30" in out
 
 
 def test_lm_cli_a2a_mode(mesh8, capsys):
