@@ -1,0 +1,196 @@
+"""Speculative decoding (Leviathan et al. 2023, greedy variant).
+
+A small DRAFT model proposes ``gamma`` tokens autoregressively; the
+TARGET model scores the whole proposal in ONE multi-token cache pass
+(a (gamma+1)-wide chunk instead of gamma+1 sequential decode steps —
+the MXU sees a batched matmul and the weights are read once per
+round). Greedy acceptance keeps the longest prefix where the draft's
+token equals the target's argmax, then appends the target's
+correction — so the output is GUARANTEED token-for-token equal to
+plain greedy decoding of the target model; the only thing speculation
+changes is how many target passes it takes. The reference has no
+serving path at all (extension, alongside lm_generate).
+
+Cache invariant (both models): at round start every position
+``< committed-1`` is cached; the slot at ``committed-1`` (the last
+committed token, round input x0) is written DURING the round — the
+draft writes it decoding proposal 1, the target writes it verifying
+the chunk. Rejected proposals leave stale slots past the committed
+point, which the per-row position masks never attend and the next
+round overwrites.
+
+Batch rows accept different prefix lengths, so positions are
+PER-ROW (``committed [B]``) — unlike lm_generate's scalar scan
+position. Rows that finish early keep re-processing their last slot
+(capped commit) until the slowest row completes; compute per round is
+static.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (
+    LMConfig,
+    _chunk_decode,
+    _prefill,
+)
+
+
+def _alloc_cache(cfg: LMConfig, b: int, total: int):
+    hd = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, b, cfg.kv_heads, total, hd)
+    dtype = (
+        jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    )
+    if cfg.kv_cache_dtype == "int8":
+        k = (jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32))
+    else:
+        k = (jnp.zeros(shape, dtype), None)
+    return k, jax.tree.map(jnp.zeros_like, k)
+
+
+def speculative_generate(
+    target_params: Dict[str, jax.Array],
+    target_cfg: LMConfig,
+    draft_params: Dict[str, jax.Array],
+    draft_cfg: LMConfig,
+    prompt: jax.Array,  # [B, P] int32
+    steps: int,
+    *,
+    gamma: int = 4,
+    return_stats: bool = False,
+) -> "jax.Array | Tuple[jax.Array, Dict[str, jax.Array]]":
+    """Greedy speculative decoding whose output exactly matches plain
+    greedy decoding of the target model.
+
+    Token-for-token equal to ``lm_generate(target_params, ...,
+    temperature=None)`` — verified by tests — in
+    ~``steps / (1 + mean_accepted)`` target passes instead of
+    ``steps``. ``gamma``: draft proposals per round. Both configs must
+    share the vocab; windows/rope/GQA/bf16/int8-cache compose per
+    model independently (each model runs its OWN config against its
+    own cache). Dense FFN only (same restriction as lm_generate).
+
+    ``return_stats=True`` additionally returns
+    ``{"rounds": r, "target_passes": r, "accepted_frac": f}`` —
+    ``accepted_frac`` is the fraction of draft proposals that were
+    accepted AND committed, counted only while a row was still live
+    (finished rows keep spinning until the slowest row completes, and
+    their idle work must not skew the number that decides whether a
+    draft model pays for itself)."""
+    for name, cfg in (("target", target_cfg), ("draft", draft_cfg)):
+        if cfg.moe_every > 0:
+            raise ValueError(
+                f"speculative_generate: {name} model must be dense-FFN "
+                "(same restriction as lm_generate)"
+            )
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"vocab mismatch: target {target_cfg.vocab} vs draft "
+            f"{draft_cfg.vocab} — the models must share a tokenizer"
+        )
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    return _spec_jit(
+        target_params, draft_params, prompt,
+        tcfg=target_cfg, dcfg=draft_cfg, steps=steps, gamma=gamma,
+        return_stats=return_stats,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tcfg", "dcfg", "steps", "gamma",
+                              "return_stats")
+)
+def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
+              return_stats):
+    b, p_len = prompt.shape
+    limit = p_len + steps
+    # slack: a round can overshoot by gamma tokens + 1 trash slot
+    total = limit + gamma + 1
+    trash = total - 1  # masked-commit writes land here, never read
+    tk, tv = _alloc_cache(tcfg, b, total)
+    dk, dv = _alloc_cache(dcfg, b, total)
+    prompt = prompt.astype(jnp.int32)
+    # prefill BOTH models on the prompt (slots [0, p_len))
+    t_logits, tk, tv = _prefill(tparams, tcfg, prompt, tk, tv)
+    _, dk, dv = _prefill(dparams, dcfg, prompt, dk, dv)
+    toks = jnp.zeros((b, total), jnp.int32).at[:, :p_len].set(prompt)
+    # first committed token comes straight from the target prefill
+    toks = toks.at[:, p_len].set(
+        jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+    )
+    committed = jnp.full((b,), p_len + 1, jnp.int32)
+    rows = jnp.arange(b)
+
+    def round_body(carry):
+        toks, committed, tk, tv, dk, dv, rounds, acc, prop = carry
+        live = committed < limit  # rows still decoding at round start
+        x0 = toks[rows, committed - 1]  # [B] last committed token
+        # -- draft: gamma sequential proposals (C=1 chunk steps) --
+        d_toks = []
+        cur = x0
+        for j in range(gamma):
+            dl, dk, dv = _chunk_decode(
+                dparams, dcfg, cur[:, None], dk, dv, committed - 1 + j
+            )
+            cur = jnp.argmax(dl[:, 0], axis=-1).astype(jnp.int32)
+            d_toks.append(cur)
+        d = jnp.stack(d_toks, axis=1)  # [B, gamma]
+        # -- target: ONE (gamma+1)-chunk verify over [x0, d1..dg] --
+        chunk = jnp.concatenate([x0[:, None], d], axis=1)
+        tl, tk, tv = _chunk_decode(
+            tparams, tcfg, chunk, tk, tv, committed - 1
+        )
+        tpred = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [B, gamma+1]
+        # greedy acceptance: longest prefix where d[j] == tpred[j]
+        agree = d == tpred[:, :gamma]  # [B, gamma]
+        n = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
+        # committed tokens this round: d[0..n-1] then the correction
+        # tpred[n]; lay them out as a [B, gamma+1] row and mask-commit
+        j_idx = jnp.arange(gamma + 1)[None, :]
+        correction = tpred[rows, n]  # [B]
+        commit_row = jnp.where(
+            j_idx < n[:, None],
+            jnp.pad(d, ((0, 0), (0, 1))),  # d[j] for j < n
+            correction[:, None],  # at j == n; masked out beyond
+        )
+        # capped commit: a finished row re-processes its last slot
+        # instead of overflowing the buffer
+        n_eff = jnp.minimum(n + 1, limit - committed)
+        dest = jnp.where(
+            j_idx < n_eff[:, None], committed[:, None] + j_idx, trash
+        )
+        toks = toks.at[rows[:, None], dest].set(commit_row)
+        committed = committed + n_eff
+        # stats count only LIVE rows and only accepted-AND-committed
+        # proposals (a capped commit may truncate the accepted run)
+        acc = acc + jnp.sum(jnp.where(live, jnp.minimum(n, n_eff), 0))
+        prop = prop + jnp.sum(jnp.where(live, gamma, 0))
+        return toks, committed, tk, tv, dk, dv, rounds + 1, acc, prop
+
+    def cond(carry):
+        return jnp.min(carry[1]) < limit
+
+    toks, committed, *_, rounds, acc, prop = jax.lax.while_loop(
+        cond,
+        round_body,
+        (toks, committed, tk, tv, dk, dv, jnp.int32(0), jnp.int32(0),
+         jnp.int32(0)),
+    )
+    out = toks[:, :limit]
+    if not return_stats:
+        return out
+    stats = {
+        "rounds": rounds,
+        "target_passes": rounds,
+        "accepted_frac": acc / jnp.maximum(prop, 1),
+    }
+    return out, stats

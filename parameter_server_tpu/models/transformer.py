@@ -387,50 +387,72 @@ def _cache_layer(cache, i):
     return full
 
 
-def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
-    """One KV-cached decoder step. tok [B]; caches are ``(data, scale)``
-    pytrees with data [L, B, kvh, T, hd] (kvh = cfg.kv_heads — under
-    GQA the cache carries only the K/V heads, the serving-side point of
-    GQA) and scale None or [L, B, kvh, T] (int8 cache); pos scalar
-    int32. Returns (logits [B, vocab], new caches). Runs in
-    ``cfg.compute_dtype`` like the training forward (softmax and logits
-    in f32), so decode matches training numerics dtype for dtype."""
-    b = tok.shape[0]
+def _cache_write_rows(cache, i, qpos, val):
+    """Write ``val`` [B, C, kvh, hd] into layer ``i`` at PER-ROW
+    absolute positions ``qpos`` [B, C]. Advanced-index layout: indexing
+    data[i] with (rows [B,1], :, qpos [B,C]) puts the broadcast [B, C]
+    dims first -> slot shape [B, C, kvh, hd], matching val."""
+    data, scale = cache
+    rows = jnp.arange(val.shape[0])[:, None]
+    if scale is None:
+        return (data.at[i, rows, :, qpos].set(val.astype(data.dtype)), None)
+    q, s = _quant_kv_i8(val)
+    return (
+        data.at[i, rows, :, qpos].set(q),
+        scale.at[i, rows, :, qpos].set(s),
+    )
+
+
+def _chunk_decode(params, cfg: LMConfig, toks, kcache, vcache, pos):
+    """The ONE home of cached decoding: ``toks`` [B, C] live at
+    absolute positions ``pos[:, None] + arange(C)`` (per-row ``pos``
+    [B]). Writes both caches at those slots — each chunk position
+    attends everything cached up to itself, including earlier chunk
+    positions — and returns (logits [B, C, vocab], caches). C=1 is the
+    lm_generate scan step (see :func:`_decode_step`); C=gamma+1 is
+    speculative decoding's target verify pass. Runs in
+    ``cfg.compute_dtype`` like the training forward (softmax and
+    logits in f32), so decode matches training numerics dtype for
+    dtype."""
+    b, c = toks.shape
     nh = cfg.n_heads
     kvh = cfg.kv_heads
     g = nh // kvh  # query heads per K/V head (1 = MHA)
     hd = cfg.d_model // nh
     t_max = kcache[0].shape[3]
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
-    x = (params["emb"][tok] * np.sqrt(cfg.d_model)).astype(dtype)  # [B, d]
+    x = (params["emb"][toks] * np.sqrt(cfg.d_model)).astype(dtype)  # [B,C,d]
+    qpos = pos[:, None] + jnp.arange(c)  # [B, C]
     t_range = jnp.arange(t_max)
-    keep = t_range <= pos
+    keep = t_range[None, None, :] <= qpos[..., None]  # [B, C, T]
     if cfg.window is not None:  # sliding window, mirroring lm_forward
-        keep &= (pos - t_range) < cfg.window
-    mask = keep[None, None, None, :]  # [1, 1, 1, T]
+        keep &= (qpos[..., None] - t_range[None, None, :]) < cfg.window
     rope_cs = (
-        _rope_tables(pos, hd, cfg.rope_theta) if cfg.rope else None
+        _rope_tables(qpos, hd, cfg.rope_theta) if cfg.rope else None
     )
     for i in range(cfg.n_layers):
         cast = lambda k: params[f"l{i}/{k}"].astype(dtype)  # noqa: E731,B023
         h = _ln(x, cast("ln1"))
-        q = (h @ cast("wq")).reshape(b, kvh, g, hd)
-        k = (h @ cast("wk")).reshape(b, kvh, hd)
-        v = (h @ cast("wv")).reshape(b, kvh, hd)
+        q = (h @ cast("wq")).reshape(b, c, kvh, g, hd)
+        k = (h @ cast("wk")).reshape(b, c, kvh, hd)
+        v = (h @ cast("wv")).reshape(b, c, kvh, hd)
         if cfg.rope:  # rotate at the absolute slot; the cache stores
             # ROTATED k, matching the prefill/training convention
-            q = _rotate(q, *rope_cs)
-            k = _rotate(k, *rope_cs)
-        kcache = _cache_write(kcache, (i, slice(None), slice(None), pos), k)
-        vcache = _cache_write(vcache, (i, slice(None), slice(None), pos), v)
+            cos, sin = rope_cs
+            q = _rotate(q, cos[:, :, None, None, :], sin[:, :, None, None, :])
+            k = _rotate(k, cos[:, :, None, :], sin[:, :, None, :])
+        kcache = _cache_write_rows(kcache, i, qpos, k)
+        vcache = _cache_write_rows(vcache, i, qpos, v)
         s = jnp.einsum(
-            "bkgd,bktd->bkgt", q.astype(jnp.float32), _cache_layer(kcache, i)
+            "bckgd,bktd->bckgt",
+            q.astype(jnp.float32),
+            _cache_layer(kcache, i),
         ) / np.sqrt(hd)
-        s = jnp.where(mask, s, -1e30)
+        s = jnp.where(keep[:, :, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         att = (
-            jnp.einsum("bkgt,bktd->bkgd", p, _cache_layer(vcache, i))
-            .reshape(b, cfg.d_model)
+            jnp.einsum("bckgt,bktd->bckgd", p, _cache_layer(vcache, i))
+            .reshape(b, c, cfg.d_model)
             .astype(dtype)
         )
         x = x + att @ cast("wo")
@@ -438,6 +460,18 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
         x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
     x32 = x.astype(jnp.float32)
     return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
+
+
+def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
+    """One KV-cached decoder step (lm_generate's scan body): tok [B],
+    scalar pos — delegates to :func:`_chunk_decode` with C=1 so the
+    decode math has a single home."""
+    b = tok.shape[0]
+    logits, kcache, vcache = _chunk_decode(
+        params, cfg, tok[:, None], kcache, vcache,
+        jnp.full((b,), pos, jnp.int32),
+    )
+    return logits[:, 0], kcache, vcache
 
 
 def _chunked_causal_attn(q, k, v, window, chunk: int = 256):

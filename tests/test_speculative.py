@@ -1,0 +1,184 @@
+"""Speculative decoding (models/speculative.py): the greedy variant's
+defining property is EXACT token equality with plain greedy decoding
+of the target model — speculation may only change how many target
+passes it takes, never the output."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from parameter_server_tpu.models.speculative import speculative_generate
+from parameter_server_tpu.models.transformer import (
+    LMConfig,
+    init_lm,
+    lm_generate,
+)
+
+
+@pytest.fixture(scope="module")
+def tcfg():
+    return LMConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def dcfg():
+    # a genuinely smaller draft: narrower and shallower
+    return LMConfig(vocab=32, d_model=16, n_heads=2, n_layers=1, d_ff=32)
+
+
+@pytest.fixture(scope="module")
+def tparams(tcfg):
+    return init_lm(jax.random.PRNGKey(0), tcfg)
+
+
+@pytest.fixture(scope="module")
+def dparams(dcfg):
+    return init_lm(jax.random.PRNGKey(1), dcfg)
+
+
+def _prompt(b=2, p=9, seed=3):
+    return np.random.default_rng(seed).integers(0, 32, (b, p)).astype(
+        np.int32
+    )
+
+
+class TestExactness:
+    @pytest.mark.parametrize("gamma", [1, 3, 4])
+    def test_matches_plain_greedy(self, tcfg, dcfg, tparams, dparams, gamma):
+        prompt = _prompt()
+        want = np.asarray(lm_generate(tparams, prompt, tcfg, steps=14))
+        got = np.asarray(
+            speculative_generate(
+                tparams, tcfg, dparams, dcfg, prompt, steps=14, gamma=gamma
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_under_feature_composition(self):
+        """Target with GQA+rope+bf16, draft with rope — each model runs
+        its own config; output still exactly equals plain greedy."""
+        tcfg = LMConfig(
+            vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            n_kv_heads=2, rope=True, compute_dtype="bfloat16",
+        )
+        dcfg = LMConfig(
+            vocab=32, d_model=16, n_heads=2, n_layers=1, d_ff=32, rope=True
+        )
+        tp = init_lm(jax.random.PRNGKey(4), tcfg)
+        dp = init_lm(jax.random.PRNGKey(5), dcfg)
+        prompt = _prompt(seed=6)
+        want = np.asarray(lm_generate(tp, prompt, tcfg, steps=10))
+        got = np.asarray(
+            speculative_generate(tp, tcfg, dp, dcfg, prompt, steps=10,
+                                 gamma=3)
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_with_int8_caches(self, tcfg, dcfg, tparams, dparams):
+        """int8 KV caches on BOTH models (the per-row scale-scatter
+        write path is only reachable here): output equals plain greedy
+        decode of the target with the SAME int8 cache config."""
+        t8 = dataclasses.replace(tcfg, kv_cache_dtype="int8")
+        d8 = dataclasses.replace(dcfg, kv_cache_dtype="int8")
+        prompt = _prompt(seed=12)
+        want = np.asarray(lm_generate(tparams, prompt, t8, steps=10))
+        got = np.asarray(
+            speculative_generate(
+                tparams, t8, dparams, d8, prompt, steps=10, gamma=3
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_single_step_and_odd_lengths(self, tcfg, dcfg, tparams, dparams):
+        """steps smaller than gamma, and steps=1, must still terminate
+        and match (the capped-commit path)."""
+        prompt = _prompt(b=3, p=5, seed=7)
+        for steps in (1, 2):
+            want = np.asarray(lm_generate(tparams, prompt, tcfg, steps=steps))
+            got = np.asarray(
+                speculative_generate(
+                    tparams, tcfg, dparams, dcfg, prompt, steps=steps,
+                    gamma=4,
+                )
+            )
+            np.testing.assert_array_equal(got, want)
+
+
+class TestSpeedupMechanics:
+    def test_perfect_draft_accepts_everything(self, tcfg, tparams):
+        """draft == target: every proposal is accepted, so steps tokens
+        arrive in ~steps/(gamma+1) rounds — the upper bound on what a
+        draft can buy."""
+        prompt = _prompt(seed=8)
+        steps, gamma = 16, 3
+        out, stats = speculative_generate(
+            tparams, tcfg, tparams, tcfg, prompt, steps=steps, gamma=gamma,
+            return_stats=True,
+        )
+        want = np.asarray(lm_generate(tparams, prompt, tcfg, steps=steps))
+        np.testing.assert_array_equal(np.asarray(out), want)
+        assert float(stats["accepted_frac"]) > 0.99, stats
+        # ceil(steps / (gamma+1)) rounds when everything is accepted
+        assert int(stats["rounds"]) <= -(-steps // (gamma + 1)) + 1, stats
+
+    def test_stats_reported_for_weak_draft(self, tcfg, dcfg, tparams,
+                                           dparams):
+        out, stats = speculative_generate(
+            tparams, tcfg, dparams, dcfg, _prompt(seed=9), steps=12,
+            gamma=4, return_stats=True,
+        )
+        assert int(stats["rounds"]) >= 1
+        assert 0.0 <= float(stats["accepted_frac"]) <= 1.0
+        assert int(stats["target_passes"]) == int(stats["rounds"])
+        # a random draft against a random target still cannot take MORE
+        # rounds than one commit per round
+        assert int(stats["rounds"]) <= 12
+
+
+class TestRejectionPath:
+    def test_full_rejection_still_exact(self):
+        """Random-init models collapse to near-constant emissions, so
+        acceptance is usually all-or-nothing; this seed pair REJECTS
+        every proposal (verified when the test was written) — one
+        committed token per round, pure correction path — and the
+        output still exactly equals plain greedy."""
+        tcfg = LMConfig(vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64)
+        dcfg = LMConfig(vocab=32, d_model=16, n_heads=2, n_layers=1, d_ff=32)
+        tp = init_lm(jax.random.PRNGKey(4), tcfg)
+        dp = init_lm(jax.random.PRNGKey(104), dcfg)
+        prompt = _prompt(b=2, p=8, seed=4)  # this exact prompt rejects
+        want = np.asarray(lm_generate(tp, prompt, tcfg, steps=16))
+        got, st = speculative_generate(
+            tp, tcfg, dp, dcfg, prompt, steps=16, gamma=4,
+            return_stats=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+        # seed-dependent numerics: assert the INTENT (mostly-rejecting)
+        # with slack for a stray tie flip on another backend, not the
+        # exact round count
+        assert float(st["accepted_frac"]) < 0.5, st
+        assert 8 <= int(st["rounds"]) <= 15, st
+
+
+class TestValidation:
+    def test_rejects_vocab_mismatch(self, tcfg, tparams):
+        bad = LMConfig(vocab=64, d_model=16, n_heads=2, n_layers=1, d_ff=32)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(
+                tparams, tcfg, init_lm(jax.random.PRNGKey(2), bad), bad,
+                _prompt(), steps=4,
+            )
+
+    def test_rejects_moe_and_bad_gamma(self, tcfg, dcfg, tparams, dparams):
+        moe = dataclasses.replace(tcfg, moe_every=2)
+        with pytest.raises(ValueError, match="dense-FFN"):
+            speculative_generate(
+                tparams, moe, dparams, dcfg, _prompt(), steps=4
+            )
+        with pytest.raises(ValueError, match="gamma"):
+            speculative_generate(
+                tparams, tcfg, dparams, dcfg, _prompt(), steps=4, gamma=0
+            )

@@ -678,12 +678,13 @@ class TestGQA:
 
         b, p = 2, 8
         hd = cfg.d_model // cfg.n_heads
-        kcache = jnp.zeros((cfg.n_layers, b, cfg.kv_heads, p, hd))
+        # caches are (data, scale) pytrees; scale None = plain dtype
+        kcache = (jnp.zeros((cfg.n_layers, b, cfg.kv_heads, p, hd)), None)
         logits, kcache, _ = _prefill(
             params, cfg, jnp.zeros((b, p), jnp.int32), kcache,
-            jnp.zeros_like(kcache),
+            jax.tree.map(jnp.zeros_like, kcache),
         )
-        assert kcache.shape[2] == 2  # kv heads, not 4 query heads
+        assert kcache[0].shape[2] == 2  # kv heads, not 4 query heads
         assert logits.shape == (b, p, cfg.vocab)
 
     def test_gqa_trains(self, mesh8):
