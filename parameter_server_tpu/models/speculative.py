@@ -15,9 +15,12 @@ Cache invariant (both models): at round start every position
 ``< committed-1`` is cached; the slot at ``committed-1`` (the last
 committed token, round input x0) is written DURING the round — the
 draft writes it decoding proposal 1, the target writes it verifying
-the chunk. Rejected proposals leave stale slots past the committed
-point, which the per-row position masks never attend and the next
-round overwrites.
+the chunk. The draft runs one EXTRA step so the last proposal's own
+slot is written too (a fully-accepted round advances past it; an
+unwritten slot would sit as silent zeros inside every later mask).
+Rejected proposals leave stale slots past the committed point; each
+stale slot is overwritten by a later round's write BEFORE the first
+query whose mask includes it.
 
 Batch rows accept different prefix lengths, so positions are
 PER-ROW (``committed [B]``) — unlike lm_generate's scalar scan
@@ -36,22 +39,10 @@ import jax.numpy as jnp
 
 from .transformer import (
     LMConfig,
+    _alloc_kv_caches,
     _chunk_decode,
     _prefill,
 )
-
-
-def _alloc_cache(cfg: LMConfig, b: int, total: int):
-    hd = cfg.d_model // cfg.n_heads
-    shape = (cfg.n_layers, b, cfg.kv_heads, total, hd)
-    dtype = (
-        jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
-    )
-    if cfg.kv_cache_dtype == "int8":
-        k = (jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32))
-    else:
-        k = (jnp.zeros(shape, dtype), None)
-    return k, jax.tree.map(jnp.zeros_like, k)
 
 
 def speculative_generate(
@@ -116,8 +107,8 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
     # slack: a round can overshoot by gamma tokens + 1 trash slot
     total = limit + gamma + 1
     trash = total - 1  # masked-commit writes land here, never read
-    tk, tv = _alloc_cache(tcfg, b, total)
-    dk, dv = _alloc_cache(dcfg, b, total)
+    tk, tv = _alloc_kv_caches(tcfg, b, total)
+    dk, dv = _alloc_kv_caches(dcfg, b, total)
     prompt = prompt.astype(jnp.int32)
     # prefill BOTH models on the prompt (slots [0, p_len))
     t_logits, tk, tv = _prefill(tparams, tcfg, prompt, tk, tv)
@@ -143,6 +134,18 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
             )
             cur = jnp.argmax(dl[:, 0], axis=-1).astype(jnp.int32)
             d_toks.append(cur)
+        # one extra draft step processes d_gamma itself: its K/V slot
+        # (committed-1+gamma) would otherwise NEVER be written, and on a
+        # fully-accepted round the next round starts past it — the hole
+        # would sit inside every later query's mask as silent zeros,
+        # eroding draft quality (and so acceptance) forever. For
+        # partially-accepted rows this write is stale, but every stale
+        # slot is overwritten by a later round's draft step BEFORE the
+        # first query whose mask includes it (write-then-attend within a
+        # step). The produced logits are deliberately unused.
+        _, dk, dv = _chunk_decode(
+            dparams, dcfg, cur[:, None], dk, dv, committed - 1 + gamma
+        )
         d = jnp.stack(d_toks, axis=1)  # [B, gamma]
         # -- target: ONE (gamma+1)-chunk verify over [x0, d1..dg] --
         chunk = jnp.concatenate([x0[:, None], d], axis=1)

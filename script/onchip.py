@@ -619,6 +619,70 @@ def task_lm() -> int:
                 "metric": f"lm_decode_tokens_per_sec{tag}",
                 "error": repr(e)[:500],
             })
+
+    # speculative decoding: rounds replace per-token target passes. The
+    # draft==target run is the mechanism's UPPER bound (every proposal
+    # accepted -> ceil(steps/(gamma+1)) target passes) and isolates the
+    # chunk-verify overhead; the small-draft run prices a realistic
+    # draft (random-init models give degenerate acceptance, so its
+    # tokens/s is a floor — accepted_frac is reported for the reader)
+    try:
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        tcfg = _dc.replace(base_cfg, n_kv_heads=kvh)
+        tparams = init_lm(jax.random.PRNGKey(0), tcfg)
+        small = LMConfig(
+            vocab=256,
+            d_model=tcfg.d_model // 4,
+            n_heads=max(1, tcfg.n_heads // 4),
+            n_layers=2,
+            d_ff=tcfg.d_ff // 4,
+            compute_dtype=tcfg.compute_dtype,
+        )
+        dparams = init_lm(jax.random.PRNGKey(7), small)
+        prompt = jnp.asarray(rng.integers(0, 256, (b, prefill), np.int32))
+        gamma = 4
+        plain_t0 = time.perf_counter()
+        _flush(lm_generate(tparams, prompt, tcfg, steps=steps))
+        plain_compile = time.perf_counter() - plain_t0
+        t0 = time.perf_counter()
+        _flush(lm_generate(tparams, prompt, tcfg, steps=steps))
+        plain_sec = time.perf_counter() - t0
+        for stag, dp, dc in (
+            ("upper", tparams, tcfg), ("draft4x", dparams, small)
+        ):
+            t0 = time.perf_counter()
+            out, st = speculative_generate(
+                tparams, tcfg, dp, dc, prompt, steps=steps, gamma=gamma,
+                return_stats=True,
+            )
+            _flush(out)
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out, st = speculative_generate(
+                tparams, tcfg, dp, dc, prompt, steps=steps, gamma=gamma,
+                return_stats=True,
+            )
+            _flush(out)
+            sec = time.perf_counter() - t0
+            emit({
+                "metric": f"lm_decode_speculative_{stag}",
+                "value": round(b * steps / sec, 1),
+                "unit": "tokens/sec",
+                "batch": b, "prefill": prefill, "steps": steps,
+                "gamma": gamma,
+                "plain_tokens_per_sec": round(b * steps / plain_sec, 1),
+                "speedup_vs_plain": round(plain_sec / sec, 2),
+                "rounds": int(st["rounds"]),
+                "accepted_frac": round(float(st["accepted_frac"]), 3),
+                "compile_s": round(compile_s + plain_compile, 1),
+                "device_kind": dev.device_kind,
+            })
+            plain_compile = 0.0
+    except Exception as e:
+        emit({"metric": "lm_decode_speculative", "error": repr(e)[:500]})
     return 0
 
 
