@@ -45,6 +45,52 @@ from .transformer import (
 )
 
 
+def _accept_and_correct(key, d, p_d, p_t):
+    """The Leviathan accept/reject core, pure so its distribution
+    guarantee is statistically testable in isolation.
+
+    ``d`` [B, g] sampled draft proposals, ``p_d`` [B, g, V] the draft
+    probabilities they were sampled from, ``p_t`` [B, g+1, V] target
+    probabilities at the same positions (row g is the bonus position
+    after all proposals). Position j's proposal is accepted with
+    probability ``min(1, p_t[j][d_j] / p_d[j][d_j])``; ``n`` is the
+    count of leading accepts, and the correction token at position n
+    is sampled from the normalized residual ``max(p_t[n] - p_d[n], 0)``
+    (plain ``p_t[g]`` at the bonus position, where there is no draft).
+    The marginal of the emitted token at every position is EXACTLY the
+    target distribution (Leviathan et al. 2023, Thm 1).
+
+    Returns (n [B], commit_row [B, g+1]): commit_row[j] = d[j] for
+    j < n, the correction sample at j = n, undefined beyond."""
+    b, g = d.shape
+    rows = jnp.arange(b)
+    k_u, k_c = jax.random.split(key)
+    u = jax.random.uniform(k_u, (b, g))
+    pd_at = jnp.take_along_axis(p_d, d[..., None], axis=-1)[..., 0]
+    pt_at = jnp.take_along_axis(p_t[:, :g], d[..., None], axis=-1)[..., 0]
+    accept = u * jnp.maximum(pd_at, 1e-30) < pt_at  # u < pt/pd
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # residual at the rejection position; at the bonus position (n=g)
+    # there is no draft, so the "residual" is the target row itself
+    # (p_d extended with zeros)
+    p_d_ext = jnp.concatenate([p_d, jnp.zeros_like(p_t[:, :1])], axis=1)
+    resid = jnp.maximum(p_t[rows, n] - p_d_ext[rows, n], 0.0)  # [B, V]
+    mass = resid.sum(-1, keepdims=True)
+    # mass == 0 only when p_t <= p_d everywhere, i.e. p_t == p_d — then
+    # the rejection probability was 0; fall back to p_t for safety
+    resid = jnp.where(mass > 1e-12, resid, p_t[rows, n])
+    correction = jax.random.categorical(
+        k_c, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    j_idx = jnp.arange(g + 1)[None, :]
+    commit_row = jnp.where(
+        j_idx < n[:, None],
+        jnp.pad(d, ((0, 0), (0, 1))),
+        correction[:, None],
+    )
+    return n, commit_row
+
+
 def speculative_generate(
     target_params: Dict[str, jax.Array],
     target_cfg: LMConfig,
@@ -54,18 +100,29 @@ def speculative_generate(
     steps: int,
     *,
     gamma: int = 4,
+    temperature: "float | None" = None,
+    key: "jax.Array | None" = None,
     return_stats: bool = False,
 ) -> "jax.Array | Tuple[jax.Array, Dict[str, jax.Array]]":
-    """Greedy speculative decoding whose output exactly matches plain
-    greedy decoding of the target model.
+    """Speculative decoding that provably matches decoding the target
+    model directly.
 
-    Token-for-token equal to ``lm_generate(target_params, ...,
-    temperature=None)`` — verified by tests — in
-    ~``steps / (1 + mean_accepted)`` target passes instead of
-    ``steps``. ``gamma``: draft proposals per round. Both configs must
-    share the vocab; windows/rope/GQA/bf16/int8-cache compose per
-    model independently (each model runs its OWN config against its
-    own cache). Dense FFN only (same restriction as lm_generate).
+    ``temperature=None`` (or 0) is the GREEDY variant: token-for-token
+    equal to ``lm_generate(target_params, ..., temperature=None)`` —
+    verified by tests — in ~``steps / (1 + mean_accepted)`` target
+    passes instead of ``steps``. ``temperature > 0`` is the SAMPLED
+    variant (Leviathan et al. 2023): the draft samples its proposals,
+    each is accepted with probability ``min(1, p_t/p_d)``, rejections
+    sample the normalized residual ``max(p_t - p_d, 0)`` — the emitted
+    distribution at every position is exactly the target's
+    softmax(logits/temperature) (the acceptance core is the pure
+    ``_accept_and_correct``, statistically pinned by tests); sampling
+    needs ``key``.
+
+    ``gamma``: draft proposals per round. Both configs must share the
+    vocab; windows/rope/GQA/bf16/int8-cache compose per model
+    independently (each model runs its OWN config against its own
+    cache). Dense FFN only (same restriction as lm_generate).
 
     ``return_stats=True`` additionally returns
     ``{"rounds": r, "target_passes": r, "accepted_frac": f}`` —
@@ -89,19 +146,33 @@ def speculative_generate(
         raise ValueError(f"gamma must be >= 1, got {gamma}")
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    # mirror lm_generate's contract: greedy detection needs a CONCRETE
+    # Python number (a jax Array would make `greedy` — a static
+    # argument — non-hashable); a traced/Array temperature is treated
+    # as sampling, so sweeping it never recompiles
+    concrete = isinstance(temperature, (int, float))
+    greedy = temperature is None or (concrete and temperature == 0)
+    if not greedy:
+        if concrete and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if key is None:
+            raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by the greedy path
     return _spec_jit(
         target_params, draft_params, prompt,
+        jnp.asarray(1.0 if greedy else temperature, jnp.float32), key,
         tcfg=target_cfg, dcfg=draft_cfg, steps=steps, gamma=gamma,
-        return_stats=return_stats,
+        greedy=greedy, return_stats=return_stats,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tcfg", "dcfg", "steps", "gamma",
+    jax.jit, static_argnames=("tcfg", "dcfg", "steps", "gamma", "greedy",
                               "return_stats")
 )
-def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
-              return_stats):
+def _spec_jit(tparams, dparams, prompt, temperature, key, *, tcfg, dcfg,
+              steps, gamma, greedy, return_stats):
     b, p_len = prompt.shape
     limit = p_len + steps
     # slack: a round can overshoot by gamma tokens + 1 trash slot
@@ -115,24 +186,38 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
     _, dk, dv = _prefill(dparams, dcfg, prompt, dk, dv)
     toks = jnp.zeros((b, total), jnp.int32).at[:, :p_len].set(prompt)
     # first committed token comes straight from the target prefill
-    toks = toks.at[:, p_len].set(
-        jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
-    )
+    key, k0 = jax.random.split(key)
+    if greedy:
+        first = jnp.argmax(t_logits[:, -1], axis=-1)
+    else:
+        first = jax.random.categorical(
+            k0, t_logits[:, -1] / temperature, axis=-1
+        )
+    toks = toks.at[:, p_len].set(first.astype(jnp.int32))
     committed = jnp.full((b,), p_len + 1, jnp.int32)
     rows = jnp.arange(b)
 
     def round_body(carry):
-        toks, committed, tk, tv, dk, dv, rounds, acc, prop = carry
+        toks, committed, tk, tv, dk, dv, key, rounds, acc, prop = carry
         live = committed < limit  # rows still decoding at round start
         x0 = toks[rows, committed - 1]  # [B] last committed token
         # -- draft: gamma sequential proposals (C=1 chunk steps) --
+        key, k_acc, *k_draft = jax.random.split(key, 2 + gamma)
         d_toks = []
+        d_probs = []
         cur = x0
         for j in range(gamma):
             dl, dk, dv = _chunk_decode(
                 dparams, dcfg, cur[:, None], dk, dv, committed - 1 + j
             )
-            cur = jnp.argmax(dl[:, 0], axis=-1).astype(jnp.int32)
+            if greedy:
+                cur = jnp.argmax(dl[:, 0], axis=-1).astype(jnp.int32)
+            else:
+                z = dl[:, 0] / temperature
+                cur = jax.random.categorical(
+                    k_draft[j], z, axis=-1
+                ).astype(jnp.int32)
+                d_probs.append(jax.nn.softmax(z, axis=-1))
             d_toks.append(cur)
         # one extra draft step processes d_gamma itself: its K/V slot
         # (committed-1+gamma) would otherwise NEVER be written, and on a
@@ -152,19 +237,25 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
         tl, tk, tv = _chunk_decode(
             tparams, tcfg, chunk, tk, tv, committed - 1
         )
-        tpred = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [B, gamma+1]
-        # greedy acceptance: longest prefix where d[j] == tpred[j]
-        agree = d == tpred[:, :gamma]  # [B, gamma]
-        n = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
-        # committed tokens this round: d[0..n-1] then the correction
-        # tpred[n]; lay them out as a [B, gamma+1] row and mask-commit
         j_idx = jnp.arange(gamma + 1)[None, :]
-        correction = tpred[rows, n]  # [B]
-        commit_row = jnp.where(
-            j_idx < n[:, None],
-            jnp.pad(d, ((0, 0), (0, 1))),  # d[j] for j < n
-            correction[:, None],  # at j == n; masked out beyond
-        )
+        if greedy:
+            tpred = jnp.argmax(tl, axis=-1).astype(jnp.int32)  # [B, g+1]
+            # greedy acceptance: longest prefix where d[j] == tpred[j]
+            agree = d == tpred[:, :gamma]  # [B, gamma]
+            n = jnp.sum(
+                jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1
+            )
+            correction = tpred[rows, n]  # [B]
+            commit_row = jnp.where(
+                j_idx < n[:, None],
+                jnp.pad(d, ((0, 0), (0, 1))),  # d[j] for j < n
+                correction[:, None],  # at j == n; masked out beyond
+            )
+        else:
+            p_t = jax.nn.softmax(tl / temperature, axis=-1)  # [B, g+1, V]
+            n, commit_row = _accept_and_correct(
+                k_acc, d, jnp.stack(d_probs, axis=1), p_t
+            )
         # capped commit: a finished row re-processes its last slot
         # instead of overflowing the buffer
         n_eff = jnp.minimum(n + 1, limit - committed)
@@ -177,7 +268,8 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
         # proposals (a capped commit may truncate the accepted run)
         acc = acc + jnp.sum(jnp.where(live, jnp.minimum(n, n_eff), 0))
         prop = prop + jnp.sum(jnp.where(live, gamma, 0))
-        return toks, committed, tk, tv, dk, dv, rounds + 1, acc, prop
+        return (toks, committed, tk, tv, dk, dv, key, rounds + 1, acc,
+                prop)
 
     def cond(carry):
         return jnp.min(carry[1]) < limit
@@ -185,7 +277,7 @@ def _spec_jit(tparams, dparams, prompt, *, tcfg, dcfg, steps, gamma,
     toks, committed, *_, rounds, acc, prop = jax.lax.while_loop(
         cond,
         round_body,
-        (toks, committed, tk, tv, dk, dv, jnp.int32(0), jnp.int32(0),
+        (toks, committed, tk, tv, dk, dv, key, jnp.int32(0), jnp.int32(0),
          jnp.int32(0)),
     )
     out = toks[:, :limit]
