@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -645,43 +645,51 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
     return logits, kcache, vcache
 
 
-def lm_generate(
-    params: Dict[str, jax.Array],
-    prompt: jax.Array,  # [B, P] int32
-    cfg: LMConfig,
-    steps: int,
-    *,  # options are keyword-only: inserting new ones can never silently
-    # rebind a positional caller's arguments
-    return_logits: bool = False,
-    temperature=None,
-    top_k: "int | None" = None,
-    top_p: "float | None" = None,
-    key: jax.Array = None,
-) -> jax.Array:
-    """KV-cached decoding (the serving path — single device; the
-    sharded-mesh schedules are the TRAINING story): ingests the prompt
-    with ONE batched causal forward that fills the KV caches
-    (``_prefill``), then a lax.scan extends it ``steps`` tokens one at a
-    time. Sampling consumes one PRNG split for the first generated token
-    plus one per scan step (NOT one per prompt position — the per-token
-    prompt walk is gone).
-    ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
-    softmax(logits/temperature), optionally truncated to the ``top_k``
-    most likely tokens and/or the nucleus holding ``top_p`` probability
-    mass (smallest prefix of the sorted distribution with cumulative
-    probability >= top_p; both filters compose — k-truncate, then
-    nucleus). Sampling needs ``key``. A non-zero temperature is a
-    TRACED operand of the jitted core — sweeping it does not recompile
-    the decode scan. Returns [B, P+steps]. Dense FFN layers only (the
-    reference has no serving path at all; MoE decode would need token
-    routing with batch-1 capacity, out of scope).
+def _pick_token(logits, k_step, temperature, top_p, *, greedy, top_k,
+                has_top_p):
+    """Greedy argmax or temperature/top-k/top-p sampling of one token
+    per row — shared by lm_generate and lm_generate_continue."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    z = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(z, axis=-1)[:, -top_k][:, None]
+        z = jnp.where(z >= kth, z, -jnp.inf)
+    if has_top_p:
+        # nucleus: keep the smallest sorted prefix with cumulative
+        # probability >= top_p. A token stays iff the cumulative mass
+        # STRICTLY BEFORE it (descending order) is < top_p — the
+        # argmax token always survives (cum-before = 0 < top_p)
+        zs = jnp.sort(z, axis=-1)[:, ::-1]  # descending
+        ps = jax.nn.softmax(zs, axis=-1)
+        before = jnp.cumsum(ps, axis=-1) - ps
+        zs_masked = jnp.where(before < top_p, zs, jnp.inf)
+        cutoff = jnp.min(zs_masked, axis=-1, keepdims=True)
+        z = jnp.where(z >= cutoff, z, -jnp.inf)
+    return jax.random.categorical(k_step, z, axis=-1).astype(jnp.int32)
 
-    This wrapper is EAGER on purpose: argument validation (greedy
-    detection, sign/range checks) needs concrete Python values, which a
-    jitted body never sees — the heavy lifting lives in the jitted core
-    below."""
-    if cfg.moe_every > 0:
-        raise ValueError("lm_generate supports dense FFN layers only")
+
+@dataclasses.dataclass(frozen=True)
+class GenState:
+    """Resumable generation state (multi-turn serving): the KV caches,
+    the last emitted token (whose cache slot is NOT yet written — the
+    same boundary invariant speculative decoding uses), and how many
+    tokens exist. ``capacity`` (cache slots) bounds how far
+    :func:`lm_generate_continue` can extend. Opaque to callers."""
+
+    kcache: tuple
+    vcache: tuple
+    last_tok: jax.Array  # [B] int32
+    length: int  # tokens emitted so far (prompt + generated)
+
+    @property
+    def capacity(self) -> int:
+        return self.kcache[0].shape[3]
+
+
+def _sampling_args(cfg, temperature, top_k, top_p, key):
+    """Shared wrapper-side validation for the generate family; returns
+    (greedy, temperature-array, top_p-array, key)."""
     concrete = isinstance(temperature, (int, float))
     greedy = temperature is None or (concrete and temperature == 0)
     if concrete and temperature < 0:
@@ -710,53 +718,119 @@ def lm_generate(
         key = jax.random.PRNGKey(0)  # unused by the greedy path
     if greedy:
         temperature = 1.0  # dead operand on the greedy trace
+    return (
+        greedy,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        key,
+    )
+
+
+def lm_generate(
+    params: Dict[str, jax.Array],
+    prompt: jax.Array,  # [B, P] int32
+    cfg: LMConfig,
+    steps: int,
+    *,  # options are keyword-only: inserting new ones can never silently
+    # rebind a positional caller's arguments
+    return_logits: bool = False,
+    return_state: bool = False,
+    max_len: "int | None" = None,
+    temperature=None,
+    top_k: "int | None" = None,
+    top_p: "float | None" = None,
+    key: "jax.Array | None" = None,
+) -> jax.Array:
+    """KV-cached decoding (the serving path — single device; the
+    sharded-mesh schedules are the TRAINING story): ingests the prompt
+    with ONE batched causal forward that fills the KV caches
+    (``_prefill``), then a lax.scan extends it ``steps`` tokens one at a
+    time. Sampling consumes one PRNG split for the first generated token
+    plus one per scan step (NOT one per prompt position — the per-token
+    prompt walk is gone).
+    ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
+    softmax(logits/temperature), optionally truncated to the ``top_k``
+    most likely tokens and/or the nucleus holding ``top_p`` probability
+    mass (smallest prefix of the sorted distribution with cumulative
+    probability >= top_p; both filters compose — k-truncate, then
+    nucleus). Sampling needs ``key``. A non-zero temperature is a
+    TRACED operand of the jitted core — sweeping it does not recompile
+    the decode scan. Returns [B, P+steps]. Dense FFN layers only (the
+    reference has no serving path at all; MoE decode would need token
+    routing with batch-1 capacity, out of scope).
+
+    ``return_state=True`` appends a :class:`GenState` to the return —
+    resumable by :func:`lm_generate_continue` for multi-turn serving
+    without re-prefilling the history; pass ``max_len`` to pre-size
+    the caches for the expected conversation length (default: exactly
+    prompt+steps, leaving no continuation headroom).
+
+    This wrapper is EAGER on purpose: argument validation (greedy
+    detection, sign/range checks) needs concrete Python values, which a
+    jitted body never sees — the heavy lifting lives in the jitted core
+    below."""
+    if cfg.moe_every > 0:
+        raise ValueError("lm_generate supports dense FFN layers only")
+    greedy, temperature, top_p_arr, key = _sampling_args(
+        cfg, temperature, top_k, top_p, key
+    )
+    total = prompt.shape[1] + steps
+    capacity = max_len if max_len is not None else total
+    if capacity < total:
+        raise ValueError(
+            f"max_len={max_len} < prompt+steps={total}: the caches "
+            "cannot hold the generation being requested"
+        )
     # top_p rides as a TRACED operand (sweeping it must not recompile,
     # same contract as temperature); only its PRESENCE is static, so the
     # disabled path pays no sort/cumsum
-    return _lm_generate_jit(
-        params, prompt, jnp.asarray(temperature, jnp.float32),
-        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32), key,
+    out = _lm_generate_jit(
+        params, prompt, temperature, top_p_arr, key,
         cfg=cfg, steps=steps, return_logits=return_logits, top_k=top_k,
-        has_top_p=top_p is not None, greedy=greedy,
+        has_top_p=top_p is not None, greedy=greedy, capacity=capacity,
+        return_state=return_state,
     )
+    if not return_state:
+        return out
+    *rest, kcache, vcache = out
+    toks = rest[0]
+    state = GenState(
+        kcache=kcache, vcache=vcache, last_tok=toks[:, total - 1],
+        length=total,
+    )
+    return (*rest, state) if len(rest) > 1 else (toks, state)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "steps", "return_logits", "top_k", "has_top_p", "greedy"
+        "cfg", "steps", "return_logits", "top_k", "has_top_p", "greedy",
+        "capacity", "return_state",
     ),
 )
 def _lm_generate_jit(
     params, prompt, temperature, top_p, key, *, cfg, steps, return_logits,
-    top_k, has_top_p, greedy,
+    top_k, has_top_p, greedy, capacity=None, return_state=False,
 ):
     b, p_len = prompt.shape
     total = p_len + steps
-    kcache, vcache = _alloc_kv_caches(cfg, b, total)
+    kcache, vcache = _alloc_kv_caches(
+        cfg, b, total if capacity is None else capacity
+    )
     toks = jnp.concatenate(
         [prompt.astype(jnp.int32), jnp.zeros((b, steps), jnp.int32)], axis=1
     )
 
     def pick(logits, k_step):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        z = logits / temperature
-        if top_k is not None:
-            kth = jnp.sort(z, axis=-1)[:, -top_k][:, None]
-            z = jnp.where(z >= kth, z, -jnp.inf)
-        if has_top_p:
-            # nucleus: keep the smallest sorted prefix with cumulative
-            # probability >= top_p. A token stays iff the cumulative mass
-            # STRICTLY BEFORE it (descending order) is < top_p — the
-            # argmax token always survives (cum-before = 0 < top_p)
-            zs = jnp.sort(z, axis=-1)[:, ::-1]  # descending
-            ps = jax.nn.softmax(zs, axis=-1)
-            before = jnp.cumsum(ps, axis=-1) - ps
-            zs_masked = jnp.where(before < top_p, zs, jnp.inf)
-            cutoff = jnp.min(zs_masked, axis=-1, keepdims=True)
-            z = jnp.where(z >= cutoff, z, -jnp.inf)
-        return jax.random.categorical(k_step, z, axis=-1).astype(jnp.int32)
+        return _pick_token(
+            logits, k_step, temperature, top_p, greedy=greedy,
+            top_k=top_k, has_top_p=has_top_p,
+        )
+
+    def ret(*main):
+        return (*main, kcache, vcache) if return_state else (
+            main if len(main) > 1 else main[0]
+        )
 
     # batched prefill: one causal forward ingests the whole prompt; the
     # sequential scan below covers only the GENERATED tokens
@@ -766,7 +840,9 @@ def _lm_generate_jit(
     if steps == 0:
         # contract: total-1 logit rows (row t predicts token t+1); the
         # last prompt position's prediction has no output slot here
-        return (toks, prefill_logits[:, :-1]) if return_logits else toks
+        return ret(toks, prefill_logits[:, :-1]) if return_logits else ret(
+            toks
+        )
     key, k0 = jax.random.split(key)
     toks = toks.at[:, p_len].set(pick(prefill_logits[:, -1], k0))
 
@@ -784,16 +860,135 @@ def _lm_generate_jit(
     # positions p_len .. total-2: each processes an already-written token
     # and writes the next one (the final position total-1 is written by
     # the last iteration and needs no processing)
-    (toks, _, _, _), gen_logits = jax.lax.scan(
+    (toks, kcache, vcache, _), gen_logits = jax.lax.scan(
         body, (toks, kcache, vcache, key), jnp.arange(p_len, total - 1)
     )
     if return_logits:
         # [B, T-1, vocab]: row t predicts token t+1 — the decode-vs-full-
         # forward parity hook for tests (prefill rows + generated rows)
-        return toks, jnp.concatenate(
+        return ret(toks, jnp.concatenate(
             [prefill_logits, jnp.swapaxes(gen_logits, 0, 1)], axis=1
+        ))
+    return ret(toks)
+
+
+def lm_generate_continue(
+    params: Dict[str, jax.Array],
+    state: GenState,
+    cfg: LMConfig,
+    steps: int,
+    *,
+    new_tokens: "jax.Array | None" = None,
+    temperature=None,
+    top_k: "int | None" = None,
+    top_p: "float | None" = None,
+    key: "jax.Array | None" = None,
+) -> "Tuple[jax.Array, GenState]":
+    """Extend a :class:`GenState` by ``steps`` tokens — multi-turn
+    serving without re-prefilling the history.
+
+    ``new_tokens`` [B, M] (e.g. the next user turn) is ingested first
+    in ONE multi-token cache pass (:func:`_chunk_decode` — weights read
+    once for the whole turn), then the usual one-token decode scan
+    generates. Returns ``(generated [B, steps], new_state)``. The
+    state's cache capacity (``lm_generate(..., max_len=)``) must hold
+    ``state.length + M + steps`` slots. The same sampling options as
+    lm_generate apply. The window/rope/GQA/int8-cache config must be
+    the one the state was created with (the caches carry its layout).
+
+    ``steps=0`` with ``new_tokens`` is the ingest-only call ("absorb
+    the user's turn now, generate later"): the returned state's
+    boundary slot is then ALREADY cached, and the next continuation
+    re-writes it with identical values (same token, same position,
+    same prefix — a deterministic recompute), so the boundary
+    invariant degrades to a harmless one-slot rewrite.
+
+    ``state.length`` rides as a TRACED operand: turns of the same
+    (new-turn width, steps) shape reuse one compiled program no matter
+    how long the conversation has grown."""
+    if cfg.moe_every > 0:
+        raise ValueError(
+            "the lm_generate family supports dense FFN layers only"
         )
-    return toks
+    greedy, temperature, top_p_arr, key = _sampling_args(
+        cfg, temperature, top_k, top_p, key
+    )
+    m = 0 if new_tokens is None else new_tokens.shape[1]
+    if steps == 0 and m == 0:
+        return (
+            jnp.zeros((state.last_tok.shape[0], 0), jnp.int32), state
+        )
+    need = state.length + m + steps
+    if need > state.capacity:
+        raise ValueError(
+            f"continuation needs {need} cache slots but the state was "
+            f"allocated {state.capacity} — create it with "
+            f"lm_generate(..., max_len={need}) or more"
+        )
+    if new_tokens is None:
+        new_tokens = jnp.zeros((state.last_tok.shape[0], 0), jnp.int32)
+    gen, kcache, vcache, last = _lm_continue_jit(
+        params, state.kcache, state.vcache, state.last_tok,
+        new_tokens.astype(jnp.int32), jnp.int32(state.length),
+        temperature, top_p_arr, key,
+        cfg=cfg, steps=steps, top_k=top_k,
+        has_top_p=top_p is not None, greedy=greedy,
+    )
+    return gen, GenState(
+        kcache=kcache, vcache=vcache, last_tok=last, length=need
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "top_k", "has_top_p", "greedy"),
+)
+def _lm_continue_jit(
+    params, kcache, vcache, last_tok, new_tokens, length, temperature,
+    top_p, key, *, cfg, steps, top_k, has_top_p, greedy,
+):
+    b, m = new_tokens.shape
+
+    def pick(logits, k_step):
+        return _pick_token(
+            logits, k_step, temperature, top_p, greedy=greedy,
+            top_k=top_k, has_top_p=has_top_p,
+        )
+
+    # ingest [last_tok, new turn] as one chunk: writes the boundary
+    # token's pending cache slot (length-1) plus the turn's slots; the
+    # final row's logits predict the first generated token
+    chunk = jnp.concatenate([last_tok[:, None], new_tokens], axis=1)
+    logits_c, kcache, vcache = _chunk_decode(
+        params, cfg, chunk, kcache, vcache,
+        jnp.full((b,), length - 1, jnp.int32),
+    )
+    if steps == 0:  # ingest-only (m > 0): see the wrapper docstring
+        return (
+            jnp.zeros((b, 0), jnp.int32), kcache, vcache,
+            new_tokens[:, -1],
+        )
+    key, k0 = jax.random.split(key)
+    first = pick(logits_c[:, -1], k0)
+    start = length + m  # absolute position of the first generated token
+    gen = jnp.zeros((b, steps), jnp.int32).at[:, 0].set(first)
+
+    def body(carry, i):
+        gen, kcache, vcache, key = carry
+        key, k_step = jax.random.split(key)
+        tok = jax.lax.dynamic_index_in_dim(gen, i, axis=1, keepdims=False)
+        logits, kcache, vcache = _decode_step(
+            params, cfg, tok, kcache, vcache, start + i
+        )
+        nxt = pick(logits, k_step)
+        gen = jax.lax.dynamic_update_index_in_dim(gen, nxt, i + 1, axis=1)
+        return (gen, kcache, vcache, key), None
+
+    if steps > 1:
+        (gen, kcache, vcache, _), _ = jax.lax.scan(
+            body, (gen, kcache, vcache, key), jnp.arange(steps - 1)
+        )
+    return gen, kcache, vcache, gen[:, -1]
 
 
 def lm_loss(params, tokens, cfg, mesh, axis="data"):

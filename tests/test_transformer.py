@@ -479,6 +479,166 @@ class TestDecodeStepChunkParity:
             )
 
 
+class TestGenerateContinue:
+    """Multi-turn serving: lm_generate(..., return_state=True) +
+    lm_generate_continue must reproduce single-shot generation — the
+    state carries the caches, so no history is re-prefetched."""
+
+    def test_split_equals_single_shot(self, cfg, params):
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        rng = np.random.default_rng(20)
+        prompt = rng.integers(0, cfg.vocab, (2, 10)).astype(np.int32)
+        full = np.asarray(lm_generate(params, prompt, cfg, steps=12))
+        part, state = lm_generate(
+            params, prompt, cfg, steps=5, return_state=True,
+            max_len=prompt.shape[1] + 12,
+        )
+        gen2, state2 = lm_generate_continue(params, state, cfg, steps=7)
+        got = np.concatenate([np.asarray(part), np.asarray(gen2)], axis=1)
+        np.testing.assert_array_equal(got, full)
+        assert state2.length == prompt.shape[1] + 12
+
+    def test_new_turn_matches_fresh_generation(self, cfg, params):
+        """Ingesting a second 'user turn' through the state must equal
+        generating from the full concatenated history."""
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        rng = np.random.default_rng(21)
+        p1 = rng.integers(0, cfg.vocab, (2, 8)).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab, (2, 5)).astype(np.int32)
+        out1, state = lm_generate(
+            params, p1, cfg, steps=4, return_state=True, max_len=40
+        )
+        gen2, _ = lm_generate_continue(
+            params, state, cfg, steps=6, new_tokens=p2
+        )
+        # fresh run over the concatenated history (p1 + generated + p2)
+        history = np.concatenate([np.asarray(out1), p2], axis=1)
+        want = np.asarray(
+            lm_generate(params, history, cfg, steps=6)
+        )[:, history.shape[1]:]
+        np.testing.assert_array_equal(np.asarray(gen2), want)
+
+    def test_continue_composes_with_features(self):
+        """rope + GQA + bf16 + int8 cache through the state handoff."""
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        cfg = LMConfig(
+            vocab=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            n_kv_heads=2, rope=True, compute_dtype="bfloat16",
+            kv_cache_dtype="int8",
+        )
+        p = init_lm(jax.random.PRNGKey(6), cfg)
+        prompt = np.random.default_rng(22).integers(0, 32, (2, 8)).astype(
+            np.int32
+        )
+        full = np.asarray(lm_generate(p, prompt, cfg, steps=10))
+        part, state = lm_generate(
+            p, prompt, cfg, steps=4, return_state=True, max_len=18
+        )
+        gen2, _ = lm_generate_continue(p, state, cfg, steps=6)
+        got = np.concatenate([np.asarray(part), np.asarray(gen2)], axis=1)
+        np.testing.assert_array_equal(got, full)
+
+    def test_capacity_validation(self, cfg, params):
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        prompt = np.zeros((1, 4), np.int32)
+        with pytest.raises(ValueError, match="max_len"):
+            lm_generate(params, prompt, cfg, steps=8, max_len=10)
+        _, state = lm_generate(
+            params, prompt, cfg, steps=2, return_state=True
+        )  # capacity exactly 6: no headroom
+        with pytest.raises(ValueError, match="cache slots"):
+            lm_generate_continue(params, state, cfg, steps=1)
+
+    def test_ingest_only_then_generate(self, cfg, params):
+        """steps=0 + new_tokens is the 'absorb the turn now, generate
+        later' call; the later generation must equal single-shot over
+        the concatenated history (the boundary slot's re-write is an
+        identical deterministic recompute)."""
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        rng = np.random.default_rng(24)
+        p1 = rng.integers(0, cfg.vocab, (2, 7)).astype(np.int32)
+        p2 = rng.integers(0, cfg.vocab, (2, 4)).astype(np.int32)
+        out1, state = lm_generate(
+            params, p1, cfg, steps=3, return_state=True, max_len=30
+        )
+        empty, state = lm_generate_continue(
+            params, state, cfg, steps=0, new_tokens=p2
+        )
+        assert empty.shape == (2, 0)
+        gen, _ = lm_generate_continue(params, state, cfg, steps=5)
+        history = np.concatenate([np.asarray(out1), p2], axis=1)
+        want = np.asarray(
+            lm_generate(params, history, cfg, steps=5)
+        )[:, history.shape[1]:]
+        np.testing.assert_array_equal(np.asarray(gen), want)
+        # steps=0 with no tokens is a no-op
+        noop, st2 = lm_generate_continue(params, state, cfg, steps=0)
+        assert noop.shape == (2, 0) and st2.length == state.length
+
+    def test_growing_length_does_not_recompile(self, cfg, params):
+        """state.length is a traced operand: same-(m, steps) turns at
+        different conversation lengths share one compiled program."""
+        from parameter_server_tpu.models.transformer import (
+            _lm_continue_jit,
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        prompt = np.zeros((1, 4), np.int32)
+        _, state = lm_generate(
+            params, prompt, cfg, steps=2, return_state=True, max_len=64
+        )
+        before = None
+        for _ in range(3):  # three turns, three different lengths
+            _, state = lm_generate_continue(params, state, cfg, steps=3)
+            size = _lm_continue_jit._cache_size()
+            if before is not None:
+                assert size == before, "continuation recompiled per turn"
+            before = size
+
+    def test_sampled_continuation_reproducible(self, cfg, params):
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        prompt = np.random.default_rng(23).integers(
+            0, cfg.vocab, (2, 6)
+        ).astype(np.int32)
+        _, state = lm_generate(
+            params, prompt, cfg, steps=3, return_state=True, max_len=20,
+        )
+        a, _ = lm_generate_continue(
+            params, state, cfg, steps=5, temperature=0.9,
+            key=jax.random.PRNGKey(1),
+        )
+        b, _ = lm_generate_continue(
+            params, state, cfg, steps=5, temperature=0.9,
+            key=jax.random.PRNGKey(1),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestInt8KVCache:
     """kv_cache_dtype="int8": per-token symmetric int8 cache storage.
     The quant error budget: scale = rowmax/127, so |dequant - x| <=
