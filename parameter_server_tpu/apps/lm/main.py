@@ -89,6 +89,11 @@ def main(argv=None) -> int:
                     "bf16's traffic again; decode is cache-bandwidth-"
                     "bound under GQA). Generation only — training is "
                     "unaffected")
+    ap.add_argument("--log-file", metavar="PATH", default=None,
+                    help="append one JSON line per report interval "
+                    "(step, loss, bits/byte, eval loss when measured, "
+                    "tokens/sec, wall time) — machine-readable training "
+                    "telemetry beside the printed table")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler device trace of the "
                     "training loop into DIR (TensorBoard profile / "
@@ -447,24 +452,66 @@ def main(argv=None) -> int:
           + (f" (+{eval_corpus.size} held out)" if eval_corpus is not None
              else ""))
     print(f"{'step':>5} {'loss':>9} {'bits/byte':>10}")
+    import json as _json
+    import time as _time
+
     from ...utils.profiling import device_trace
 
+    log_f = open(args.log_file, "a") if args.log_file else None
+    t_start = _time.perf_counter()
+    last_t, last_i = t_start, start_step
     try:
         with device_trace(args.profile):
             for i in range(start_step + spl, args.steps + 1, spl):
                 params, opt, loss = step(params, opt, *launch_data())
-                if i % args.report_every < spl or i == args.steps:
+                report = i % args.report_every < spl or i == args.steps
+                ev = None
+                rec = None
+                if report:
                     ll = float(loss)
                     print(f"{i:>5} {ll:>9.4f} {ll / np.log(2):>10.4f}",
                           flush=True)
+                    # throughput window closes BEFORE any eval below so
+                    # held-out evaluation never pollutes tokens_per_sec
+                    now = _time.perf_counter()
+                    rec = {
+                        "step": i,
+                        "wall_s": round(now - t_start, 2),
+                        "loss": round(ll, 6),
+                        "bits_per_byte": round(ll / float(np.log(2)), 6),
+                        "tokens_per_sec": round(
+                            (i - last_i) * args.batch * args.seq_len
+                            / max(now - last_t, 1e-9),
+                            1,
+                        ),
+                    }
+                    last_t, last_i = now, i
                 if eval_fn is not None and (
                     i % args.eval_every < spl or i == args.steps
                 ):
-                    el = eval_fn(params)
+                    ev_t0 = _time.perf_counter()
+                    ev = eval_fn(params)
+                    # shift the open window past the eval's wall time
+                    last_t += _time.perf_counter() - ev_t0
                     print(
-                        f" eval@{i:<4} {el:>8.4f} {el / np.log(2):>10.4f}",
+                        f" eval@{i:<4} {ev:>8.4f} {ev / np.log(2):>10.4f}",
                         flush=True,
                     )
+                # telemetry: a line per report interval, PLUS a line for
+                # any eval measured off the report grid (an eval curve
+                # point must never be silently dropped from the log)
+                if log_f is not None and (rec is not None or ev is not None):
+                    if rec is None:
+                        rec = {
+                            "step": i,
+                            "wall_s": round(
+                                _time.perf_counter() - t_start, 2
+                            ),
+                        }
+                    if ev is not None:
+                        rec["eval_loss"] = round(float(ev), 6)
+                    log_f.write(_json.dumps(rec) + "\n")
+                    log_f.flush()
                 if mgr is not None and (
                     i == args.steps
                     or (args.save_every and i % args.save_every == 0)
@@ -476,6 +523,8 @@ def main(argv=None) -> int:
                     # next training steps.
                     mgr.save_async(i, {"params": params, "opt": opt})
     finally:
+        if log_f is not None:
+            log_f.close()
         if mgr is not None:
             # drain even when the loop raises: the daemon writer thread
             # would otherwise be killed at interpreter exit (the atomic

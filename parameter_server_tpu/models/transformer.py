@@ -671,16 +671,26 @@ def _pick_token(logits, k_step, temperature, top_p, *, greedy, top_k,
 
 @dataclasses.dataclass(frozen=True)
 class GenState:
-    """Resumable generation state (multi-turn serving): the KV caches,
-    the last emitted token (whose cache slot is NOT yet written — the
-    same boundary invariant speculative decoding uses), and how many
-    tokens exist. ``capacity`` (cache slots) bounds how far
-    :func:`lm_generate_continue` can extend. Opaque to callers."""
+    """Resumable generation state (multi-turn serving). Opaque to
+    callers. ``capacity`` (cache slots) bounds how far
+    :func:`lm_generate_continue` can extend.
+
+    Two boundary shapes exist, and the state records which:
+    ``boundary_cached=False`` — the last token's cache slot is NOT yet
+    written (a generation scan ended; the same invariant speculative
+    decoding uses) and the continuation processes it first.
+    ``boundary_cached=True`` — every token's slot IS written (prefill-
+    only or ingest-only states) and ``last_logits`` carries the next-
+    token logits so the continuation never recomputes (and never
+    re-writes) an already-cached slot: every path stays EXACTLY equal
+    to single-shot generation."""
 
     kcache: tuple
     vcache: tuple
     last_tok: jax.Array  # [B] int32
     length: int  # tokens emitted so far (prompt + generated)
+    boundary_cached: bool = False
+    last_logits: "jax.Array | None" = None  # [B, vocab], f32
 
     @property
     def capacity(self) -> int:
@@ -792,11 +802,15 @@ def lm_generate(
     )
     if not return_state:
         return out
-    *rest, kcache, vcache = out
+    *rest, last_logits, kcache, vcache = out
     toks = rest[0]
     state = GenState(
         kcache=kcache, vcache=vcache, last_tok=toks[:, total - 1],
         length=total,
+        # steps=0: prefill wrote EVERY slot; the prompt's next-token
+        # logits ride along so a continuation never re-touches slots
+        boundary_cached=steps == 0,
+        last_logits=last_logits,
     )
     return (*rest, state) if len(rest) > 1 else (toks, state)
 
@@ -827,8 +841,8 @@ def _lm_generate_jit(
             top_k=top_k, has_top_p=has_top_p,
         )
 
-    def ret(*main):
-        return (*main, kcache, vcache) if return_state else (
+    def ret(*main, last_logits=None):
+        return (*main, last_logits, kcache, vcache) if return_state else (
             main if len(main) > 1 else main[0]
         )
 
@@ -839,10 +853,12 @@ def _lm_generate_jit(
     )
     if steps == 0:
         # contract: total-1 logit rows (row t predicts token t+1); the
-        # last prompt position's prediction has no output slot here
-        return ret(toks, prefill_logits[:, :-1]) if return_logits else ret(
-            toks
-        )
+        # last prompt position's prediction has no output slot here —
+        # it rides into the GenState instead (boundary_cached)
+        last = prefill_logits[:, -1]
+        if return_logits:
+            return ret(toks, prefill_logits[:, :-1], last_logits=last)
+        return ret(toks, last_logits=last)
     key, k0 = jax.random.split(key)
     toks = toks.at[:, p_len].set(pick(prefill_logits[:, -1], k0))
 
@@ -897,11 +913,11 @@ def lm_generate_continue(
     the one the state was created with (the caches carry its layout).
 
     ``steps=0`` with ``new_tokens`` is the ingest-only call ("absorb
-    the user's turn now, generate later"): the returned state's
-    boundary slot is then ALREADY cached, and the next continuation
-    re-writes it with identical values (same token, same position,
-    same prefix — a deterministic recompute), so the boundary
-    invariant degrades to a harmless one-slot rewrite.
+    the user's turn now, generate later"): the returned state carries
+    ``boundary_cached=True`` plus the turn's next-token logits, so the
+    follow-up continuation starts from those logits and never touches
+    an already-written cache slot — every path stays exactly equal to
+    single-shot generation.
 
     ``state.length`` rides as a TRACED operand: turns of the same
     (new-turn width, steps) shape reuse one compiled program no matter
@@ -927,25 +943,29 @@ def lm_generate_continue(
         )
     if new_tokens is None:
         new_tokens = jnp.zeros((state.last_tok.shape[0], 0), jnp.int32)
-    gen, kcache, vcache, last = _lm_continue_jit(
+    gen, kcache, vcache, last, last_logits = _lm_continue_jit(
         params, state.kcache, state.vcache, state.last_tok,
-        new_tokens.astype(jnp.int32), jnp.int32(state.length),
-        temperature, top_p_arr, key,
+        state.last_logits, new_tokens.astype(jnp.int32),
+        jnp.int32(state.length), temperature, top_p_arr, key,
         cfg=cfg, steps=steps, top_k=top_k,
         has_top_p=top_p is not None, greedy=greedy,
+        boundary_cached=state.boundary_cached,
     )
     return gen, GenState(
-        kcache=kcache, vcache=vcache, last_tok=last, length=need
+        kcache=kcache, vcache=vcache, last_tok=last, length=need,
+        boundary_cached=steps == 0, last_logits=last_logits,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "top_k", "has_top_p", "greedy"),
+    static_argnames=("cfg", "steps", "top_k", "has_top_p", "greedy",
+                     "boundary_cached"),
 )
 def _lm_continue_jit(
-    params, kcache, vcache, last_tok, new_tokens, length, temperature,
-    top_p, key, *, cfg, steps, top_k, has_top_p, greedy,
+    params, kcache, vcache, last_tok, last_logits, new_tokens, length,
+    temperature, top_p, key, *, cfg, steps, top_k, has_top_p, greedy,
+    boundary_cached,
 ):
     b, m = new_tokens.shape
 
@@ -955,21 +975,36 @@ def _lm_continue_jit(
             top_k=top_k, has_top_p=has_top_p,
         )
 
-    # ingest [last_tok, new turn] as one chunk: writes the boundary
-    # token's pending cache slot (length-1) plus the turn's slots; the
-    # final row's logits predict the first generated token
-    chunk = jnp.concatenate([last_tok[:, None], new_tokens], axis=1)
-    logits_c, kcache, vcache = _chunk_decode(
-        params, cfg, chunk, kcache, vcache,
-        jnp.full((b,), length - 1, jnp.int32),
-    )
-    if steps == 0:  # ingest-only (m > 0): see the wrapper docstring
+    if boundary_cached:
+        # every existing slot is written (prefill-/ingest-only state):
+        # ingest ONLY the new turn at positions length..length+m-1; with
+        # no new turn the carried last_logits already predict the next
+        # token (m=0 AND steps=0 was dispatched in the wrapper)
+        if m > 0:
+            logits_c, kcache, vcache = _chunk_decode(
+                params, cfg, new_tokens, kcache, vcache,
+                jnp.full((b,), length, jnp.int32),
+            )
+            src_logits = logits_c[:, -1]
+        else:
+            src_logits = last_logits
+    else:
+        # ingest [last_tok, new turn] as one chunk: writes the boundary
+        # token's pending cache slot (length-1) plus the turn's slots;
+        # the final row's logits predict the first generated token
+        chunk = jnp.concatenate([last_tok[:, None], new_tokens], axis=1)
+        logits_c, kcache, vcache = _chunk_decode(
+            params, cfg, chunk, kcache, vcache,
+            jnp.full((b,), length - 1, jnp.int32),
+        )
+        src_logits = logits_c[:, -1]
+    if steps == 0:  # ingest-only: hand the logits to the next turn
         return (
             jnp.zeros((b, 0), jnp.int32), kcache, vcache,
-            new_tokens[:, -1],
+            new_tokens[:, -1], src_logits,
         )
     key, k0 = jax.random.split(key)
-    first = pick(logits_c[:, -1], k0)
+    first = pick(src_logits, k0)
     start = length + m  # absolute position of the first generated token
     gen = jnp.zeros((b, steps), jnp.int32).at[:, 0].set(first)
 
@@ -988,7 +1023,7 @@ def _lm_continue_jit(
         (gen, kcache, vcache, _), _ = jax.lax.scan(
             body, (gen, kcache, vcache, key), jnp.arange(steps - 1)
         )
-    return gen, kcache, vcache, gen[:, -1]
+    return gen, kcache, vcache, gen[:, -1], None
 
 
 def lm_loss(params, tokens, cfg, mesh, axis="data"):

@@ -41,6 +41,14 @@ def resume_cli(capsys, ck, *extra):
     return capsys.readouterr().out
 
 
+def _write_corpus(tmp_path):
+    """8-periodic corpus shared by the corpus-consuming CLI tests: the
+    model should get well under 1 bit/byte on it fast."""
+    f = tmp_path / "corpus.txt"
+    f.write_bytes(b"abcdefgh" * 4096)
+    return f
+
+
 def test_lm_cli_trains_and_generates(mesh8, capsys):
     out, losses = run_cli(capsys)
     assert losses[-1] < losses[0], losses
@@ -61,10 +69,7 @@ def test_lm_cli_flash_window_remat(mesh8, capsys):
 
 
 def test_lm_cli_corpus_file(mesh8, capsys, tmp_path):
-    f = tmp_path / "corpus.txt"
-    f.write_bytes(b"abcdefgh" * 4096)
-    out, losses = run_cli(capsys, "--data", str(f))
-    # 8-periodic text: the model should get well under 1 bit/byte fast
+    out, losses = run_cli(capsys, "--data", str(_write_corpus(tmp_path)))
     assert losses[-1] < 0.7 * losses[0], losses
 
 
@@ -106,6 +111,29 @@ def test_lm_cli_fsdp(mesh8, capsys, tmp_path):
     run_cli(capsys, "--fsdp", "--num-servers", "2", "--ckpt-dir", ck)
     out = resume_cli(capsys, ck, "--fsdp", "--num-servers", "2")
     assert "resumed from step 30" in out
+
+
+def test_lm_cli_log_file(mesh8, capsys, tmp_path):
+    """--log-file appends one JSON line per report interval (full
+    telemetry), plus a line for every eval measured OFF the report
+    grid — no eval-curve point is ever dropped from the log."""
+    import json
+
+    log = tmp_path / "train.jsonl"
+    run_cli(  # report grid 10/20/30; eval grid 6/12/18/24/30
+        capsys, "--log-file", str(log), "--eval-every", "6",
+        "--data", str(_write_corpus(tmp_path)),
+    )
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    assert recs, "no telemetry written"
+    assert [r["step"] for r in recs] == sorted(r["step"] for r in recs)
+    full = [r for r in recs if "tokens_per_sec" in r]
+    assert [r["step"] for r in full] == [10, 20, 30], full
+    for r in full:
+        assert {"step", "loss", "bits_per_byte", "wall_s"} <= set(r)
+        assert r["tokens_per_sec"] > 0
+    evals = [r["step"] for r in recs if "eval_loss" in r]
+    assert evals == [6, 12, 18, 24, 30], evals  # off-grid ones kept
 
 
 def test_lm_cli_profile_trace(mesh8, capsys, tmp_path):
@@ -162,10 +190,9 @@ def test_lm_cli_training_hygiene_flags(mesh8, capsys):
 def test_lm_cli_eval_holdout(mesh8, capsys, tmp_path):
     """--eval-every scores fixed held-out batches the model never
     trains on, printed alongside the train rows."""
-    f = tmp_path / "corpus.txt"
-    f.write_bytes(b"abcdefgh" * 4096)
     out, losses = run_cli(
-        capsys, "--data", str(f), "--eval-every", "10",
+        capsys, "--data", str(_write_corpus(tmp_path)), "--eval-every",
+        "10",
     )
     assert "held out" in out
     evals = [

@@ -616,6 +616,27 @@ class TestGenerateContinue:
                 assert size == before, "continuation recompiled per turn"
             before = size
 
+    def test_prefill_only_state_is_exact(self, cfg, params):
+        """steps=0 generate: prefill wrote EVERY slot, so the state is
+        boundary_cached and the continuation starts from the carried
+        logits — exactly equal to single-shot, no slot recomputed."""
+        from parameter_server_tpu.models.transformer import (
+            lm_generate,
+            lm_generate_continue,
+        )
+
+        rng = np.random.default_rng(25)
+        prompt = rng.integers(0, cfg.vocab, (2, 9)).astype(np.int32)
+        _, state = lm_generate(
+            params, prompt, cfg, steps=0, return_state=True, max_len=25
+        )
+        assert state.boundary_cached and state.last_logits is not None
+        gen, _ = lm_generate_continue(params, state, cfg, steps=8)
+        want = np.asarray(
+            lm_generate(params, prompt, cfg, steps=8)
+        )[:, prompt.shape[1]:]
+        np.testing.assert_array_equal(np.asarray(gen), want)
+
     def test_sampled_continuation_reproducible(self, cfg, params):
         from parameter_server_tpu.models.transformer import (
             lm_generate,
