@@ -199,7 +199,7 @@ def _finish(rec: dict) -> None:
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
 
-def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: float = 120.0):
+def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: float = 120.0):
     """Fail fast when the accelerator is unreachable: returns None when
     healthy, else a human-readable diagnosis (timeout vs crash, with the
     child's stderr tail).
@@ -210,8 +210,15 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: floa
     can emit an explicit error JSON line instead of hanging the driver.
     Wedges are often TRANSIENT (the relay times out the dead claim), so
     a failed probe is retried ``attempts`` times with a pause — a bench
-    run should not be zeroed by a hiccup that clears in two minutes."""
+    run should not be zeroed by a hiccup that clears in two minutes.
+    The default budget (10 attempts x 120s wait + 180s probe) rides out
+    a ~45-minute wedge — round 3's 2-retry budget gave up in 10 minutes
+    against a wedge that cleared later, zeroing the round artifact.
+    Each retry refreshes the priority marker so the watcher stays away
+    for the whole probing window."""
     import subprocess
+
+    from parameter_server_tpu.utils.device_lock import request_priority
 
     # self-contained inline copy of mesh.honor_jax_platforms: the probe
     # diagnoses DEVICE health, so it must not also depend on the whole
@@ -232,6 +239,7 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 3, retry_wait_s: floa
                 file=sys.stderr,
             )
             time.sleep(retry_wait_s)
+        request_priority("bench-probe")
         try:
             r = subprocess.run(
                 [sys.executable, "-c", probe_src],
@@ -843,6 +851,15 @@ def run_real(args) -> int:
 
 
 def main() -> int:
+    # a supervisor (watcher/driver) stopping the bench sends SIGTERM;
+    # convert to SystemExit so the tunnel client's atexit/GC gets a
+    # shot at releasing its device claim (a hard-killed client has
+    # wedged the relay for hours — probe_device docstring)
+    import contextlib as _ctx
+    import signal as _signal
+
+    with _ctx.suppress(ValueError):  # non-main thread: leave it
+        _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(143))
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI)")
     ap.add_argument("--minibatch", type=int, default=16384)
@@ -890,16 +907,37 @@ def main() -> int:
     # entirely; a holder's child skips via PS_DEVICE_LOCK_HELD.
     import contextlib
 
-    from parameter_server_tpu.utils.device_lock import device_lock
+    from parameter_server_tpu.utils.device_lock import (
+        clear_priority,
+        device_lock,
+    )
 
     lock = (
         contextlib.nullcontext(True) if args.smoke  # CPU-bound: no lock
-        else device_lock()
+        # priority_note announces BEFORE waiting on the flock (and
+        # keeps the marker fresh however long the wait runs): the
+        # watcher yields — preempting its running task child — within
+        # seconds, so the round driver's bench, the artifact of
+        # record, never waits out a full watcher task, let alone
+        # 5700s. After the bound, keep waiting and ACQUIRE (never run
+        # unlocked: the watcher would collide the moment the previous
+        # holder exits and frees the flock).
+        else device_lock(block_after_timeout=True, priority_note="bench")
     )
     with lock:
-        diagnosis = probe_device()
-        if diagnosis is not None:
-            return emit_device_error(diagnosis)
+        try:
+            diagnosis = probe_device()
+            if diagnosis is not None:
+                return emit_device_error(diagnosis)
+        finally:
+            # unconditional: probe_device writes a marker even on a
+            # --smoke run (which skips the request above), and a
+            # leaked marker idles the watcher for the full freshness
+            # window. The flock itself keeps the watcher off the
+            # device from here on; dropping the marker the moment
+            # probing ends also means a crashed bench never idles the
+            # watcher long.
+            clear_priority()
         global _WATCHDOG
         _WATCHDOG = Watchdog(
             "criteo_real_examples_per_sec"

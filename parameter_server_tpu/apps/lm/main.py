@@ -460,6 +460,7 @@ def main(argv=None) -> int:
     log_f = open(args.log_file, "a") if args.log_file else None
     t_start = _time.perf_counter()
     last_t, last_i = t_start, start_step
+    loop_raised = False
     try:
         with device_trace(args.profile):
             for i in range(start_step + spl, args.steps + 1, spl):
@@ -522,6 +523,15 @@ def main(argv=None) -> int:
                     # here (donation-safe), the disk write overlaps the
                     # next training steps.
                     mgr.save_async(i, {"params": params, "opt": opt})
+    except BaseException:
+        # an explicit flag, NOT sys.exc_info(): inside the drain's
+        # except handler below exc_info reports the exception BEING
+        # HANDLED (always true there), and even read at the top of the
+        # finally it reports handled exceptions from CALLER frames —
+        # both readings swallowed a save failure on a clean run (exit
+        # 0 with the final checkpoint missing)
+        loop_raised = True
+        raise
     finally:
         if log_f is not None:
             log_f.close()
@@ -536,7 +546,7 @@ def main(argv=None) -> int:
                 # an async-save failure is the primary error only when
                 # the loop exited cleanly — never mask the loop's own
                 # exception (or a Ctrl-C) with the drain's
-                if sys.exc_info()[0] is None:
+                if not loop_raised:
                     raise
                 print(f"async checkpoint failure during shutdown: {e}",
                       file=sys.stderr)

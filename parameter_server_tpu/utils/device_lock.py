@@ -26,6 +26,17 @@ exclusion cannot exist, proceed).
 Children spawned BY a lock holder must not re-acquire — holders export
 ``PS_DEVICE_LOCK_HELD=1`` (via :func:`held_env`) and ``device_lock``
 becomes a no-op under it.
+
+**Priority protocol** (round 4): the round driver's ``bench.py`` run is
+the artifact of record, so the watcher must never make it wait. A
+process that needs the device *now* calls :func:`request_priority`
+before waiting on the flock; cooperative background holders (the
+watcher) poll :func:`foreign_priority` and (a) stop probing/starting
+tasks while a fresh foreign request exists, and (b) preempt a running
+task child to release the lock within seconds. The requester clears
+its marker via :func:`clear_priority` on exit; a crashed requester's
+marker simply ages out (``PRIORITY_FRESH_S``). The marker is advisory
+— it changes who *waits*, never who may run.
 """
 
 from __future__ import annotations
@@ -42,6 +53,11 @@ HELD_ENV = "PS_DEVICE_LOCK_HELD"
 
 #: above the longest WATCHER-side hold (bench_real task timeout: 5400s)
 WAIT_ABOVE_LONGEST_HOLD_S = 5700.0
+
+#: a priority request younger than this keeps cooperative holders away
+#: (covers the requester's probe retries and inter-phase gaps; a crashed
+#: requester's stale marker costs at most this much watcher idle time)
+PRIORITY_FRESH_S = 1800.0
 
 
 class LockResult:
@@ -62,44 +78,147 @@ class LockResult:
         return f"LockResult({self.acquired}, {self.reason!r})"
 
 
-def _open_lock_file() -> int:
+def _lock_path() -> str:
+    return os.environ.get(LOCK_ENV, "/tmp/ps_tpu_device.lock")
+
+
+def _open_lock_file() -> "int | None":
     """Open (creating if needed) the lock file. The shared /tmp path is
     chmod'd world-writable so a second user can take the same lock; if
     another user's umask already made it unwritable for us, fall back
-    to a per-uid path (loses cross-user exclusion, never crashes the
-    caller's JSON contract)."""
-    path = os.environ.get(LOCK_ENV, "/tmp/ps_tpu_device.lock")
+    to a per-uid path (loses cross-user exclusion). Returns None when
+    no lock file can be opened at all (e.g. /tmp unwritable) — the
+    caller reports "unsupported", never crashes the JSON contract."""
+    path = _lock_path()
     try:
         fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
         with contextlib.suppress(OSError):
             os.chmod(path, 0o666)  # defeat the creator's umask
         return fd
     except OSError:
-        fallback = f"{path}.{os.getuid()}"
-        return os.open(fallback, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            fallback = f"{path}.{os.getuid()}"
+            return os.open(fallback, os.O_CREAT | os.O_RDWR, 0o666)
+        except OSError:
+            return None
+
+
+# -- priority requests ------------------------------------------------------
+
+def _request_path() -> str:
+    return _lock_path() + ".request"
+
+
+def request_priority(note: str = "bench") -> None:
+    """Mark that THIS process needs the device now. Cooperative
+    background holders (the watcher) yield while the marker is fresh.
+    Atomic write; never raises (a priority marker is best-effort)."""
+    path = _request_path()
+    try:
+        tmp = f"{path}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(f"{os.getpid()} {time.time():.0f} {note}\n")
+        os.replace(tmp, path)
+        with contextlib.suppress(OSError):
+            os.chmod(path, 0o666)
+    except OSError:
+        pass
+
+
+def clear_priority() -> None:
+    """Remove OUR priority marker (a foreign one is left alone)."""
+    path = _request_path()
+    try:
+        with open(path) as f:
+            pid = int(f.read().split()[0])
+        if pid == os.getpid():
+            os.unlink(path)
+    except (OSError, ValueError, IndexError):
+        pass
+
+
+def foreign_priority(fresh_s: float = PRIORITY_FRESH_S) -> "str | None":
+    """A fresh priority request from ANOTHER process, or None.
+
+    Returns a short human-readable description ("pid 123 note, 45s
+    ago") for the yielding side's log. A marker from a dead pid is
+    still honored while fresh — the requester may be a shell whose
+    python child does the device work."""
+    path = _request_path()
+    try:
+        with open(path) as f:
+            parts = f.read().split(None, 2)
+        pid = int(parts[0])
+        stamp = float(parts[1])
+        note = parts[2].strip() if len(parts) > 2 else "?"
+    except (OSError, ValueError, IndexError):
+        return None
+    if pid == os.getpid() or os.environ.get(HELD_ENV):
+        return None  # our own request (or our holder parent's)
+    age = time.time() - stamp
+    # the marker stamp is written at whole-second precision, so a
+    # just-written marker can read up to 0.5s "in the future"; allow a
+    # small negative age, reject real clock skew
+    if not (-60 <= age < fresh_s):
+        return None  # stale (or clock-skewed far into the future)
+    return f"pid {pid} ({note}), {age:.0f}s ago"
 
 
 @contextlib.contextmanager
 def device_lock(
-    timeout_s: float = WAIT_ABOVE_LONGEST_HOLD_S, poll_s: float = 5.0
+    timeout_s: float = WAIT_ABOVE_LONGEST_HOLD_S,
+    poll_s: float = 5.0,
+    block_after_timeout: bool = False,
+    priority_note: "str | None" = None,
 ) -> Iterator[LockResult]:
     """Hold the device flock for the enclosed block.
 
     Yields a truthy :class:`LockResult` when the lock was acquired (or
     a parent holds it); falsy with ``reason`` "busy"/"unsupported"
     otherwise — the block still runs either way, callers choose their
-    policy from the reason (see module docstring)."""
+    policy from the reason (see module docstring).
+
+    ``block_after_timeout=True`` (the bench's policy): when the wait
+    bound expires, KEEP polling until the holder releases and take the
+    lock then, instead of running unlocked — a lockless bench would
+    let the watcher's next task collide with it the moment the
+    original holder exits. The overrun is disclosed on stderr each
+    extra minute so a wedged holder is visible in the driver's log.
+
+    ``priority_note`` makes the wait a PRIORITY wait: the request
+    marker is written on entry and re-written while polling (every
+    ``PRIORITY_FRESH_S/3``), so it cannot age out under a wait longer
+    than the freshness window — a stale marker would let the watcher
+    win the flock race against the very caller the protocol
+    prioritizes. The caller still owns clearing it (clear_priority)
+    when its device need ends."""
     if os.environ.get(HELD_ENV):
         yield LockResult(True, "held-by-parent")
         return
     import fcntl
 
     fd = _open_lock_file()
+    if fd is None:
+        print(
+            "device_lock: no lock file could be opened; "
+            "no exclusion possible",
+            file=sys.stderr,
+        )
+        yield LockResult(False, "unsupported")
+        return
     res = LockResult(False, "busy")
     t0 = time.monotonic()
     warned_wait = False
+    overrun_said = 0.0
+    refreshed = time.monotonic()
+    if priority_note is not None:
+        request_priority(priority_note)
     try:
         while True:
+            if (priority_note is not None
+                    and time.monotonic() - refreshed > PRIORITY_FRESH_S / 3):
+                request_priority(priority_note)
+                refreshed = time.monotonic()
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                 res = LockResult(True, "acquired")
@@ -116,15 +235,26 @@ def device_lock(
                     )
                     res = LockResult(False, "unsupported")
                     break
-                if time.monotonic() - t0 >= timeout_s:
-                    if timeout_s > 0:
+                waited = time.monotonic() - t0
+                if waited >= timeout_s:
+                    if not block_after_timeout:
+                        if timeout_s > 0:
+                            print(
+                                f"device_lock: holder outlived the "
+                                f"{timeout_s:.0f}s wait",
+                                file=sys.stderr,
+                            )
+                        break
+                    if waited - overrun_said >= 60.0:
                         print(
-                            f"device_lock: holder outlived the "
-                            f"{timeout_s:.0f}s wait",
+                            f"device_lock: holder past the "
+                            f"{timeout_s:.0f}s bound ({waited:.0f}s); "
+                            "still waiting to acquire (will not run "
+                            "unlocked)",
                             file=sys.stderr,
                         )
-                    break
-                if not warned_wait:
+                        overrun_said = waited
+                elif not warned_wait:
                     # a silent multi-minute block is indistinguishable
                     # from a wedge — say what we're doing, once
                     print(

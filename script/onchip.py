@@ -33,10 +33,12 @@ is our equivalent discipline for the single tunneled chip.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -849,8 +851,18 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
     """(ok, diagnosis). A nonzero exit is a deterministic CRASH (bad
     install/env — retrying won't help, surface the stderr tail); a
     timeout is the tunnel wedge (transient, keep retrying)."""
-    from parameter_server_tpu.utils.device_lock import device_lock, held_env
+    from parameter_server_tpu.utils.device_lock import (
+        device_lock,
+        foreign_priority,
+        held_env,
+    )
 
+    req = foreign_priority()
+    if req:
+        # a driver/interactive bench announced it needs the device —
+        # don't even probe (two concurrent tunnel clients wedge each
+        # other); stay away while the request is fresh
+        return False, f"yielding to priority request ({req})"
     with device_lock(timeout_s=0) as got:
         if not got and got.reason == "busy":
             # another process (a driver/interactive bench) is on the
@@ -873,15 +885,30 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
 
 
 def run_task(name: str, argv, timeout_s: int) -> "bool | None":
-    """True = ok, False = failed, None = deferred (device busy — does
-    not consume an attempt; a live bench may hold the device for
-    hours, and the watcher's job is to wait its turn, never collide)."""
-    from parameter_server_tpu.utils.device_lock import device_lock, held_env
+    """True = ok, False = failed, None = deferred (device busy or
+    preempted by a priority request — does not consume an attempt; a
+    live bench may hold the device for hours, and the watcher's job is
+    to wait its turn, never collide).
+
+    While the task child runs, a foreign priority request (the round
+    driver's bench announcing itself — see utils/device_lock.py)
+    PREEMPTS it: the child is killed, its partial JSON is appended with
+    a preempted marker, and the flock is released within ~2s so the
+    requester never waits out a 5400s task hold."""
+    from parameter_server_tpu.utils.device_lock import (
+        device_lock,
+        foreign_priority,
+        held_env,
+    )
 
     if argv is None:
         argv = [sys.executable, os.path.abspath(__file__), "--task", name]
     elif SMOKE:
         argv = argv + ["--smoke"]
+    req = foreign_priority()
+    if req:
+        _wlog(f"task {name}: deferred (yielding to priority request {req})")
+        return None
     # hold the device flock for the child's whole run so a driver
     # bench starting mid-task waits instead of colliding; the child
     # sees PS_DEVICE_LOCK_HELD and does not re-acquire
@@ -896,28 +923,70 @@ def run_task(name: str, argv, timeout_s: int) -> "bool | None":
             _wlog(f"task {name}: lock acquired after {waited:.0f}s wait")
         _wlog(f"task {name}: starting ({' '.join(argv)})")
         t0 = time.perf_counter()
-        try:
-            r = subprocess.run(
-                argv, timeout=timeout_s, capture_output=True, text=True,
+        preempted = None
+        timed_out = False
+
+        def _stop(p):
+            # SIGTERM + grace before SIGKILL: the child is a live
+            # tunnel client, and a SIGKILLed client has left the
+            # relay's claim/grant protocol stuck for hours (bench.py
+            # probe_device docstring) — a graceful exit lets it
+            # release its claim, which is the whole point of handing
+            # the device over quickly
+            p.terminate()
+            try:
+                p.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+        with tempfile.TemporaryFile(mode="w+") as fout, \
+                tempfile.TemporaryFile(mode="w+") as ferr:
+            p = subprocess.Popen(
+                argv, stdout=fout, stderr=ferr, text=True,
                 cwd=REPO, env=held_env(),
             )
-            out, rc = r.stdout, r.returncode
-            err_tail = "\n".join(r.stderr.strip().splitlines()[-4:])
-        except subprocess.TimeoutExpired as e:
-            out = (e.stdout or b"").decode(errors="replace") if isinstance(
-                e.stdout, bytes) else (e.stdout or "")
-            rc = -1
+            rc = None
+            while True:
+                try:
+                    rc = p.wait(timeout=2.0)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if time.perf_counter() - t0 > timeout_s:
+                    timed_out = True
+                    _stop(p)
+                    rc = p.returncode
+                    break
+                req = foreign_priority()
+                if req:
+                    preempted = req
+                    _stop(p)
+                    rc = p.returncode
+                    break
+            fout.seek(0)
+            out = fout.read()
+            ferr.seek(0)
+            err_tail = "\n".join(ferr.read().strip().splitlines()[-4:])
+        if timed_out:
             err_tail = f"TIMEOUT after {timeout_s}s"
         dt = time.perf_counter() - t0
-    lines = [f"\n## {_now()} — {name} (rc={rc}, {dt:.0f}s)", "```"]
+    if preempted:
+        _wlog(f"task {name}: PREEMPTED after {dt:.0f}s "
+              f"(priority request {preempted}); lock released")
+    lines = [f"\n## {_now()} — {name} (rc={rc}, {dt:.0f}s"
+             + (", preempted by priority request" if preempted else "")
+             + ")", "```"]
     json_lines = [
         ln for ln in out.splitlines() if ln.startswith("{")
     ]
     lines += json_lines or ["(no JSON output)"]
-    if rc != 0 and err_tail:
+    if rc != 0 and not preempted and err_tail:
         lines += [f"stderr: {err_tail}"]
     lines += ["```"]
     _append_log(lines)
+    if preempted:
+        return None  # not an attempt; retried after the requester's turn
     ok = rc == 0 and bool(json_lines)
     _wlog(f"task {name}: {'ok' if ok else 'FAILED'} in {dt:.0f}s")
     return ok
@@ -987,6 +1056,14 @@ def watch(args) -> int:
 
 
 def main() -> int:
+    # the watcher preempts task children with SIGTERM (grace before
+    # SIGKILL); default disposition would terminate without running
+    # Python finalizers — convert to SystemExit so the tunnel client
+    # gets its atexit/GC shot at releasing the device claim
+    import signal
+
+    with contextlib.suppress(ValueError):  # non-main thread: leave it
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     ap = argparse.ArgumentParser()
     ap.add_argument("--task", choices=sorted(INTERNAL))
     ap.add_argument("--watch", action="store_true")

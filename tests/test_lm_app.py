@@ -90,6 +90,30 @@ def test_lm_cli_checkpoint_resume(mesh8, capsys, tmp_path, extra):
     assert [int(r[0]) for r in rows] == [35, 40], rows
 
 
+def test_lm_cli_async_save_failure_fails_clean_run(mesh8, tmp_path,
+                                                   monkeypatch):
+    """An async checkpoint-save failure on a CLEAN run must propagate
+    (the '--ckpt-dir always saves the final step' resume contract) —
+    r3 advisor: sys.exc_info() read INSIDE the except handler always
+    saw the drain's own RuntimeError, so the CLI swallowed the failure
+    and exited 0 with the final checkpoint missing."""
+    from parameter_server_tpu.parameter import replica
+
+    def boom(self, path, host_tree):
+        raise OSError("disk full (simulated)")
+
+    monkeypatch.setattr(replica.CheckpointManager, "_write", boom)
+    with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        main(
+            [
+                "--steps", "4", "--seq-len", "64", "--batch", "2",
+                "--d-model", "32", "--n-heads", "2", "--d-ff", "64",
+                "--report-every", "4",
+                "--ckpt-dir", str(tmp_path / "ck"),
+            ]
+        )
+
+
 def test_lm_cli_tensor_parallel(mesh8, capsys):
     # sp x tp on one 2-D mesh: 4 data x 2 server, flash attention
     out, losses = run_cli(

@@ -357,3 +357,83 @@ class TestDeviceLock:
         # nested use under a holding parent: no flock call, reports held
         with device_lock(timeout_s=0) as a, device_lock(timeout_s=0) as b:
             assert a and b
+
+    def test_block_after_timeout_acquires_not_skips(
+        self, tmp_path, monkeypatch
+    ):
+        """ADVICE r3: on wait-bound expiry the bench must KEEP waiting
+        and take the lock when freed — never proceed unlocked (a
+        lockless bench lets the watcher collide once the holder
+        exits). Holder releases 0.4s in; contender's bound is 0.1s."""
+        import threading
+        import time as _t
+
+        from parameter_server_tpu.utils.device_lock import device_lock
+
+        monkeypatch.setenv("PS_DEVICE_LOCK", str(tmp_path / "dev.lock"))
+        monkeypatch.delenv("PS_DEVICE_LOCK_HELD", raising=False)
+        release = threading.Event()
+
+        def holder():
+            with device_lock(timeout_s=0) as got:
+                assert got
+                release.wait(5)
+
+        th = threading.Thread(target=holder)
+        # flock exclusion is per-(fd); same-process threads DO contend
+        # through separate device_lock() calls (each opens its own fd)
+        th.start()
+        _t.sleep(0.1)
+        threading.Timer(0.4, release.set).start()
+        with device_lock(
+            timeout_s=0.1, poll_s=0.02, block_after_timeout=True
+        ) as got:
+            # acquired AFTER the bound because the holder released
+            assert got and got.reason == "acquired"
+        th.join()
+
+    def test_priority_request_roundtrip(self, tmp_path, monkeypatch):
+        """request/clear/foreign visibility: one's own request is never
+        'foreign'; another pid's fresh request is; stale ages out."""
+        import os
+        import time as _t
+
+        import parameter_server_tpu.utils.device_lock as dl
+
+        monkeypatch.setenv("PS_DEVICE_LOCK", str(tmp_path / "dev.lock"))
+        monkeypatch.delenv("PS_DEVICE_LOCK_HELD", raising=False)
+        assert dl.foreign_priority() is None  # no marker at all
+        dl.request_priority("bench")
+        assert dl.foreign_priority() is None  # our own marker
+        # forge another process's marker (pid+1, fresh stamp)
+        with open(dl._request_path(), "w") as f:
+            f.write(f"{os.getpid() + 1} {_t.time():.0f} bench\n")
+        seen = dl.foreign_priority()
+        assert seen and "bench" in seen
+        # stale marker is ignored
+        with open(dl._request_path(), "w") as f:
+            f.write(f"{os.getpid() + 1} {_t.time() - 1e6:.0f} bench\n")
+        assert dl.foreign_priority() is None
+        # clear_priority leaves a FOREIGN marker alone
+        with open(dl._request_path(), "w") as f:
+            f.write(f"{os.getpid() + 1} {_t.time():.0f} bench\n")
+        dl.clear_priority()
+        assert dl.foreign_priority() is not None
+        # ...but removes our own
+        dl.request_priority("bench")
+        dl.clear_priority()
+        assert not os.path.exists(dl._request_path())
+
+    def test_priority_suppressed_under_held_env(self, tmp_path, monkeypatch):
+        """A lock-holder's child must not yield to its own parent's
+        request marker (the bench's children run under HELD_ENV)."""
+        import os
+        import time as _t
+
+        import parameter_server_tpu.utils.device_lock as dl
+
+        monkeypatch.setenv("PS_DEVICE_LOCK", str(tmp_path / "dev.lock"))
+        with open(dl._request_path(), "w") as f:
+            f.write(f"{os.getpid() + 1} {_t.time():.0f} bench\n")
+        monkeypatch.setenv("PS_DEVICE_LOCK_HELD", "1")
+        assert dl.foreign_priority() is None
