@@ -1133,12 +1133,10 @@ class AsyncSGDWorker(ISGDCompNode):
         # manager.cc NodeAdd / Range::EvenDivide). Padded tail slots are
         # storage only — never addressed.
         self.directory = KeyDirectory(sgd.num_slots, hashed=True)
-        self.state = jax.tree.map(
-            lambda leaf: jax.device_put(
-                leaf,
-                NamedSharding(mesh, P(SERVER_AXIS) if leaf.ndim >= 1 else P()),
-            ),
-            self.updater.init(self.num_slots),
+        # direct-to-sharded init (no transient whole-array copy — the
+        # 2^30-table OOM lesson; rationale at meshlib.init_sharded)
+        self.state = meshlib.init_sharded(
+            lambda: self.updater.init(self.num_slots), mesh
         )
         # step functions cached per (encoding, binary, with_aux)
         self._steps: Dict[Tuple[str, bool, bool], object] = {}

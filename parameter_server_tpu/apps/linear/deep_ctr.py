@@ -215,12 +215,11 @@ class DeepCTRWorker(ISGDCompNode):
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         self.directory = KeyDirectory(sgd.num_slots, hashed=True)
         rng = np.random.default_rng(seed)
-        sharding = lambda nd: NamedSharding(  # noqa: E731
-            mesh, P(SERVER_AXIS, *([None] * (nd - 1)))
-        )
         dims = (self.lanes * self.k,) + self.hidden + (1,)
         mlp = []
         for d_in, d_out in zip(dims[:-1], dims[1:]):
+            # the MLP is small and replicated: host init is fine (and
+            # keeps He-init reproducibility with numpy rng)
             mlp.append(
                 jnp.asarray(
                     rng.normal(0.0, np.sqrt(2.0 / d_in), (d_in, d_out)),
@@ -228,28 +227,23 @@ class DeepCTRWorker(ISGDCompNode):
                 )
             )
             mlp.append(jnp.zeros((d_out,), jnp.float32))
+
+        # the server-sharded TABLE (the scale-bearing state) goes
+        # direct-to-sharded (rationale at meshlib.init_sharded)
+        def _init_table():
+            n, k = self.num_slots, self.k
+            return {
+                "w": jnp.zeros((n,), jnp.float32),
+                "w_ss": jnp.zeros((n,), jnp.float32),
+                "v": v_init_std * jax.random.normal(
+                    jax.random.PRNGKey(seed), (n, k), jnp.float32
+                ),
+                "v_ss": jnp.zeros((n, k), jnp.float32),
+            }
+
+        table = meshlib.init_sharded(_init_table, mesh)
         self.state = {
-            "table": {
-                "w": jax.device_put(
-                    jnp.zeros((self.num_slots,), jnp.float32), sharding(1)
-                ),
-                "w_ss": jax.device_put(
-                    jnp.zeros((self.num_slots,), jnp.float32), sharding(1)
-                ),
-                "v": jax.device_put(
-                    jnp.asarray(
-                        rng.normal(
-                            0.0, v_init_std, (self.num_slots, self.k)
-                        ),
-                        jnp.float32,
-                    ),
-                    sharding(2),
-                ),
-                "v_ss": jax.device_put(
-                    jnp.zeros((self.num_slots, self.k), jnp.float32),
-                    sharding(2),
-                ),
-            },
+            "table": table,
             "mlp": mlp,
             "mlp_ss": [jnp.zeros_like(p) for p in mlp],
             "b": jnp.zeros((), jnp.float32),

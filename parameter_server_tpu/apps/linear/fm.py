@@ -184,30 +184,22 @@ class FMWorker(ISGDCompNode):
         )
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         self.directory = KeyDirectory(sgd.num_slots, hashed=True)
-        rng = np.random.default_rng(seed)
-        sharding = lambda nd: NamedSharding(  # noqa: E731
-            mesh, P(SERVER_AXIS, *([None] * (nd - 1)))
-        )
-        self.state = {
-            "w": jax.device_put(
-                jnp.zeros((self.num_slots,), jnp.float32), sharding(1)
-            ),
-            "w_ss": jax.device_put(
-                jnp.zeros((self.num_slots,), jnp.float32), sharding(1)
-            ),
-            "v": jax.device_put(
-                jnp.asarray(
-                    rng.normal(0.0, v_init_std, (self.num_slots, self.k)),
-                    jnp.float32,
+        # direct-to-sharded init (rationale at meshlib.init_sharded);
+        # v uses on-device PRNG so the table never crosses the host link
+        def _init():
+            n, k = self.num_slots, self.k
+            return {
+                "w": jnp.zeros((n,), jnp.float32),
+                "w_ss": jnp.zeros((n,), jnp.float32),
+                "v": v_init_std * jax.random.normal(
+                    jax.random.PRNGKey(seed), (n, k), jnp.float32
                 ),
-                sharding(2),
-            ),
-            "v_ss": jax.device_put(
-                jnp.zeros((self.num_slots, self.k), jnp.float32), sharding(2)
-            ),
-            "b": jnp.zeros((), jnp.float32),
-            "b_ss": jnp.zeros((), jnp.float32),
-        }
+                "v_ss": jnp.zeros((n, k), jnp.float32),
+                "b": jnp.zeros((), jnp.float32),
+                "b_ss": jnp.zeros((), jnp.float32),
+            }
+
+        self.state = meshlib.init_sharded(_init, mesh)
         self._step = make_fm_step(
             mesh, self.num_slots, self.k, self.loss, self.penalty, self.lr,
             v_lr_scale,
