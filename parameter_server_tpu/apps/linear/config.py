@@ -128,7 +128,11 @@ _ENUMS = {
     "CONSTANT": "constant", "DECAY": "decay", "FTRL": "ftrl",
     "STANDARD": "standard", "TEXT": "text", "LIBSVM": "libsvm",
     "CRITEO": "criteo", "ADFEA": "adfea", "TERAFEA": "terafea",
-    "BIN": "bin", "PROTO": "record",
+    # a reference .conf declaring PROTO means the REFERENCE's binary
+    # format (protobuf Example recordio, data/ref_interop.py) — that is
+    # what its readers consume as DataConfig.PROTO; this repo's own
+    # crc-framed batches keep the separate "record" format name
+    "BIN": "bin", "PROTO": "ref_record",
     "SPARSE": "ps_sparse", "SPARSE_BINARY": "ps_sparse_binary",
     "DENSE": "ps_dense", "KEY_CACHING": "key_caching",
     "COMPRESSING": "compressing", "FIXING_FLOAT": "fixing_float",

@@ -67,7 +67,10 @@ class StreamReader:
     def __init__(self, files: List[str], data_format: str = "libsvm"):
         self.files = psfile.expand_globs(files)
         self.format = data_format
-        self.parser = ExampleParser(data_format) if data_format != "record" else None
+        self.parser = (
+            ExampleParser(data_format)
+            if data_format not in ("record", "ref_record") else None
+        )
 
     def _lines(self) -> Iterator[str]:
         for path in self.files:
@@ -81,10 +84,34 @@ class StreamReader:
                 for payload in recordio.RecordReader(f):
                     yield batch_from_bytes(payload)
 
+    def _ref_record_batches(self, size: int) -> Iterator[SparseBatch]:
+        """Reference-produced protobuf Example recordio files
+        (data/ref_interop.py; ref src/util/recordio.h + example.proto):
+        one Example per record, grouped here into SparseBatches."""
+        from .ref_interop import (
+            decode_example,
+            example_slots_to_row,
+            iter_ref_records,
+            rows_to_batch,
+        )
+
+        rows: List = []
+        for path in self.files:
+            for payload in iter_ref_records(path):
+                rows.append(example_slots_to_row(decode_example(payload)))
+                if len(rows) >= size:
+                    yield rows_to_batch(rows)
+                    rows = []
+        if rows:
+            yield rows_to_batch(rows)
+
     def minibatches(self, size: int) -> Iterator[SparseBatch]:
         """Yield batches of ``size`` examples (last may be smaller)."""
         if self.format == "record":
             yield from rebatch(self._record_batches(), size)
+            return
+        if self.format == "ref_record":
+            yield from self._ref_record_batches(size)
             return
         lines: List[str] = []
         for line in self._lines():

@@ -4,7 +4,13 @@ binary record files, which StreamReader reads back with format="record".
 
     python -m parameter_server_tpu.data.text2record \\
         --input data/part-* --format criteo --output data/part.rec \\
-        [--batch 65536]
+        [--batch 65536] [--ref-format]
+
+``--ref-format`` writes the REFERENCE's binary format instead —
+protobuf ``Example`` records in magic-framed recordio
+(data/ref_interop.py; ref src/util/recordio.h + example.proto) — so a
+converted dataset is consumable by a reference process, and reads back
+here with format="ref_record".
 """
 
 from __future__ import annotations
@@ -29,18 +35,39 @@ def convert(inputs, data_format: str, output: str, batch_size: int = 65536) -> i
     return n
 
 
+def convert_ref(inputs, data_format: str, output: str, batch_size: int = 65536) -> int:
+    """Text -> reference protobuf Example recordio (one record per
+    example, ref recordio.h framing owned by ref_interop)."""
+    from .ref_interop import batch_to_ref_payloads, write_ref_records
+
+    reader = StreamReader(list(inputs), data_format)
+    return write_ref_records(
+        output,
+        (
+            payload
+            for batch in reader.minibatches(batch_size)
+            for payload in batch_to_ref_payloads(batch)
+        ),
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--input", nargs="+", required=True)
     ap.add_argument("--format", default="libsvm")
     ap.add_argument("--output", required=True)
     ap.add_argument("--batch", type=int, default=65536)
+    ap.add_argument(
+        "--ref-format", action="store_true",
+        help="write the reference's protobuf Example recordio format",
+    )
     args = ap.parse_args(argv)
     files = psfile.expand_globs(args.input)
     if not files:
         print(f"no input files match {args.input}", file=sys.stderr)
         return 2
-    n = convert(files, args.format, args.output, args.batch)
+    fn = convert_ref if args.ref_format else convert
+    n = fn(files, args.format, args.output, args.batch)
     print(f"wrote {n} examples to {args.output}")
     return 0
 

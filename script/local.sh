@@ -44,16 +44,20 @@ for ((i = N - 1; i >= 0; i--)); do
 done
 
 # fail fast: if any child exits nonzero, kill the siblings instead of
-# letting them block in the rendezvous until the coordinator timeout
+# letting them block in the rendezvous until the coordinator timeout.
+# wait -n -p disambiguates "no children left" from a child that itself
+# exited 127 (command not found): 127 with no reaped pid = drained.
 rc=0
-while true; do
+remaining=${#pids[@]}
+while (( remaining > 0 )); do
   r=0
-  wait -n 2>/dev/null || r=$?
+  reaped=""
+  wait -n -p reaped "${pids[@]}" 2>/dev/null || r=$?
+  if [[ -z ${reaped} ]]; then break; fi  # set drained
+  remaining=$((remaining - 1))
   if (( r != 0 )); then
-    if (( r == 127 )); then break; fi  # no children left
     if (( rc == 0 )); then rc=$r; fi   # keep the FIRST failure, not SIGTERMs
     cleanup
   fi
-  if [ -z "$(jobs -pr)" ]; then break; fi
 done
 exit "$rc"

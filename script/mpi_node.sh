@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Per-rank adapter for cluster launchers (mpirun/srun): translate the
+# launcher's rank/size environment into the framework's multi-host env
+# contract (PS_PROCESS_ID / PS_NUM_PROCESSES / PS_COORDINATOR_ADDRESS,
+# consumed by parallel/distributed.init_distributed) and exec the
+# training command.
+#
+# TPU-native counterpart of the reference's script/mpi_node.sh, which
+# maps PMI_RANK/OMPI_COMM_WORLD_RANK onto scheduler/server/worker
+# process roles. Here there is ONE SPMD program per host — roles are
+# mesh axes — so the only thing rank decides is the process id, and
+# process 0 doubles as the coordinator (the reference's scheduler).
+#
+# Usage (normally via mpi_root.sh):
+#   mpi_node.sh <coordinator_host:port> <command...>
+#
+# Rank sources, in order: OpenMPI, MPICH/PMI, Slurm, PS_PROCESS_ID
+# already set by a custom launcher.
+set -euo pipefail
+if (( $# < 2 )); then
+  echo "usage: mpi_node.sh <coordinator_host:port> <command...>" >&2
+  exit 2
+fi
+COORD=$1; shift
+
+if [[ -n ${OMPI_COMM_WORLD_RANK:-} ]]; then
+  rank=${OMPI_COMM_WORLD_RANK}; size=${OMPI_COMM_WORLD_SIZE}
+elif [[ -n ${PMI_RANK:-} ]]; then
+  rank=${PMI_RANK}; size=${PMI_SIZE}
+elif [[ -n ${SLURM_PROCID:-} ]]; then
+  rank=${SLURM_PROCID}; size=${SLURM_NTASKS}
+elif [[ -n ${PS_PROCESS_ID:-} && -n ${PS_NUM_PROCESSES:-} ]]; then
+  rank=${PS_PROCESS_ID}; size=${PS_NUM_PROCESSES}
+else
+  echo "mpi_node.sh: no rank found (OMPI_COMM_WORLD_RANK / PMI_RANK / \
+SLURM_PROCID / PS_PROCESS_ID all unset)" >&2
+  exit 1
+fi
+
+ROOT=$(cd "$(dirname "$0")/.." && pwd)
+export PYTHONPATH="${ROOT}${PYTHONPATH:+:$PYTHONPATH}"
+export PS_COORDINATOR_ADDRESS="${COORD}"
+export PS_NUM_PROCESSES="${size}"
+export PS_PROCESS_ID="${rank}"
+exec "$@"

@@ -62,3 +62,33 @@ def test_local_sh_n_hosts(nproc):
     lm = re.findall(r"PS_LM_OK ([0-9.]+)", proc.stdout)
     assert len(lm) == nproc, proc.stdout[-2000:]
     assert len(set(lm)) == 1, lm
+
+
+def test_mpi_root_sh_4_ranks():
+    """script/mpi_root.sh (the reference's mpi_root.sh/mpi_node.sh
+    twins): ranks reach the SAME multihost training path through the
+    mpi_node.sh env adapter — with no MPI runtime installed the
+    launcher emulates local ranks, and mpi_node.sh still performs the
+    rank->PS_* translation (the part a real mpirun would exercise
+    per-host)."""
+    import re
+
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    env["PS_PORT"] = str(_free_port())
+    env["PS_LOCAL_DEVICES"] = "2"
+    # force the emulation branch even on machines WITH an MPI runtime —
+    # this test pins the adapter/emulation path, not mpirun itself
+    env["PS_MPIRUN"] = "/nonexistent/mpirun-for-test"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "script", "mpi_root.sh"), "4",
+         sys.executable, os.path.join(REPO, "tests", "multihost_child.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "emulating 4 local ranks" in proc.stderr
+    oks = re.findall(r"PS_OK (\d+)", proc.stdout)
+    assert len(oks) == 4 and len(set(oks)) == 1, proc.stdout[-2000:]
