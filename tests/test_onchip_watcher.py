@@ -148,6 +148,35 @@ def test_run_task_preempts_running_child_on_request(onchip, tmp_path):
     assert "PREEMPTED" in open(onchip.WATCH_LOG).read()
 
 
+def test_session_stats_median_and_match(onchip, tmp_path):
+    """Cross-session medians read prior SAME-CONFIG captures from the
+    evidence log; mismatched device_kind/shape records are excluded."""
+    with open(onchip.LOG_MD, "w") as f:
+        f.write(
+            '{"metric": "m", "value": 100.0, "device_kind": "TPU v5 lite"}\n'
+            '{"metric": "m", "value": 300.0, "device_kind": "TPU v5 lite"}\n'
+            '{"metric": "m", "value": 9.0, "device_kind": "cpu"}\n'
+            '{"metric": "m", "value": 7.0}\n'  # missing key = excluded
+            '{"metric": "other", "value": 1.0, "device_kind": "TPU v5 lite"}\n'
+            '{"metric": "m", "value": 0, "device_kind": "TPU v5 lite"}\n'
+            '{"metric": "m", "val'  # half-written tail must not break it
+        )
+    st = onchip.session_stats(
+        "m", 200.0, {"device_kind": "TPU v5 lite"}
+    )
+    assert st["sessions"] == 3  # 100, 300 prior + this 200; cpu excluded
+    assert st["median_across_sessions"] == 200.0
+    assert st["session_spread"] == 1.0  # (300-100)/200
+    # no log at all: this run is its own (only) session
+    onchip.LOG_MD = str(tmp_path / "missing.md")
+    st = onchip.session_stats("m", 50.0)
+    assert st == {
+        "sessions": 1,
+        "median_across_sessions": 50.0,
+        "session_spread": 0.0,
+    }
+
+
 def test_probe_yields_to_foreign_request(onchip, tmp_path):
     """probe() must not even spawn the device-touching child while a
     fresh foreign request exists (two tunnel clients wedge each
