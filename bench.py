@@ -28,6 +28,7 @@ main lever.
 """
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -339,24 +340,30 @@ def tree_host_nbytes(prepped) -> int:
     )
 
 
+def timed_upload(prepped):
+    """(staged_tree, seconds): device_put timed until every array has
+    really LANDED — fetch one element of EVERY leaf, because device_put
+    is async and block_until_ready under-waits on the tunneled
+    backend."""
+    import jax
+
+    t0 = time.perf_counter()
+    dev = jax.device_put(prepped)
+    for leaf in jax.tree.leaves(dev):
+        np.asarray(leaf.ravel()[:1])
+    return dev, time.perf_counter() - t0
+
+
 def measure_upload_mb_s(prepped, reps: int = 3) -> float:
     """Median host->device bandwidth moving a real prepped batch (the
     tunnel drifts several x over minutes; see README)."""
-    import jax
-
     nbytes = tree_host_nbytes(prepped)
     obs = []
     for _ in range(reps):
         _beat()
         _grace_for_transfer(nbytes)
-        t0 = time.perf_counter()
-        dev = jax.device_put(prepped)
-        # fetch one element of EVERY leaf: device_put is async and
-        # block_until_ready under-waits on the tunneled backend, so the
-        # clock must not stop until each array has really landed
-        for leaf in jax.tree.leaves(dev):
-            np.asarray(leaf.ravel()[:1])
-        obs.append(nbytes / (time.perf_counter() - t0) / 1e6)
+        _, sec = timed_upload(prepped)
+        obs.append(nbytes / sec / 1e6)
     return float(np.median(obs))
 
 
@@ -412,6 +419,95 @@ def flush(worker):
     import jax
 
     np.asarray(jax.tree.leaves(worker.state)[0][:1])
+
+
+def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
+                    profile_dir: "str | None" = None) -> dict:
+    """Serialized prep -> upload -> device timing for a few launches.
+
+    The pipelined e2e loops overlap these stages (that is the point of
+    the pipeline), which also HIDES where a launch's time goes — r3
+    verdict: "1.018x with 96% of the roofline unexplained". Outside the
+    timed windows, run each stage to completion with a flush between:
+    the sum exceeds a pipelined launch (overlap removed) but the RATIO
+    answers which stage bounds the pipeline. ``profile_dir`` wraps the
+    first launch's device step in a jax.profiler trace
+    (utils/profiling.device_trace) for op-level attribution."""
+    import jax
+
+    from parameter_server_tpu.utils.profiling import device_trace
+
+    prep_s = up_s = dev_s = 0.0
+    bytes_moved = 0
+    for i in range(launches):
+        _beat()
+        t0 = time.perf_counter()
+        sb = stack_supersteps(make_parts(i), T)
+        prep_s += time.perf_counter() - t0
+        nb = tree_host_nbytes(sb)
+        bytes_moved += nb
+        _grace_for_transfer(nb)
+        staged, sec_up = timed_upload(sb)
+        up_s += sec_up
+        ctx = (
+            device_trace(profile_dir) if (profile_dir and i == 0)
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
+        with ctx:
+            worker.executor.wait(
+                worker._submit_prepped(staged, with_aux=False)
+            )
+            flush(worker)
+        dev_s += time.perf_counter() - t0
+    total = prep_s + up_s + dev_s
+    out = {
+        "breakdown_launches": launches,
+        "breakdown_prep_s_per_launch": round(prep_s / launches, 4),
+        "breakdown_upload_s_per_launch": round(up_s / launches, 4),
+        "breakdown_device_s_per_launch": round(dev_s / launches, 4),
+        "breakdown_bound": max(
+            (prep_s, "host_prep"), (up_s, "upload"), (dev_s, "device")
+        )[1],
+        "breakdown_fracs": {
+            "host_prep": round(prep_s / total, 3),
+            "upload": round(up_s / total, 3),
+            "device": round(dev_s / total, 3),
+        } if total else None,
+    }
+    if up_s:
+        out["breakdown_upload_mb_s"] = round(bytes_moved / up_s / 1e6, 1)
+    if profile_dir:
+        out["profile_dir"] = profile_dir
+    return out
+
+
+def reconcile_link_ceiling(rec: dict, bytes_moved: int, done_ex: int,
+                           dt: float) -> None:
+    """Make the link-bound ceiling consistent with what the e2e phase
+    itself observed (r3 verdict: e2e beat its own 'ceiling' by 1.6x —
+    the probe-based MB/s was measured at a different moment on a link
+    that drifts several x over minutes). The phase's own achieved wire
+    rate (bytes actually staged / phase wall time) is a PROVEN lower
+    bound on link capacity during the phase; the published ceiling uses
+    whichever of probe/achieved is higher, with both disclosed."""
+    if not (bytes_moved and done_ex and dt):
+        return
+    bpe = bytes_moved / done_ex
+    achieved_mb_s = bytes_moved / dt / 1e6
+    rec["e2e_bytes_per_example"] = round(bpe, 1)
+    rec["e2e_achieved_wire_mb_s"] = round(achieved_mb_s, 1)
+    probe = rec.get("host_to_device_mb_s")
+    used = max(achieved_mb_s, probe or 0.0)
+    rec["link_mb_s_used_for_ceiling"] = round(used, 1)
+    rec["link_bound_examples_per_sec_at_measured_mb_s"] = round(
+        used * 1e6 / bpe, 1
+    )
+    if probe and achieved_mb_s > probe:
+        rec["link_probe_underestimated"] = (
+            "in-phase achieved wire rate exceeded the probe's MB/s — "
+            "the probe hit a throttled stretch; ceiling uses achieved"
+        )
 
 
 def stack_supersteps(parts, t: int):
@@ -784,6 +880,22 @@ def run_real(args) -> int:
             "parity_ok": parity_ok,
         },
     )
+    # serialized stage pricing (localize+pack / upload / device) — the
+    # --real stream adds PARSE on top, priced by comparing e2e below.
+    # Guarded + re-beaten (see run_synthetic's breakdown note).
+    try:
+        headline.update(phase_breakdown(
+            worker,
+            lambda i: [
+                worker.prep(kept[(i * T + j) % len(kept)], device_put=False)
+                for j in range(T)
+            ],
+            T,
+            profile_dir=args.profile,
+        ))
+    except Exception as e:
+        headline["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    _beat("e2e", **headline)
 
     def prepped_stream():
         if multi_core:
@@ -811,6 +923,7 @@ def run_real(args) -> int:
     t0 = time.perf_counter()
     done_ex = 0
     skipped_tail = 0
+    wire_bytes_moved = 0
     pending = []
     parts = []
     for item in prepped_stream():
@@ -821,7 +934,9 @@ def run_real(args) -> int:
         parts = []
         done_ex += int(prepped.num_examples)
         _beat()
-        _grace_for_transfer(tree_host_nbytes(prepped))
+        nb = tree_host_nbytes(prepped)
+        wire_bytes_moved += nb  # actual staged bytes, not a dtype model
+        _grace_for_transfer(nb)
         pending.append(
             worker._submit_prepped(jax.device_put(prepped), with_aux=False)
         )
@@ -846,6 +961,7 @@ def run_real(args) -> int:
         "skipped_tail_rows": int(skipped_tail),
     }
     rec.update(headline)
+    reconcile_link_ceiling(rec, wire_bytes_moved, done_ex, dt)
     _finish(rec)
     return 0
 
@@ -855,10 +971,9 @@ def main() -> int:
     # convert to SystemExit so the tunnel client's atexit/GC gets a
     # shot at releasing its device claim (a hard-killed client has
     # wedged the relay for hours — probe_device docstring)
-    import contextlib as _ctx
     import signal as _signal
 
-    with _ctx.suppress(ValueError):  # non-main thread: leave it
+    with contextlib.suppress(ValueError):  # non-main thread: leave it
         _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(143))
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI)")
@@ -886,6 +1001,14 @@ def main() -> int:
         "amortizes the tunnel round trip",
     )
     ap.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler device trace of one serialized "
+        "launch into DIR (utils/profiling.device_trace; view in "
+        "TensorBoard/Perfetto)",
+    )
+    ap.add_argument(
         "--stall-timeout",
         type=float,
         default=300.0,
@@ -905,8 +1028,6 @@ def main() -> int:
     # the bound, and that timeout is disclosed on stderr before
     # proceeding. Smoke runs are CPU-bound and skip the lock
     # entirely; a holder's child skips via PS_DEVICE_LOCK_HELD.
-    import contextlib
-
     from parameter_server_tpu.utils.device_lock import (
         clear_priority,
         device_lock,
@@ -1013,6 +1134,8 @@ def run_synthetic(args) -> int:
     raw = [gen(i) for i in range(min(args.steps + args.warmup, 32))]
     worker._padding(raw[0])
 
+    wire_counter = {"bytes": 0}
+
     def prep_upload_submit(i: int):
         # with_aux=False: skip the per-example AUC outputs in the hot loop
         parts = [
@@ -1020,7 +1143,9 @@ def run_synthetic(args) -> int:
             for j in range(T)
         ]
         sb = stack_supersteps(parts, T)
-        _grace_for_transfer(tree_host_nbytes(sb))
+        nb = tree_host_nbytes(sb)
+        wire_counter["bytes"] += nb  # actual staged bytes, not a model
+        _grace_for_transfer(nb)
         return worker._submit_prepped(jax.device_put(sb), with_aux=False)
 
     # warmup (compile)
@@ -1057,6 +1182,24 @@ def run_synthetic(args) -> int:
         "depth of the disclosed sweep); "
         "e2e_median_window = prep+upload+step through the tunnel",
     )
+    # serialized stage pricing (+ optional device trace): which of
+    # prep/upload/device bounds the pipeline below. Guarded like
+    # device_only_sweep: a transient failure in these EXTRA launches
+    # must not cost the e2e phase; re-beat so a later wedge's partial
+    # record still carries the breakdown.
+    try:
+        headline.update(phase_breakdown(
+            worker,
+            lambda i: [
+                worker.prep(raw[(i * T + j) % len(raw)], device_put=False)
+                for j in range(T)
+            ],
+            T,
+            profile_dir=args.profile,
+        ))
+    except Exception as e:
+        headline["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    _beat("e2e", **headline)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes
     # (shared link), so a single long average is hostage to one throttled
@@ -1071,6 +1214,7 @@ def run_synthetic(args) -> int:
     window = max(5, n_launches // 5) if n_launches >= 5 else n_launches
     rates = []
     done = 0
+    wire_counter["bytes"] = 0  # count the TIMED phase only (not warmup)
     t0 = time.perf_counter()
     pending = []
     win_done, win_t0 = 0, t0
@@ -1106,6 +1250,9 @@ def run_synthetic(args) -> int:
         "best": round(max(rates), 1) if rates else None,
     }
     rec.update(headline)
+    reconcile_link_ceiling(
+        rec, wire_counter["bytes"], done * args.minibatch, dt
+    )
     _finish(rec)
     return 0
 
