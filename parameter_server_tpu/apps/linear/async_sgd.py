@@ -707,7 +707,7 @@ def make_train_step_ell(
             jnp.where(ok, g_flat, 0.0)
         )
         g_shard, touched = push_touched(g_shard, seed)
-        new_state = updater.apply(live, g_shard, touched)
+        new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
@@ -768,7 +768,7 @@ def _make_bits_mini_step(
             jnp.where(ok, g_flat, 0.0)
         )
         g_shard, touched = push_touched(g_shard, seed)
-        new_state = updater.apply(live, g_shard, touched)
+        new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
@@ -924,7 +924,7 @@ def make_train_step_hashed(
             jnp.where(ok, g_e, 0.0)
         )
         g_shard, touched = push_touched(g_shard, seed)
-        new_state = updater.apply(live, g_shard, touched)
+        new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
@@ -995,7 +995,7 @@ def make_train_step(
         g_shard, touched = push_touched(g_shard, seed)
 
         def apply_leafwise(state):
-            return updater.apply(state, g_shard, touched)
+            return updater.apply(state, g_shard, touched, seed=seed)
 
         new_state = apply_leafwise(live)
 
@@ -1103,7 +1103,10 @@ class AsyncSGDWorker(ISGDCompNode):
         self.lr = LearningRate(
             conf.learning_rate.type, conf.learning_rate.alpha, conf.learning_rate.beta
         )
-        self.updater = create_updater(sgd.algo, sgd.ada_grad, self.lr, self.penalty)
+        self.updater = create_updater(
+            sgd.algo, sgd.ada_grad, self.lr, self.penalty,
+            ftrl_state_dtype=sgd.ftrl_state_dtype,
+        )
 
         from ...parameter.parameter import KeyDirectory, pad_slots
 

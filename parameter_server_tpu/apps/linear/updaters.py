@@ -18,10 +18,38 @@ from __future__ import annotations
 
 from typing import Dict
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .learning_rate import LearningRate
 from .penalty import ElasticNet
+
+
+def _stochastic_round_bf16(x: jnp.ndarray, seed) -> jnp.ndarray:
+    """Unbiased f32 -> bf16 narrowing: add hash-derived uniform dither
+    in [0, 2^16) to the f32 bits, then truncate the low mantissa bits.
+
+    Deterministic truncation would make a bf16 accumulator SATURATE by
+    absorption — once ``n`` exceeds ~2^8 times the per-update
+    increment, ``n + dn`` rounds back to ``n`` every step and the
+    accumulator stops moving. With E[rounded] = x the accumulator
+    instead performs an unbiased walk and tracks the f32 trajectory in
+    expectation. The dither is a counter-based integer hash of
+    (position, seed) — cheap, stateless, vectorized; rounding dither
+    needs uniformity, not cryptographic quality."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    i = jax.lax.iota(jnp.uint32, x.shape[0] if x.ndim else 1)
+    h = (i * np.uint32(2654435761)) ^ (
+        jnp.uint32(seed) * np.uint32(0x9E3779B9)
+    )
+    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+    rnd = (h ^ (h >> 16)) & np.uint32(0xFFFF)
+    out = (bits + rnd) & np.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(
+        jnp.bfloat16
+    )
 
 
 class FTRLUpdater:
@@ -29,28 +57,46 @@ class FTRLUpdater:
 
         n' = sqrt(n² + g²); σ = (n' − n)/α; z += g − σ w; n = n'
         w = prox(−z·η, η),  η = lr.eval(n') = α/(n' + β)
+
+    ``sqrt_n_dtype="bfloat16"`` stores the gradient-magnitude
+    accumulator at half width (state 16 B/slot -> 12 B/slot; the
+    single-chip slot ceiling grows ~1.33x). All MATH stays f32 —
+    sqrt_n is widened at read and narrowed at write — and the narrow
+    is STOCHASTICALLY rounded when the caller passes a ``seed``
+    (the fused SPMD step does): deterministic truncation would stall
+    the accumulator by absorption once n >> per-update increment,
+    freezing the per-coordinate learning-rate decay for hot features
+    (see :func:`_stochastic_round_bf16`). Without a seed (the KVMap
+    entry protocol) the narrow truncates deterministically — fine for
+    short-lived tables, disclosed here. z, the model accumulator, is
+    always f32.
     """
 
-    def __init__(self, lr: LearningRate, penalty: ElasticNet):
+    def __init__(self, lr: LearningRate, penalty: ElasticNet,
+                 sqrt_n_dtype=jnp.float32):
         self.lr = lr
         self.penalty = penalty
+        self.sqrt_n_dtype = jnp.dtype(sqrt_n_dtype)
 
     def init(self, num_slots: int) -> Dict[str, jnp.ndarray]:
         return {
             "z": jnp.zeros(num_slots, jnp.float32),
-            "sqrt_n": jnp.zeros(num_slots, jnp.float32),
+            "sqrt_n": jnp.zeros(num_slots, self.sqrt_n_dtype),
         }
 
     def weights(self, state):
-        eta = self.lr.eval(state["sqrt_n"])
+        eta = self.lr.eval(state["sqrt_n"].astype(jnp.float32))
         return self.penalty.proximal(-state["z"] * eta, eta)
 
-    def apply(self, state, grad, touched):
-        z, sqrt_n = state["z"], state["sqrt_n"]
-        if self.lr.type == LearningRate.DECAY and z.ndim == 1:
+    def apply(self, state, grad, touched, seed=None):
+        z = state["z"]
+        sqrt_n = state["sqrt_n"].astype(jnp.float32)
+        if (self.lr.type == LearningRate.DECAY and z.ndim == 1
+                and self.sqrt_n_dtype == jnp.float32):
             # fused Pallas kernel (ops/ftrl.py): one HBM pass vs the XLA
             # elementwise chain on TPU; the op itself falls back to the
-            # reference path off-TPU and for non-tile-aligned shards
+            # reference path off-TPU and for non-tile-aligned shards.
+            # (bf16 sqrt_n takes the XLA chain — the cast fuses there.)
             from ...ops.ftrl import ftrl_update
 
             z_new, n_new = ftrl_update(
@@ -63,9 +109,14 @@ class FTRLUpdater:
         sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
         sigma = (sqrt_n_new - sqrt_n) / self.lr.alpha
         z_new = z + grad - sigma * w
+        masked_n = jnp.where(touched, sqrt_n_new, sqrt_n)
+        if self.sqrt_n_dtype == jnp.bfloat16 and seed is not None:
+            # untouched slots round-trip exactly (their f32 value IS a
+            # bf16 value), so the dither cannot drift idle slots
+            masked_n = _stochastic_round_bf16(masked_n, seed)
         return {
             "z": jnp.where(touched, z_new, z),
-            "sqrt_n": jnp.where(touched, sqrt_n_new, sqrt_n),
+            "sqrt_n": masked_n.astype(self.sqrt_n_dtype),
         }
 
 
@@ -86,7 +137,7 @@ class AdaGradUpdater:
     def weights(self, state):
         return state["w"]
 
-    def apply(self, state, grad, touched):
+    def apply(self, state, grad, touched, seed=None):
         sum_sq = state["sum_sq"] + grad * grad
         eta = self.lr.eval(jnp.sqrt(sum_sq))
         w = self.penalty.proximal(state["w"] - eta * grad, eta)
@@ -113,18 +164,19 @@ class SGDUpdater:
     def weights(self, state):
         return state["w"]
 
-    def apply(self, state, grad, touched):
+    def apply(self, state, grad, touched, seed=None):
         t = state["t"] + 1.0
         eta = self.lr.eval(jnp.sqrt(t))
         w = self.penalty.proximal(state["w"] - eta * grad, eta)
         return {"w": jnp.where(touched, w, state["w"]), "t": t}
 
 
-def create_updater(algo: str, ada_grad: bool, lr: LearningRate, penalty: ElasticNet):
+def create_updater(algo: str, ada_grad: bool, lr: LearningRate,
+                   penalty: ElasticNet, ftrl_state_dtype: str = "float32"):
     """ref AsyncSGDServer ctor dispatch (async_sgd.h:46-58)."""
     a = algo.lower()
     if a == "ftrl":
-        return FTRLUpdater(lr, penalty)
+        return FTRLUpdater(lr, penalty, sqrt_n_dtype=ftrl_state_dtype)
     if a == "standard":
         return AdaGradUpdater(lr, penalty) if ada_grad else SGDUpdater(lr, penalty)
     raise ValueError(f"unknown sgd algo: {algo}")

@@ -105,6 +105,58 @@ class TestConvergence:
         assert ev["logloss"] < 0.68  # below chance log(2)
         assert prog.num_examples_processed == 40 * 256
 
+    def test_ftrl_bf16_sqrt_n_tracks_f32(self, mesh8, w_true):
+        """ftrl_state_dtype='bfloat16' (12 B/slot instead of 16 — the
+        2^31 single-chip lever): sqrt_n mantissa loss perturbs only
+        the per-coordinate step-size schedule, so the final logloss
+        must track the f32 run closely and the state dtype must
+        actually be bf16."""
+        import jax.numpy as jnp
+
+        evs = {}
+        for dt in ("float32", "bfloat16"):
+            conf = make_conf(num_slots=4096)
+            conf.async_sgd.ftrl_state_dtype = dt
+            worker = AsyncSGDWorker(conf, mesh=mesh8)
+            assert worker.state["sqrt_n"].dtype == jnp.dtype(dt)
+            worker.train(synth(40, w_true))
+            evs[dt] = worker.evaluate(
+                random_sparse(2000, 512, 8, seed=999, w_true=w_true)
+            )
+        assert evs["bfloat16"]["logloss"] < 0.68
+        assert abs(
+            evs["bfloat16"]["logloss"] - evs["float32"]["logloss"]
+        ) < 5e-3, evs
+
+    def test_bf16_sqrt_n_no_absorption_stall(self):
+        """Stochastic rounding keeps the bf16 accumulator moving: with
+        deterministic truncation, sqrt(n^2+g^2) rounds back to n once
+        n > ~16|g| (bf16's 8-bit mantissa) and the per-coordinate LR
+        stops decaying forever. 4000 constant-gradient updates must
+        reach ~sqrt(T)|g| like f32, far past the ~8.0 stall point."""
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.apps.linear.learning_rate import (
+            LearningRate,
+        )
+        from parameter_server_tpu.apps.linear.penalty import ElasticNet
+        from parameter_server_tpu.apps.linear.updaters import FTRLUpdater
+
+        lr = LearningRate("decay", alpha=0.1, beta=1.0)
+        upd = FTRLUpdater(lr, ElasticNet(0.0, 0.0),
+                          sqrt_n_dtype="bfloat16")
+        state = upd.init(8)
+        g = jnp.full(8, 0.5, jnp.float32)
+        touched = jnp.ones(8, bool)
+        for i in range(4000):
+            state = upd.apply(state, g, touched, seed=np.uint32(i))
+        n = float(np.asarray(state["sqrt_n"].astype(jnp.float32))[0])
+        expect = float(np.sqrt(4000) * 0.5)  # f32 trajectory ~31.6
+        assert n > 25.0, (
+            f"bf16 sqrt_n stalled at {n} (absorption); expected ~{expect}"
+        )
+        assert n < 1.3 * expect, f"bf16 sqrt_n overshot: {n} vs {expect}"
+
     def test_adagrad_converges(self, mesh8, w_true):
         worker = AsyncSGDWorker(
             make_conf(algo="standard", ada_grad=True, num_slots=4096), mesh=mesh8
