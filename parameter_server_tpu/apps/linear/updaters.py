@@ -26,32 +26,6 @@ from .learning_rate import LearningRate
 from .penalty import ElasticNet
 
 
-def _stochastic_round_bf16(x: jnp.ndarray, seed) -> jnp.ndarray:
-    """Unbiased f32 -> bf16 narrowing: add hash-derived uniform dither
-    in [0, 2^16) to the f32 bits, then truncate the low mantissa bits.
-
-    Deterministic truncation would make a bf16 accumulator SATURATE by
-    absorption — once ``n`` exceeds ~2^8 times the per-update
-    increment, ``n + dn`` rounds back to ``n`` every step and the
-    accumulator stops moving. With E[rounded] = x the accumulator
-    instead performs an unbiased walk and tracks the f32 trajectory in
-    expectation. The dither is a counter-based integer hash of
-    (position, seed) — cheap, stateless, vectorized; rounding dither
-    needs uniformity, not cryptographic quality."""
-    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
-    i = jax.lax.iota(jnp.uint32, x.shape[0] if x.ndim else 1)
-    h = (i * np.uint32(2654435761)) ^ (
-        jnp.uint32(seed) * np.uint32(0x9E3779B9)
-    )
-    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
-    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
-    rnd = (h ^ (h >> 16)) & np.uint32(0xFFFF)
-    out = (bits + rnd) & np.uint32(0xFFFF0000)
-    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(
-        jnp.bfloat16
-    )
-
-
 class FTRLUpdater:
     """FTRL-proximal (ref FTRLEntry::Set, async_sgd.h:131-151):
 
@@ -66,10 +40,10 @@ class FTRLUpdater:
     (the fused SPMD step does): deterministic truncation would stall
     the accumulator by absorption once n >> per-update increment,
     freezing the per-coordinate learning-rate decay for hot features
-    (see :func:`_stochastic_round_bf16`). Without a seed (the KVMap
-    entry protocol) the narrow truncates deterministically — fine for
-    short-lived tables, disclosed here. z, the model accumulator, is
-    always f32.
+    (see ops/ftrl.py stochastic_round_bf16 / the kernel's on-core
+    PRNG). Without a seed (the KVMap entry protocol) the narrow
+    truncates deterministically — fine for short-lived tables,
+    disclosed here. z, the model accumulator, is always f32.
     """
 
     def __init__(self, lr: LearningRate, penalty: ElasticNet,
@@ -90,30 +64,32 @@ class FTRLUpdater:
 
     def apply(self, state, grad, touched, seed=None):
         z = state["z"]
-        sqrt_n = state["sqrt_n"].astype(jnp.float32)
-        if (self.lr.type == LearningRate.DECAY and z.ndim == 1
-                and self.sqrt_n_dtype == jnp.float32):
-            # fused Pallas kernel (ops/ftrl.py): one HBM pass vs the XLA
-            # elementwise chain on TPU; the op itself falls back to the
-            # reference path off-TPU and for non-tile-aligned shards.
-            # (bf16 sqrt_n takes the XLA chain — the cast fuses there.)
+        if self.lr.type == LearningRate.DECAY and z.ndim == 1:
+            # fused op (ops/ftrl.py): Pallas single-HBM-pass kernel on
+            # TPU (f32 AND bf16-sqrt_n variants — the bf16 kernel
+            # stochastically rounds with the on-core PRNG), jnp
+            # reference path elsewhere; the op owns every fallback
             from ...ops.ftrl import ftrl_update
 
             z_new, n_new = ftrl_update(
-                z, sqrt_n, grad, touched,
+                z, state["sqrt_n"], grad, touched,
                 alpha=self.lr.alpha, beta=self.lr.beta,
                 l1=self.penalty.lambda1, l2=self.penalty.lambda2,
+                seed=seed,
             )
             return {"z": z_new, "sqrt_n": n_new}
+        sqrt_n = state["sqrt_n"].astype(jnp.float32)
         w = self.weights(state)
         sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
         sigma = (sqrt_n_new - sqrt_n) / self.lr.alpha
         z_new = z + grad - sigma * w
         masked_n = jnp.where(touched, sqrt_n_new, sqrt_n)
         if self.sqrt_n_dtype == jnp.bfloat16 and seed is not None:
+            from ...ops.ftrl import stochastic_round_bf16
+
             # untouched slots round-trip exactly (their f32 value IS a
             # bf16 value), so the dither cannot drift idle slots
-            masked_n = _stochastic_round_bf16(masked_n, seed)
+            masked_n = stochastic_round_bf16(masked_n, seed)
         return {
             "z": jnp.where(touched, z_new, z),
             "sqrt_n": masked_n.astype(self.sqrt_n_dtype),

@@ -5,8 +5,15 @@ VMEM-resident pass: reads (z, √n, g, touched), emits (z', √n') with the
 weight derivation inlined, so the whole per-shard state update is a single
 HBM round trip. Grid tiles the slot dimension in (8,128)-aligned blocks.
 
+``sqrt_n`` may be stored bf16 (``SGDConfig.ftrl_state_dtype`` — 12
+B/slot table state): math widens to f32 and the write-back narrows with
+STOCHASTIC rounding (on-core PRNG in the kernel; hash dither in the jnp
+path) — deterministic truncation would saturate the accumulator by
+absorption once n >> per-update increment, freezing the per-coordinate
+learning-rate decay for hot features.
+
 ``ftrl_update(z, n, g, touched, ...)`` auto-selects: Pallas on TPU backends,
-pure-jnp elsewhere (bit-identical math; tests compare both).
+pure-jnp elsewhere (bit-identical math in f32; tests compare both).
 """
 
 from __future__ import annotations
@@ -15,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _LANES = 128
 _SUBLANES = 8
@@ -25,18 +33,57 @@ def _use_pallas() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def ftrl_update_ref(z, sqrt_n, grad, touched, *, alpha, beta, l1, l2):
-    """Pure-jnp reference (identical to updaters.FTRLUpdater.apply math)."""
-    eta = alpha / (sqrt_n + beta)
+def stochastic_round_bf16(x: jnp.ndarray, seed) -> jnp.ndarray:
+    """Unbiased f32 -> bf16 narrowing (jnp path): add hash-derived
+    uniform dither in [0, 2^16) to the f32 bits, then truncate the low
+    mantissa bits. E[rounded] = x, so a bf16 accumulator performs an
+    unbiased walk instead of stalling by absorption. The dither is a
+    counter-based integer hash of (position, seed) — cheap, stateless,
+    vectorized; rounding dither needs uniformity, not cryptographic
+    quality. Values whose f32 form is already exactly bf16 (e.g.
+    untouched slots round-tripped through storage) are returned
+    unchanged for every dither draw."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    i = jax.lax.iota(jnp.uint32, max(1, x.size)).reshape(x.shape)
+    h = (i * np.uint32(2654435761)) ^ (
+        jnp.uint32(seed) * np.uint32(0x9E3779B9)
+    )
+    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+    rnd = (h ^ (h >> 16)) & np.uint32(0xFFFF)
+    out = (bits + rnd) & np.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(
+        jnp.bfloat16
+    )
+
+
+def _ftrl_math(z, n, g, *, alpha, beta, l1, l2):
+    """The FTRL-proximal step on f32 operands — THE single copy of the
+    math, shared by the jnp reference and both kernel variants (a fix
+    applied to one copy cannot miss the others)."""
+    eta = alpha / (n + beta)
     zt = -z * eta
     w = jnp.sign(zt) * jnp.maximum(jnp.abs(zt) - l1 * eta, 0.0) / (1.0 + l2 * eta)
-    sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
-    sigma = (sqrt_n_new - sqrt_n) / alpha
-    z_new = z + grad - sigma * w
-    return (
-        jnp.where(touched, z_new, z),
-        jnp.where(touched, sqrt_n_new, sqrt_n),
+    n_new = jnp.sqrt(n * n + g * g)
+    sigma = (n_new - n) / alpha
+    z_new = z + g - sigma * w
+    return z_new, n_new
+
+
+def ftrl_update_ref(z, sqrt_n, grad, touched, *, alpha, beta, l1, l2,
+                    seed=None):
+    """Pure-jnp reference (identical to updaters.FTRLUpdater.apply math).
+    bf16 sqrt_n widens for math; the narrow is stochastically rounded
+    when ``seed`` is given, else deterministically."""
+    store_dtype = sqrt_n.dtype
+    sqrt_n = sqrt_n.astype(jnp.float32)
+    z_new, sqrt_n_new = _ftrl_math(
+        z, sqrt_n, grad, alpha=alpha, beta=beta, l1=l1, l2=l2
     )
+    n_out = jnp.where(touched, sqrt_n_new, sqrt_n)
+    if store_dtype == jnp.bfloat16 and seed is not None:
+        n_out = stochastic_round_bf16(n_out, seed)
+    return jnp.where(touched, z_new, z), n_out.astype(store_dtype)
 
 
 def _kernel(z_ref, n_ref, g_ref, t_ref, z_out, n_out, *, alpha, beta, l1, l2):
@@ -44,19 +91,71 @@ def _kernel(z_ref, n_ref, g_ref, t_ref, z_out, n_out, *, alpha, beta, l1, l2):
     n = n_ref[:]
     g = g_ref[:]
     t = t_ref[:]
-    eta = alpha / (n + beta)
-    zt = -z * eta
-    w = jnp.sign(zt) * jnp.maximum(jnp.abs(zt) - l1 * eta, 0.0) / (1.0 + l2 * eta)
-    n_new = jnp.sqrt(n * n + g * g)
-    sigma = (n_new - n) / alpha
-    z_new = z + g - sigma * w
+    z_new, n_new = _ftrl_math(z, n, g, alpha=alpha, beta=beta, l1=l1, l2=l2)
     keep = t > 0
     z_out[:] = jnp.where(keep, z_new, z)
     n_out[:] = jnp.where(keep, n_new, n)
 
 
+def _hash_dither_bits(seed_scalar, shape):
+    """Interpret-mode dither source: the same counter-hash used by
+    :func:`stochastic_round_bf16`, as raw uint32 bits. Interpret mode
+    cannot execute ``pltpu.prng_*`` (no CPU lowering), so the kernel
+    body is tested with this substitute while the PRNG path itself is
+    pinned by tests/test_mosaic_lowering.py."""
+    n = 1
+    for d in shape:
+        n *= d
+    i = jax.lax.iota(jnp.uint32, n).reshape(shape)
+    h = (i * np.uint32(2654435761)) ^ (
+        seed_scalar.astype(jnp.uint32) * np.uint32(0x9E3779B9)
+    )
+    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _kernel_bf16(z_ref, n_ref, g_ref, t_ref, seed_ref, z_out, n_out, *,
+                 alpha, beta, l1, l2, dither_fn=None):
+    """bf16-``sqrt_n`` variant: widen in VMEM, stochastically round the
+    narrow with the on-core PRNG (per-block stream — block-correlated
+    rounding noise is biased in aggregate, ops/quantize.py note).
+    ``dither_fn``: interpret-mode substitute for the PRNG (see
+    :func:`_hash_dither_bits`)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    z = z_ref[:]
+    n = n_ref[:].astype(jnp.float32)
+    g = g_ref[:]
+    t = t_ref[:]
+    z_new, n_new = _ftrl_math(z, n, g, alpha=alpha, beta=beta, l1=l1, l2=l2)
+    keep = t > 0
+    z_out[:] = jnp.where(keep, z_new, z)
+    n_keep = jnp.where(keep, n_new, n)
+    # stochastic f32->bf16: dither the low 16 bits, truncate. An
+    # already-bf16-exact value (untouched slots) is unchanged by
+    # construction (its low mantissa bits are zero).
+    if dither_fn is None:
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+        rnd = pltpu.bitcast(
+            pltpu.prng_random_bits(n_keep.shape), jnp.uint32
+        )
+        bits = pltpu.bitcast(n_keep, jnp.uint32)
+        rounded = (bits + (rnd & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000)
+        n_out[:] = pltpu.bitcast(rounded, jnp.float32).astype(jnp.bfloat16)
+    else:
+        rnd = dither_fn(seed_ref[0] + pl.program_id(0), n_keep.shape)
+        bits = jax.lax.bitcast_convert_type(n_keep, jnp.uint32)
+        rounded = (bits + (rnd & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000)
+        n_out[:] = jax.lax.bitcast_convert_type(
+            rounded, jnp.float32
+        ).astype(jnp.bfloat16)
+
+
 @functools.partial(
-    jax.jit, static_argnames=("alpha", "beta", "l1", "l2", "force_pallas")
+    jax.jit,
+    static_argnames=("alpha", "beta", "l1", "l2", "force_pallas", "interpret"),
 )
 def ftrl_update(
     z: jax.Array,
@@ -68,18 +167,29 @@ def ftrl_update(
     beta: float,
     l1: float,
     l2: float = 0.0,
+    seed=None,
     force_pallas: bool = False,
+    interpret: bool = False,
 ):
     """Fused update over a 1-D slot shard. touched: bool/float mask.
+    ``seed`` (traced uint32 scalar) drives the stochastic narrow when
+    ``sqrt_n`` is stored bf16; without it the bf16 narrow truncates
+    (callers that care about long-horizon LR decay must pass one).
 
     Falls back to the jnp reference path off-TPU and for shards that are not
     tile-aligned, so any caller can use it unconditionally.
     """
     p = z.shape[0]
-    if not (force_pallas or _use_pallas()) or z.ndim != 1 or p % _TILE != 0:
+    bf16_n = sqrt_n.dtype == jnp.bfloat16
+    if (
+        not (force_pallas or _use_pallas())
+        or z.ndim != 1
+        or p % _TILE != 0
+        or (bf16_n and seed is None)
+    ):
         return ftrl_update_ref(
             z, sqrt_n, grad, touched.astype(jnp.float32) > 0,
-            alpha=alpha, beta=beta, l1=l1, l2=l2,
+            alpha=alpha, beta=beta, l1=l1, l2=l2, seed=seed,
         )
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -97,15 +207,36 @@ def ftrl_update(
     spec = pl.BlockSpec(
         (block_rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
+    out_shape = (
+        jax.ShapeDtypeStruct(shape2d, z.dtype),
+        jax.ShapeDtypeStruct(shape2d, sqrt_n.dtype),
+    )
+    if bf16_n:
+        kernel = functools.partial(
+            _kernel_bf16, alpha=alpha, beta=beta, l1=l1, l2=l2,
+            dither_fn=_hash_dither_bits if interpret else None,
+        )
+        z_new, n_new = pl.pallas_call(
+            kernel,
+            grid=grid,
+            out_shape=out_shape,
+            in_specs=[spec, spec, spec, spec,
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(spec, spec),
+            interpret=interpret,
+        )(
+            z.reshape(shape2d), sqrt_n.reshape(shape2d),
+            grad.reshape(shape2d), t2d,
+            jnp.asarray(seed, jnp.int32).reshape(1),
+        )
+        return z_new.reshape(p), n_new.reshape(p)
     kernel = functools.partial(_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2)
     z_new, n_new = pl.pallas_call(
         kernel,
         grid=grid,
-        out_shape=(
-            jax.ShapeDtypeStruct(shape2d, z.dtype),
-            jax.ShapeDtypeStruct(shape2d, sqrt_n.dtype),
-        ),
+        out_shape=out_shape,
         in_specs=[spec, spec, spec, spec],
         out_specs=(spec, spec),
+        interpret=interpret,
     )(z.reshape(shape2d), sqrt_n.reshape(shape2d), grad.reshape(shape2d), t2d)
     return z_new.reshape(p), n_new.reshape(p)

@@ -920,6 +920,11 @@ def task_scale() -> int:
         if SMOKE
         else [
             ("2e28", 1 << 28, "float32"),
+            # same size in bf16n: the direct f32-vs-bf16 state speed
+            # comparison (the dense update's HBM traffic drops 16->12
+            # B/slot; both run fused Pallas kernels — _kernel vs
+            # _kernel_bf16 with its on-core stochastic narrow)
+            ("2e28_bf16n", 1 << 28, "bfloat16"),
             ("2e29", 1 << 29, "float32"),
             ("800M", 800_000_000, "float32"),
             ("2e30", 1 << 30, "float32"),

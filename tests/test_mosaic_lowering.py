@@ -98,6 +98,24 @@ def test_ftrl_kernel_lowers():
     lower_tpu(fn, Z(p), Z(p), Z(p), Z(p, jnp.bool_))
 
 
+def test_ftrl_bf16_kernel_lowers():
+    """The bf16-sqrt_n variant (on-core PRNG stochastic narrow) must
+    lower under real Mosaic rules — bitcasts, prng_seed/random_bits,
+    and the bf16 VMEM output ref."""
+    p = 1 << 14
+
+    def fn(z, n, g, t, seed):
+        return ftrl_update(
+            z, n, g, t, alpha=0.1, beta=1.0, l1=1.0, l2=0.1,
+            seed=seed, force_pallas=True,
+        )
+
+    lower_tpu(
+        fn, Z(p), Z(p, jnp.bfloat16), Z(p), Z(p, jnp.bool_),
+        jnp.uint32(3),
+    )
+
+
 def test_quantize_kernel_lowers():
     def fn(x, seed):
         return quantize(x, seed, num_bytes=1, force_pallas=True)

@@ -87,6 +87,60 @@ class TestFtrlOp:
         np.testing.assert_allclose(np.asarray(z1), 1.0)
         np.testing.assert_allclose(np.asarray(n1), 1.0)
 
+    def test_bf16_kernel_matches_reference(self):
+        """_kernel_bf16 numerics (interpret mode — the same kernel body
+        Mosaic compiles): z must EQUAL the f32 reference (z math is
+        deterministic); stored sqrt_n must be one of the two bf16
+        neighbors of the f32 value (stochastic rounding never moves
+        more than one ulp); untouched slots must be bit-frozen."""
+        rng = np.random.default_rng(1)
+        p = 2048
+        z = jnp.asarray(rng.normal(size=p), jnp.float32)
+        n_f32 = jnp.abs(jnp.asarray(rng.normal(size=p), jnp.float32))
+        n = n_f32.astype(jnp.bfloat16)
+        g = jnp.asarray(rng.normal(size=p) * (rng.random(p) < 0.5),
+                        jnp.float32)
+        t = g != 0
+        kw = dict(alpha=0.5, beta=1.0, l1=0.1, l2=0.01)
+        zk, nk = ftrl_update(z, n, g, t, seed=jnp.uint32(9),
+                             force_pallas=True, interpret=True, **kw)
+        assert nk.dtype == jnp.bfloat16
+        # reference on the SAME widened operands, f32 result
+        zr, nr = ftrl_update_ref(z, n.astype(jnp.float32), g, t, **kw)
+        np.testing.assert_allclose(np.asarray(zk), np.asarray(zr),
+                                   atol=1e-6)
+        # each stored value is a bf16 neighbor of the exact f32 value
+        nk32 = np.asarray(nk.astype(jnp.float32))
+        nr32 = np.asarray(nr)
+        down = np.asarray(jnp.asarray(nr32).astype(jnp.bfloat16)
+                          .astype(jnp.float32))
+        ulp = np.maximum(np.abs(nr32) * 2.0**-7, 1e-30)
+        assert np.all(np.abs(nk32 - nr32) <= ulp), (
+            np.abs(nk32 - nr32).max(), ulp.min()
+        )
+        # untouched slots: exact round-trip of the stored bf16 value
+        frozen = ~np.asarray(t)
+        np.testing.assert_array_equal(
+            nk32[frozen], np.asarray(n.astype(jnp.float32))[frozen]
+        )
+        del down
+
+    def test_bf16_stochastic_rounding_unbiased(self):
+        """Across many seeds the bf16 narrow must average to the exact
+        f32 value (unbiased walk) — deterministic truncation would
+        bias low and stall accumulators (absorption)."""
+        from parameter_server_tpu.ops.ftrl import stochastic_round_bf16
+
+        x = jnp.full(256, 1.0 + 1.0 / 512.0, jnp.float32)  # mid-ulp
+        acc = np.zeros(256, np.float64)
+        k = 200
+        for s in range(k):
+            acc += np.asarray(
+                stochastic_round_bf16(x, np.uint32(s)).astype(jnp.float32)
+            )
+        mean = acc / k
+        np.testing.assert_allclose(mean, np.asarray(x), rtol=2e-3)
+
 
 class TestQuantizeOp:
     def test_error_within_one_step(self):
