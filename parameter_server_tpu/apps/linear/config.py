@@ -147,6 +147,20 @@ _ENUMS = {
 }
 
 
+def _ftrl_state_dtype(val) -> str:
+    """Validated ftrl_state_dtype: only the two supported storage
+    dtypes. Anything else — f16 (absorption-stalls WITHOUT the bf16
+    stochastic-rounding path, plus overflow range), f64, or a typo
+    like "bf16" — must fail AT PARSE TIME with the accepted values,
+    not as an obscure dtype error deep in server construction."""
+    v = str(val).lower()
+    if v not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"ftrl_state_dtype must be 'float32' or 'bfloat16', got {val!r}"
+        )
+    return v
+
+
 def parse_conf_dict(text: str) -> dict:
     """Parse protobuf text format into nested dicts (repeated -> lists)."""
     text = re.sub(r"#[^\n]*", "", text)
@@ -278,6 +292,9 @@ def parse_conf(text: str) -> Config:
             num_replicas=int(s.get("num_replicas", 0)),
             replica_every=int(s.get("replica_every", 1)),
             steps_per_launch=int(s.get("steps_per_launch", 1)),
+            ftrl_state_dtype=_ftrl_state_dtype(
+                s.get("ftrl_state_dtype", "float32")
+            ),
             push_filter=_filter_list(s.get("push_filter")),
             pull_filter=_filter_list(s.get("pull_filter")),
         )

@@ -57,6 +57,26 @@ def test_linear_cli_runs_conf(mesh8, svm_conf, capsys):
     assert "examples" in out, out
 
 
+def test_linear_cli_bf16_state_conf(mesh8, svm_conf, capsys):
+    """ftrl_state_dtype is .conf-reachable end to end: the run trains
+    with a bf16 sqrt_n table through the full CLI path."""
+    from parameter_server_tpu.apps.linear.config import parse_conf
+
+    text = svm_conf.read_text().replace(
+        "algo: FTRL", 'algo: FTRL\n  ftrl_state_dtype: "bfloat16"'
+    )
+    # the injection must have taken effect (a fixture wording change
+    # would otherwise silently turn this into a duplicate f32 test)
+    assert parse_conf(text).async_sgd.ftrl_state_dtype == "bfloat16"
+    svm_conf.write_text(text)
+    rc = main([str(svm_conf)])
+    assert rc == 0
+    assert "examples" in capsys.readouterr().out
+
+    with pytest.raises(ValueError, match="ftrl_state_dtype"):
+        parse_conf(text.replace('"bfloat16"', '"bf16"'))
+
+
 def test_linear_cli_profile_trace(mesh8, svm_conf, tmp_path, capsys):
     prof = tmp_path / "trace"
     rc = main([str(svm_conf), "--profile", str(prof)])
