@@ -802,6 +802,30 @@ def run_real(args) -> int:
     # -- phase 1: logloss parity vs the NumPy oracle (sequential weights:
     # max_delay=0 means the device pulls the latest state every step, so
     # the oracle sees identical math modulo f32 reduction order) --
+    # parse-only ceiling: disk -> C++ parse, no localize/upload/device —
+    # the host-parse term of the pipeline roofline (the breakdown fields
+    # price localize/upload/device). Direct parser-core measurement
+    # (reader/prefetch machinery would measure its BUFFER drain rate,
+    # not parsing), taken BEFORE the parity stream exists so its
+    # thread-pool's in-flight chunk parses can't contend for the core.
+    _beat("parse_rate")
+    from parameter_server_tpu.data.text_parser import ExampleParser
+
+    with open(path, "rb") as f:
+        chunk = f.read(2 << 20 if args.smoke else 16 << 20)
+    chunk = chunk[: chunk.rfind(b"\n") + 1]
+    pparser = ExampleParser("criteo")
+    # warm (C++ lib load, caches) with a LINE-ALIGNED prefix — a
+    # mid-row cut is outside parse_text's documented contract
+    pparser.parse_text(chunk[: chunk.rfind(b"\n", 0, 1 << 18) + 1])
+    t0 = time.perf_counter()
+    pb = pparser.parse_text(chunk)
+    parse_sec = time.perf_counter() - t0
+    parse_only_ex_s = (
+        round(pb.n / parse_sec, 1) if parse_sec and pb.n else None
+    )
+    del chunk, pb
+
     oracle = FtrlOracle(num_slots, alpha, beta, l1)
     parity_steps = 4 if args.smoke else args.parity_steps
     dev_obj = orc_obj = parity_ex = 0.0
@@ -829,6 +853,7 @@ def run_real(args) -> int:
     assert parity_ok, (
         f"logloss parity FAILED: device {ll_dev:.5f} vs oracle {ll_orc:.5f}"
     )
+
 
     # -- phase 2: end-to-end timed stream, parsing inside the pipeline.
     # On a multi-core host a producer thread parses (C++ releases the
@@ -878,6 +903,7 @@ def run_real(args) -> int:
             "logloss_device": round(ll_dev, 5),
             "logloss_oracle": round(ll_orc, 5),
             "parity_ok": parity_ok,
+            "parse_only_examples_per_sec": parse_only_ex_s,
         },
     )
     # serialized stage pricing (localize+pack / upload / device) — the
