@@ -746,6 +746,7 @@ def lm_generate(
     return_logits: bool = False,
     return_state: bool = False,
     max_len: "int | None" = None,
+    prompt_lengths: "jax.Array | None" = None,
     temperature=None,
     top_k: "int | None" = None,
     top_p: "float | None" = None,
@@ -758,6 +759,17 @@ def lm_generate(
     time. Sampling consumes one PRNG split for the first generated token
     plus one per scan step (NOT one per prompt position — the per-token
     prompt walk is gone).
+
+    ``prompt_lengths`` [B] enables RAGGED batches: ``prompt`` is
+    right-padded to a common width and each row decodes from its own
+    length — row b's continuation lands at positions
+    ``[len_b, len_b + steps)`` and every row's output equals what a
+    single-row call on its unpadded prompt would produce (pad slots are
+    progressively OVERWRITTEN by generated tokens, and the per-row
+    position masks in the chunked decode path never attend a slot that
+    still holds pad garbage). Positions past ``len_b + steps`` in the
+    returned array are zeros. Ragged mode returns tokens only
+    (``return_logits``/``return_state`` are dense-batch features).
     ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
     softmax(logits/temperature), optionally truncated to the ``top_k``
     most likely tokens and/or the nucleus holding ``top_p`` probability
@@ -790,6 +802,36 @@ def lm_generate(
         raise ValueError(
             f"max_len={max_len} < prompt+steps={total}: the caches "
             "cannot hold the generation being requested"
+        )
+    if prompt_lengths is not None:
+        if return_logits or return_state:
+            raise ValueError(
+                "prompt_lengths (ragged batches) does not compose with "
+                "return_logits/return_state — pad-split the batch or "
+                "use the dense path for those"
+            )
+        if steps == 0:
+            raise ValueError("ragged generation needs steps >= 1")
+        lens_np = np.asarray(prompt_lengths)
+        if lens_np.ndim != 1 or lens_np.shape[0] != prompt.shape[0]:
+            raise ValueError(
+                f"prompt_lengths must be [B={prompt.shape[0]}], got "
+                f"shape {lens_np.shape}"
+            )
+        if lens_np.min() < 1 or lens_np.max() > prompt.shape[1]:
+            # out-of-range lengths would SILENTLY produce garbage under
+            # jit (clamped gathers, dropped scatters) — fail here where
+            # the values are concrete
+            raise ValueError(
+                "prompt_lengths must lie in [1, padded width="
+                f"{prompt.shape[1]}], got range "
+                f"[{lens_np.min()}, {lens_np.max()}]"
+            )
+        return _lm_generate_ragged_jit(
+            params, prompt, jnp.asarray(prompt_lengths, jnp.int32),
+            temperature, top_p_arr, key,
+            cfg=cfg, steps=steps, top_k=top_k,
+            has_top_p=top_p is not None, greedy=greedy, capacity=capacity,
         )
     # top_p rides as a TRACED operand (sweeping it must not recompile,
     # same contract as temperature); only its PRESENCE is static, so the
@@ -886,6 +928,73 @@ def _lm_generate_jit(
             [prefill_logits, jnp.swapaxes(gen_logits, 0, 1)], axis=1
         ))
     return ret(toks)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "steps", "top_k", "has_top_p", "greedy", "capacity",
+    ),
+)
+def _lm_generate_ragged_jit(
+    params, prompt, lengths, temperature, top_p, key, *, cfg, steps,
+    top_k, has_top_p, greedy, capacity,
+):
+    """Ragged-batch core: right-padded prompt [B, P] + per-row lengths.
+
+    One padded prefill fills cache slots [0, len_b) correctly per row
+    (pad rows' garbage K/V lands at [len_b, P) — never attended: the
+    chunked decode's ``keep`` mask admits only slots <= the row's
+    CURRENT position, and every slot up to there has been overwritten
+    by a real generated token by the time it becomes admissible). The
+    decode loop runs :func:`_chunk_decode` with C=1 and per-row
+    positions ``lengths + t`` — cache writes, rope tables and window
+    masks all follow the row's own clock."""
+    b, p_len = prompt.shape
+    kcache, vcache = _alloc_kv_caches(cfg, b, capacity)
+    prompt = prompt.astype(jnp.int32)
+    rows = jnp.arange(b)
+    # output: prompt with pad slots zeroed (so rows are comparable
+    # regardless of what padding value the caller used), widened to
+    # hold each row's continuation at [len_b, len_b + steps)
+    col = jnp.arange(p_len)
+    out = jnp.zeros((b, p_len + steps), jnp.int32)
+    out = out.at[:, :p_len].set(
+        jnp.where(col[None, :] < lengths[:, None], prompt, 0)
+    )
+
+    def pick(logits, k_step):
+        return _pick_token(
+            logits, k_step, temperature, top_p, greedy=greedy,
+            top_k=top_k, has_top_p=has_top_p,
+        )
+
+    prefill_logits, kcache, vcache = _prefill(
+        params, cfg, prompt, kcache, vcache
+    )
+    # each row's next-token logits live at ITS last real position
+    last = jnp.take_along_axis(
+        prefill_logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    key, k0 = jax.random.split(key)
+    cur = pick(last, k0)
+    out = out.at[rows, lengths].set(cur)
+
+    def body(carry, t):
+        out, kcache, vcache, cur, key = carry
+        key, k_step = jax.random.split(key)
+        pos = lengths + t  # [B]: absolute slot of `cur`, per row
+        logits, kcache, vcache = _chunk_decode(
+            params, cfg, cur[:, None], kcache, vcache, pos
+        )
+        nxt = pick(logits[:, 0], k_step)
+        out = out.at[rows, pos + 1].set(nxt)
+        return (out, kcache, vcache, nxt, key), None
+
+    (out, kcache, vcache, _, _), _ = jax.lax.scan(
+        body, (out, kcache, vcache, cur, key), jnp.arange(steps - 1)
+    )
+    return out
 
 
 def lm_generate_continue(

@@ -1,0 +1,140 @@
+"""Ragged-batch serving (lm_generate prompt_lengths=): right-padded
+variable-length prompts decode in ONE batch, each row exactly equal to
+a single-row call on its unpadded prompt — across rope, GQA, int8
+cache, and sliding-window configs, and under tensor parallelism."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parameter_server_tpu.models.transformer import (
+    LMConfig,
+    init_lm,
+    lm_generate,
+    shard_lm_params,
+)
+
+BASE = LMConfig(vocab=61, d_model=32, n_heads=4, n_layers=2, d_ff=64)
+
+
+def _ragged_prompts(rng, widths, pad_to):
+    rows = [rng.integers(1, 61, w).astype(np.int32) for w in widths]
+    padded = np.zeros((len(rows), pad_to), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, : r.size] = r
+    return rows, padded, np.asarray(widths, np.int32)
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        BASE,
+        dataclasses.replace(BASE, rope=True),
+        dataclasses.replace(BASE, n_kv_heads=2),
+        dataclasses.replace(
+            BASE, n_kv_heads=2, kv_cache_dtype="int8", rope=True
+        ),
+        dataclasses.replace(BASE, window=8),
+    ],
+    ids=["base", "rope", "gqa", "gqa_int8_rope", "window"],
+)
+def test_ragged_rows_equal_single_row_calls(cfg):
+    rng = np.random.default_rng(0)
+    steps = 7
+    rows, padded, lengths = _ragged_prompts(rng, [5, 12, 9], pad_to=12)
+    params = init_lm(jax.random.PRNGKey(1), cfg)
+    out = np.asarray(
+        lm_generate(
+            params, jnp.asarray(padded), cfg, steps=steps,
+            prompt_lengths=lengths,
+        )
+    )
+    for i, r in enumerate(rows):
+        solo = np.asarray(
+            lm_generate(params, jnp.asarray(r[None, :]), cfg, steps=steps)
+        )[0]
+        got = out[i, : r.size + steps]
+        np.testing.assert_array_equal(got, solo, err_msg=f"row {i}")
+        # positions past the row's content are zeroed
+        assert (out[i, r.size + steps:] == 0).all()
+
+
+def test_uniform_lengths_match_dense_path():
+    """prompt_lengths all equal to the padded width must reproduce the
+    dense path bit for bit."""
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(1, 61, (3, 10)), np.int32)
+    params = init_lm(jax.random.PRNGKey(3), BASE)
+    dense = np.asarray(lm_generate(params, prompt, BASE, steps=6))
+    ragged = np.asarray(
+        lm_generate(
+            params, prompt, BASE, steps=6,
+            prompt_lengths=np.full(3, 10, np.int32),
+        )
+    )
+    np.testing.assert_array_equal(dense, ragged)
+
+
+def test_ragged_sampling_runs_and_respects_lengths():
+    rng = np.random.default_rng(4)
+    rows, padded, lengths = _ragged_prompts(rng, [3, 8], pad_to=8)
+    params = init_lm(jax.random.PRNGKey(5), BASE)
+    out = np.asarray(
+        lm_generate(
+            params, jnp.asarray(padded), BASE, steps=5,
+            prompt_lengths=lengths, temperature=0.8, top_k=10,
+            key=jax.random.PRNGKey(6),
+        )
+    )
+    assert out.shape == (2, 13)
+    # generated region is fully populated (vocab excludes 0 in prompts;
+    # sampled tokens may be 0, so only check prompt echo + shape)
+    np.testing.assert_array_equal(out[0, :3], rows[0])
+    np.testing.assert_array_equal(out[1, :8], rows[1])
+
+
+def test_ragged_under_tensor_parallelism(mesh8):
+    """The multi-chip serving composition: ragged decode with
+    Megatron-placed weights equals the replicated ragged run."""
+    rng = np.random.default_rng(7)
+    rows, padded, lengths = _ragged_prompts(rng, [4, 11, 7], pad_to=11)
+    params = init_lm(jax.random.PRNGKey(8), BASE)
+    rep = np.asarray(
+        lm_generate(
+            params, jnp.asarray(padded), BASE, steps=6,
+            prompt_lengths=lengths,
+        )
+    )
+    tp = np.asarray(
+        lm_generate(
+            shard_lm_params(params, mesh8), jnp.asarray(padded), BASE,
+            steps=6, prompt_lengths=lengths,
+        )
+    )
+    np.testing.assert_array_equal(rep, tp)
+
+
+def test_ragged_rejects_unsupported_composition():
+    params = init_lm(jax.random.PRNGKey(0), BASE)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    lens = np.asarray([2, 4], np.int32)
+    with pytest.raises(ValueError, match="ragged"):
+        lm_generate(
+            params, prompt, BASE, steps=2, prompt_lengths=lens,
+            return_state=True,
+        )
+    with pytest.raises(ValueError, match="steps"):
+        lm_generate(params, prompt, BASE, steps=0, prompt_lengths=lens)
+    with pytest.raises(ValueError, match="range|lie in"):
+        lm_generate(
+            params, prompt, BASE, steps=2,
+            prompt_lengths=np.asarray([0, 4], np.int32),
+        )
+    with pytest.raises(ValueError, match="range|lie in"):
+        lm_generate(
+            params, prompt, BASE, steps=2,
+            prompt_lengths=np.asarray([2, 5], np.int32),
+        )
