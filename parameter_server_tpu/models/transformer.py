@@ -747,6 +747,7 @@ def lm_generate(
     return_state: bool = False,
     max_len: "int | None" = None,
     prompt_lengths: "jax.Array | None" = None,
+    eos_id: "int | None" = None,
     temperature=None,
     top_k: "int | None" = None,
     top_p: "float | None" = None,
@@ -760,14 +761,23 @@ def lm_generate(
     plus one per scan step (NOT one per prompt position — the per-token
     prompt walk is gone).
 
+    ``eos_id`` freezes a row after it EMITS that token: the rest of
+    its fixed-length budget fills with the pad token 0 ("eos then
+    pads" — lax.scan cannot end early, so all rows still run
+    ``steps`` iterations; frozen rows keep caching their pad tokens,
+    which nothing meaningful attends). Works in dense and ragged
+    modes.
+
     ``prompt_lengths`` [B] enables RAGGED batches: ``prompt`` is
     right-padded to a common width and each row decodes from its own
     length — row b's continuation lands at positions
-    ``[len_b, len_b + steps)`` and every row's output equals what a
-    single-row call on its unpadded prompt would produce (pad slots are
-    progressively OVERWRITTEN by generated tokens, and the per-row
-    position masks in the chunked decode path never attend a slot that
-    still holds pad garbage). Positions past ``len_b + steps`` in the
+    ``[len_b, len_b + steps)``, and under GREEDY decoding every row's
+    output equals what a single-row call on its unpadded prompt would
+    produce (pad slots are progressively OVERWRITTEN by generated
+    tokens, and the per-row position masks in the chunked decode path
+    never attend a slot that still holds pad garbage). Sampled rows
+    see the same DISTRIBUTION but not the same draws as a single-row
+    call — the per-step Gumbel noise is shaped by the batch. Positions past ``len_b + steps`` in the
     returned array are zeros. Ragged mode returns tokens only
     (``return_logits``/``return_state`` are dense-batch features).
     ``temperature=None`` (or 0) is greedy argmax; otherwise samples from
@@ -803,6 +813,16 @@ def lm_generate(
             f"max_len={max_len} < prompt+steps={total}: the caches "
             "cannot hold the generation being requested"
         )
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(
+            f"eos_id must be in [0, vocab={cfg.vocab}), got {eos_id}"
+        )
+    # eos rides as a TRACED operand (same contract as temperature/
+    # top_p: serving different stop tokens must not recompile); only
+    # its PRESENCE is static
+    eos_arr = jnp.asarray(
+        0 if eos_id is None else eos_id, jnp.int32
+    )
     if prompt_lengths is not None:
         if return_logits or return_state:
             raise ValueError(
@@ -832,6 +852,7 @@ def lm_generate(
             temperature, top_p_arr, key,
             cfg=cfg, steps=steps, top_k=top_k,
             has_top_p=top_p is not None, greedy=greedy, capacity=capacity,
+            eos=eos_arr, has_eos=eos_id is not None,
         )
     # top_p rides as a TRACED operand (sweeping it must not recompile,
     # same contract as temperature); only its PRESENCE is static, so the
@@ -840,7 +861,7 @@ def lm_generate(
         params, prompt, temperature, top_p_arr, key,
         cfg=cfg, steps=steps, return_logits=return_logits, top_k=top_k,
         has_top_p=top_p is not None, greedy=greedy, capacity=capacity,
-        return_state=return_state,
+        return_state=return_state, eos=eos_arr, has_eos=eos_id is not None,
     )
     if not return_state:
         return out
@@ -861,12 +882,13 @@ def lm_generate(
     jax.jit,
     static_argnames=(
         "cfg", "steps", "return_logits", "top_k", "has_top_p", "greedy",
-        "capacity", "return_state",
+        "capacity", "return_state", "has_eos",
     ),
 )
 def _lm_generate_jit(
     params, prompt, temperature, top_p, key, *, cfg, steps, return_logits,
     top_k, has_top_p, greedy, capacity=None, return_state=False,
+    eos=None, has_eos=False,
 ):
     b, p_len = prompt.shape
     total = p_len + steps
@@ -902,24 +924,33 @@ def _lm_generate_jit(
             return ret(toks, prefill_logits[:, :-1], last_logits=last)
         return ret(toks, last_logits=last)
     key, k0 = jax.random.split(key)
-    toks = toks.at[:, p_len].set(pick(prefill_logits[:, -1], k0))
+    first = pick(prefill_logits[:, -1], k0)
+    toks = toks.at[:, p_len].set(first)
+    # eos freeze mask: a row that has EMITTED the (traced) eos token
+    # keeps emitting the pad token 0 for the rest of the fixed-length
+    # scan (lax.scan cannot end early; the contract is "eos then
+    # pads"). Only carried when the feature is on (has_eos is static).
+    done = first == eos if has_eos else jnp.zeros(b, bool)
 
     def body(carry, pos):
-        toks, kcache, vcache, key = carry
+        toks, kcache, vcache, key, done = carry
         key, k_step = jax.random.split(key)
         tok = jax.lax.dynamic_index_in_dim(toks, pos, axis=1, keepdims=False)
         logits, kcache, vcache = _decode_step(
             params, cfg, tok, kcache, vcache, pos
         )
         nxt = pick(logits, k_step)
+        if has_eos:
+            nxt = jnp.where(done, 0, nxt)
+            done = done | (nxt == eos)
         toks = jax.lax.dynamic_update_index_in_dim(toks, nxt, pos + 1, axis=1)
-        return (toks, kcache, vcache, key), logits
+        return (toks, kcache, vcache, key, done), logits
 
     # positions p_len .. total-2: each processes an already-written token
     # and writes the next one (the final position total-1 is written by
     # the last iteration and needs no processing)
-    (toks, kcache, vcache, _), gen_logits = jax.lax.scan(
-        body, (toks, kcache, vcache, key), jnp.arange(p_len, total - 1)
+    (toks, kcache, vcache, _, _), gen_logits = jax.lax.scan(
+        body, (toks, kcache, vcache, key, done), jnp.arange(p_len, total - 1)
     )
     if return_logits:
         # [B, T-1, vocab]: row t predicts token t+1 — the decode-vs-full-
@@ -934,11 +965,12 @@ def _lm_generate_jit(
     jax.jit,
     static_argnames=(
         "cfg", "steps", "top_k", "has_top_p", "greedy", "capacity",
+        "has_eos",
     ),
 )
 def _lm_generate_ragged_jit(
     params, prompt, lengths, temperature, top_p, key, *, cfg, steps,
-    top_k, has_top_p, greedy, capacity,
+    top_k, has_top_p, greedy, capacity, eos=None, has_eos=False,
 ):
     """Ragged-batch core: right-padded prompt [B, P] + per-row lengths.
 
@@ -979,20 +1011,24 @@ def _lm_generate_ragged_jit(
     key, k0 = jax.random.split(key)
     cur = pick(last, k0)
     out = out.at[rows, lengths].set(cur)
+    done = cur == eos if has_eos else jnp.zeros(b, bool)
 
     def body(carry, t):
-        out, kcache, vcache, cur, key = carry
+        out, kcache, vcache, cur, key, done = carry
         key, k_step = jax.random.split(key)
         pos = lengths + t  # [B]: absolute slot of `cur`, per row
         logits, kcache, vcache = _chunk_decode(
             params, cfg, cur[:, None], kcache, vcache, pos
         )
         nxt = pick(logits[:, 0], k_step)
+        if has_eos:
+            nxt = jnp.where(done, 0, nxt)
+            done = done | (nxt == eos)
         out = out.at[rows, pos + 1].set(nxt)
-        return (out, kcache, vcache, nxt, key), None
+        return (out, kcache, vcache, nxt, key, done), None
 
-    (out, kcache, vcache, _, _), _ = jax.lax.scan(
-        body, (out, kcache, vcache, cur, key), jnp.arange(steps - 1)
+    (out, kcache, vcache, _, _, _), _ = jax.lax.scan(
+        body, (out, kcache, vcache, cur, key, done), jnp.arange(steps - 1)
     )
     return out
 

@@ -117,6 +117,80 @@ def test_ragged_under_tensor_parallelism(mesh8):
     np.testing.assert_array_equal(rep, tp)
 
 
+class TestEos:
+    """eos_id freeze semantics: 'eos then pads' in both modes."""
+
+    def _params_cfg(self):
+        return init_lm(jax.random.PRNGKey(1), BASE), BASE
+
+    def test_dense_rows_freeze_after_eos(self):
+        params, cfg = self._params_cfg()
+        rng = np.random.default_rng(9)
+        prompt = jnp.asarray(rng.integers(1, 61, (3, 6)), np.int32)
+        base = np.asarray(lm_generate(params, prompt, cfg, steps=12))
+        gen = base[:, 6:]
+        # choose an eos that actually occurs mid-stream in some row
+        cands = [
+            (r, t) for r in range(3) for t in range(8)
+            if gen[r, t] != 0 and (gen[r, :t] != gen[r, t]).all()
+        ]
+        assert cands, gen
+        row, t_hit = max(cands, key=lambda c: c[1])
+        eos = int(gen[row, t_hit])
+        out = np.asarray(
+            lm_generate(params, prompt, cfg, steps=12, eos_id=eos)
+        )[:, 6:]
+        for r in range(3):
+            hits = np.flatnonzero(out[r] == eos)
+            if hits.size:
+                h = hits[0]
+                # greedy prefix up to and including eos matches plain
+                np.testing.assert_array_equal(out[r, : h + 1],
+                                              gen[r, : h + 1])
+                assert (out[r, h + 1:] == 0).all(), out[r]
+            else:
+                np.testing.assert_array_equal(out[r], gen[r])
+
+    def test_ragged_eos(self):
+        params, cfg = self._params_cfg()
+        rng = np.random.default_rng(10)
+        rows, padded, lengths = _ragged_prompts(rng, [4, 9], pad_to=9)
+        base = np.asarray(
+            lm_generate(
+                params, jnp.asarray(padded), cfg, steps=10,
+                prompt_lengths=lengths,
+            )
+        )
+        # pick an eos appearing in row 0's continuation
+        cont0 = base[0, 4:14]
+        # any position whose token has no earlier occurrence works as
+        # the eos probe; t=0 always qualifies (degenerate random-weight
+        # models can emit one repeated token — h=0 still checks the
+        # freeze)
+        nz = [t for t in range(0, 8) if cont0[t] != 0
+              and (cont0[:t] != cont0[t]).all()]
+        assert nz, cont0
+        eos = int(cont0[nz[-1]])
+        out = np.asarray(
+            lm_generate(
+                params, jnp.asarray(padded), cfg, steps=10,
+                prompt_lengths=lengths, eos_id=eos,
+            )
+        )
+        h = np.flatnonzero(out[0, 4:14] == eos)[0]
+        np.testing.assert_array_equal(out[0, 4:4 + h + 1],
+                                      cont0[: h + 1])
+        assert (out[0, 4 + h + 1: 14] == 0).all()
+
+    def test_eos_id_validated(self):
+        params, cfg = self._params_cfg()
+        with pytest.raises(ValueError, match="eos_id"):
+            lm_generate(
+                params, jnp.zeros((1, 4), jnp.int32), cfg, steps=2,
+                eos_id=61,
+            )
+
+
 def test_ragged_rejects_unsupported_composition():
     params = init_lm(jax.random.PRNGKey(0), BASE)
     prompt = jnp.zeros((2, 4), jnp.int32)
