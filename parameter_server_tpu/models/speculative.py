@@ -100,6 +100,7 @@ def speculative_generate(
     steps: int,
     *,
     gamma: int = 4,
+    prompt_lengths: "jax.Array | None" = None,
     temperature: "float | None" = None,
     key: "jax.Array | None" = None,
     return_stats: bool = False,
@@ -123,6 +124,17 @@ def speculative_generate(
     vocab; windows/rope/GQA/bf16/int8-cache compose per model
     independently (each model runs its OWN config against its own
     cache). Dense FFN only (same restriction as lm_generate).
+
+    ``prompt_lengths`` [B] enables RAGGED batches (same contract as
+    ``lm_generate``): right-padded prompts, each row speculating from
+    its own length, output row b's continuation at
+    ``[len_b, len_b + steps)`` with zeros beyond — and, greedy, every
+    row EXACTLY equal to plain greedy decode of its unpadded prompt.
+    The per-row ``committed`` clocks the core already keeps make this
+    a parametrization, not a new path: pad-garbage cache slots obey
+    the same overwrite-before-admissible invariant as stale rejected
+    proposals (rounds write contiguous chunks from the row's front, so
+    no hole is ever attended).
 
     ``return_stats=True`` additionally returns
     ``{"rounds": r, "target_passes": r, "accepted_frac": f}`` —
@@ -159,8 +171,14 @@ def speculative_generate(
             raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by the greedy path
+    if prompt_lengths is None:
+        lengths = jnp.full(prompt.shape[0], prompt.shape[1], jnp.int32)
+    else:
+        from .transformer import _validate_prompt_lengths
+
+        lengths = _validate_prompt_lengths(prompt_lengths, prompt)
     return _spec_jit(
-        target_params, draft_params, prompt,
+        target_params, draft_params, prompt, lengths,
         jnp.asarray(1.0 if greedy else temperature, jnp.float32), key,
         tcfg=target_cfg, dcfg=draft_cfg, steps=steps, gamma=gamma,
         greedy=greedy, return_stats=return_stats,
@@ -171,31 +189,38 @@ def speculative_generate(
     jax.jit, static_argnames=("tcfg", "dcfg", "steps", "gamma", "greedy",
                               "return_stats")
 )
-def _spec_jit(tparams, dparams, prompt, temperature, key, *, tcfg, dcfg,
-              steps, gamma, greedy, return_stats):
+def _spec_jit(tparams, dparams, prompt, lengths, temperature, key, *,
+              tcfg, dcfg, steps, gamma, greedy, return_stats):
     b, p_len = prompt.shape
-    limit = p_len + steps
+    # per-row budget: row b decodes until lengths[b] + steps (for dense
+    # batches lengths == p_len everywhere and this is the old scalar)
+    limit = lengths + steps  # [B]
     # slack: a round can overshoot by gamma tokens + 1 trash slot
-    total = limit + gamma + 1
+    total = p_len + steps + gamma + 1
     trash = total - 1  # masked-commit writes land here, never read
     tk, tv = _alloc_kv_caches(tcfg, b, total)
     dk, dv = _alloc_kv_caches(dcfg, b, total)
     prompt = prompt.astype(jnp.int32)
-    # prefill BOTH models on the prompt (slots [0, p_len))
+    # prefill BOTH models on the prompt (slots [0, p_len); for ragged
+    # rows the pad slots' garbage obeys the overwrite-before-admissible
+    # invariant — see speculative_generate docstring)
     t_logits, tk, tv = _prefill(tparams, tcfg, prompt, tk, tv)
     _, dk, dv = _prefill(dparams, dcfg, prompt, dk, dv)
-    toks = jnp.zeros((b, total), jnp.int32).at[:, :p_len].set(prompt)
-    # first committed token comes straight from the target prefill
+    col = jnp.arange(p_len)
+    toks = jnp.zeros((b, total), jnp.int32).at[:, :p_len].set(
+        jnp.where(col[None, :] < lengths[:, None], prompt, 0)
+    )
+    rows = jnp.arange(b)
+    # first committed token: each row's target-prefill logits at ITS
+    # last real position
+    last = t_logits[rows, lengths - 1]
     key, k0 = jax.random.split(key)
     if greedy:
-        first = jnp.argmax(t_logits[:, -1], axis=-1)
+        first = jnp.argmax(last, axis=-1)
     else:
-        first = jax.random.categorical(
-            k0, t_logits[:, -1] / temperature, axis=-1
-        )
-    toks = toks.at[:, p_len].set(first.astype(jnp.int32))
-    committed = jnp.full((b,), p_len + 1, jnp.int32)
-    rows = jnp.arange(b)
+        first = jax.random.categorical(k0, last / temperature, axis=-1)
+    toks = toks.at[rows, lengths].set(first.astype(jnp.int32))
+    committed = lengths + 1
 
     def round_body(carry):
         toks, committed, tk, tv, dk, dv, key, rounds, acc, prop = carry
@@ -272,7 +297,7 @@ def _spec_jit(tparams, dparams, prompt, temperature, key, *, tcfg, dcfg,
                 prop)
 
     def cond(carry):
-        return jnp.min(carry[1]) < limit
+        return jnp.any(carry[1] < limit)
 
     toks, committed, *_, rounds, acc, prop = jax.lax.while_loop(
         cond,
@@ -280,7 +305,7 @@ def _spec_jit(tparams, dparams, prompt, temperature, key, *, tcfg, dcfg,
         (toks, committed, tk, tv, dk, dv, key, jnp.int32(0), jnp.int32(0),
          jnp.int32(0)),
     )
-    out = toks[:, :limit]
+    out = toks[:, : p_len + steps]
     if not return_stats:
         return out
     stats = {

@@ -697,6 +697,26 @@ class GenState:
         return self.kcache[0].shape[3]
 
 
+def _validate_prompt_lengths(prompt_lengths, prompt) -> jax.Array:
+    """Shared ragged-batch validation (lm_generate + speculative):
+    out-of-range lengths would SILENTLY produce garbage under jit
+    (clamped gathers, dropped scatters) — fail here where the values
+    are concrete."""
+    lens_np = np.asarray(prompt_lengths)
+    if lens_np.ndim != 1 or lens_np.shape[0] != prompt.shape[0]:
+        raise ValueError(
+            f"prompt_lengths must be [B={prompt.shape[0]}], got "
+            f"shape {lens_np.shape}"
+        )
+    if lens_np.min() < 1 or lens_np.max() > prompt.shape[1]:
+        raise ValueError(
+            "prompt_lengths must lie in [1, padded width="
+            f"{prompt.shape[1]}], got range "
+            f"[{lens_np.min()}, {lens_np.max()}]"
+        )
+    return jnp.asarray(lens_np, jnp.int32)
+
+
 def _sampling_args(cfg, temperature, top_k, top_p, key):
     """Shared wrapper-side validation for the generate family; returns
     (greedy, temperature-array, top_p-array, key)."""
@@ -817,6 +837,16 @@ def lm_generate(
         raise ValueError(
             f"eos_id must be in [0, vocab={cfg.vocab}), got {eos_id}"
         )
+    if eos_id is not None and (return_state or return_logits):
+        # a frozen row's GenState is poisoned (pad tokens fill its
+        # cache, last_tok is the pad) and its gen_logits tail no longer
+        # satisfies "row t predicts token t+1" — reject rather than
+        # hand back silently-wrong continuations/parity hooks
+        raise ValueError(
+            "eos_id does not compose with return_state/return_logits: "
+            "frozen rows cache pad tokens, which breaks the multi-turn "
+            "and logits-parity contracts"
+        )
     # eos rides as a TRACED operand (same contract as temperature/
     # top_p: serving different stop tokens must not recompile); only
     # its PRESENCE is static
@@ -832,23 +862,8 @@ def lm_generate(
             )
         if steps == 0:
             raise ValueError("ragged generation needs steps >= 1")
-        lens_np = np.asarray(prompt_lengths)
-        if lens_np.ndim != 1 or lens_np.shape[0] != prompt.shape[0]:
-            raise ValueError(
-                f"prompt_lengths must be [B={prompt.shape[0]}], got "
-                f"shape {lens_np.shape}"
-            )
-        if lens_np.min() < 1 or lens_np.max() > prompt.shape[1]:
-            # out-of-range lengths would SILENTLY produce garbage under
-            # jit (clamped gathers, dropped scatters) — fail here where
-            # the values are concrete
-            raise ValueError(
-                "prompt_lengths must lie in [1, padded width="
-                f"{prompt.shape[1]}], got range "
-                f"[{lens_np.min()}, {lens_np.max()}]"
-            )
         return _lm_generate_ragged_jit(
-            params, prompt, jnp.asarray(prompt_lengths, jnp.int32),
+            params, prompt, _validate_prompt_lengths(prompt_lengths, prompt),
             temperature, top_p_arr, key,
             cfg=cfg, steps=steps, top_k=top_k,
             has_top_p=top_p is not None, greedy=greedy, capacity=capacity,

@@ -189,6 +189,86 @@ class TestEos:
                 params, jnp.zeros((1, 4), jnp.int32), cfg, steps=2,
                 eos_id=61,
             )
+        # frozen rows cache pad tokens: GenState/logits contracts break,
+        # so the compositions are rejected rather than silently wrong
+        with pytest.raises(ValueError, match="does not compose"):
+            lm_generate(
+                params, jnp.zeros((1, 4), jnp.int32), cfg, steps=2,
+                eos_id=3, return_state=True,
+            )
+        with pytest.raises(ValueError, match="does not compose"):
+            lm_generate(
+                params, jnp.zeros((1, 4), jnp.int32), cfg, steps=2,
+                eos_id=3, return_logits=True,
+            )
+
+
+class TestRaggedSpeculative:
+    """spec decode x ragged batches: the exactness contract holds per
+    row against plain greedy decode of the unpadded prompt."""
+
+    def test_ragged_spec_equals_plain_greedy(self):
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        rng = np.random.default_rng(11)
+        tcfg = dataclasses.replace(BASE, n_kv_heads=2, rope=True)
+        dcfg = LMConfig(vocab=61, d_model=16, n_heads=2, n_layers=1,
+                        d_ff=32)
+        tparams = init_lm(jax.random.PRNGKey(12), tcfg)
+        dparams = init_lm(jax.random.PRNGKey(13), dcfg)
+        rows, padded, lengths = _ragged_prompts(rng, [5, 11, 8], pad_to=11)
+        steps = 9
+        out, st = speculative_generate(
+            tparams, tcfg, dparams, dcfg, jnp.asarray(padded), steps,
+            gamma=3, prompt_lengths=lengths, return_stats=True,
+        )
+        out = np.asarray(out)
+        for i, r in enumerate(rows):
+            plain = np.asarray(
+                lm_generate(tparams, jnp.asarray(r[None, :]), tcfg,
+                            steps=steps)
+            )[0]
+            np.testing.assert_array_equal(
+                out[i, : r.size + steps], plain, err_msg=f"row {i}"
+            )
+            assert (out[i, r.size + steps:] == 0).all()
+        assert int(st["rounds"]) >= 1
+
+    def test_dense_batches_unchanged(self):
+        """lengths=None must reproduce the pre-ragged dense behavior
+        (exactness vs plain greedy — the existing contract)."""
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        rng = np.random.default_rng(14)
+        prompt = jnp.asarray(rng.integers(1, 61, (2, 7)), np.int32)
+        params = init_lm(jax.random.PRNGKey(15), BASE)
+        dcfg = LMConfig(vocab=61, d_model=16, n_heads=2, n_layers=1,
+                        d_ff=32)
+        dparams = init_lm(jax.random.PRNGKey(16), dcfg)
+        plain = np.asarray(lm_generate(params, prompt, BASE, steps=6))
+        spec = np.asarray(
+            speculative_generate(
+                params, BASE, dparams, dcfg, prompt, 6, gamma=2
+            )
+        )
+        np.testing.assert_array_equal(plain, spec)
+
+    def test_ragged_spec_validation(self):
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        params = init_lm(jax.random.PRNGKey(0), BASE)
+        with pytest.raises(ValueError, match="lie in|range"):
+            speculative_generate(
+                params, BASE, params, BASE,
+                jnp.zeros((2, 4), jnp.int32), 2,
+                prompt_lengths=np.asarray([0, 4], np.int32),
+            )
 
 
 def test_ragged_rejects_unsupported_composition():
