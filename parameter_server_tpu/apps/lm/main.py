@@ -552,10 +552,6 @@ def main(argv=None) -> int:
                       file=sys.stderr)
 
     if args.prompt is not None:
-        if args.moe_every:
-            print("generation skipped: lm_generate is dense-FFN only",
-                  file=sys.stderr)
-            return 0
         prompt = np.frombuffer(
             args.prompt.encode("utf-8", "replace") or b"\n", np.uint8
         ).astype(np.int32)[None, :]

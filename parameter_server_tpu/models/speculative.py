@@ -123,7 +123,9 @@ def speculative_generate(
     ``gamma``: draft proposals per round. Both configs must share the
     vocab; windows/rope/GQA/bf16/int8-cache compose per model
     independently (each model runs its OWN config against its own
-    cache). Dense FFN only (same restriction as lm_generate).
+    cache), and MoE targets/drafts are served with dropless routing
+    (transformer._moe_ffn_dropless; exactness pinned in
+    tests/test_moe_serving.py).
 
     ``prompt_lengths`` [B] enables RAGGED batches (same contract as
     ``lm_generate``): right-padded prompts, each row speculating from
@@ -143,12 +145,6 @@ def speculative_generate(
     (finished rows keep spinning until the slowest row completes, and
     their idle work must not skew the number that decides whether a
     draft model pays for itself)."""
-    for name, cfg in (("target", target_cfg), ("draft", draft_cfg)):
-        if cfg.moe_every > 0:
-            raise ValueError(
-                f"speculative_generate: {name} model must be dense-FFN "
-                "(same restriction as lm_generate)"
-            )
     if target_cfg.vocab != draft_cfg.vocab:
         raise ValueError(
             f"vocab mismatch: target {target_cfg.vocab} vs draft "

@@ -188,6 +188,54 @@ def _is_moe_layer(cfg: LMConfig, i: int) -> bool:
     return cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
 
 
+def _moe_layer_params(params, i: int):
+    """The MoE leaves of layer ``i`` for the serving path (one place —
+    three forwards consume this slice)."""
+    return {
+        "moe_router": params[f"l{i}/moe_router"],
+        "moe_w_in": params[f"l{i}/moe_w_in"],
+        "moe_w_out": params[f"l{i}/moe_w_out"],
+    }
+
+
+def _moe_ffn_dropless(lp, h2, n_experts: int):
+    """Serving-side MoE FFN: DROPLESS per-token top-1 routing.
+
+    The training layer (models/moe.py) drops over-capacity tokens, and
+    which tokens drop depends on every other token in the shard — a
+    decision incremental decoding cannot reproduce (the cache sees
+    tokens one at a time). Serving therefore routes every token
+    independently with no capacity: self-consistent across prefill /
+    chunk-ingest / one-token decode (the generate-family exactness
+    contracts hold), and equal to the training forward whenever the
+    training capacity did not bind (capacity_factor >= n_experts
+    guarantees that; tests pin it). Math mirrors moe_ffn: routing and
+    experts in f32, relu activation, gate-weighted output.
+
+    Implementation is a static per-expert loop with masking — every
+    expert's weights are read once regardless of batch (decode is
+    weights-bound anyway) and no [T, E, C] dispatch tensor or per-token
+    weight gather is materialized. COST NOTE: this computes every
+    expert's FFN over all T tokens (n_experts x the dense-FFN FLOPs),
+    which is the right trade for the one-token decode step but makes
+    MoE PREFILL compute-heavy on long prompts; a sort/gather-by-expert
+    prefill variant is the known optimization if MoE serving becomes a
+    measured bottleneck."""
+    shape = h2.shape
+    x = h2.reshape(-1, shape[-1]).astype(jnp.float32)  # [T, d]
+    router = lp["moe_router"].astype(jnp.float32)
+    gates = jax.nn.softmax(x @ router, axis=-1)  # [T, E]
+    expert = jnp.argmax(gates, axis=-1)  # [T]
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=1)[:, 0]
+    out = jnp.zeros_like(x)
+    for e in range(n_experts):
+        y = jax.nn.relu(
+            x @ lp["moe_w_in"][e].astype(jnp.float32)
+        ) @ lp["moe_w_out"][e].astype(jnp.float32)
+        out = out + jnp.where((expert == e)[:, None], y, 0.0)
+    return (out * gate[:, None]).reshape(shape)
+
+
 def _ln(x, scale):
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
@@ -476,7 +524,12 @@ def _chunk_decode(params, cfg: LMConfig, toks, kcache, vcache, pos):
         )
         x = x + att @ cast("wo")
         h2 = _ln(x, cast("ln2"))
-        x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
+        if _is_moe_layer(cfg, i):
+            x = x + _moe_ffn_dropless(
+                _moe_layer_params(params, i), h2, cfg.n_experts
+            ).astype(dtype)
+        else:
+            x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
     x32 = x.astype(jnp.float32)
     return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
 
@@ -531,7 +584,12 @@ def _decode_step(params, cfg: LMConfig, tok, kcache, vcache, pos):
         )
         x = x + att @ cast("wo")
         h2 = _ln(x, cast("ln2"))
-        x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
+        if _is_moe_layer(cfg, i):
+            x = x + _moe_ffn_dropless(
+                _moe_layer_params(params, i), h2, cfg.n_experts
+            ).astype(dtype)
+        else:
+            x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
     x32 = x.astype(jnp.float32)
     return _ln(x32, params["ln_f"]) @ params["emb"].T, kcache, vcache
 
@@ -639,7 +697,12 @@ def _prefill(params, cfg: LMConfig, prompt, kcache, vcache):
         att = _prefill_attention(q, k, v, cfg.window).astype(dtype)
         x = x + att @ cast("wo")
         h2 = _ln(x, cast("ln2"))
-        x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
+        if _is_moe_layer(cfg, i):
+            x = x + _moe_ffn_dropless(
+                _moe_layer_params(params, i), h2, cfg.n_experts
+            ).astype(dtype)
+        else:
+            x = x + jax.nn.gelu(h2 @ cast("w1")) @ cast("w2")
     x32 = x.astype(jnp.float32)
     logits = _ln(x32, params["ln_f"]) @ params["emb"].T
     return logits, kcache, vcache
@@ -807,9 +870,11 @@ def lm_generate(
     probability >= top_p; both filters compose — k-truncate, then
     nucleus). Sampling needs ``key``. A non-zero temperature is a
     TRACED operand of the jitted core — sweeping it does not recompile
-    the decode scan. Returns [B, P+steps]. Dense FFN layers only (the
-    reference has no serving path at all; MoE decode would need token
-    routing with batch-1 capacity, out of scope).
+    the decode scan. Returns [B, P+steps]. MoE layers are served with DROPLESS
+    per-token routing (see :func:`_moe_ffn_dropless` — capacity drops
+    are a whole-batch decision incremental decoding cannot reproduce;
+    outputs match the training forward exactly whenever its capacity
+    did not bind).
 
     ``return_state=True`` appends a :class:`GenState` to the return —
     resumable by :func:`lm_generate_continue` for multi-turn serving
@@ -821,8 +886,6 @@ def lm_generate(
     detection, sign/range checks) needs concrete Python values, which a
     jitted body never sees — the heavy lifting lives in the jitted core
     below."""
-    if cfg.moe_every > 0:
-        raise ValueError("lm_generate supports dense FFN layers only")
     greedy, temperature, top_p_arr, key = _sampling_args(
         cfg, temperature, top_k, top_p, key
     )
@@ -1082,10 +1145,6 @@ def lm_generate_continue(
     ``state.length`` rides as a TRACED operand: turns of the same
     (new-turn width, steps) shape reuse one compiled program no matter
     how long the conversation has grown."""
-    if cfg.moe_every > 0:
-        raise ValueError(
-            "the lm_generate family supports dense FFN layers only"
-        )
     greedy, temperature, top_p_arr, key = _sampling_args(
         cfg, temperature, top_k, top_p, key
     )

@@ -418,15 +418,22 @@ class TestGenerate:
                 allowed = np.argsort(logits[0, t])[-k:]
                 assert out[0, t + 1] in allowed, (t, out[0, t + 1], allowed)
 
-    def test_generate_rejects_moe(self, params):
-        from parameter_server_tpu.models.transformer import lm_generate
+    def test_generate_supports_moe(self):
+        """Round 4 lifted the dense-FFN-only restriction: MoE models
+        generate (dropless per-token routing; exactness suite in
+        tests/test_moe_serving.py — this pins mere reachability)."""
+        from parameter_server_tpu.models.transformer import (
+            init_lm,
+            lm_generate,
+        )
 
         cfg_m = LMConfig(
             vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
-            moe_every=2,
+            moe_every=2, n_experts=4,
         )
-        with pytest.raises(ValueError, match="dense FFN"):
-            lm_generate(params, np.zeros((1, 4), np.int32), cfg_m, steps=1)
+        p_m = init_lm(jax.random.PRNGKey(0), cfg_m)
+        out = lm_generate(p_m, np.zeros((1, 4), np.int32), cfg_m, steps=2)
+        assert np.asarray(out).shape == (1, 6)
 
 
 class TestDecodeStepChunkParity:
