@@ -1111,6 +1111,146 @@ def _lm_generate_ragged_jit(
     return out
 
 
+def lm_beam_search(
+    params: Dict[str, jax.Array],
+    prompt: jax.Array,  # [B, P] int32
+    cfg: LMConfig,
+    steps: int,
+    *,
+    beam_width: int = 4,
+    eos_id: "int | None" = None,
+    length_penalty: float = 0.0,
+) -> "Tuple[jax.Array, jax.Array]":
+    """Beam search over the KV-cached decode path: maintains the
+    ``beam_width`` highest-logprob continuations per prompt and returns
+    ``(tokens [B, W, P+steps], scores [B, W])`` best-first.
+
+    One prefill on [B, P] fills the caches, which are then tiled W×
+    (beam-major rows ``b*W + w``); every step scores all ``W * vocab``
+    candidates, keeps the global top W, and REORDERS the caches by each
+    survivor's parent beam (the gather is the classic beam cost).
+    ``scores`` are exact sums of next-token log-probabilities under the
+    model — tests pin them against teacher-forcing the returned
+    sequences through the training forward.
+
+    ``eos_id``: a beam that emits it is FINISHED — its score freezes
+    and it pads (it competes as a single candidate; an unfinished beam
+    can still overtake it). ``length_penalty`` alpha applies the GNMT
+    normalization ``score / ((5 + len) / 6)^alpha`` at the FINAL
+    ranking only (len = generated tokens incl. eos; without eos all
+    beams share one length and the ranking is unaffected).
+
+    Deterministic (no sampling); dense batches only."""
+    if beam_width < 1:
+        raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(
+            f"eos_id must be in [0, vocab={cfg.vocab}), got {eos_id}"
+        )
+    if beam_width > cfg.vocab:
+        raise ValueError(
+            f"beam_width {beam_width} > vocab {cfg.vocab}: the first "
+            "expansion cannot fill the beams"
+        )
+    toks, scores, gen_len = _beam_jit(
+        params, prompt,
+        jnp.asarray(0 if eos_id is None else eos_id, jnp.int32),
+        cfg=cfg, steps=steps, beam_width=beam_width,
+        has_eos=eos_id is not None,
+    )
+    # final ranking on the host: length_penalty only scales the [B, W]
+    # ranking, so sweeping alpha must never recompile the decode program
+    if length_penalty:
+        norm = ((5.0 + gen_len.astype(jnp.float32)) / 6.0) ** float(
+            length_penalty
+        )
+        ranked = scores / norm
+    else:
+        ranked = scores
+    order = jnp.argsort(-ranked, axis=1)
+    return (
+        jnp.take_along_axis(toks, order[:, :, None], axis=1),
+        jnp.take_along_axis(scores, order, axis=1),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "steps", "beam_width", "has_eos"),
+)
+def _beam_jit(params, prompt, eos, *, cfg, steps, beam_width, has_eos):
+    b, p_len = prompt.shape
+    w = beam_width
+    total = p_len + steps
+    prompt = prompt.astype(jnp.int32)
+    kc, vc = _alloc_kv_caches(cfg, b, total)
+    prefill_logits, kc, vc = _prefill(params, cfg, prompt, kc, vc)
+    logp0 = jax.nn.log_softmax(
+        prefill_logits[:, -1].astype(jnp.float32), axis=-1
+    )  # [B, V]
+    scores, tok0 = jax.lax.top_k(logp0, w)  # [B, W] each
+    # beam-major tiling: row r = b*W + w_idx shares prompt history
+    tile = lambda a: jnp.repeat(a, w, axis=1)  # noqa: E731  [L,B,...] -> [L,B*W,...]
+    kc, vc = (
+        jax.tree.map(lambda x: tile(x) if x is not None else None, c,
+                     is_leaf=lambda x: x is None)
+        for c in (kc, vc)
+    )
+    toks = jnp.broadcast_to(
+        prompt[:, None, :], (b, w, p_len)
+    )
+    toks = jnp.concatenate(
+        [toks, jnp.zeros((b, w, steps), jnp.int32)], axis=2
+    )
+    toks = toks.at[:, :, p_len].set(tok0)
+    done = (tok0 == eos) if has_eos else jnp.zeros((b, w), bool)
+    gen_len = jnp.ones((b, w), jnp.int32)  # tokens emitted (incl. eos)
+    batch_base = (jnp.arange(b) * w)[:, None]  # [B, 1]
+
+    def body(carry, pos):
+        toks, kc, vc, scores, done, gen_len = carry
+        cur = toks[:, :, pos].reshape(b * w)
+        logits, kc, vc = _decode_step(params, cfg, cur, kc, vc, pos)
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1
+        ).reshape(b, w, cfg.vocab)
+        if has_eos:
+            # a finished beam competes as ONE candidate: pad (token 0)
+            # at unchanged score; every other continuation is -inf
+            frozen = jnp.full_like(logp, -jnp.inf).at[:, :, 0].set(0.0)
+            logp = jnp.where(done[:, :, None], frozen, logp)
+        cand = scores[:, :, None] + logp  # [B, W, V]
+        scores, idx = jax.lax.top_k(cand.reshape(b, w * cfg.vocab), w)
+        parent = idx // cfg.vocab  # [B, W]
+        tok = (idx % cfg.vocab).astype(jnp.int32)
+        # reorder beam state by parent
+        toks = jnp.take_along_axis(toks, parent[:, :, None], axis=1)
+        done = jnp.take_along_axis(done, parent, axis=1)
+        gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+        flat_parent = (batch_base + parent).reshape(-1)  # [B*W]
+
+        def reorder(x):
+            return None if x is None else x[:, flat_parent]
+
+        kc = jax.tree.map(reorder, kc, is_leaf=lambda x: x is None)
+        vc = jax.tree.map(reorder, vc, is_leaf=lambda x: x is None)
+        toks = toks.at[:, :, pos + 1].set(tok)
+        if has_eos:
+            gen_len = gen_len + (~done).astype(jnp.int32)
+            done = done | (tok == eos)
+        else:
+            gen_len = gen_len + 1
+        return (toks, kc, vc, scores, done, gen_len), None
+
+    (toks, kc, vc, scores, done, gen_len), _ = jax.lax.scan(
+        body, (toks, kc, vc, scores, done, gen_len),
+        jnp.arange(p_len, total - 1),
+    )
+    return toks, scores, gen_len
+
+
 def lm_generate_continue(
     params: Dict[str, jax.Array],
     state: GenState,
