@@ -150,6 +150,17 @@ def main(argv=None) -> int:
         help="nucleus sampling: keep the smallest probability mass >= "
         "top-p (composes with --top-k; needs --temperature > 0)",
     )
+    ap.add_argument(
+        "--beam", type=int, default=0, metavar="W",
+        help="beam search with W beams instead of greedy/sampled "
+        "decoding (prints the best beam; deterministic — ignores "
+        "--temperature/--top-k/--top-p)",
+    )
+    ap.add_argument(
+        "--eos-byte", type=int, default=None, metavar="B",
+        help="stop-token byte: a generation that emits byte B freezes "
+        "('eos then pads'); works with greedy/sampled and --beam",
+    )
     args = ap.parse_args(argv)
 
     from ...parallel.mesh import honor_jax_platforms
@@ -555,16 +566,34 @@ def main(argv=None) -> int:
         prompt = np.frombuffer(
             args.prompt.encode("utf-8", "replace") or b"\n", np.uint8
         ).astype(np.int32)[None, :]
-        out = np.asarray(
-            lm_generate(
+        if args.beam:
+            from ...models.transformer import lm_beam_search
+
+            beams, scores = lm_beam_search(
                 params, prompt, cfg, steps=args.gen_tokens,
-                temperature=args.temperature, top_k=args.top_k,
-                top_p=args.top_p,
-                key=jax.random.PRNGKey(args.seed + 1),
+                beam_width=args.beam, eos_id=args.eos_byte,
             )
-        )[0]
+            out = np.asarray(beams)[0, 0]
+            note = f"beam {args.beam}, logprob {float(scores[0, 0]):.2f}"
+        else:
+            out = np.asarray(
+                lm_generate(
+                    params, prompt, cfg, steps=args.gen_tokens,
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p, eos_id=args.eos_byte,
+                    key=jax.random.PRNGKey(args.seed + 1),
+                )
+            )[0]
+            note = "greedy" if not args.temperature else "sampled"
+        if args.eos_byte is not None:
+            # "eos then pads": truncate at the first stop byte inside
+            # the GENERATED region so the terminal never sees the pads
+            gen_start = prompt.shape[1]
+            hits = np.flatnonzero(out[gen_start:] == args.eos_byte)
+            if hits.size:
+                out = out[: gen_start + hits[0] + 1]
         text = bytes(out.astype(np.uint8)).decode("utf-8", "replace")
-        print(f"--- generation ({args.gen_tokens} tokens) ---")
+        print(f"--- generation ({args.gen_tokens} tokens, {note}) ---")
         print(text)
     return 0
 

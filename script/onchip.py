@@ -779,6 +779,59 @@ def task_lm() -> int:
                 "error": repr(e)[:500],
             })
 
+    # beam search: the serving mode whose per-step cost ADDS the cache
+    # parent-gather to the decode step — price it against plain decode
+    # at the same batch of sequences (W x the rows, so tok/s here is
+    # sequences-completed x steps, not raw row-tokens)
+    try:
+        from parameter_server_tpu.models.transformer import lm_beam_search
+
+        bw = 4
+        bcfg = _dc.replace(base_cfg, n_kv_heads=kvh)
+        bparams = init_lm(jax.random.PRNGKey(0), bcfg)
+        bprompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, 256, (b, prefill), np.int32)
+        )
+        bsteps = 8 if SMOKE else 128
+        # same differencing discipline as the decode metric: a 1-step
+        # and a bsteps run share the prefill + tiling cost, so the
+        # difference isolates PURE beam stepping — the number the
+        # "compare with plain decode" note needs (the baseline is
+        # differenced the same way)
+        def beam_timed(ns):
+            t0 = time.perf_counter()
+            np.asarray(lm_beam_search(bparams, bprompt, bcfg, steps=ns,
+                                      beam_width=bw)[0])
+            return time.perf_counter() - t0
+
+        beam_timed(1)       # compile short program
+        beam_timed(bsteps)  # compile long program
+        sec_short = beam_timed(1)
+        sec_long = beam_timed(bsteps)
+        beam_sec = sec_long - sec_short
+        noisy = beam_sec < 0.2 * sec_long
+        if noisy:
+            beam_sec = sec_long  # conservative: charge the whole call
+        rec = {
+            "metric": f"lm_beam_search_w{bw}",
+            "value": round(b * (bsteps - 1) / beam_sec, 1),
+            "unit": "sequences*steps/sec",
+            "batch": b, "prefill": prefill, "steps": bsteps,
+            "beam_width": bw, "diff_noisy": noisy,
+            "note": "differenced like lm_decode_tokens_per_sec_kv* "
+            "(prefill+tiling excluded); per-step cost includes the "
+            "W-way cache parent-gather",
+            "device_kind": dev.device_kind,
+        }
+        rec.update(session_stats(
+            rec["metric"], rec["value"],
+            {"device_kind": rec["device_kind"], "batch": b,
+             "prefill": prefill, "steps": bsteps},
+        ))
+        emit(rec)
+    except Exception as e:
+        emit({"metric": "lm_beam_search_w4", "error": repr(e)[:400]})
+
     # Speculative decoding: rounds replace per-token target passes.
     # A speed claim needs a draft whose proposals the target ACCEPTS —
     # two random-init models give degenerate acceptance and prove

@@ -55,6 +55,20 @@ def test_lm_cli_trains_and_generates(mesh8, capsys):
     assert "--- generation" in out
 
 
+def test_lm_cli_beam_and_eos(mesh8, capsys):
+    out, losses = run_cli(capsys, "--beam", "3", "--eos-byte", "10")
+    assert losses[-1] < losses[0], losses
+    assert "beam 3, logprob" in out
+
+
+def test_lm_cli_moe_generates(mesh8, capsys):
+    """Round 4: MoE models generate from the CLI (the old path printed
+    'generation skipped' and exited)."""
+    out, _ = run_cli(capsys, "--moe-every", "2")
+    assert "--- generation" in out
+    assert "generation skipped" not in out
+
+
 def test_lm_cli_zigzag_mode(mesh8, capsys):
     out, losses = run_cli(capsys, "--attention", "ring_zigzag")
     assert losses[-1] < losses[0], losses
