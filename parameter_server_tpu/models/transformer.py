@@ -1120,6 +1120,7 @@ def lm_beam_search(
     beam_width: int = 4,
     eos_id: "int | None" = None,
     length_penalty: float = 0.0,
+    prompt_lengths: "jax.Array | None" = None,
 ) -> "Tuple[jax.Array, jax.Array]":
     """Beam search over the KV-cached decode path: maintains the
     ``beam_width`` highest-logprob continuations per prompt and returns
@@ -1140,7 +1141,15 @@ def lm_beam_search(
     ranking only (len = generated tokens incl. eos; without eos all
     beams share one length and the ranking is unaffected).
 
-    Deterministic (no sampling); dense batches only."""
+    ``prompt_lengths`` [B] enables RAGGED batches (same contract as
+    lm_generate): right-padded prompts, each prompt's beams expanding
+    from its own length — row b's beams carry their continuations at
+    ``[len_b, len_b + steps)`` (zeros beyond), and every prompt's beam
+    set equals what a single-prompt call on the unpadded prompt
+    produces. The ragged path steps through the per-row-position chunk
+    decode; dense batches keep the scalar-position fast path.
+
+    Deterministic (no sampling)."""
     if beam_width < 1:
         raise ValueError(f"beam_width must be >= 1, got {beam_width}")
     if steps < 1:
@@ -1154,11 +1163,15 @@ def lm_beam_search(
             f"beam_width {beam_width} > vocab {cfg.vocab}: the first "
             "expansion cannot fill the beams"
         )
+    if prompt_lengths is not None:
+        lengths = _validate_prompt_lengths(prompt_lengths, prompt)
+    else:
+        lengths = jnp.full(prompt.shape[0], prompt.shape[1], jnp.int32)
     toks, scores, gen_len = _beam_jit(
-        params, prompt,
+        params, prompt, lengths,
         jnp.asarray(0 if eos_id is None else eos_id, jnp.int32),
         cfg=cfg, steps=steps, beam_width=beam_width,
-        has_eos=eos_id is not None,
+        has_eos=eos_id is not None, ragged=prompt_lengths is not None,
     )
     # final ranking on the host: length_penalty only scales the [B, W]
     # ranking, so sweeping alpha must never recompile the decode program
@@ -1178,18 +1191,25 @@ def lm_beam_search(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "steps", "beam_width", "has_eos"),
+    static_argnames=("cfg", "steps", "beam_width", "has_eos", "ragged"),
 )
-def _beam_jit(params, prompt, eos, *, cfg, steps, beam_width, has_eos):
+def _beam_jit(params, prompt, lengths, eos, *, cfg, steps, beam_width,
+              has_eos, ragged):
     b, p_len = prompt.shape
     w = beam_width
     total = p_len + steps
     prompt = prompt.astype(jnp.int32)
     kc, vc = _alloc_kv_caches(cfg, b, total)
     prefill_logits, kc, vc = _prefill(params, cfg, prompt, kc, vc)
-    logp0 = jax.nn.log_softmax(
-        prefill_logits[:, -1].astype(jnp.float32), axis=-1
-    )  # [B, V]
+    # each prompt's first-expansion logits live at ITS last real
+    # position (== column -1 for dense batches)
+    last = (
+        jnp.take_along_axis(
+            prefill_logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
+        if ragged else prefill_logits[:, -1]  # static slice, no gather
+    )
+    logp0 = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)  # [B, V]
     scores, tok0 = jax.lax.top_k(logp0, w)  # [B, W] each
     # beam-major tiling: row r = b*W + w_idx shares prompt history
     tile = lambda a: jnp.repeat(a, w, axis=1)  # noqa: E731  [L,B,...] -> [L,B*W,...]
@@ -1198,21 +1218,43 @@ def _beam_jit(params, prompt, eos, *, cfg, steps, beam_width, has_eos):
                      is_leaf=lambda x: x is None)
         for c in (kc, vc)
     )
-    toks = jnp.broadcast_to(
-        prompt[:, None, :], (b, w, p_len)
+    col = jnp.arange(p_len)
+    base_prompt = (
+        jnp.where(col[None, :] < lengths[:, None], prompt, 0)
+        if ragged else prompt
     )
+    toks = jnp.broadcast_to(base_prompt[:, None, :], (b, w, p_len))
     toks = jnp.concatenate(
         [toks, jnp.zeros((b, w, steps), jnp.int32)], axis=2
     )
-    toks = toks.at[:, :, p_len].set(tok0)
+    rows_b = jnp.arange(b)[:, None]
+    if ragged:
+        toks = toks.at[
+            rows_b, jnp.arange(w)[None, :], lengths[:, None]
+        ].set(tok0)
+    else:
+        toks = toks.at[:, :, p_len].set(tok0)
     done = (tok0 == eos) if has_eos else jnp.zeros((b, w), bool)
     gen_len = jnp.ones((b, w), jnp.int32)  # tokens emitted (incl. eos)
     batch_base = (jnp.arange(b) * w)[:, None]  # [B, 1]
+    lengths_rows = jnp.repeat(lengths, w)  # [B*W], beam-major
 
-    def body(carry, pos):
-        toks, kc, vc, scores, done, gen_len = carry
-        cur = toks[:, :, pos].reshape(b * w)
-        logits, kc, vc = _decode_step(params, cfg, cur, kc, vc, pos)
+    def body(carry, t):
+        toks, kc, vc, scores, done, gen_len, cur = carry
+        if ragged:
+            # per-row clocks through the chunk path (cache writes,
+            # rope, masks all follow each prompt's own position)
+            pos_rows = lengths_rows + t
+            logits, kc, vc = _chunk_decode(
+                params, cfg, cur.reshape(b * w)[:, None], kc, vc,
+                pos_rows,
+            )
+            logits = logits[:, 0]
+        else:
+            # dense: scalar-position fast path (~2x per token)
+            logits, kc, vc = _decode_step(
+                params, cfg, cur.reshape(b * w), kc, vc, lengths[0] + t
+            )
         logp = jax.nn.log_softmax(
             logits.astype(jnp.float32), axis=-1
         ).reshape(b, w, cfg.vocab)
@@ -1236,17 +1278,25 @@ def _beam_jit(params, prompt, eos, *, cfg, steps, beam_width, has_eos):
 
         kc = jax.tree.map(reorder, kc, is_leaf=lambda x: x is None)
         vc = jax.tree.map(reorder, vc, is_leaf=lambda x: x is None)
-        toks = toks.at[:, :, pos + 1].set(tok)
+        if ragged:
+            toks = toks.at[
+                rows_b, jnp.arange(w)[None, :], (lengths + t + 1)[:, None]
+            ].set(tok)
+        else:
+            # dense: one dynamic-update-slice, not a general scatter
+            toks = jax.lax.dynamic_update_slice_in_dim(
+                toks, tok[:, :, None], p_len + 1 + t, axis=2
+            )
         if has_eos:
             gen_len = gen_len + (~done).astype(jnp.int32)
             done = done | (tok == eos)
         else:
             gen_len = gen_len + 1
-        return (toks, kc, vc, scores, done, gen_len), None
+        return (toks, kc, vc, scores, done, gen_len, tok), None
 
-    (toks, kc, vc, scores, done, gen_len), _ = jax.lax.scan(
-        body, (toks, kc, vc, scores, done, gen_len),
-        jnp.arange(p_len, total - 1),
+    (toks, kc, vc, scores, done, gen_len, _), _ = jax.lax.scan(
+        body, (toks, kc, vc, scores, done, gen_len, tok0),
+        jnp.arange(steps - 1),
     )
     return toks, scores, gen_len
 

@@ -178,6 +178,92 @@ def test_length_penalty_reranks_only(params):
     )
 
 
+def test_ragged_beams_equal_single_prompt_calls(params):
+    """Each prompt's beam set (tokens AND scores) must equal a
+    single-prompt call on the unpadded prompt — the ragged beam path's
+    exactness contract."""
+    rng = np.random.default_rng(10)
+    widths = [4, 9, 6]
+    rows = [rng.integers(1, 37, w).astype(np.int32) for w in widths]
+    padded = np.zeros((3, 9), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, : r.size] = r
+    toks, scores = lm_beam_search(
+        params, jnp.asarray(padded), CFG, steps=5, beam_width=3,
+        prompt_lengths=np.asarray(widths, np.int32),
+    )
+    toks, scores = np.asarray(toks), np.asarray(scores)
+    for i, r in enumerate(rows):
+        solo_t, solo_s = lm_beam_search(
+            params, jnp.asarray(r[None, :]), CFG, steps=5, beam_width=3
+        )
+        np.testing.assert_allclose(
+            scores[i], np.asarray(solo_s)[0], atol=1e-5, rtol=1e-5,
+            err_msg=f"row {i}",
+        )
+        np.testing.assert_array_equal(
+            toks[i, :, : r.size + 5], np.asarray(solo_t)[0],
+            err_msg=f"row {i}",
+        )
+        assert (toks[i, :, r.size + 5:] == 0).all()
+
+
+def test_ragged_beam_with_eos_matches_single_prompt(params):
+    """ragged x eos: the riskiest composition (per-row pad writes,
+    frozen done-beams, gen_len clocks) — each prompt's beams must still
+    equal its single-prompt eos run exactly."""
+    rng = np.random.default_rng(12)
+    widths = [3, 8]
+    rows = [rng.integers(1, 37, w).astype(np.int32) for w in widths]
+    padded = np.zeros((2, 8), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, : r.size] = r
+    # choose an eos from what the eos-free top beams actually emit
+    base, _ = lm_beam_search(
+        params, jnp.asarray(padded), CFG, steps=6, beam_width=2,
+        prompt_lengths=np.asarray(widths, np.int32),
+    )
+    emitted = [
+        t for i in range(2)
+        for t in np.asarray(base)[i, 0, widths[i]: widths[i] + 6].tolist()
+        if t != 0
+    ]
+    if not emitted:
+        pytest.skip("degenerate model emitted only pads")
+    eos = int(emitted[-1])
+    toks, scores = lm_beam_search(
+        params, jnp.asarray(padded), CFG, steps=6, beam_width=2,
+        eos_id=eos, prompt_lengths=np.asarray(widths, np.int32),
+        length_penalty=0.6,
+    )
+    toks, scores = np.asarray(toks), np.asarray(scores)
+    for i, r in enumerate(rows):
+        solo_t, solo_s = lm_beam_search(
+            params, jnp.asarray(r[None, :]), CFG, steps=6, beam_width=2,
+            eos_id=eos, length_penalty=0.6,
+        )
+        np.testing.assert_array_equal(
+            toks[i, :, : r.size + 6], np.asarray(solo_t)[0],
+            err_msg=f"row {i}",
+        )
+        np.testing.assert_allclose(
+            scores[i], np.asarray(solo_s)[0], atol=1e-5, rtol=1e-5
+        )
+
+
+def test_ragged_beam_uniform_equals_dense(params):
+    rng = np.random.default_rng(11)
+    prompt = jnp.asarray(rng.integers(1, 37, (2, 7)), np.int32)
+    a_t, a_s = lm_beam_search(params, prompt, CFG, steps=4, beam_width=2)
+    b_t, b_s = lm_beam_search(
+        params, prompt, CFG, steps=4, beam_width=2,
+        prompt_lengths=np.full(2, 7, np.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(a_t), np.asarray(b_t))
+    np.testing.assert_allclose(np.asarray(a_s), np.asarray(b_s),
+                               atol=1e-5)
+
+
 def test_validation(params):
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="beam_width"):
