@@ -264,6 +264,23 @@ def test_ragged_beam_uniform_equals_dense(params):
                                atol=1e-5)
 
 
+def test_beam_under_tensor_parallelism(params, mesh8):
+    """Beam search with Megatron-placed weights: the per-step cache
+    parent-gather and top-k run over TP-sharded compute — tokens must
+    match the replicated run exactly (scores to f32 psum tolerance)."""
+    from parameter_server_tpu.models.transformer import shard_lm_params
+
+    rng = np.random.default_rng(13)
+    prompt = jnp.asarray(rng.integers(0, 37, (2, 6)), np.int32)
+    rep_t, rep_s = lm_beam_search(params, prompt, CFG, steps=5,
+                                  beam_width=3)
+    tp = shard_lm_params(params, mesh8)
+    tp_t, tp_s = lm_beam_search(tp, prompt, CFG, steps=5, beam_width=3)
+    np.testing.assert_array_equal(np.asarray(rep_t), np.asarray(tp_t))
+    np.testing.assert_allclose(np.asarray(rep_s), np.asarray(tp_s),
+                               atol=1e-4)
+
+
 def test_validation(params):
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="beam_width"):

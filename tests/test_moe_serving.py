@@ -122,6 +122,21 @@ def test_moe_speculative_target(mesh8, params):
     np.testing.assert_array_equal(plain, spec)
 
 
+def test_moe_under_tensor_parallelism(mesh8, params):
+    """MoE decode with TP-sharded dense weights (the expert tables
+    stay replicated under shard_lm_params): tokens equal the
+    replicated run exactly."""
+    from parameter_server_tpu.models.transformer import shard_lm_params
+
+    rng = np.random.default_rng(11)
+    prompt = jnp.asarray(rng.integers(0, 61, (2, 7)), np.int32)
+    rep = np.asarray(lm_generate(params, prompt, MOE, steps=5))
+    tp = np.asarray(
+        lm_generate(shard_lm_params(params, mesh8), prompt, MOE, steps=5)
+    )
+    np.testing.assert_array_equal(rep, tp)
+
+
 def test_moe_sampled_generation_runs(mesh8, params):
     rng = np.random.default_rng(7)
     prompt = jnp.asarray(rng.integers(0, 61, (2, 5)), np.int32)
