@@ -101,6 +101,7 @@ def speculative_generate(
     *,
     gamma: int = 4,
     prompt_lengths: "jax.Array | None" = None,
+    eos_id: "int | None" = None,
     temperature: "float | None" = None,
     key: "jax.Array | None" = None,
     return_stats: bool = False,
@@ -138,6 +139,13 @@ def speculative_generate(
     proposals (rounds write contiguous chunks from the row's front, so
     no hole is ever attended).
 
+    ``eos_id``: a row that COMMITS the stop token finishes — the
+    commit is clamped at the eos and the rest of the row's budget
+    stays pad 0; greedy output exactly matches
+    ``lm_generate(eos_id=)``'s "eos then pads" (tested). Works in the
+    sampled variant too (tokens before the stop keep the target
+    distribution).
+
     ``return_stats=True`` additionally returns
     ``{"rounds": r, "target_passes": r, "accepted_frac": f}`` —
     ``accepted_frac`` is the fraction of draft proposals that were
@@ -152,6 +160,10 @@ def speculative_generate(
         )
     if gamma < 1:
         raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if eos_id is not None and not 0 <= eos_id < target_cfg.vocab:
+        raise ValueError(
+            f"eos_id must be in [0, vocab={target_cfg.vocab}), got {eos_id}"
+        )
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     # mirror lm_generate's contract: greedy detection needs a CONCRETE
@@ -175,18 +187,20 @@ def speculative_generate(
         lengths = _validate_prompt_lengths(prompt_lengths, prompt)
     return _spec_jit(
         target_params, draft_params, prompt, lengths,
-        jnp.asarray(1.0 if greedy else temperature, jnp.float32), key,
+        jnp.asarray(1.0 if greedy else temperature, jnp.float32),
+        jnp.asarray(0 if eos_id is None else eos_id, jnp.int32), key,
         tcfg=target_cfg, dcfg=draft_cfg, steps=steps, gamma=gamma,
-        greedy=greedy, return_stats=return_stats,
+        greedy=greedy, has_eos=eos_id is not None,
+        return_stats=return_stats,
     )
 
 
 @functools.partial(
     jax.jit, static_argnames=("tcfg", "dcfg", "steps", "gamma", "greedy",
-                              "return_stats")
+                              "has_eos", "return_stats")
 )
-def _spec_jit(tparams, dparams, prompt, lengths, temperature, key, *,
-              tcfg, dcfg, steps, gamma, greedy, return_stats):
+def _spec_jit(tparams, dparams, prompt, lengths, temperature, eos, key, *,
+              tcfg, dcfg, steps, gamma, greedy, has_eos, return_stats):
     b, p_len = prompt.shape
     # per-row budget: row b decodes until lengths[b] + steps (for dense
     # batches lengths == p_len everywhere and this is the old scalar)
@@ -217,6 +231,10 @@ def _spec_jit(tparams, dparams, prompt, lengths, temperature, key, *,
         first = jax.random.categorical(k0, last / temperature, axis=-1)
     toks = toks.at[rows, lengths].set(first.astype(jnp.int32))
     committed = lengths + 1
+    if has_eos:
+        # a first token that IS the stop token finishes the row now
+        committed = jnp.where(first.astype(jnp.int32) == eos, limit,
+                              committed)
 
     def round_body(carry):
         toks, committed, tk, tv, dk, dv, key, rounds, acc, prop = carry
@@ -280,11 +298,22 @@ def _spec_jit(tparams, dparams, prompt, lengths, temperature, key, *,
         # capped commit: a finished row re-processes its last slot
         # instead of overflowing the buffer
         n_eff = jnp.minimum(n + 1, limit - committed)
+        if has_eos:
+            # clamp at the first stop token inside the commit: tokens
+            # past it never land ("eos then pads" — toks stays 0
+            # there), and the row freezes below
+            is_eos = (commit_row == eos) & (j_idx < n_eff[:, None])
+            first_eos = jnp.min(
+                jnp.where(is_eos, j_idx, gamma + 1), axis=1
+            )  # [B]; gamma+1 = none
+            n_eff = jnp.minimum(n_eff, first_eos + 1)
         dest = jnp.where(
             j_idx < n_eff[:, None], committed[:, None] + j_idx, trash
         )
         toks = toks.at[rows[:, None], dest].set(commit_row)
         committed = committed + n_eff
+        if has_eos:
+            committed = jnp.where(first_eos <= gamma, limit, committed)
         # stats count only LIVE rows and only accepted-AND-committed
         # proposals (a capped commit may truncate the accepted run)
         acc = acc + jnp.sum(jnp.where(live, jnp.minimum(n, n_eff), 0))

@@ -292,3 +292,89 @@ def test_ragged_rejects_unsupported_composition():
             params, prompt, BASE, steps=2,
             prompt_lengths=np.asarray([2, 5], np.int32),
         )
+
+
+class TestSpeculativeEos:
+    """spec decode x eos: clamped chunk commits must reproduce
+    lm_generate's 'eos then pads' exactly (greedy), dense and ragged."""
+
+    def _models(self):
+        tcfg = dataclasses.replace(BASE, n_kv_heads=2)
+        dcfg = LMConfig(vocab=61, d_model=16, n_heads=2, n_layers=1,
+                        d_ff=32)
+        return (
+            tcfg, init_lm(jax.random.PRNGKey(20), tcfg),
+            dcfg, init_lm(jax.random.PRNGKey(21), dcfg),
+        )
+
+    def test_dense_spec_eos_equals_plain_eos(self):
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        tcfg, tp, dcfg, dp = self._models()
+        rng = np.random.default_rng(22)
+        prompt = jnp.asarray(rng.integers(1, 61, (2, 6)), np.int32)
+        plain = np.asarray(lm_generate(tp, prompt, tcfg, steps=8))
+        emitted = [t for t in plain[:, 6:].ravel().tolist() if t != 0]
+        if not emitted:
+            pytest.skip("degenerate model emitted only pads")
+        eos = int(emitted[len(emitted) // 2])
+        want = np.asarray(
+            lm_generate(tp, prompt, tcfg, steps=8, eos_id=eos)
+        )
+        got = np.asarray(
+            speculative_generate(
+                tp, tcfg, dp, dcfg, prompt, 8, gamma=3, eos_id=eos
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_ragged_spec_eos_equals_plain_eos(self):
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        tcfg, tp, dcfg, dp = self._models()
+        rng = np.random.default_rng(23)
+        rows, padded, lengths = _ragged_prompts(rng, [4, 9], pad_to=9)
+        plain = np.asarray(
+            lm_generate(
+                tp, jnp.asarray(padded), tcfg, steps=7,
+                prompt_lengths=lengths,
+            )
+        )
+        emitted = [
+            t
+            for i in range(2)
+            for t in plain[i, lengths[i]: lengths[i] + 7].tolist()
+            if t != 0
+        ]
+        if not emitted:
+            pytest.skip("degenerate model emitted only pads")
+        eos = int(emitted[-1])
+        want = np.asarray(
+            lm_generate(
+                tp, jnp.asarray(padded), tcfg, steps=7,
+                prompt_lengths=lengths, eos_id=eos,
+            )
+        )
+        got = np.asarray(
+            speculative_generate(
+                tp, tcfg, dp, dcfg, jnp.asarray(padded), 7, gamma=2,
+                prompt_lengths=lengths, eos_id=eos,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_spec_eos_validation(self):
+        from parameter_server_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        tcfg, tp, dcfg, dp = self._models()
+        with pytest.raises(ValueError, match="eos_id"):
+            speculative_generate(
+                tp, tcfg, dp, dcfg, jnp.zeros((1, 4), jnp.int32), 2,
+                eos_id=61,
+            )
