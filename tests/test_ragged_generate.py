@@ -1,7 +1,8 @@
-"""Ragged-batch serving (lm_generate prompt_lengths=): right-padded
-variable-length prompts decode in ONE batch, each row exactly equal to
-a single-row call on its unpadded prompt — across rope, GQA, int8
-cache, and sliding-window configs, and under tensor parallelism."""
+"""Ragged-batch + stop-token serving: right-padded variable-length
+prompts decode in ONE batch, each row exactly equal to a single-row
+call on its unpadded prompt — across rope, GQA, int8 cache, and
+sliding-window configs, under tensor parallelism, for speculative
+decoding, and composed with eos_id (plain and speculative)."""
 
 import dataclasses
 
