@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Idempotently ensure the on-chip evidence watcher is running.
+# Safe to call from any shell hook or session bootstrap: exits 0
+# without action when a watcher is already alive. The watcher itself
+# serializes against benches via the device flock + priority protocol
+# (utils/device_lock.py), so starting it can never collide with a
+# running capture.
+set -euo pipefail
+DIR=$(cd "$(dirname "$0")/.." && pwd)
+if pgrep -f "onchip.py --watch" >/dev/null 2>&1; then
+  echo "watcher already running (pid $(pgrep -f 'onchip.py --watch' | head -1))"
+  exit 0
+fi
+cd "$DIR"
+nohup python script/onchip.py --watch >> doc/onchip_watch_stdout.log 2>&1 &
+disown
+echo "watcher started (pid $!)"
