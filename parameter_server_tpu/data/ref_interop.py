@@ -64,7 +64,10 @@ def _read_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
-            return result, pos
+            # mask to 64 bits: a maximal 10-byte varint carries up to
+            # 70 payload bits, and compliant proto parsers TRUNCATE
+            # (fuzz-found: the unmasked value overflowed numpy uint64)
+            return result & 0xFFFFFFFFFFFFFFFF, pos
         shift += 7
         if shift > 63:
             raise ValueError("varint longer than 64 bits")

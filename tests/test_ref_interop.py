@@ -331,6 +331,36 @@ class TestToolingRoundTrip:
         assert b.n == 3
 
 
+class TestDecoderFuzz:
+    """The wire decoder must never hang/crash on arbitrary bytes —
+    malformed input raises ValueError (or decodes, for bytes that
+    happen to be valid proto), nothing else."""
+
+    def test_random_bytes(self):
+        rng = np.random.default_rng(99)
+        for _ in range(300):
+            blob = rng.integers(0, 256, rng.integers(0, 64),
+                                dtype=np.uint8).tobytes()
+            try:
+                decode_example(blob)
+            except ValueError:
+                pass  # the only acceptable failure mode
+
+    def test_mutated_golden(self):
+        """Bit-flipped versions of REAL payloads — closer to the
+        corruption a torn write produces than uniform noise."""
+        rng = np.random.default_rng(100)
+        payloads = list(iter_ref_records(GOLDEN))
+        for _ in range(200):
+            p = bytearray(payloads[rng.integers(len(payloads))])
+            for _ in range(rng.integers(1, 4)):
+                p[rng.integers(len(p))] ^= 1 << rng.integers(8)
+            try:
+                decode_example(bytes(p))
+            except ValueError:
+                pass
+
+
 class TestInfoAscii:
     def test_roundtrip(self):
         from parameter_server_tpu.data.example import ExampleInfo, SlotInfo
