@@ -112,7 +112,14 @@ def _request_path() -> str:
 def request_priority(note: str = "bench") -> None:
     """Mark that THIS process needs the device now. Cooperative
     background holders (the watcher) yield while the marker is fresh.
-    Atomic write; never raises (a priority marker is best-effort)."""
+    Atomic write; never raises (a priority marker is best-effort).
+
+    No-op when a parent already holds the flock for us (HELD_ENV): a
+    holder's child asking for priority is self-sabotage — the watcher
+    spawning ``bench.py`` saw its own child's probe marker as foreign
+    and preempted it (observed 2026-08-01, task bench killed at 6s)."""
+    if os.environ.get(HELD_ENV):
+        return
     path = _request_path()
     try:
         tmp = f"{path}.{os.getpid()}"
@@ -137,13 +144,18 @@ def clear_priority() -> None:
         pass
 
 
-def foreign_priority(fresh_s: float = PRIORITY_FRESH_S) -> "str | None":
+def foreign_priority(
+    fresh_s: float = PRIORITY_FRESH_S, ignore_pid: "int | None" = None
+) -> "str | None":
     """A fresh priority request from ANOTHER process, or None.
 
     Returns a short human-readable description ("pid 123 note, 45s
     ago") for the yielding side's log. A marker from a dead pid is
     still honored while fresh — the requester may be a shell whose
-    python child does the device work."""
+    python child does the device work. ``ignore_pid`` lets a holder
+    running a known child disregard that child's own marker (belt to
+    request_priority's HELD_ENV suspenders — an older child binary
+    without the no-op would otherwise still self-preempt)."""
     path = _request_path()
     try:
         with open(path) as f:
@@ -153,8 +165,8 @@ def foreign_priority(fresh_s: float = PRIORITY_FRESH_S) -> "str | None":
         note = parts[2].strip() if len(parts) > 2 else "?"
     except (OSError, ValueError, IndexError):
         return None
-    if pid == os.getpid() or os.environ.get(HELD_ENV):
-        return None  # our own request (or our holder parent's)
+    if pid == os.getpid() or pid == ignore_pid or os.environ.get(HELD_ENV):
+        return None  # our own request (or our holder parent's/child's)
     age = time.time() - stamp
     # the marker stamp is written at whole-second precision, so a
     # just-written marker can read up to 0.5s "in the future"; allow a

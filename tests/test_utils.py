@@ -437,3 +437,27 @@ class TestDeviceLock:
             f.write(f"{os.getpid() + 1} {_t.time():.0f} bench\n")
         monkeypatch.setenv("PS_DEVICE_LOCK_HELD", "1")
         assert dl.foreign_priority() is None
+
+    def test_held_child_never_requests_priority(self, tmp_path, monkeypatch):
+        """A process whose parent holds the flock (HELD_ENV) must not
+        write a priority marker: the watcher spawning bench.py saw its
+        own child's probe marker as foreign and preempted it after 6s
+        (observed 2026-08-01). request_priority is a no-op under
+        HELD_ENV; foreign_priority(ignore_pid=child) is the backstop."""
+        import os
+        import time as _t
+
+        import parameter_server_tpu.utils.device_lock as dl
+
+        monkeypatch.setenv("PS_DEVICE_LOCK", str(tmp_path / "dev.lock"))
+        monkeypatch.setenv("PS_DEVICE_LOCK_HELD", "1")
+        dl.request_priority("bench-probe")
+        assert not os.path.exists(dl._request_path())
+        # backstop: even if an old child binary wrote its marker, the
+        # watcher ignores the pid of the child it spawned
+        monkeypatch.delenv("PS_DEVICE_LOCK_HELD", raising=False)
+        child_pid = os.getpid() + 1
+        with open(dl._request_path(), "w") as f:
+            f.write(f"{child_pid} {_t.time():.0f} bench-probe\n")
+        assert dl.foreign_priority() is not None
+        assert dl.foreign_priority(ignore_pid=child_pid) is None
