@@ -494,6 +494,17 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
         _grace_for_transfer(nb)
         staged, sec_up = timed_upload(sb)
         up_s += sec_up
+        if profile_dir and i == 0:
+            # fresh capture: the watcher reuses a fixed /tmp path, and
+            # summarize_trace must not mix this run with stale traces
+            # from a previous bench (or code version). Remove ONLY the
+            # profiler's own plugins/ subtree — the user may have
+            # pointed --profile at a directory holding other files
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(profile_dir, "plugins"), ignore_errors=True
+            )
         ctx = (
             device_trace(profile_dir) if (profile_dir and i == 0)
             else contextlib.nullcontext()
@@ -524,6 +535,16 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
         out["breakdown_upload_mb_s"] = round(bytes_moved / up_s / 1e6, 1)
     if profile_dir:
         out["profile_dir"] = profile_dir
+        from parameter_server_tpu.utils.profiling import summarize_trace
+
+        summary = summarize_trace(profile_dir)
+        if summary:
+            # self-contained phase attribution (ps_pull/ps_compute/
+            # ps_push/ps_update named scopes) — the record answers
+            # "where does the device step time go" without TensorBoard
+            out["profile_device_ms"] = summary["device_ms"]
+            out["profile_phases_ms"] = summary["phases"]
+            out["profile_top_ops"] = summary["top_ops"][:6]
     return out
 
 
@@ -1077,7 +1098,8 @@ def main() -> int:
         metavar="DIR",
         help="capture a jax.profiler device trace of one serialized "
         "launch into DIR (utils/profiling.device_trace; view in "
-        "TensorBoard/Perfetto)",
+        "TensorBoard/Perfetto). DIR/plugins from any previous capture "
+        "is removed first so the summary reflects this run only",
     )
     ap.add_argument(
         "--stall-timeout",

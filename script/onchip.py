@@ -62,7 +62,13 @@ TASKS = [
     ("bench", [sys.executable, "bench.py"], 2400),
     ("lm", None, 3600),
     ("scale", None, 2400),
-    ("bench_real", [sys.executable, "bench.py", "--real"], 5400),
+    # --profile: one jax.profiler device trace of the first serialized
+    # launch, summarized into the record by named-scope phase
+    # (ps_pull/ps_compute/ps_push/ps_update) — the r3 verdict's
+    # "where does the --real step time go" breakdown
+    ("bench_real",
+     [sys.executable, "bench.py", "--real",
+      "--profile", "/tmp/ps_profile_real"], 5400),
     ("flash", None, 2400),
     ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 2400),
 ]

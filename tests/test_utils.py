@@ -461,3 +461,108 @@ class TestDeviceLock:
             f.write(f"{child_pid} {_t.time():.0f} bench-probe\n")
         assert dl.foreign_priority() is not None
         assert dl.foreign_priority(ignore_pid=child_pid) is None
+
+
+class TestTraceSummary:
+    def test_summarize_synthetic_chrome_trace(self, tmp_path):
+        """summarize_trace buckets device-track complete events by
+        named-scope phase (ps_* prefixes reach HLO op metadata) and
+        ignores host tracks; no trace -> None."""
+        import gzip
+        import json
+
+        from parameter_server_tpu.utils.profiling import summarize_trace
+
+        assert summarize_trace(str(tmp_path)) is None
+
+        events = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "python host threads"}},
+            # device tracks: only the op-level tid counts — the
+            # module-span tid covers the sum of its ops and would
+            # double device_ms if included
+            {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+             "args": {"name": "XLA Modules"}},
+            # device ops: args.name carries the jax.named_scope path
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1500,
+             "name": "fusion.1",
+             "args": {"name": "jit(step)/ps_pull/gather"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 1500, "dur": 2500,
+             "name": "fusion.2",
+             "args": {"name": "jit(step)/ps_update/while"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 4000, "dur": 500,
+             "name": "copy.3", "args": {}},
+            # module aggregate span: must NOT count
+            {"ph": "X", "pid": 1, "tid": 2, "ts": 0, "dur": 4500,
+             "name": "jit_mini_step", "args": {}},
+            # host event on another track: must not count
+            {"ph": "X", "pid": 2, "tid": 9, "ts": 0, "dur": 9e6,
+             "name": "$main.py:1 run", "args": {}},
+        ]
+        run = tmp_path / "plugins" / "profile" / "run1"
+        run.mkdir(parents=True)
+        with gzip.open(run / "host.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+        s = summarize_trace(str(tmp_path))
+        assert s is not None
+        assert s["device_ms"] == 4.5
+        assert s["phases"]["ps_pull"] == 1.5
+        assert s["phases"]["ps_update"] == 2.5
+        assert s["phases"]["other"] == 0.5
+        names = [o["name"] for o in s["top_ops"]]
+        assert "fusion.2" in names
+        assert "$main.py:1 run" not in names
+        assert "jit_mini_step" not in names
+
+    def test_summarize_newest_run_only_and_host_only_none(self, tmp_path):
+        """A reused profile dir accumulates runs — only the newest
+        plugins/profile/<ts> run is summed; a trace with no
+        identifiable device track returns None (host wall-clock must
+        never be reported as device time)."""
+        import gzip
+        import json
+        import os
+        import time as _t
+
+        from parameter_server_tpu.utils.profiling import summarize_trace
+
+        def write_run(name, dur, device=True):
+            run = tmp_path / "plugins" / "profile" / name
+            run.mkdir(parents=True)
+            pname = "/device:TPU:0" if device else "host python"
+            events = [
+                {"ph": "M", "pid": 1, "name": "process_name",
+                 "args": {"name": pname}},
+                {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": dur,
+                 "name": "fusion.9",
+                 "args": {"name": "jit(f)/ps_compute/dot"}},
+            ]
+            with gzip.open(run / "t.trace.json.gz", "wt") as f:
+                json.dump({"traceEvents": events}, f)
+            return run
+
+        old = write_run("run_old", 7000)
+        _t.sleep(0.05)
+        write_run("run_new", 2000)
+        # age the old dir so mtime ordering is unambiguous
+        os.utime(old, (1, 1))
+        s = summarize_trace(str(tmp_path))
+        assert s is not None and s["device_ms"] == 2.0
+
+        host_only = tmp_path / "hostonly"
+        write_host = host_only / "plugins" / "profile" / "r"
+        write_host.mkdir(parents=True)
+        events = [
+            {"ph": "M", "pid": 5, "name": "process_name",
+             "args": {"name": "python host threads"}},
+            {"ph": "X", "pid": 5, "tid": 1, "ts": 0, "dur": 5e6,
+             "name": "run", "args": {}},
+        ]
+        with gzip.open(write_host / "t.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        assert summarize_trace(str(host_only)) is None
