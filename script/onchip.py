@@ -9,17 +9,20 @@ task — each in its own subprocess with a timeout so a mid-task wedge
 cannot hang the watcher — and appends every result as a timestamped
 JSON line to ``BENCH_ONCHIP.md``.
 
-Tasks (priority order):
+Tasks (priority order — open round evidence first):
   link        host<->device bandwidth + device identity + HBM stats
-  flash       Pallas flash-attention kernels under REAL Mosaic:
-              compile, fwd/bwd parity vs the XLA path (causal, offsets,
-              window, GQA, lse), then GFLOP/s fwd and fwd+bwd
   bench       python bench.py               (synthetic headline)
-  bench_real  python bench.py --real        (parse-in-loop + parity)
+  lm          byte-LM train-step tokens/s + MFU, attention-mode
+              comparison, and the >=100M-param MFU-push configs
+  scale       largest FTRL table on one chip (2^28-2^31) with HBM
+              accounting, f32 vs bf16 FTRL state
+  serve       KV-cached decode (MHA/GQA/int8), beam search,
+              speculative-decoding speedup with a trained draft
+  bench_real  python bench.py --real --profile  (parse-in-loop +
+              parity + named-scope device-time breakdown)
+  flash       Pallas flash-attention kernels under REAL Mosaic:
+              compile, fwd/bwd parity vs the XLA path, then GFLOP/s
   components  python -m parameter_server_tpu.benchmarks
-  lm          byte-LM train-step tokens/s + MFU at seq 8192,
-              attention mode comparison (ring/xla vs ring_flash vs window)
-  scale       largest FTRL table on one chip (2^28+) with HBM accounting
 
 State lives in doc/onchip_state.json (per-task status + attempts); the
 watcher retries failed tasks up to --max-attempts, then keeps re-running
@@ -62,6 +65,7 @@ TASKS = [
     ("bench", [sys.executable, "bench.py"], 2400),
     ("lm", None, 3600),
     ("scale", None, 2400),
+    ("serve", None, 3600),
     # --profile: one jax.profiler device trace of the first serialized
     # launch, summarized into the record by named-scope phase
     # (ps_pull/ps_compute/ps_push/ps_update) — the r3 verdict's
@@ -494,6 +498,20 @@ def task_flash() -> int:
     return 1 if failures else 0
 
 
+def _lm_base() -> dict:
+    """The byte-LM base shape shared by task_lm and task_serve. ONE
+    definition on purpose: serve metrics pool session_stats medians
+    with prior captures keyed on these shapes, so the two tasks
+    drifting apart would silently split the cross-round history."""
+    base = dict(
+        vocab=256, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
+        remat=True, compute_dtype="bfloat16",
+    )
+    if SMOKE:
+        base.update(d_model=64, n_heads=2, n_layers=2, d_ff=128)
+    return base
+
+
 def task_lm() -> int:
     """Byte-LM train step on one chip at seq 8192: tokens/s + MFU for
     each attention mode (VERDICT r2 item 4)."""
@@ -517,12 +535,7 @@ def task_lm() -> int:
     # identical training semantics to spl separate calls, minus the
     # per-step dispatch round trip that dominates through the tunnel
     # (~0.3s/launch — the linear bench's T lever, applied to the LM)
-    base = dict(
-        vocab=256, d_model=512, n_heads=8, n_layers=8, d_ff=2048,
-        remat=True, compute_dtype="bfloat16",
-    )
-    if SMOKE:
-        base.update(d_model=64, n_heads=2, n_layers=2, d_ff=128)
+    base = _lm_base()
     big = dict(base)
     if not SMOKE:  # ~100M params: MFU at a size where matmuls dominate
         big.update(d_model=1024, n_layers=12, d_ff=4096)
@@ -565,6 +578,18 @@ def task_lm() -> int:
             ("mfu_d1024_s4096_noremat",
              LMConfig(attention="ring_flash", **{**big, "remat": False}),
              {"seq": 4096, "batch": 4})
+        )
+        # ~400M params (d 2048, 8 layers, d_ff 8192, d_head 128):
+        # attention falls to ~1/6 of step FLOPs, so the matmul share —
+        # the MXU's home turf — sets MFU almost alone. SGD + donation:
+        # 1.6 GB params + grads transiently, remat activations; fits
+        # one 16 GB chip with room
+        modes.append(
+            ("mfu_d2048_s4096",
+             LMConfig(attention="ring_flash",
+                      **{**base, "d_model": 2048, "n_heads": 16,
+                         "n_layers": 8, "d_ff": 8192}),
+             {"seq": 4096, "batch": 4, "spl": 4})
         )
     rng = np.random.default_rng(0)
 
@@ -649,6 +674,39 @@ def task_lm() -> int:
         except Exception as e:  # keep going: one mode failing is evidence too
             emit({"metric": f"lm_train_{name}", "error": repr(e)[:500]})
 
+    return 0
+
+
+def task_serve() -> int:
+    """Serving-path captures on one chip: KV-cached decode (MHA vs GQA
+    vs GQA+int8 cache) with physically-checked HBM accounting, beam
+    search stepping cost, and speculative-decoding speedup with a
+    TRAINED draft (r3 verdict items 3 and 56s). Split from task_lm so
+    a tunnel wedge mid-train cannot cost the serving evidence and
+    vice versa."""
+    import jax
+    import numpy as np
+
+    from parameter_server_tpu.models.transformer import (
+        LMConfig,
+        init_lm,
+        make_lm_train_step,
+        shard_tokens,
+    )
+    from parameter_server_tpu.system.postoffice import Postoffice
+
+    Postoffice.reset()
+    po = Postoffice.instance().start()
+    mesh = po.mesh
+
+    # the same shapes task_lm's decode section measured historically,
+    # so serve metrics stay comparable across rounds (_lm_base is the
+    # single shared definition)
+    base = _lm_base()
+    base_cfg = LMConfig(attention="ring", **base)
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+
     # KV-cached decode throughput (the serving path): prefill a prompt,
     # then time pure generation tokens/s. Decode is bandwidth-bound
     # (weights re-read per token), so report achieved GB/s vs HBM peak
@@ -663,7 +721,6 @@ def task_lm() -> int:
     # "" = the base (MHA) config; the grouped variant shrinks the KV
     # cache (quartered when n_heads allows, else MQA) — its decode
     # speedup vs base is the on-chip evidence for GQA serving
-    base_cfg = modes[0][1]
     kvh = base_cfg.n_heads // 4 if base_cfg.n_heads % 4 == 0 else 1
     decode_cfgs = [
         ("", base_cfg),
@@ -871,6 +928,11 @@ def task_lm() -> int:
         noise = rng.integers(0, 256, pat.size, np.int32)
         corpus = np.where(rng.random(pat.size) < 0.1, noise, pat)
         train_seq, train_steps = (64, 4) if SMOKE else (512, 120)
+        # shard_tokens shards the [B, S] token width over the data
+        # axis: S = train_seq+1 must divide it (the 8-device CPU smoke
+        # mesh rejected width 65; the single-chip mesh never trips)
+        n_data = mesh.shape.get("data", 1)
+        train_seq = max(n_data, (train_seq + 1) // n_data * n_data) - 1
         trained = {}
         for nm, cfg_i in (("target", tcfg), ("draft", dcfg)):
             p_i = init_lm(jax.random.PRNGKey(0 if nm == "target" else 7),
@@ -1075,7 +1137,7 @@ def task_scale() -> int:
 
 
 INTERNAL = {"link": task_link, "flash": task_flash, "lm": task_lm,
-            "scale": task_scale}
+            "scale": task_scale, "serve": task_serve}
 
 
 # ---------------------------------------------------------------------------
