@@ -56,6 +56,12 @@ class Postoffice:
         # platform programmatically — this is what lets ps.sh/main.py
         # run on CPU meshes
         meshlib.honor_jax_platforms()
+        # persistent compile cache before the first jit: retries and
+        # multi-process runs reuse serialized executables instead of
+        # re-exercising the (fragile, slow through the tunnel) compiler
+        from parameter_server_tpu.utils.compile_cache import enable
+
+        enable()
         init_distributed()
         self.mesh = meshlib.make_mesh(num_data=num_data, num_server=num_server)
         self.van = Van(self.mesh)

@@ -1425,6 +1425,12 @@ def main() -> int:
             import jax
 
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        # persistent compile cache for tasks that never build a
+        # Postoffice (link, flash — the Mosaic kernels recompile ~27s
+        # per attempt otherwise); Postoffice.start() covers the rest
+        from parameter_server_tpu.utils.compile_cache import enable
+
+        enable()
         return INTERNAL[args.task]()
     if args.once:
         up, diag = probe(args.probe_timeout)

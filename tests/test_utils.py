@@ -566,3 +566,31 @@ class TestTraceSummary:
         with gzip.open(write_host / "t.trace.json.gz", "wt") as f:
             json.dump({"traceEvents": events}, f)
         assert summarize_trace(str(host_only)) is None
+
+
+class TestCompileCache:
+    def test_enable_sets_config_and_opt_out(self, tmp_path, monkeypatch):
+        import jax
+
+        from parameter_server_tpu.utils import compile_cache as cc
+
+        monkeypatch.setattr(cc, "_ENABLED_DIR", None)
+        # the documented opt-out must not fail the test for devs using it
+        monkeypatch.delenv("PS_NO_COMPILE_CACHE", raising=False)
+        prev = jax.config.jax_compilation_cache_dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            d = str(tmp_path / "cache")
+            assert cc.enable(d) == d
+            assert jax.config.jax_compilation_cache_dir == d
+            # idempotent
+            assert cc.enable(d) == d
+            # opt-out wins
+            monkeypatch.setattr(cc, "_ENABLED_DIR", None)
+            monkeypatch.setenv("PS_NO_COMPILE_CACHE", "1")
+            assert cc.enable(d) is None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
