@@ -46,6 +46,19 @@ def enable(cache_dir: "str | None" = None) -> "str | None":
     )
     if _ENABLED_DIR == cache_dir:
         return _ENABLED_DIR
+    # the cache holds executables jax will deserialize and RUN, and a
+    # predictable /tmp name is world-creatable: make the dir 0700 and
+    # refuse one we don't own (another user pre-planting entries would
+    # be arbitrary code execution in our process) — the XDG runtime-dir
+    # check pattern
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        st = os.stat(cache_dir)
+        if st.st_uid != os.getuid():
+            return None
+        os.chmod(cache_dir, 0o700)
+    except OSError:
+        return None
     try:
         import jax
 

@@ -584,12 +584,22 @@ def task_lm() -> int:
         # the MXU's home turf — sets MFU almost alone. SGD + donation:
         # 1.6 GB params + grads transiently, remat activations; fits
         # one 16 GB chip with room
+        d2048 = {**base, "d_model": 2048, "n_heads": 16,
+                 "n_layers": 8, "d_ff": 8192}
         modes.append(
             ("mfu_d2048_s4096",
-             LMConfig(attention="ring_flash",
-                      **{**base, "d_model": 2048, "n_heads": 16,
-                         "n_layers": 8, "d_ff": 8192}),
+             LMConfig(attention="ring_flash", **d2048),
              {"seq": 4096, "batch": 4, "spl": 4})
+        )
+        # same model, seq 2048 at batch 8 (same tokens/step): attention
+        # time is ~proportional to T*S at fixed tokens, so halving S
+        # halves the attention share again — insurance against the
+        # flash kernel underperforming at mid sequence lengths (the
+        # 04:27 capture showed s=4096 flash at 1/3 the s=8192 rate)
+        modes.append(
+            ("mfu_d2048_s2048",
+             LMConfig(attention="ring_flash", **d2048),
+             {"seq": 2048, "batch": 8, "spl": 4})
         )
     rng = np.random.default_rng(0)
 
