@@ -46,6 +46,27 @@ def enable(cache_dir: "str | None" = None) -> "str | None":
     )
     if _ENABLED_DIR == cache_dir:
         return _ENABLED_DIR
+    # CPU: compiles are fast AND the XLA:CPU AOT loader warns about
+    # machine-feature mismatches on reload ("could lead to ... SIGILL")
+    # — observed 2026-08-01 reloading an entry written minutes earlier
+    # on the SAME host. The win is the tunneled TPU backend's remote
+    # compiler, so CPU stays off unless explicitly requested
+    # (PS_COMPILE_CACHE_CPU=1). The platform is read from the REQUEST
+    # (env/jax_platforms config), never jax.default_backend(): that
+    # call initializes the backend, and Postoffice.start() runs this
+    # BEFORE the jax.distributed rendezvous, where early backend init
+    # is fatal for multi-process runs.
+    if not os.environ.get("PS_COMPILE_CACHE_CPU"):
+        requested = os.environ.get("JAX_PLATFORMS", "")
+        if not requested:
+            try:
+                import jax
+
+                requested = jax.config.jax_platforms or ""
+            except Exception:
+                requested = ""
+        if requested.split(",")[0].strip().lower() == "cpu":
+            return None
     # the cache holds executables jax will deserialize and RUN, and a
     # predictable /tmp name is world-creatable: make the dir 0700 and
     # refuse one we don't own (another user pre-planting entries would

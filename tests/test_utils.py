@@ -577,6 +577,8 @@ class TestCompileCache:
         monkeypatch.setattr(cc, "_ENABLED_DIR", None)
         # the documented opt-out must not fail the test for devs using it
         monkeypatch.delenv("PS_NO_COMPILE_CACHE", raising=False)
+        # the suite runs on CPU, where the cache is gated off by default
+        monkeypatch.setenv("PS_COMPILE_CACHE_CPU", "1")
         prev = jax.config.jax_compilation_cache_dir
         # knob absent on some jax builds — the product code tolerates
         # that, so the test must too
@@ -592,6 +594,12 @@ class TestCompileCache:
             # opt-out wins
             monkeypatch.setattr(cc, "_ENABLED_DIR", None)
             monkeypatch.setenv("PS_NO_COMPILE_CACHE", "1")
+            assert cc.enable(d) is None
+            # on the CPU backend the cache is gated off by default
+            # (AOT reload SIGILL warnings) unless PS_COMPILE_CACHE_CPU
+            monkeypatch.delenv("PS_NO_COMPILE_CACHE", raising=False)
+            monkeypatch.delenv("PS_COMPILE_CACHE_CPU", raising=False)
+            monkeypatch.setattr(cc, "_ENABLED_DIR", None)
             assert cc.enable(d) is None
         finally:
             jax.config.update("jax_compilation_cache_dir", prev)
