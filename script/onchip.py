@@ -135,26 +135,16 @@ def session_stats(metric: str, value: float, match: "dict | None" = None) -> dic
     (device_kind, shapes) — a CPU smoke capture or a re-shaped config
     must never pollute the on-chip median."""
     vals = [float(value)]
-    try:
-        with open(LOG_MD) as f:
-            for ln in f:
-                if not ln.startswith('{"metric"'):
-                    continue
-                try:
-                    d = json.loads(ln)
-                except ValueError:
-                    continue  # half-written tail line
-                if d.get("metric") != metric or not isinstance(
-                    d.get("value"), (int, float)
-                ) or d["value"] <= 0:
-                    continue
-                if match and any(
-                    d.get(k) != v for k, v in match.items()
-                ):
-                    continue  # missing key = no agreement (no pooling)
-                vals.append(float(d["value"]))
-    except OSError:
-        pass
+    for _ts, d in _iter_log_records(LOG_MD):
+        if d.get("metric") != metric or not isinstance(
+            d.get("value"), (int, float)
+        ) or d["value"] <= 0:
+            continue
+        if match and any(
+            d.get(k) != v for k, v in match.items()
+        ):
+            continue  # missing key = no agreement (no pooling)
+        vals.append(float(d["value"]))
     vals.sort()
     med = vals[len(vals) // 2]
     return {
@@ -511,6 +501,65 @@ def _commit_replicated(params, mesh):
     return jax.device_put(params, NamedSharding(mesh, _P()))
 
 
+class _SkipCaptured(Exception):
+    """Raised inside a capture section whose metrics are all fresh —
+    caught by the section's own except and silently skipped."""
+
+
+def _iter_log_records(path):
+    """Yield (section_epoch_ts, record_dict) for every parseable JSON
+    metric line in the append-only log. ONE parser for both freshness
+    (_fresh_capture) and median pooling (session_stats) so the two can
+    never drift on format details."""
+    cur_ts = 0.0
+    try:
+        with open(path) as f:
+            for ln in f:
+                if ln.startswith("## "):
+                    try:
+                        cur_ts = time.mktime(time.strptime(
+                            ln[3:22], "%Y-%m-%d %H:%M:%S"
+                        ))
+                    except ValueError:
+                        pass
+                    continue
+                if not ln.startswith('{"metric"'):
+                    continue
+                try:
+                    yield cur_ts, json.loads(ln)
+                except ValueError:
+                    continue  # half-written tail line
+    except OSError:
+        return
+
+
+def _fresh_capture(metric: str, within_s: float = 86400.0) -> bool:
+    """True when BENCH_ONCHIP.md already holds a SUCCESSFUL on-chip
+    capture of ``metric`` newer than ``within_s``. Retry resumption: a
+    task that wedged at mode k must not re-pay modes 1..k-1 against
+    its attempt budget and a flaky tunnel window — it skips straight
+    to the open modes; next-round reruns still happen because captures
+    age out.
+
+    "Successful on-chip" is strict: value > 0, no error field, a
+    non-cpu device_kind (a smoke watcher run appends cpu lines to the
+    SAME log — they must never satisfy a chip task), and not
+    diff_noisy (a deliberately deflated conservative number should be
+    retried for a clean sample while budget remains)."""
+    for ts, d in _iter_log_records(LOG_MD):
+        if (
+            d.get("metric") == metric
+            and isinstance(d.get("value"), (int, float))
+            and d["value"] > 0
+            and "error" not in d
+            and d.get("device_kind") not in (None, "cpu")
+            and d.get("diff_noisy") is not True
+            and time.time() - ts < within_s
+        ):
+            return True
+    return False
+
+
 def _lm_base() -> dict:
     """The byte-LM base shape shared by task_lm and task_serve. ONE
     definition on purpose: serve metrics pool session_stats medians
@@ -620,7 +669,11 @@ def task_lm() -> int:
     peak = PEAK_BF16.get(dev.device_kind)
     # FLOPs per step: 6*P*T matmul + attention 12*L*H*S^2*dh (fwd+bwd,
     # causal halves it)
+    skipped_fresh = []
     for name, cfg, ov in modes:
+        if not SMOKE and _fresh_capture(f"lm_train_{name}"):
+            skipped_fresh.append(name)
+            continue  # retry resumption: this mode already landed
         try:
             seq = ov.get("seq", 256 if SMOKE else 8192)
             batch = ov.get("batch", 2 if SMOKE else 4)
@@ -699,6 +752,9 @@ def task_lm() -> int:
         except Exception as e:  # keep going: one mode failing is evidence too
             emit({"metric": f"lm_train_{name}", "error": repr(e)[:500]})
 
+    if skipped_fresh:
+        emit({"metric": "lm_task_resume", "value": len(skipped_fresh),
+              "unit": "modes_skipped_fresh", "skipped": skipped_fresh})
     return 0
 
 
@@ -729,7 +785,6 @@ def task_serve() -> int:
     # single shared definition)
     base = _lm_base()
     base_cfg = LMConfig(attention="ring", **base)
-    rng = np.random.default_rng(0)
     dev = jax.devices()[0]
 
     # KV-cached decode throughput (the serving path): prefill a prompt,
@@ -756,11 +811,21 @@ def task_serve() -> int:
         (f"_kv{kvh}_i8",
          _dc.replace(base_cfg, n_kv_heads=kvh, kv_cache_dtype="int8")),
     ]
-    for tag, cfg in decode_cfgs:
+    skipped_fresh = []
+    for di, (tag, cfg) in enumerate(decode_cfgs):
+        if not SMOKE and _fresh_capture(f"lm_decode_tokens_per_sec{tag}"):
+            skipped_fresh.append(f"decode{tag}")
+            continue  # retry resumption
         try:
             params = init_lm(jax.random.PRNGKey(0), cfg)
+            # per-section seed: resumption may SKIP earlier modes, so
+            # sharing one rng stream would hand this mode different
+            # prompt bytes depending on which modes were fresh —
+            # breaking cross-round comparability of the medians
             prompt = jnp.asarray(
-                rng.integers(0, 256, (b, prefill), np.int32)
+                np.random.default_rng(100 + di).integers(
+                    0, 256, (b, prefill), np.int32
+                )
             )
 
             def timed(s, params=params, prompt=prompt, cfg=cfg):
@@ -877,6 +942,8 @@ def task_serve() -> int:
         from parameter_server_tpu.models.transformer import lm_beam_search
 
         bw = 4
+        if not SMOKE and _fresh_capture(f"lm_beam_search_w{bw}"):
+            raise _SkipCaptured
         bcfg = _dc.replace(base_cfg, n_kv_heads=kvh)
         bparams = init_lm(jax.random.PRNGKey(0), bcfg)
         bprompt = jnp.asarray(
@@ -919,6 +986,8 @@ def task_serve() -> int:
              "prefill": prefill, "steps": bsteps},
         ))
         emit(rec)
+    except _SkipCaptured:
+        skipped_fresh.append("beam")
     except Exception as e:
         emit({"metric": "lm_beam_search_w4", "error": repr(e)[:400]})
 
@@ -938,6 +1007,13 @@ def task_serve() -> int:
             speculative_generate,
         )
 
+        if not SMOKE and all(
+            _fresh_capture(f"lm_decode_speculative_{t}_g{g}")
+            for t, g in (("upper", 4), ("draft4x", 2), ("draft4x", 4),
+                         ("draft4x", 8))
+        ):
+            raise _SkipCaptured
+
         tcfg = _dc.replace(base_cfg, n_kv_heads=kvh)
         dcfg = LMConfig(
             vocab=256,
@@ -948,10 +1024,14 @@ def task_serve() -> int:
             compute_dtype=tcfg.compute_dtype,
             n_kv_heads=None,
         )
-        # structured corpus: period-16 byte pattern + 10% uniform noise
+        # structured corpus: period-16 byte pattern + 10% uniform noise.
+        # Own seeded stream (not the shared rng): resumption can skip
+        # the decode modes before this section, and the corpus/training
+        # draws must be identical either way
+        srng = np.random.default_rng(7)
         pat = np.tile(np.arange(97, 113, dtype=np.int32), 1 << 14)
-        noise = rng.integers(0, 256, pat.size, np.int32)
-        corpus = np.where(rng.random(pat.size) < 0.1, noise, pat)
+        noise = srng.integers(0, 256, pat.size, np.int32)
+        corpus = np.where(srng.random(pat.size) < 0.1, noise, pat)
         train_seq, train_steps = (64, 4) if SMOKE else (512, 120)
         # shard_tokens shards the [B, S] token width over the data
         # axis: S = train_seq+1 must divide it (the 8-device CPU smoke
@@ -967,7 +1047,7 @@ def task_serve() -> int:
             )
             step_i = make_lm_train_step(cfg_i, mesh, donate=True)
             for it in range(train_steps):
-                starts = rng.integers(
+                starts = srng.integers(
                     0, corpus.size - train_seq - 1, 8)
                 toks = np.stack(
                     [corpus[s:s + train_seq + 1] for s in starts]
@@ -980,7 +1060,7 @@ def task_serve() -> int:
         sp, ssteps = (8, 8) if SMOKE else (256, 256)
         prompt = jnp.asarray(
             np.stack([corpus[s:s + sp] for s in
-                      rng.integers(0, corpus.size - sp, b)])
+                      srng.integers(0, corpus.size - sp, b)])
         )
         def med_time(fn, k=3):
             # same discipline as the decode section: the headline
@@ -1033,8 +1113,13 @@ def task_serve() -> int:
                     "compile_s": round(compile_s, 1),
                     "device_kind": dev.device_kind,
                 })
+    except _SkipCaptured:
+        skipped_fresh.append("speculative")
     except Exception as e:
         emit({"metric": "lm_decode_speculative", "error": repr(e)[:500]})
+    if skipped_fresh:
+        emit({"metric": "serve_task_resume", "value": len(skipped_fresh),
+              "unit": "sections_skipped_fresh", "skipped": skipped_fresh})
     return 0
 
 
@@ -1085,7 +1170,11 @@ def task_scale() -> int:
     import gc
 
     worker = None
+    skipped_fresh = []
     for label, num_slots, state_dtype in sizes:
+        if not SMOKE and _fresh_capture(f"ftrl_table_{label}"):
+            skipped_fresh.append(label)
+            continue  # retry resumption
         try:
             # drop the PREVIOUS size's table before allocating the next:
             # `worker` stays bound across iterations, so without this the
@@ -1153,6 +1242,7 @@ def task_scale() -> int:
                     "unit": "examples/sec",
                     "num_slots": num_slots,
                     "ftrl_state_dtype": state_dtype,
+                    "device_kind": dev.device_kind,
                     "table_gb": round(num_slots * bytes_per_slot / 2**30, 2),
                     "hbm_bytes_in_use": stats.get("bytes_in_use"),
                     "hbm_bytes_limit": stats.get("bytes_limit"),
@@ -1161,6 +1251,9 @@ def task_scale() -> int:
             )
         except Exception as e:
             emit({"metric": f"ftrl_table_{label}", "error": repr(e)[:500]})
+    if skipped_fresh:
+        emit({"metric": "scale_task_resume", "value": len(skipped_fresh),
+              "unit": "sizes_skipped_fresh", "skipped": skipped_fresh})
     return 0
 
 

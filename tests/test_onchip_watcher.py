@@ -190,3 +190,44 @@ def test_probe_yields_to_foreign_request(onchip, tmp_path):
     up, diag = onchip.probe(timeout_s=5)
     assert not up
     assert "yielding to priority request" in diag
+
+
+def test_fresh_capture_resume_logic(onchip):
+    """_fresh_capture: True only for a SUCCESSFUL metric line under a
+    section header newer than the window — errors, zero values, stale
+    sections, and absent metrics never count (a retry must redo them)."""
+    import json
+    import time
+
+    now = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+    old = time.strftime(
+        "%Y-%m-%d %H:%M:%S", time.localtime(time.time() - 2 * 86400)
+    )
+    kind = {"device_kind": "TPU v5 lite"}
+    lines = [
+        f"## {old} — lm (rc=0, 100s)",
+        json.dumps({"metric": "lm_train_stale", "value": 123.0, **kind}),
+        f"## {now} — lm (rc=0, 100s)",
+        json.dumps({"metric": "lm_train_good", "value": 123.0, **kind}),
+        json.dumps({"metric": "lm_train_err", "error": "boom", **kind}),
+        json.dumps({"metric": "lm_train_zero", "value": 0, **kind}),
+        # smoke lines and deflated conservative numbers never satisfy
+        # a chip task's freshness check
+        json.dumps({"metric": "lm_train_smoke", "value": 5.0,
+                    "device_kind": "cpu"}),
+        json.dumps({"metric": "lm_train_nokind", "value": 5.0}),
+        json.dumps({"metric": "lm_decode_noisy", "value": 5.0,
+                    "diff_noisy": True, **kind}),
+    ]
+    with open(onchip.LOG_MD, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    assert onchip._fresh_capture("lm_train_good")
+    assert not onchip._fresh_capture("lm_train_stale")  # aged out
+    assert not onchip._fresh_capture("lm_train_err")
+    assert not onchip._fresh_capture("lm_train_zero")
+    assert not onchip._fresh_capture("lm_train_absent")
+    assert not onchip._fresh_capture("lm_train_smoke")
+    assert not onchip._fresh_capture("lm_train_nokind")
+    assert not onchip._fresh_capture("lm_decode_noisy")
+    # a tighter window rejects even the fresh one
+    assert not onchip._fresh_capture("lm_train_good", within_s=0.0)
