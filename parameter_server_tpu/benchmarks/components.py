@@ -248,3 +248,115 @@ def attention_perf(smoke: bool = False) -> None:
         report("attention_flash_bf16_gflops", flops / sec / 1e9, "GFLOP/s")
         sec = timeit(make_run(False, np.dtype("bfloat16")), n)
         report("attention_xla_bf16_gflops", flops / sec / 1e9, "GFLOP/s")
+
+
+@benchmark("step_phases")
+def step_phases_perf(smoke: bool = False) -> None:
+    """Each phase of the fused async-SGD bits step as its OWN jitted
+    program at the headline bench shapes (rows 16384 x 39 lanes, 2^22
+    slots) — the decomposition of bench.py's ~26 ms device step.
+
+    The r3 sweep data shows the device-only rate is step-bound, not
+    dispatch-bound (T=8->32 moved it 1%), while the step's HBM traffic
+    justifies <1 ms: one of these phases is eating ~95% of the time,
+    and this bench names it even if the axon backend's profiler traces
+    turn out unparseable (insurance for --profile). Phase sum !=
+    fused-step time exactly (XLA fuses across phase boundaries), but a
+    300x structural outlier dwarfs that error bar.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..apps.linear.learning_rate import LearningRate
+    from ..apps.linear.penalty import ElasticNet
+    from ..apps.linear.updaters import FTRLUpdater
+    from ..utils.bitpack import (
+        pack_bits,
+        slot_bits,
+        stream_to_words,
+        unpack_bits,
+        unpack_sign_bits,
+    )
+
+    rows, lanes = (1024, 8) if smoke else (16384, 39)
+    num_slots = 1 << (14 if smoke else 22)
+    bits = slot_bits(num_slots)
+    rng = np.random.default_rng(0)
+
+    slots_host = rng.integers(0, num_slots, rows * lanes, np.int64)
+    # the SAME <u4 word layout the production decode consumes
+    # (async_sgd.py unpack path): a raw byte stream would make the
+    # timed gathers byte-granular and the decode verdict wrong
+    words = jax.device_put(
+        stream_to_words(pack_bits(slots_host, bits), rows * lanes, bits)
+    )
+    y_bits = jax.device_put(
+        np.packbits(rng.integers(0, 2, rows).astype(np.uint8))
+    )
+    updater = FTRLUpdater(
+        LearningRate(type_=LearningRate.DECAY, alpha=0.1, beta=1.0),
+        ElasticNet(1.0, 0.0),
+    )
+    state = {
+        "z": jax.device_put(
+            rng.normal(size=num_slots).astype(np.float32)
+        ),
+        "sqrt_n": jax.device_put(
+            np.abs(rng.normal(size=num_slots)).astype(np.float32)
+        ),
+    }
+    rel = jax.device_put(slots_host.astype(np.int32))
+    gr = jax.device_put(rng.normal(size=rows).astype(np.float32))
+    grad = jax.device_put(rng.normal(size=num_slots).astype(np.float32))
+    touched = jax.device_put(
+        (rng.random(num_slots) < 0.01).astype(bool)
+    )
+
+    def timed_phase(name, fn, *args):
+        jf = jax.jit(fn)
+        jax.block_until_ready(jf(*args))  # compile untimed
+        n = 3 if smoke else 10
+        sec = timeit(lambda: jax.block_until_ready(jf(*args)), n)
+        report(f"step_phase_{name}_ms", sec * 1e3, "ms")
+        return sec
+
+    total = 0.0
+    total += timed_phase(
+        "decode",
+        lambda w, yb: (
+            unpack_bits(w, rows * lanes, bits),
+            unpack_sign_bits(yb, rows),
+        ),
+        words, y_bits,
+    )
+    total += timed_phase(
+        "weights_dense", lambda st: updater.weights(st), state
+    )
+
+    # gather timed on a PRECOMPUTED dense weight vector: the dense
+    # transform is already its own phase above, and the production
+    # updater.weights is reused rather than re-derived
+    w_dense = jax.block_until_ready(jax.jit(updater.weights)(state))
+    total += timed_phase(
+        "gather_sum",
+        lambda w, idx: w[idx].reshape(rows, lanes).sum(axis=1),
+        w_dense, rel,
+    )
+    total += timed_phase(
+        "scatter_add",
+        lambda idx, g: jnp.zeros((num_slots,), jnp.float32)
+        .at[idx]
+        .add(jnp.broadcast_to(g[:, None], (rows, lanes)).reshape(-1)),
+        rel, gr,
+    )
+    total += timed_phase(
+        "ftrl_update",
+        lambda st, g, t: updater.apply(st, g, t, seed=np.uint32(1)),
+        state, grad, touched,
+    )
+    report("step_phase_sum_ms", total * 1e3, "ms")
+    report(
+        "step_phase_sum_equiv_examples_per_sec",
+        rows / total,
+        "examples/sec",
+    )
