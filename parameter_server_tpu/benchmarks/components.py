@@ -253,8 +253,10 @@ def attention_perf(smoke: bool = False) -> None:
 @benchmark("step_phases")
 def step_phases_perf(smoke: bool = False) -> None:
     """Each phase of the fused async-SGD bits step as its OWN jitted
-    program at the headline bench shapes (rows 16384 x 39 lanes, 2^22
-    slots) — the decomposition of bench.py's ~26 ms device step.
+    program at the headline bench shapes (rows 16384 x 39 lanes), at
+    BOTH headline table sizes — 2^22 slots (synthetic bench) and 2^26
+    (--real criteo) — the decomposition of bench.py's ~26-32 ms device
+    step.
 
     The r3 sweep data shows the device-only rate is step-bound, not
     dispatch-bound (T=8->32 moved it 1%), while the step's HBM traffic
@@ -264,6 +266,18 @@ def step_phases_perf(smoke: bool = False) -> None:
     fused-step time exactly (XLA fuses across phase boundaries), but a
     300x structural outlier dwarfs that error bar.
     """
+    rows, lanes = (1024, 8) if smoke else (16384, 39)
+    # both headline table sizes: 2^22 (synthetic bench) and 2^26
+    # (--real criteo) — the structural loss may be size-dependent
+    # (gather working set 16 MB vs 256 MB spans VMEM-resident to
+    # HBM-bound regimes)
+    for num_slots in ([1 << 14] if smoke else [1 << 22, 1 << 26]):
+        _step_phases_at(rows, lanes, num_slots, smoke)
+
+
+def _step_phases_at(
+    rows: int, lanes: int, num_slots: int, smoke: bool
+) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -278,8 +292,7 @@ def step_phases_perf(smoke: bool = False) -> None:
         unpack_sign_bits,
     )
 
-    rows, lanes = (1024, 8) if smoke else (16384, 39)
-    num_slots = 1 << (14 if smoke else 22)
+    tag = f"_s{num_slots.bit_length() - 1}"
     bits = slot_bits(num_slots)
     rng = np.random.default_rng(0)
 
@@ -315,9 +328,15 @@ def step_phases_perf(smoke: bool = False) -> None:
     def timed_phase(name, fn, *args):
         jf = jax.jit(fn)
         jax.block_until_ready(jf(*args))  # compile untimed
+        # tight per-phase budget: 12 phases x 2 sizes through the
+        # tunnel must fit the watcher's components timeout (the
+        # un-budgeted schedule blew a 2400s suite timeout once —
+        # timeit docstring)
         n = 3 if smoke else 10
-        sec = timeit(lambda: jax.block_until_ready(jf(*args)), n)
-        report(f"step_phase_{name}_ms", sec * 1e3, "ms")
+        sec = timeit(
+            lambda: jax.block_until_ready(jf(*args)), n, budget_s=25.0
+        )
+        report(f"step_phase_{name}{tag}_ms", sec * 1e3, "ms")
         return sec
 
     total = 0.0
@@ -354,9 +373,9 @@ def step_phases_perf(smoke: bool = False) -> None:
         lambda st, g, t: updater.apply(st, g, t, seed=np.uint32(1)),
         state, grad, touched,
     )
-    report("step_phase_sum_ms", total * 1e3, "ms")
+    report(f"step_phase_sum{tag}_ms", total * 1e3, "ms")
     report(
-        "step_phase_sum_equiv_examples_per_sec",
+        f"step_phase_sum{tag}_equiv_examples_per_sec",
         rows / total,
         "examples/sec",
     )

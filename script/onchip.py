@@ -74,7 +74,7 @@ TASKS = [
      [sys.executable, "bench.py", "--real",
       "--profile", "/tmp/ps_profile_real"], 5400),
     ("flash", None, 2400),
-    ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 2400),
+    ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 3600),
 ]
 
 # bf16 peak matmul FLOP/s by device_kind (public spec sheets); MFU is
