@@ -75,6 +75,8 @@ TASKS = [
       "--profile", "/tmp/ps_profile_real"], 5400),
     ("flash", None, 2400),
     ("components", [sys.executable, "-m", "parameter_server_tpu.benchmarks"], 3600),
+    # last: optimization experiments, valuable but not round evidence
+    ("gatherx", None, 1800),
 ]
 
 # bf16 peak matmul FLOP/s by device_kind (public spec sheets); MFU is
@@ -1123,6 +1125,111 @@ def task_serve() -> int:
     return 0
 
 
+def task_gatherx() -> int:
+    """A/B the gather/scatter formulations that could unthrottle the
+    fused step (r3: random gathers ~8ms per 640k indices; the step is
+    gather/scatter-bound, and step_phases decomposes but does not
+    compare alternatives). Each variant is its own jitted program at
+    the headline shapes, timed with the SAME _median_windows + _flush
+    discipline as the other tasks (block_until_ready under-waits on
+    the tunnel), resumption-gated per variant, with device_kind on
+    every record.
+
+    Variants: baseline take-gather; gather from a PRE-SORTED index
+    vector (locality sensitivity — sorting cost excluded, so this is
+    the upper bound sorting could buy); bf16 and int8 weight-table
+    gathers (if gathers are granularity/bandwidth-bound, narrower
+    elements should win ~linearly; production pull_quant can then be
+    flipped on for real); scatter-add baseline vs sort+segment_sum
+    (micro-level twin of the r3 full-path experiment that lost 3x);
+    gather+lane-sum at the production matrix layout for direct
+    comparison with step_phases."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    rows, lanes = (256, 8) if SMOKE else (16384, 39)
+    n_idx = rows * lanes
+    skipped_fresh = []
+
+    def timed(name, fn, *args):
+        if not SMOKE and _fresh_capture(name):
+            skipped_fresh.append(name)
+            return
+        try:
+            jf = jax.jit(fn)
+            _flush(jf(*args))  # compile untimed
+            med, spread = _median_windows(
+                lambda: jf(*args), _flush,
+                windows=2 if SMOKE else 3, n=2 if SMOKE else 5,
+            )
+            emit({
+                "metric": name,
+                "value": round(med * 1e3, 3),
+                "unit": "ms",
+                "spread": spread,
+                "n_idx": n_idx,
+                "device_kind": dev.device_kind,
+            })
+        except Exception as e:
+            emit({"metric": name, "error": repr(e)[:300]})
+
+    for logs in ([14] if SMOKE else [22, 26]):
+        num_slots = 1 << logs
+        tag = f"_s{logs}"
+        rng = np.random.default_rng(0)
+        # build everything on host, transfer once (no D2H round trips
+        # through the tunnel just to sort/quantize)
+        idx_np = rng.integers(0, num_slots, n_idx).astype(np.int32)
+        w_np = rng.normal(size=num_slots).astype(np.float32)
+        g_np = rng.normal(size=n_idx).astype(np.float32)
+        idx = jax.device_put(idx_np)
+        idx_sorted = jax.device_put(np.sort(idx_np))
+        w32 = jax.device_put(w_np)
+        w16 = jax.device_put(w_np.astype(jnp.bfloat16))
+        w8 = jax.device_put((w_np * 10).astype(np.int8))
+        g = jax.device_put(g_np)
+
+        timed(f"gather_f32{tag}", lambda w, i: w[i].sum(), w32, idx)
+        timed(f"gather_f32_sorted{tag}",
+              lambda w, i: w[i].sum(), w32, idx_sorted)
+        timed(f"gather_bf16{tag}",
+              lambda w, i: w[i].astype(jnp.float32).sum(), w16, idx)
+        timed(f"gather_int8{tag}",
+              lambda w, i: (w[i].astype(jnp.float32) * 0.1).sum(),
+              w8, idx)
+        timed(
+            f"scatter_add_f32{tag}",
+            lambda i, v: jnp.zeros((num_slots,), jnp.float32)
+            .at[i].add(v).sum(),
+            idx, g,
+        )
+        timed(
+            f"scatter_add_f32_sorted_idx{tag}",
+            lambda i, v: jnp.zeros((num_slots,), jnp.float32)
+            .at[i].add(v).sum(),
+            idx_sorted, g,
+        )
+
+        def sort_segment(i, v, num_slots=num_slots):
+            order = jnp.argsort(i)
+            return jax.ops.segment_sum(
+                v[order], i[order], num_segments=num_slots
+            ).sum()
+
+        timed(f"scatter_sort_segment{tag}", sort_segment, idx, g)
+        timed(
+            f"gather_lanesum_f32{tag}",
+            lambda w, i: w[i].reshape(rows, lanes).sum(axis=1).sum(),
+            w32, idx,
+        )
+    if skipped_fresh:
+        emit({"metric": "gatherx_task_resume", "value": len(skipped_fresh),
+              "unit": "variants_skipped_fresh", "skipped": skipped_fresh})
+    return 0
+
+
 def task_scale() -> int:
     """Largest FTRL table one chip holds, with HBM accounting
     (VERDICT r2 item 3; BASELINE north star Criteo-1TB ~800M keys)."""
@@ -1258,7 +1365,8 @@ def task_scale() -> int:
 
 
 INTERNAL = {"link": task_link, "flash": task_flash, "lm": task_lm,
-            "scale": task_scale, "serve": task_serve}
+            "scale": task_scale, "serve": task_serve,
+            "gatherx": task_gatherx}
 
 
 # ---------------------------------------------------------------------------
