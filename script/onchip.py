@@ -1149,6 +1149,13 @@ def task_gatherx() -> int:
     import numpy as np
 
     dev = jax.devices()[0]
+    if dev.platform != "tpu" and not SMOKE:
+        # same guard as task_flash: a CPU-fallback run would emit
+        # device_kind='cpu' records (which _fresh_capture rightly
+        # ignores) yet return 0 — the watcher would mark the task ok
+        # and never capture the on-chip numbers
+        emit({"metric": "gatherx_onchip", "error": "not on tpu"})
+        return 1
     rows, lanes = (256, 8) if SMOKE else (16384, 39)
     n_idx = rows * lanes
     skipped_fresh = []

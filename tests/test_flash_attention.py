@@ -51,8 +51,18 @@ def test_flash_kernel_matches_ref(causal, qo, ko):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_kernel_gradients(causal):
-    bh, sq, sk, d = 2, 200, 136, 48
+@pytest.mark.parametrize(
+    "sq,sk,d",
+    [
+        (200, 136, 48),  # unaligned: block/lane padding path
+        # d_head 128 — every MFU-push LM config's head size
+        # (mfu_d1024/mfu_d2048/h4 run d_model/n_heads = 128); a d=128
+        # regression must not surface only on-chip mid-capture-window
+        (160, 192, 128),
+    ],
+)
+def test_flash_kernel_gradients(causal, sq, sk, d):
+    bh = 2
     q, k, v = _rand((bh, sq, d), 1), _rand((bh, sk, d), 2), _rand((bh, sk, d), 3)
     w = _rand((bh, sq, d), 4)
 
@@ -68,6 +78,18 @@ def test_flash_kernel_gradients(causal):
 
     for a, b in zip(make_loss(False)(q, k, v), make_loss(True)(q, k, v)):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4)
+
+
+def test_flash_kernel_d128_fwd():
+    """d=128 forward parity (grad coverage lives in the parametrized
+    test_flash_kernel_gradients shape (160, 192, 128))."""
+    bh, sq, sk, d = 2, 160, 192, 128
+    q, k, v = _rand((bh, sq, d), 1), _rand((bh, sk, d), 2), _rand((bh, sk, d), 3)
+    o_ref = flash_attention(q, k, v, causal=True, use_pallas=False)
+    o_pal = flash_attention(
+        q, k, v, causal=True, use_pallas=True, interpret=True
+    )
+    np.testing.assert_allclose(o_ref, o_pal, atol=2e-5, rtol=1e-5)
 
 
 def test_flash_ref_matches_dense():
