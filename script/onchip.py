@@ -535,6 +535,20 @@ def _iter_log_records(path):
         return
 
 
+def _chip_success(d: dict) -> bool:
+    """ONE definition of "successful on-chip capture" shared by
+    _fresh_capture and script/summarize_evidence.py: value > 0, no
+    error, a non-cpu device_kind (smoke runs append to the same log),
+    and not diff_noisy (a deliberately deflated conservative number)."""
+    return (
+        isinstance(d.get("value"), (int, float))
+        and d["value"] > 0
+        and "error" not in d
+        and d.get("device_kind") not in (None, "cpu")
+        and d.get("diff_noisy") is not True
+    )
+
+
 def _fresh_capture(metric: str, within_s: float = 86400.0) -> bool:
     """True when BENCH_ONCHIP.md already holds a SUCCESSFUL on-chip
     capture of ``metric`` newer than ``within_s``. Retry resumption: a
@@ -551,11 +565,7 @@ def _fresh_capture(metric: str, within_s: float = 86400.0) -> bool:
     for ts, d in _iter_log_records(LOG_MD):
         if (
             d.get("metric") == metric
-            and isinstance(d.get("value"), (int, float))
-            and d["value"] > 0
-            and "error" not in d
-            and d.get("device_kind") not in (None, "cpu")
-            and d.get("diff_noisy") is not True
+            and _chip_success(d)
             and time.time() - ts < within_s
         ):
             return True

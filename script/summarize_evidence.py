@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Summarize BENCH_ONCHIP.md into one table per metric.
+
+Reads the append-only evidence log and prints, for every metric: the
+latest successful on-chip value, the cross-session median/spread (the
+number PERFORMANCE.md should quote — r3 verdict weak #8), capture
+count, the newest capture's timestamp, and any trailing error. Smoke
+(cpu) records are listed separately so they can never be mistaken for
+chip evidence.
+
+Usage: python script/summarize_evidence.py [--all] [--since HOURS]
+  --all          also list cpu-only (smoke) metric names
+  --since HOURS  only consider records newer than HOURS (default: all)
+
+Metrics whose newest record is an error always print (a stale success
+followed by fresh wedges is exactly the case to surface).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _onchip():
+    spec = importlib.util.spec_from_file_location(
+        "onchip_log", os.path.join(REPO, "script", "onchip.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--since", type=float, default=None, metavar="HOURS")
+    args = ap.parse_args()
+
+    onchip = _onchip()
+    cutoff = (
+        time.time() - args.since * 3600.0
+        if args.since is not None
+        else 0.0
+    )
+    chip: dict = {}
+    errors: dict = {}
+    cpu_only: set = set()
+    for ts, d in onchip._iter_log_records(onchip.LOG_MD):
+        if ts < cutoff:
+            continue
+        m = d.get("metric")
+        if not m:
+            continue
+        if "error" in d:
+            errors[m] = (ts, str(d["error"])[:120])
+            continue
+        # the ONE shared definition of chip evidence (onchip._chip_success):
+        # excludes cpu/smoke records, value<=0, and diff_noisy deflated
+        # numbers — the same filters session_stats/_fresh_capture apply,
+        # so this table can never disagree with the log's own medians
+        if not onchip._chip_success(d):
+            if d.get("device_kind") in (None, "cpu"):
+                cpu_only.add(m)
+            continue
+        chip.setdefault(m, []).append(
+            (ts, float(d["value"]), d.get("unit", ""))
+        )
+
+    def fmt_ts(ts):
+        return time.strftime("%m-%d %H:%M", time.localtime(ts)) if ts else "?"
+
+    rows = []
+    for m, caps in sorted(chip.items()):
+        caps.sort()
+        vals = sorted(v for _, v, _ in caps)
+        med = vals[len(vals) // 2]
+        spread = (vals[-1] - vals[0]) / med if med else 0.0
+        ts, latest, unit = caps[-1]
+        rows.append(
+            (m, latest, med, len(caps), round(spread, 2), unit, fmt_ts(ts))
+        )
+    if rows:
+        wm = max(len(r[0]) for r in rows)
+        print(f"{'metric':<{wm}}  {'latest':>12}  {'median':>12}  "
+              f"n  sprd  unit            newest")
+        for m, latest, med, n, spread, unit, ts in rows:
+            print(f"{m:<{wm}}  {latest:>12,.1f}  {med:>12,.1f}  "
+                  f"{n}  {spread:<4}  {unit:<14}  {ts}")
+    else:
+        print("(no successful on-chip captures in range)")
+
+    # errors newer than the metric's latest success are live failures
+    # (an old success + fresh wedges is exactly the case to surface);
+    # metrics with ONLY errors always print
+    live_err = {}
+    for m, (ts, e) in errors.items():
+        latest_ok = max((t for t, _, _ in chip.get(m, [])), default=None)
+        if latest_ok is None or ts > latest_ok:
+            live_err[m] = (ts, e, latest_ok is not None)
+    if live_err:
+        print("\nmetrics whose NEWEST record is an error:")
+        for m, (ts, e, had_ok) in sorted(live_err.items()):
+            note = " (stale success above)" if had_ok else ""
+            print(f"  {m}  [{fmt_ts(ts)}]{note}  {e}")
+    if cpu_only - set(chip) and args.all:
+        print("\ncpu-only (smoke) metrics:", ", ".join(sorted(cpu_only - set(chip))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
