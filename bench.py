@@ -93,9 +93,14 @@ class Watchdog:
         """Push the idle clock ``seconds`` into the future: one legit
         long device operation (a deep-T superbatch upload through a
         throttled tunnel can exceed stall_s on its own) must not read
-        as a wedge. The next beat() snaps the clock back to normal."""
+        as a wedge. The next beat() snaps the clock back to normal.
+        Monotone: a later, smaller grace never SHRINKS a pending one —
+        a compile grace stacked after a large transfer grace must not
+        cut the transfer's budget short."""
         with self._lock:
-            self._last = time.monotonic() + max(0.0, seconds)
+            self._last = max(
+                self._last, time.monotonic() + max(0.0, seconds)
+            )
 
     def cancel(self) -> None:
         with self._lock:
@@ -174,6 +179,18 @@ _WATCHDOG: "Watchdog | None" = None
 def _beat(phase: str | None = None, **fields) -> None:
     if _WATCHDOG is not None:
         _WATCHDOG.beat(phase, **fields)
+
+
+def _grace_for_compile(seconds: float = 600.0) -> None:
+    """Extend the watchdog's patience across a COMPILING launch: the
+    fused scan program's remote compile through the tunnel has no
+    transfer size to derive a budget from, and a legitimately slow
+    compile window (slow link + cold cache) must not read as a wedge —
+    the 2026-08-01 08:41 run died in 'warmup' at the 300s default
+    while the tunnel was merely crawling. One-time: the next beat()
+    snaps the clock back."""
+    if _WATCHDOG is not None:
+        _WATCHDOG.grace(seconds)
 
 
 def _grace_for_transfer(nbytes: int) -> None:
@@ -948,12 +965,14 @@ def run_real(args) -> int:
     warm = stack_supersteps(prep_parts, T)
     _grace_for_transfer(tree_host_nbytes(warm))
     warm = jax.device_put(warm)
+    _grace_for_compile()  # first wait pays the big scan-program compile
     worker.executor.wait(worker._submit_prepped(warm, with_aux=False))
     flush(worker)
     _beat()
     step_fn = worker._get_step(warm, False)
     live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
     pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    _grace_for_compile()  # delayed-path program compiles here
     jax.block_until_ready(
         step_fn(live_copy, pull_copy, warm, np.uint32(0))[1]["num_ex"]
     )
@@ -1246,6 +1265,7 @@ def run_synthetic(args) -> int:
     pending = []
     for i in range(max(1, args.warmup // T)):
         pending.append(prep_upload_submit(i * T))
+    _grace_for_compile()  # first wait pays the big scan-program compile
     for ts in pending:
         worker.executor.wait(ts)
         _beat()
@@ -1263,6 +1283,7 @@ def run_synthetic(args) -> int:
     step_fn = worker._get_step(warm_sb, False)
     live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
     pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+    _grace_for_compile()  # delayed-path program compiles here
     jax.block_until_ready(
         step_fn(live_copy, pull_copy, warm_sb, np.uint32(0))[1]["num_ex"]
     )

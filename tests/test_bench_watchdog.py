@@ -166,3 +166,24 @@ def test_finish_is_atomic_and_prints_once():
     lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
     assert len(lines) == 1
     assert json.loads(lines[0])["value"] == 7.0
+
+
+def test_grace_is_monotone(monkeypatch):
+    """A later, smaller grace must never shrink a pending larger one:
+    _grace_for_compile(600) after _grace_for_transfer(big) would
+    otherwise cut a legitimate slow upload's budget short and os._exit
+    a healthy run (review finding, 2026-08-01)."""
+    import bench
+
+    wd = bench.Watchdog("m", stall_s=1e9)  # never fires on its own
+    try:
+        wd.grace(5000.0)
+        big = wd._last
+        wd.grace(10.0)
+        assert wd._last == big  # smaller grace did not shrink
+        wd.grace(9000.0)
+        assert wd._last > big  # larger grace still extends
+        wd.beat()
+        assert wd._last < big  # beat snaps back to normal
+    finally:
+        wd.cancel()
