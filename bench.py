@@ -370,6 +370,39 @@ def emit_device_error(diagnosis: str) -> int:
             ]
         except Exception:
             pass  # liveness already recorded
+        try:
+            # tunnel-outage account from the watch log: when the relay
+            # was last reachable and how long the current wedge has
+            # held — a zero record should tell the whole outage story
+            # on its own
+            wl = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "doc", "onchip_watch.log",
+            )
+            last_up = first_wedge_after_up = None
+            with open(wl) as f:
+                for ln in f:
+                    if "probe: device UP" in ln:
+                        last_up = ln[1:20]
+                        first_wedge_after_up = None
+                    elif (
+                        "probe:" in ln
+                        and first_wedge_after_up is None
+                        # busy/yield diags mean the device is HEALTHY
+                        # (another process holds it) — only unreachable
+                        # diagnoses date the wedge
+                        and "busy" not in ln
+                        and "yielding" not in ln
+                    ):
+                        first_wedge_after_up = ln[1:20]
+            if last_up:
+                rec["watcher"]["tunnel_last_up"] = last_up
+            if first_wedge_after_up:
+                rec["watcher"]["tunnel_wedged_since"] = (
+                    first_wedge_after_up
+                )
+        except Exception:
+            pass
     except Exception:
         pass
     print(json.dumps(rec))
