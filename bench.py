@@ -374,11 +374,16 @@ def emit_device_error(diagnosis: str) -> int:
             # tunnel-outage account from the watch log: when the relay
             # was last reachable and how long the current wedge has
             # held — a zero record should tell the whole outage story
-            # on its own
-            wl = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "doc", "onchip_watch.log",
-            )
+            # on its own. Path reused from the loaded onchip module
+            # when available so a moved WATCH_LOG can't silently
+            # orphan this scraper.
+            try:
+                wl = onchip_mod.WATCH_LOG
+            except NameError:
+                wl = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "doc", "onchip_watch.log",
+                )
             last_up = first_wedge_after_up = None
             with open(wl) as f:
                 for ln in f:
@@ -386,13 +391,14 @@ def emit_device_error(diagnosis: str) -> int:
                         last_up = ln[1:20]
                         first_wedge_after_up = None
                     elif (
-                        "probe:" in ln
-                        and first_wedge_after_up is None
-                        # busy/yield diags mean the device is HEALTHY
-                        # (another process holds it) — only unreachable
-                        # diagnoses date the wedge
-                        and "busy" not in ln
-                        and "yielding" not in ln
+                        first_wedge_after_up is None
+                        # POSITIVE match on the wedge diagnosis
+                        # (onchip.probe's exact wording): busy/yield
+                        # lines are a healthy held device, and a
+                        # CRASHED diag's free-text stderr tail must
+                        # not be misread either way
+                        and "probe:" in ln
+                        and ("tunnel wedge" in ln or "init hang >" in ln)
                     ):
                         first_wedge_after_up = ln[1:20]
             if last_up:

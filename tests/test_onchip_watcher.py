@@ -231,3 +231,56 @@ def test_fresh_capture_resume_logic(onchip):
     assert not onchip._fresh_capture("lm_decode_noisy")
     # a tighter window rejects even the fresh one
     assert not onchip._fresh_capture("lm_train_good", within_s=0.0)
+
+
+def test_summarize_evidence_table(onchip, tmp_path, capsys, monkeypatch):
+    """summarize_evidence: chip successes tabulated with cross-session
+    medians; cpu/noisy records excluded by the shared _chip_success;
+    a metric whose NEWEST record is an error is flagged even when an
+    older success exists."""
+    import importlib.util
+    import json
+    import os
+    import sys
+    import time
+
+    now = time.time()
+
+    def sec(ts):
+        return "## " + time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(ts)
+        ) + " — x (rc=0, 1s)"
+
+    kind = {"device_kind": "TPU v5 lite", "unit": "u"}
+    lines = [
+        sec(now - 3000),
+        json.dumps({"metric": "m_ok", "value": 100.0, **kind}),
+        json.dumps({"metric": "m_stalefail", "value": 70.0, **kind}),
+        json.dumps({"metric": "m_cpu", "value": 5.0,
+                    "device_kind": "cpu"}),
+        sec(now - 2000),
+        json.dumps({"metric": "m_ok", "value": 120.0, **kind}),
+        sec(now - 1000),
+        json.dumps({"metric": "m_stalefail", "error": "wedge"}),
+    ]
+    with open(onchip.LOG_MD, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "summarize_under_test",
+        os.path.join(REPO, "script", "summarize_evidence.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "_onchip", lambda: onchip)
+    monkeypatch.setattr(sys, "argv", ["summarize_evidence.py"])
+    assert mod.main() == 0
+    out = capsys.readouterr().out
+    # m_ok: 2 captures, median of [100, 120] -> 120 (upper median)
+    ok_line = next(ln for ln in out.splitlines() if ln.startswith("m_ok"))
+    assert "120.0" in ok_line and " 2 " in ok_line
+    # cpu record excluded from the table
+    assert "m_cpu" not in out.split("cpu-only")[0]
+    # stale success + fresh error -> flagged as live failure
+    assert "m_stalefail" in out
+    assert "stale success above" in out
