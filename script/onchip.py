@@ -1244,6 +1244,25 @@ def task_gatherx() -> int:
             lambda w, i: w[i].reshape(rows, lanes).sum(axis=1).sum(),
             w32, idx,
         )
+        # the exactness-preserving narrow-pull candidate: gather u8
+        # codes + u8 zero-mask (2 B/entry vs 4), dequantize per entry
+        # after the gather — what SGDConfig's pull filter would run if
+        # the narrow gathers win; L1-pruned exact zeros survive via
+        # the mask, matching make_pull_weights' where(w != 0) semantic
+        # UNSIGNED codes, like the production quantizer emits
+        # (filter/fixing_float.py): affine dequant over 0..255
+        qu8 = jax.device_put(
+            ((w_np * 10) + 128).clip(0, 255).astype(np.uint8)
+        )
+        zmask = jax.device_put((w_np != 0).astype(np.uint8))
+        timed(
+            f"gather_u8_plus_mask_dequant{tag}",
+            lambda q, m, i: (
+                (q[i].astype(jnp.float32) * 0.1 - 12.8)
+                * m[i].astype(jnp.float32)
+            ).sum(),
+            qu8, zmask, idx,
+        )
     if skipped_fresh:
         emit({"metric": "gatherx_task_resume", "value": len(skipped_fresh),
               "unit": "variants_skipped_fresh", "skipped": skipped_fresh})
