@@ -238,16 +238,15 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: flo
 
     from parameter_server_tpu.utils.device_lock import request_priority
 
-    # self-contained inline copy of mesh.honor_jax_platforms: the probe
-    # diagnoses DEVICE health, so it must not also depend on the whole
-    # package importing cleanly (plugin platform choice beats env alone)
-    probe_src = (
-        "import os, jax\n"
-        "p = os.environ.get('JAX_PLATFORMS')\n"
-        "if p:\n"
-        "    jax.config.update('jax_platforms', p)\n"
-        "jax.devices()\n"
+    # child source + graceful-timeout runner shared with the
+    # watcher's probe (utils/subproc): device init on a daemon
+    # thread so the child stays SIGTERM-deliverable while the
+    # wedge blocks the init C call
+    from parameter_server_tpu.utils.subproc import (
+        PROBE_CHILD_SRC,
+        run_graceful,
     )
+
     diagnosis = "probe never ran"
     for attempt in range(max(1, attempts)):
         if attempt:
@@ -259,14 +258,12 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: flo
             time.sleep(retry_wait_s)
         request_priority("bench-probe")
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", probe_src],
-                timeout=timeout_s,
-                capture_output=True,
+            rc, perr = run_graceful(
+                [sys.executable, "-c", PROBE_CHILD_SRC], timeout_s
             )
-            if r.returncode == 0:
+            if rc == 0:
                 return None
-            tail = r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+            tail = perr.decode(errors="replace").strip().splitlines()[-3:]
             # a crash (vs a hang) is deterministic — fail fast, no retry
             return "device init failed: " + " | ".join(tail)
         except subprocess.TimeoutExpired:

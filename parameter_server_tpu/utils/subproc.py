@@ -1,0 +1,87 @@
+"""Graceful subprocess timeout for tunnel-client children.
+
+``subprocess.run(timeout=...)`` SIGKILLs on timeout — and a hard-killed
+tunnel client mid-device-claim is the documented relay-wedge trigger
+(bench.py probe_device note): a probing watcher could PROLONG the very
+wedge it measures, one killed client per probe interval for hours.
+``run_graceful`` SIGTERMs first and grants a grace period so a
+responsive child can run its finalizers and release its claim.
+
+Shared by bench.py's probe_device and script/onchip.py's watcher probe
+(one definition — the interrupt-reaping subtleties below were wrong in
+two inline copies once).
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+
+def run_graceful(
+    argv,
+    timeout_s: float,
+    term_grace_s: float = 10.0,
+    **popen_kw,
+) -> "tuple[int | None, bytes]":
+    """Run ``argv`` to completion with a graceful timeout.
+
+    Returns ``(returncode, stderr_bytes)``; raises
+    ``subprocess.TimeoutExpired`` after the graceful shutdown
+    completes. On ANY exception (including KeyboardInterrupt while
+    blocked in communicate) the child is killed and reaped before the
+    exception propagates — subprocess.run's guarantee, which a naive
+    Popen/communicate port silently drops: an orphaned live tunnel
+    client outliving its parent's device-lock scope is exactly the
+    two-concurrent-clients collision the lock exists to prevent."""
+    p = subprocess.Popen(
+        argv,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        **popen_kw,
+    )
+    try:
+        _, err = p.communicate(timeout=timeout_s)
+        return p.returncode, err
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            p.communicate(timeout=term_grace_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+        raise
+    except BaseException:
+        p.kill()
+        p.communicate()
+        raise
+
+
+# The probe child's device init runs in a DAEMON THREAD: CPython only
+# delivers signal handlers between bytecodes of the MAIN thread, and a
+# main thread blocked inside the PJRT backend-init C call (the wedge
+# scenario) can never run its SIGTERM handler — the graceful shutdown
+# would silently degrade to the SIGKILL it exists to avoid. With init
+# on a side thread, the main thread sleeps in short slices, stays
+# signal-deliverable, and sys.exit(143) runs finalizers/atexit so the
+# tunnel client can release its claim.
+PROBE_CHILD_SRC = (
+    "import signal, sys, threading, time\n"
+    "signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))\n"
+    "done = []\n"
+    "def _init():\n"
+    "    import os, jax\n"
+    "    p = os.environ.get('JAX_PLATFORMS')\n"
+    "    if p:\n"
+    "        jax.config.update('jax_platforms', p)\n"
+    "    try:\n"
+    "        jax.devices()\n"
+    "        done.append(0)\n"
+    "    except BaseException as e:\n"
+    "        sys.stderr.write(repr(e) + '\\n')\n"
+    "        done.append(1)\n"
+    "t = threading.Thread(target=_init, daemon=True)\n"
+    "t.start()\n"
+    "while t.is_alive():\n"
+    "    time.sleep(0.2)\n"
+    "sys.exit(done[0] if done else 1)\n"
+)

@@ -1450,17 +1450,6 @@ def _wlog(msg: str) -> None:
         f.write(line + "\n")
 
 
-PROBE_SRC = (
-    # honor JAX_PLATFORMS via jax.config (the axon plugin's programmatic
-    # platform choice beats the env var alone — see bench.py probe)
-    "import os, jax\n"
-    "p = os.environ.get('JAX_PLATFORMS')\n"
-    "if p:\n"
-    "    jax.config.update('jax_platforms', p)\n"
-    "jax.devices()\n"
-)
-
-
 def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
     """(ok, diagnosis). A nonzero exit is a deterministic CRASH (bad
     install/env — retrying won't help, surface the stderr tail); a
@@ -1483,15 +1472,20 @@ def probe(timeout_s: float = 150.0) -> "tuple[bool, str]":
             # device — that is not a wedge, just not our turn
             return False, "device busy (another process holds the lock)"
         # "unsupported": no exclusion exists to wait for — probe anyway
+        from parameter_server_tpu.utils.subproc import (
+            PROBE_CHILD_SRC,
+            run_graceful,
+        )
+
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", PROBE_SRC], timeout=timeout_s,
-                capture_output=True, cwd=REPO, env=held_env(),
+            rc, err = run_graceful(
+                [sys.executable, "-c", PROBE_CHILD_SRC], timeout_s,
+                cwd=REPO, env=held_env(),
             )
-            if r.returncode == 0:
+            if rc == 0:
                 return True, "ok"
             tail = " | ".join(
-                r.stderr.decode(errors="replace").strip().splitlines()[-3:]
+                err.decode(errors="replace").strip().splitlines()[-3:]
             )
             return False, f"device init CRASHED (not a wedge): {tail}"
         except subprocess.TimeoutExpired:
