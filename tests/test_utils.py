@@ -607,3 +607,92 @@ class TestCompileCache:
                 jax.config.update(
                     "jax_persistent_cache_min_compile_time_secs", prev_min
                 )
+
+
+class TestRunGraceful:
+    def test_sigterm_grace_then_success_exit(self):
+        """A responsive child gets SIGTERM and exits inside the grace
+        window; TimeoutExpired still propagates (the call did not
+        finish in time) and the child is reaped."""
+        import subprocess
+        import sys
+        import time
+
+        from parameter_server_tpu.utils.subproc import run_graceful
+
+        child = (
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(143))\n"
+            "time.sleep(60)\n"
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(subprocess.TimeoutExpired):
+            run_graceful([sys.executable, "-c", child], timeout_s=1.0)
+        took = time.perf_counter() - t0
+        assert took < 8.0  # SIGTERM honored quickly, grace not burned
+
+    def test_stubborn_child_killed_after_grace(self):
+        """A child that ignores SIGTERM is SIGKILLed after the grace."""
+        import subprocess
+        import sys
+        import time
+
+        from parameter_server_tpu.utils.subproc import run_graceful
+
+        child = (
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "time.sleep(60)\n"
+        )
+        t0 = time.perf_counter()
+        with pytest.raises(subprocess.TimeoutExpired):
+            # timeout long enough for the child to INSTALL SIG_IGN
+            # (at 0.5s it was still in interpreter startup with the
+            # default disposition and died to the SIGTERM directly)
+            run_graceful(
+                [sys.executable, "-c", child],
+                timeout_s=3.0, term_grace_s=1.0,
+            )
+        took = time.perf_counter() - t0
+        assert 3.9 < took < 15.0  # waited the full grace, then killed
+
+    def test_interrupt_kills_and_reaps(self, monkeypatch):
+        """On a non-timeout exception mid-communicate the child is
+        killed and reaped before the exception propagates — an
+        orphaned live tunnel client outliving the caller's device-lock
+        scope is the two-client collision the flock prevents."""
+        import os
+        import subprocess
+        import sys
+
+        from parameter_server_tpu.utils import subproc
+
+        spawned = []
+        real_popen = subprocess.Popen
+
+        class InterruptingPopen(real_popen):
+            def communicate(self, *a, **kw):
+                if not spawned:
+                    spawned.append(self.pid)
+                    raise KeyboardInterrupt
+                return real_popen.communicate(self, *a, **kw)
+
+        monkeypatch.setattr(subprocess, "Popen", InterruptingPopen)
+        with pytest.raises(KeyboardInterrupt):
+            subproc.run_graceful(
+                [sys.executable, "-c", "import time; time.sleep(60)"],
+                timeout_s=5.0,
+            )
+        pid = spawned[0]
+        # reaped: the pid is gone (or at worst a zombie being reaped);
+        # os.kill(pid, 0) raising ProcessLookupError proves exit
+        for _ in range(50):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            import time as _t
+
+            _t.sleep(0.1)
+        else:
+            raise AssertionError(f"child {pid} still alive after interrupt")
