@@ -8,7 +8,12 @@ step in apps/linear/async_sgd.py — pull(gather+psum) → Xw/grad segment-sums
 doing localization, so device steps and host prep overlap exactly like the
 reference's MinibatchReader producer/consumer.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Record protocol (last JSON line wins): the final measurement (or
+failure) record is the LAST JSON line on stdout. Non-smoke runs print a
+provisional failure record before the device probe and refresh it on
+every retry, so a driver that kills the bench at ANY point still parses
+a record ({"metric", "value", "unit", "vs_baseline", ...}); a completed
+run's final record supersedes the provisionals.
 
 Baseline: BASELINE.json publishes no number for the 8-node ZMQ cluster; we
 use 500k examples/sec as the documented estimate for 8-node async FTRL on
@@ -76,7 +81,12 @@ class Watchdog:
         self._phase = "init"
         self._partial: dict = {}
         self._done = False
-        self._lock = threading.Lock()
+        # RLock, not Lock: the SIGTERM handler runs ON the main thread,
+        # which spends the whole run inside beat()/grace()/finish()
+        # critical sections — a plain Lock would deadlock the handler
+        # against the very frame it interrupted and the driver's
+        # follow-up SIGKILL would reproduce the r4 silent death
+        self._lock = threading.RLock()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -136,6 +146,26 @@ class Watchdog:
         rec["error"] = f"accelerator wedged: {wedge}"
         return rec, 2
 
+    def sigterm_flush(self, reason: str) -> None:
+        """Flush the best-so-far record on a supervisor SIGTERM.
+
+        The round-4 driver killed the bench mid-run and got NOTHING
+        (`BENCH_r04.json`: rc 124, parsed null) because the old SIGTERM
+        path exited without touching the staged fields. This emits the
+        same record the stall branch would — a valid measurement when
+        the headline already landed, a failure record otherwise — and
+        retires the watchdog so no second record can follow. Always
+        emits through :func:`_raw_emit` (the signal-handler path): the
+        interrupted main thread may be INSIDE a buffered stdout write,
+        where a reentrant print() raises RuntimeError and loses the
+        record."""
+        with self._lock:
+            if self._done:  # a final record already printed; stay silent
+                return
+            self._done = True
+            rec, _ = self._partial_record(reason)
+        _raw_emit(rec)
+
     def abort(self, reason: str) -> int:
         """Synchronous twin of the stall branch, for mid-run EXCEPTIONS:
         a dying backend raises (e.g. ``UNAVAILABLE: TPU backend
@@ -174,6 +204,54 @@ class Watchdog:
 
 
 _WATCHDOG: "Watchdog | None" = None
+
+# Provisional failure record staged by main() during the probe phase:
+# printed (flushed) before the first probe attempt, refreshed on every
+# retry, flushed one last time by the SIGTERM handler. Cleared the
+# moment a better source of truth exists (the watchdog, or a final
+# record). Exists because the round-4 driver killed the bench mid-probe
+# and parsed NOTHING (`BENCH_r04.json`: rc 124, parsed null).
+_PENDING_REC: "dict | None" = None
+
+
+def _raw_emit(rec: dict) -> None:
+    """Signal-safe record write: os.write to fd 1 bypasses Python's
+    buffered writer — print() from a signal handler raises
+    'RuntimeError: reentrant call' when the signal interrupted a
+    main-thread print mid-flush, which would lose the record at the
+    exact moment it matters. The leading newline isolates the record
+    from any half-written line the interrupt left behind (the driver
+    parses the last PARSEABLE line).
+
+    Also used for every PROBE-PHASE record (provisional + retries):
+    routing those through the buffered writer would let a SIGTERM land
+    between a print's buffer-write and its flush, in which case the
+    interpreter's exit flush appends the stale buffered line AFTER the
+    handler's raw record — breaking last-line-wins. os.write leaves
+    nothing buffered."""
+    with contextlib.suppress(Exception):
+        os.write(1, b"\n" + json.dumps(rec).encode() + b"\n")
+
+
+def _sigterm_handler(signum, frame):
+    """Flush the best available record BEFORE dying. Mid-run the
+    watchdog owns the staged fields (best-so-far measurement); during
+    the probe phase the provisional failure record is all we have.
+    Then exit via SystemExit — not os._exit — so the tunnel client's
+    atexit/GC gets a shot at releasing its device claim (a hard-killed
+    client has wedged the relay for hours, see probe_device)."""
+    global _PENDING_REC
+    if _WATCHDOG is not None:
+        _WATCHDOG.sigterm_flush("supervisor SIGTERM (driver timeout?)")
+    elif _PENDING_REC is not None:
+        rec = dict(_PENDING_REC)
+        rec["error"] = (
+            str(rec.get("error", ""))
+            + " | bench SIGTERM'd by its supervisor mid-probe"
+        )
+        _raw_emit(rec)
+        _PENDING_REC = None
+    sys.exit(143)
 
 
 def _beat(phase: str | None = None, **fields) -> None:
@@ -217,7 +295,8 @@ def _finish(rec: dict) -> None:
 # (BASELINE.json north star: "Criteo-1TB ... at logloss parity").
 # ---------------------------------------------------------------------------
 
-def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: float = 120.0):
+def probe_device(timeout_s: float = 150.0, attempts: int = 4,
+                 retry_wait_s: float = 60.0, on_retry=None):
     """Fail fast when the accelerator is unreachable: returns None when
     healthy, else a human-readable diagnosis (timeout vs crash, with the
     child's stderr tail).
@@ -229,9 +308,18 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: flo
     Wedges are often TRANSIENT (the relay times out the dead claim), so
     a failed probe is retried ``attempts`` times with a pause — a bench
     run should not be zeroed by a hiccup that clears in two minutes.
-    The default budget (10 attempts x 120s wait + 180s probe) rides out
-    a ~45-minute wedge — round 3's 2-retry budget gave up in 10 minutes
-    against a wedge that cleared later, zeroing the round artifact.
+
+    BUDGET (round 5): 4 attempts x 150s probe + 3 x 60s wait = 13 min,
+    deliberately UNDER the round driver's observed ~30-min patience.
+    Round 4's 10x~300s budget (~50 min) out-waited the wedge but also
+    out-waited the driver, which SIGTERM'd the bench mid-retry and got
+    no JSON at all (`BENCH_r04.json`: rc 124, parsed null). Riding out
+    a long wedge is the background WATCHER's job (script/onchip.py);
+    the bench's job is to always leave a record behind.
+
+    ``on_retry(attempt, diagnosis)`` is called before each wait so the
+    caller can refresh its provisional failure record on stdout — the
+    record the driver keeps if it kills us mid-probe.
     Each retry refreshes the priority marker so the watcher stays away
     for the whole probing window."""
     import subprocess
@@ -255,6 +343,9 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: flo
                 f"retrying in {retry_wait_s:.0f}s",
                 file=sys.stderr,
             )
+            if on_retry is not None:
+                with contextlib.suppress(Exception):
+                    on_retry(attempt, diagnosis)
             time.sleep(retry_wait_s)
         request_priority("bench-probe")
         try:
@@ -274,14 +365,22 @@ def probe_device(timeout_s: float = 180.0, attempts: int = 10, retry_wait_s: flo
     return diagnosis
 
 
-def emit_device_error(diagnosis: str) -> int:
-    """Explicit failure record — with a POINTER to the most recent
-    on-chip capture (BENCH_ONCHIP.md, written by script/onchip.py when
-    the tunnel was last up). The cached fields are diagnostics for the
-    reader, clearly labeled; ``value`` stays 0 because no live
-    measurement happened in THIS run."""
+def build_device_error(
+    diagnosis: str, metric: str = "criteo_sparse_lr_examples_per_sec"
+) -> dict:
+    """Build (don't print) the explicit failure record — with a POINTER
+    to the most recent on-chip capture (BENCH_ONCHIP.md, written by
+    script/onchip.py when the tunnel was last up). The cached fields
+    are diagnostics for the reader, clearly labeled; ``value`` stays 0
+    because no live measurement happened in THIS run.
+
+    Split from :func:`emit_device_error` so main() can stage this as
+    the PROVISIONAL record: printed before the first probe attempt and
+    refreshed on every retry, it is what the driver parses if it kills
+    the bench mid-probe (the exact r4 failure, `BENCH_r04.json`
+    rc 124 / parsed null)."""
     rec = {
-        "metric": "criteo_sparse_lr_examples_per_sec",
+        "metric": metric,
         "value": 0,
         "unit": "examples/sec",
         "vs_baseline": 0,
@@ -311,9 +410,13 @@ def emit_device_error(diagnosis: str) -> int:
                                 if k in cached}
                         line["captured_at"] = stamp
                         by_metric[cached["metric"]] = line  # latest wins
-                    stamp = None
-        line = by_metric.get(  # prefer the headline metric's capture
-            "criteo_sparse_lr_examples_per_sec"
+                        stamp = None  # first VALID capture per section
+                    # zero-value lines (the provisional/failure records
+                    # every non-smoke run now prints first) must NOT
+                    # consume the stamp — a real capture may follow
+                    # them inside the same log section
+        line = by_metric.get(  # prefer this run's headline metric
+            metric
         ) or next(iter(by_metric.values()), None)
         if line is not None:
             rec["last_onchip_capture"] = line
@@ -408,8 +511,7 @@ def emit_device_error(diagnosis: str) -> int:
             pass
     except Exception:
         pass
-    print(json.dumps(rec))
-    return 1
+    return rec
 
 
 # HBM peak bandwidth by device_kind (public spec sheets) for utilization
@@ -1114,14 +1216,26 @@ def run_real(args) -> int:
 
 
 def main() -> int:
+    global _PENDING_REC
     # a supervisor (watcher/driver) stopping the bench sends SIGTERM;
-    # convert to SystemExit so the tunnel client's atexit/GC gets a
-    # shot at releasing its device claim (a hard-killed client has
-    # wedged the relay for hours — probe_device docstring)
+    # flush the best available record, then convert to SystemExit so
+    # the tunnel client's atexit/GC gets a shot at releasing its device
+    # claim (a hard-killed client has wedged the relay for hours —
+    # probe_device docstring). Seed a minimal record BEFORE anything
+    # else: argparse + the heavyweight build_device_error take seconds
+    # on a loaded host, and a kill inside that window must still leave
+    # a parseable artifact.
+    _PENDING_REC = {
+        "metric": "criteo_sparse_lr_examples_per_sec",
+        "value": 0,
+        "unit": "examples/sec",
+        "vs_baseline": 0,
+        "error": "bench killed during startup, before the device probe",
+    }
     import signal as _signal
 
     with contextlib.suppress(ValueError):  # non-main thread: leave it
-        _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(143))
+        _signal.signal(_signal.SIGTERM, _sigterm_handler)
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny quick run (CI)")
     ap.add_argument("--minibatch", type=int, default=16384)
@@ -1193,11 +1307,48 @@ def main() -> int:
         # holder exits and frees the flock).
         else device_lock(block_after_timeout=True, priority_note="bench")
     )
+    metric = (
+        "criteo_real_examples_per_sec"
+        if args.real
+        else "criteo_sparse_lr_examples_per_sec"
+    )
+    if not args.smoke:
+        # Provisional record: the driver keeps whatever stdout holds
+        # when it loses patience, and it parses the LAST JSON line.
+        # Print the failure record FIRST (flushed), refresh it on
+        # every retry, and let any later record supersede it — a kill
+        # at ANY point after this line now leaves a parseable artifact
+        # instead of silence. MUST print before the device-lock wait
+        # below: the flock can block for minutes behind the watcher's
+        # own wedged probe (observed while verifying this change), and
+        # a kill during that wait would otherwise find empty stdout.
+        _PENDING_REC = build_device_error(
+            "provisional record: bench killed before the "
+            "device probe loop finished",
+            metric=metric,
+        )
+        _raw_emit(_PENDING_REC)
     with lock:
         try:
-            diagnosis = probe_device()
+            def _refresh(attempt: int, diag: str) -> None:
+                if _PENDING_REC is not None:
+                    _PENDING_REC["error"] = (
+                        f"accelerator unreachable: {diag} (provisional "
+                        f"after failed probe attempt {attempt})"
+                    )
+                    _raw_emit(_PENDING_REC)
+
+            diagnosis = probe_device(on_retry=_refresh)
             if diagnosis is not None:
-                return emit_device_error(diagnosis)
+                # reuse the staged provisional (same heavyweight
+                # diagnostics) rather than rebuilding it from scratch
+                rec = _PENDING_REC if _PENDING_REC is not None else (
+                    build_device_error(diagnosis, metric=metric)
+                )
+                rec["error"] = f"accelerator unreachable: {diagnosis}"
+                _PENDING_REC = None
+                _raw_emit(rec)
+                return 1
         finally:
             # unconditional: probe_device writes a marker even on a
             # --smoke run (which skips the request above), and a
@@ -1208,12 +1359,8 @@ def main() -> int:
             # watcher long.
             clear_priority()
         global _WATCHDOG
-        _WATCHDOG = Watchdog(
-            "criteo_real_examples_per_sec"
-            if args.real
-            else "criteo_sparse_lr_examples_per_sec",
-            stall_s=args.stall_timeout,
-        )
+        _WATCHDOG = Watchdog(metric, stall_s=args.stall_timeout)
+        _PENDING_REC = None  # the watchdog owns flushing from here on
         try:
             if args.real:
                 return run_real(args)

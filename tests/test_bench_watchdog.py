@@ -6,8 +6,12 @@ Runs bench.Watchdog in a subprocess because it exits via os._exit.
 """
 
 import json
+import os
 import subprocess
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 REPO_SNIPPET = """
 import sys, time
@@ -19,12 +23,9 @@ wd = Watchdog({metric!r}, stall_s=0.5, poll_s=0.1)
 
 
 def _run(body: str, metric: str = "criteo_sparse_lr_examples_per_sec"):
-    import os
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     return subprocess.run(
         [sys.executable, "-c",
-         REPO_SNIPPET.format(repo=repo, metric=metric, body=body)],
+         REPO_SNIPPET.format(repo=REPO, metric=metric, body=body)],
         capture_output=True, text=True, timeout=60,
     )
 
@@ -187,3 +188,145 @@ def test_grace_is_monotone(monkeypatch):
         assert wd._last < big  # beat snaps back to normal
     finally:
         wd.cancel()
+
+
+def test_sigterm_flush_after_headline_keeps_measurement():
+    # driver SIGTERM mid-run AFTER the headline landed: the staged
+    # measurement must survive as the final record (r4 lost exactly
+    # this: rc 124, parsed null)
+    r = _run(
+        "wd.beat('e2e', value=99.0, vs_baseline=0.2, note='n')\n"
+        "wd.sigterm_flush('supervisor SIGTERM')\n"
+        "time.sleep(0.5)\n"
+    )
+    assert r.returncode == 0
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 99.0
+    assert "SIGTERM" in rec["wedged"]
+
+
+def test_sigterm_flush_before_headline_emits_error_record():
+    r = _run(
+        "wd.beat('warmup', sweep_error='x')\n"
+        "wd.sigterm_flush('supervisor SIGTERM')\n"
+    )
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0
+    assert "SIGTERM" in rec["error"]
+    assert rec["sweep_error"] == "x"
+
+
+def test_sigterm_flush_after_finish_is_silent():
+    # the handler may fire after a final record already printed: the
+    # single-record guarantee must hold
+    r = _run(
+        "wd.finish({'metric': 'm', 'value': 3.0})\n"
+        "wd.sigterm_flush('late SIGTERM')\n"
+        "time.sleep(0.3)\n"
+    )
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["value"] == 3.0
+
+
+def test_probe_budget_stays_under_driver_patience():
+    """Total worst-case probe budget must stay well under the driver's
+    observed ~30-min kill window: round 4's ~50-min budget meant the
+    driver SIGTERM'd the bench mid-retry and recorded nothing."""
+    import inspect
+
+    import bench
+
+    sig = inspect.signature(bench.probe_device)
+    d = {k: v.default for k, v in sig.parameters.items()}
+    total = d["attempts"] * d["timeout_s"] + (d["attempts"] - 1) * d["retry_wait_s"]
+    assert total <= 900, f"probe budget {total}s exceeds the 15-min cap"
+
+
+def test_probe_retries_refresh_the_provisional_record(monkeypatch):
+    import subprocess as sp
+
+    import bench
+    from parameter_server_tpu.utils import device_lock, subproc
+
+    def _always_hangs(cmd, timeout_s):
+        raise sp.TimeoutExpired(cmd, timeout_s)
+
+    monkeypatch.setattr(subproc, "run_graceful", _always_hangs)
+    # keep the test off the real watcher's priority-marker files
+    monkeypatch.setattr(device_lock, "request_priority", lambda *a, **k: None)
+    calls = []
+    diag = bench.probe_device(
+        timeout_s=0.1, attempts=3, retry_wait_s=0.0,
+        on_retry=lambda a, d: calls.append((a, d)),
+    )
+    assert diag is not None and "did not complete" in diag
+    assert [a for a, _ in calls] == [1, 2]
+    assert all("did not complete" in d for _, d in calls)
+
+
+def test_bench_main_sigterm_during_probe_leaves_record():
+    """End-to-end kill test: SIGTERM while the probe hangs must leave a
+    parseable failure record on stdout (the exact r4 silent death)."""
+    snippet = """
+import contextlib, os, signal, sys, threading, time
+sys.path.insert(0, {repo!r})
+import bench
+import parameter_server_tpu.utils.device_lock as dl
+# no real device work in this test: neutralize the machine-wide lock
+# and priority markers so a live watcher on this host is undisturbed
+dl.device_lock = lambda **kw: contextlib.nullcontext(True)
+dl.clear_priority = lambda: None
+bench.probe_device = lambda **kw: time.sleep(600)
+threading.Timer(
+    3.0, lambda: os.kill(os.getpid(), signal.SIGTERM)
+).start()
+sys.argv = ["bench.py"]
+sys.exit(bench.main())
+""".format(repo=REPO)
+    r = subprocess.run(
+        [sys.executable, "-c", snippet],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 143
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON on stdout; stderr: {r.stderr[-500:]}"
+    rec = json.loads(lines[-1])
+    assert rec["value"] == 0 and rec["vs_baseline"] == 0
+    assert "SIGTERM'd by its supervisor" in rec["error"]
+    # the provisional printed BEFORE the kill too (belt for SIGKILL)
+    first = json.loads(lines[0])
+    assert first["value"] == 0 and "provisional" in first["error"]
+
+
+def test_build_device_error_skips_provisional_lines(tmp_path, monkeypatch):
+    """The watcher copies EVERY JSON line of a bench run into
+    BENCH_ONCHIP.md — including the new zero-value provisional printed
+    before the probe. A zero line must not consume the section's
+    attribution stamp, or the real capture behind it is never seen."""
+    import bench
+
+    (tmp_path / "BENCH_ONCHIP.md").write_text(
+        "## 2026-08-02 09:00:00 — bench (rc=0, 300s)\n"
+        "```\n"
+        '{"metric": "criteo_sparse_lr_examples_per_sec", "value": 0, '
+        '"vs_baseline": 0, "error": "provisional record: ..."}\n'
+        '{"metric": "criteo_sparse_lr_examples_per_sec", "value": 650000.0, '
+        '"unit": "examples/sec", "vs_baseline": 1.3}\n'
+        "```\n"
+    )
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rec = bench.build_device_error("tunnel down")
+    cap = rec["last_onchip_capture"]
+    assert cap["value"] == 650000.0
+    assert cap["captured_at"].startswith("2026-08-02")
+
+
+def test_build_device_error_metric_threads_through(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rec = bench.build_device_error(
+        "x", metric="criteo_real_examples_per_sec"
+    )
+    assert rec["metric"] == "criteo_real_examples_per_sec"
