@@ -1341,9 +1341,14 @@ def main() -> int:
             diagnosis = probe_device(on_retry=_refresh)
             if diagnosis is not None:
                 # reuse the staged provisional (same heavyweight
-                # diagnostics) rather than rebuilding it from scratch
-                rec = _PENDING_REC if _PENDING_REC is not None else (
-                    build_device_error(diagnosis, metric=metric)
+                # diagnostics) rather than rebuilding it from scratch.
+                # Smoke runs never staged one (their _PENDING_REC is
+                # still the minimal startup seed with a hardcoded
+                # metric): build the full record for them here
+                rec = (
+                    _PENDING_REC
+                    if _PENDING_REC is not None and not args.smoke
+                    else build_device_error(diagnosis, metric=metric)
                 )
                 rec["error"] = f"accelerator unreachable: {diagnosis}"
                 _PENDING_REC = None

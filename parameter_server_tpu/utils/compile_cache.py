@@ -23,6 +23,7 @@ env line in a launcher script. ``PS_NO_COMPILE_CACHE=1`` opts out.
 from __future__ import annotations
 
 import os
+import stat as _stat
 
 # uid-scoped: the cache holds serialized executables that jax will
 # happily deserialize and run — a world-shared fixed path would let
@@ -30,6 +31,23 @@ import os
 # every write). Same reasoning as device_lock's per-uid fallback.
 DEFAULT_DIR = f"/tmp/ps_jax_cache_{os.getuid()}"
 _ENABLED_DIR: "str | None" = None
+
+
+def _accelerator_plugin_detectable() -> bool:
+    """True when a PJRT accelerator plugin is plausibly installed,
+    checked without initializing any backend (early backend init is
+    fatal before the jax.distributed rendezvous — see enable())."""
+    try:
+        import importlib.util as ilu
+
+        if (ilu.find_spec("libtpu") is not None
+                or ilu.find_spec("jax_plugins") is not None):
+            return True
+        from importlib.metadata import entry_points
+
+        return bool(entry_points(group="jax_plugins"))
+    except Exception:
+        return False
 
 
 def enable(cache_dir: "str | None" = None) -> "str | None":
@@ -65,17 +83,35 @@ def enable(cache_dir: "str | None" = None) -> "str | None":
                 requested = jax.config.jax_platforms or ""
             except Exception:
                 requested = ""
-        if requested.split(",")[0].strip().lower() == "cpu":
+        req = requested.split(",")[0].strip().lower()
+        if req == "cpu":
             return None
+        if not req:
+            # No explicit platform request: jax may silently default to
+            # XLA:CPU, which must not get the cache either (the SIGILL
+            # reload risk above). Enable only when an accelerator
+            # plugin is detectable WITHOUT initializing a backend —
+            # jax discovers PJRT plugins via the jax_plugins namespace
+            # package AND via importlib.metadata entry points, so both
+            # registration styles are checked.
+            if not _accelerator_plugin_detectable():
+                return None
     # the cache holds executables jax will deserialize and RUN, and a
     # predictable /tmp name is world-creatable: make the dir 0700 and
     # refuse one we don't own (another user pre-planting entries would
     # be arbitrary code execution in our process) — the XDG runtime-dir
     # check pattern
     try:
+        # a pre-created SYMLINK at the predictable name would make
+        # makedirs/stat/chmod all operate on the attacker's chosen
+        # target (e.g. chmod 0700 on a dir the victim owns): reject
+        # links outright, and lstat (not stat) afterwards so a swap
+        # between makedirs and the check is also caught
+        if os.path.islink(cache_dir):
+            return None
         os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-        st = os.stat(cache_dir)
-        if st.st_uid != os.getuid():
+        st = os.lstat(cache_dir)
+        if st.st_uid != os.getuid() or not _stat.S_ISDIR(st.st_mode):
             return None
         os.chmod(cache_dir, 0o700)
     except OSError:

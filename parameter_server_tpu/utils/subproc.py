@@ -43,12 +43,24 @@ def run_graceful(
         _, err = p.communicate(timeout=timeout_s)
         return p.returncode, err
     except subprocess.TimeoutExpired:
-        p.terminate()
+        # the terminate/grace sequence needs its own interrupt guard:
+        # a KeyboardInterrupt raised while blocked in the grace-window
+        # communicate would escape BOTH handlers (the outer
+        # except BaseException cannot catch exceptions raised inside a
+        # SIBLING except block), leaving a SIGTERM'd-but-possibly-alive
+        # unreaped child — the exact orphan this module exists to
+        # prevent
         try:
-            p.communicate(timeout=term_grace_s)
-        except subprocess.TimeoutExpired:
+            p.terminate()
+            try:
+                p.communicate(timeout=term_grace_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+        except BaseException:
             p.kill()
             p.communicate()
+            raise
         raise
     except BaseException:
         p.kill()

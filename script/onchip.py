@@ -145,6 +145,10 @@ def session_stats(metric: str, value: float, match: "dict | None" = None) -> dic
             d.get("value"), (int, float)
         ) or d["value"] <= 0:
             continue
+        if d.get("exceeds_physical_peak") is True:
+            # a record that flags its own bandwidth accounting as
+            # physically impossible must not enter published medians
+            continue
         if match and any(
             d.get(k) != v for k, v in match.items()
         ):
@@ -542,13 +546,16 @@ def _chip_success(d: dict) -> bool:
     """ONE definition of "successful on-chip capture" shared by
     _fresh_capture and script/summarize_evidence.py: value > 0, no
     error, a non-cpu device_kind (smoke runs append to the same log),
-    and not diff_noisy (a deliberately deflated conservative number)."""
+    not diff_noisy (a deliberately deflated conservative number), and
+    not exceeds_physical_peak (a self-declared broken HBM derivation
+    must be re-measured, not skipped-as-fresh for 24h)."""
     return (
         isinstance(d.get("value"), (int, float))
         and d["value"] > 0
         and "error" not in d
         and d.get("device_kind") not in (None, "cpu")
         and d.get("diff_noisy") is not True
+        and d.get("exceeds_physical_peak") is not True
     )
 
 

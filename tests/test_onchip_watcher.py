@@ -218,6 +218,10 @@ def test_fresh_capture_resume_logic(onchip):
         json.dumps({"metric": "lm_train_nokind", "value": 5.0}),
         json.dumps({"metric": "lm_decode_noisy", "value": 5.0,
                     "diff_noisy": True, **kind}),
+        # a self-declared broken HBM derivation must be re-measured,
+        # not treated as a fresh success (r4 advisor finding)
+        json.dumps({"metric": "lm_decode_overpeak", "value": 5.0,
+                    "exceeds_physical_peak": True, **kind}),
     ]
     with open(onchip.LOG_MD, "w") as f:
         f.write("\n".join(lines) + "\n")
@@ -229,6 +233,7 @@ def test_fresh_capture_resume_logic(onchip):
     assert not onchip._fresh_capture("lm_train_smoke")
     assert not onchip._fresh_capture("lm_train_nokind")
     assert not onchip._fresh_capture("lm_decode_noisy")
+    assert not onchip._fresh_capture("lm_decode_overpeak")
     # a tighter window rejects even the fresh one
     assert not onchip._fresh_capture("lm_train_good", within_s=0.0)
 

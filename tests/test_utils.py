@@ -696,3 +696,101 @@ class TestRunGraceful:
             _t.sleep(0.1)
         else:
             raise AssertionError(f"child {pid} still alive after interrupt")
+
+
+class TestCompileCacheHardening:
+    def test_symlinked_cache_dir_is_rejected(self, tmp_path, monkeypatch):
+        """A predictable /tmp cache path pre-created as a SYMLINK by
+        another local user must be refused: makedirs/stat/chmod all
+        follow links, so the old uid check passed while chmodding and
+        writing into the attacker's chosen target."""
+        from parameter_server_tpu.utils import compile_cache as cc
+
+        monkeypatch.setattr(cc, "_ENABLED_DIR", None)
+        monkeypatch.delenv("PS_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.setenv("PS_COMPILE_CACHE_CPU", "1")
+        target = tmp_path / "victim"
+        target.mkdir()
+        link = tmp_path / "cache_link"
+        link.symlink_to(target)
+        assert cc.enable(str(link)) is None
+        # enable() refused, so nothing was chmodded through the link
+        # and no jax config points at it
+        assert (target.stat().st_mode & 0o777) != 0o700
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir != str(link)
+
+    def test_default_platform_without_tpu_plugin_is_gated(
+        self, tmp_path, monkeypatch
+    ):
+        """Empty JAX_PLATFORMS on a host with no accelerator plugin
+        means jax silently defaults to XLA:CPU — the cache must stay
+        off there (the documented SIGILL-on-reload risk)."""
+        from parameter_server_tpu.utils import compile_cache as cc
+
+        monkeypatch.setattr(cc, "_ENABLED_DIR", None)
+        monkeypatch.delenv("PS_NO_COMPILE_CACHE", raising=False)
+        monkeypatch.delenv("PS_COMPILE_CACHE_CPU", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        # this host HAS plugins installed; simulate a bare-CPU host at
+        # the detection seam (the helper's own logic is import probes)
+        monkeypatch.setattr(
+            cc, "_accelerator_plugin_detectable", lambda: False
+        )
+        import jax
+
+        prev = jax.config.jax_platforms
+        try:
+            jax.config.update("jax_platforms", None)
+            assert cc.enable(str(tmp_path / "c")) is None
+        finally:
+            jax.config.update("jax_platforms", prev)
+
+    def test_plugin_detection_finds_entry_points(self):
+        """On THIS image libtpu is installed: the no-init detection
+        must see it (a false negative silently disables the cache on
+        genuine accelerator hosts)."""
+        from parameter_server_tpu.utils import compile_cache as cc
+
+        assert cc._accelerator_plugin_detectable() is True
+
+
+class TestRunGracefulInterruptDuringGrace:
+    def test_interrupt_in_grace_window_still_reaps(self, monkeypatch):
+        """A KeyboardInterrupt raised while blocked in the grace-window
+        communicate must still SIGKILL and reap the child before
+        propagating (advisor r4: it escaped both handlers, leaving a
+        SIGTERM'd-but-alive tunnel client orphaned)."""
+        import subprocess
+
+        from parameter_server_tpu.utils import subproc
+
+        events = []
+
+        class FakePopen:
+            returncode = None
+
+            def __init__(self, argv, **kw):
+                self._calls = 0
+
+            def communicate(self, timeout=None):
+                self._calls += 1
+                if self._calls == 1:
+                    raise subprocess.TimeoutExpired("x", timeout)
+                if self._calls == 2:
+                    # the interrupt lands inside the grace window
+                    raise KeyboardInterrupt
+                events.append("reaped")
+                return b"", b""
+
+            def terminate(self):
+                events.append("terminate")
+
+            def kill(self):
+                events.append("kill")
+
+        monkeypatch.setattr(subproc.subprocess, "Popen", FakePopen)
+        with pytest.raises(KeyboardInterrupt):
+            subproc.run_graceful(["x"], timeout_s=0.1, term_grace_s=0.1)
+        assert events == ["terminate", "kill", "reaped"]
