@@ -330,3 +330,102 @@ def test_build_device_error_metric_threads_through(tmp_path, monkeypatch):
         "x", metric="criteo_real_examples_per_sec"
     )
     assert rec["metric"] == "criteo_real_examples_per_sec"
+
+
+class TestUploadPipeline:
+    def _patch(self, monkeypatch):
+        import jax
+
+        import bench
+
+        class FakeSB:
+            def __init__(self, parts):
+                self.num_examples = sum(p.num_examples for p in parts)
+
+        monkeypatch.setattr(
+            bench, "stack_supersteps", lambda parts, T: FakeSB(parts)
+        )
+        monkeypatch.setattr(bench, "tree_host_nbytes", lambda sb: 7)
+        monkeypatch.setattr(jax, "device_put", lambda sb: sb)
+        return bench
+
+    def test_groups_of_T_and_tail_skip(self, monkeypatch):
+        bench = self._patch(monkeypatch)
+
+        class P:
+            num_examples = 2
+
+        pipe = bench.UploadPipeline(iter([P() for _ in range(7)]), T=3)
+        got = list(pipe)
+        assert [(n, nb) for _sb, n, nb in got] == [(6, 7), (6, 7)]
+        # the 7th part is a trailing partial group: skipped + disclosed
+        assert pipe.skipped_examples == 2
+
+    def test_producer_exception_propagates(self, monkeypatch):
+        bench = self._patch(monkeypatch)
+
+        def boom():
+            class P:
+                num_examples = 1
+
+            yield P()
+            raise RuntimeError("parse died")
+
+        pipe = bench.UploadPipeline(boom(), T=1)
+        it = iter(pipe)
+        next(it)  # first group arrives
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="parse died"):
+            for _ in it:
+                pass
+
+
+def test_operation_blocks_firing_despite_foreign_beats():
+    # an in-budget operation on one thread must hold the watchdog's
+    # fire even though another thread beats (which would cancel a
+    # plain grace); stall 0.5s, op budget 3s, sleep 1.5s with beats
+    r = _run(
+        "import threading\n"
+        "stop = []\n"
+        "def beater():\n"
+        "    while not stop:\n"
+        "        wd.beat()\n"
+        "        time.sleep(0.05)\n"
+        "threading.Thread(target=beater, daemon=True).start()\n"
+        "with wd.operation(3.0):\n"
+        "    stop_t = time.monotonic() + 1.5\n"
+        "    while time.monotonic() < stop_t:\n"
+        "        time.sleep(0.1)\n"
+        "stop.append(1)\n"
+        "wd.cancel()\nprint('OP_HELD')\n"
+    )
+    assert r.returncode == 0
+    assert "OP_HELD" in r.stdout
+    assert "wedged" not in r.stdout
+
+
+def test_operation_exit_restores_sensitivity():
+    # after the op exits, plain stall detection resumes immediately
+    r = _run(
+        "with wd.operation(100.0):\n"
+        "    pass\n"
+        "time.sleep(2.0)\n"
+    )
+    assert r.returncode == 2
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "wedged" in rec["error"]
+
+
+def test_expired_operation_budget_fires():
+    # a WEDGED transfer outlives its byte-derived budget: the watchdog
+    # must fire once the budget expires instead of waiting forever
+    r = _run(
+        "import threading\n"
+        "def stuck():\n"
+        "    with wd.operation(0.2):\n"
+        "        time.sleep(60)\n"
+        "threading.Thread(target=stuck, daemon=True).start()\n"
+        "time.sleep(30)\n"
+    )
+    assert r.returncode == 2
