@@ -133,7 +133,10 @@ class Watchdog:
         finally:
             with self._lock:
                 self._ops.pop(tok, None)
-                self._last = time.monotonic()
+                # max, not assignment: an op exiting on a side thread
+                # must never SHRINK a grace() deadline the main thread
+                # armed (grace's documented monotone invariant)
+                self._last = max(self._last, time.monotonic())
 
     def cancel(self) -> None:
         with self._lock:
@@ -277,6 +280,16 @@ def _sigterm_handler(signum, frame):
         )
         _raw_emit(rec)
         _PENDING_REC = None
+    with contextlib.suppress(Exception):
+        # a SIGTERM during the device-lock WAIT dies before the
+        # clear_priority finally is even entered, leaving a marker
+        # that idles the watcher for the full 30-min freshness window
+        # (observed 2026-08-01 23:05-23:16: two killed test benches
+        # cost the watcher ~11 idle minutes). We are dying — our
+        # device need ends here, whatever phase we were in.
+        from parameter_server_tpu.utils.device_lock import clear_priority
+
+        clear_priority()
     sys.exit(143)
 
 
@@ -643,57 +656,42 @@ class UploadPipeline:
     A trailing partial group (< T minibatches) is skipped — it would
     compile a second scan shape inside the timed window — and reported
     via ``skipped_examples`` after iteration ends. Exceptions on the
-    uploader thread propagate to the consuming iterator."""
-
-    _DONE = object()
+    uploader thread propagate to the consuming iterator (the plumbing
+    is :func:`iter_on_thread`; this class only adds the staging
+    generator and the skipped-tail accounting)."""
 
     def __init__(self, parts_iter, T: int, queue_depth: int = 2):
-        import queue as _queue
-
         self.skipped_examples = 0
-        self._T = T
-        self._parts = parts_iter
-        self._q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._it = iter_on_thread(
+            self._stage(parts_iter, T), maxsize=queue_depth
+        )
 
-    def _run(self) -> None:
+    def _stage(self, parts_iter, T: int):
+        # runs on iter_on_thread's daemon thread
         import jax
 
         parts = []
-        try:
-            for item in self._parts:
-                parts.append(item)
-                if len(parts) < self._T:
-                    continue
-                sb = stack_supersteps(parts, self._T)
-                parts = []
-                nb = tree_host_nbytes(sb)
-                _beat()
-                # device_put returns promptly with transfer in flight;
-                # the bounded queue (depth 2) keeps at most a couple of
-                # superbatches staged ahead so host memory stays flat.
-                # _transfer_op (not _grace_for_transfer): the main
-                # thread beats per consumed item, and a beat would
-                # cancel a plain grace mid-transfer
-                with _transfer_op(nb):
-                    staged = jax.device_put(sb)
-                self._q.put((staged, int(sb.num_examples), nb))
-            self.skipped_examples = sum(
-                int(p.num_examples) for p in parts
-            )
-            self._q.put(self._DONE)
-        except BaseException as e:  # propagate into the consumer loop
-            self._q.put(e)
+        for item in parts_iter:
+            parts.append(item)
+            if len(parts) < T:
+                continue
+            sb = stack_supersteps(parts, T)
+            parts = []
+            nb = tree_host_nbytes(sb)
+            _beat()
+            # device_put returns promptly with transfer in flight; the
+            # bounded queue keeps at most a couple of superbatches
+            # staged ahead so host memory stays flat. _transfer_op
+            # (not _grace_for_transfer): the main thread beats per
+            # consumed item, and a beat would cancel a plain grace
+            # mid-transfer
+            with _transfer_op(nb):
+                staged = jax.device_put(sb)
+            yield staged, int(sb.num_examples), nb
+        self.skipped_examples = sum(int(p.num_examples) for p in parts)
 
     def __iter__(self):
-        while True:
-            item = self._q.get()
-            if item is self._DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        return self._it
 
 
 def measure_upload_mb_s(prepped, reps: int = 3) -> float:
@@ -1154,6 +1152,10 @@ def run_real(args) -> int:
         max_delay=0,  # parity first; the timed phase relaxes to 4
         ell_lanes=39,
         wire="bits",
+        pull_filter=(
+            [{"type": "fixing_float", "num_bytes": args.pull_bytes}]
+            if args.pull_bytes else []
+        ),
     )
     worker = AsyncSGDWorker(conf, mesh=po.mesh)
 
@@ -1306,6 +1308,10 @@ def run_real(args) -> int:
         done_ex += n_ex
         wire_bytes_moved += nb  # actual staged bytes, not a dtype model
         _beat()
+        # device_put returned with the transfer possibly still in
+        # flight: the wait below may pay the wire time, so grace it on
+        # THIS thread (the beater) like the pre-pipeline code did
+        _grace_for_transfer(nb)
         pending.append(worker._submit_prepped(dev_sb, with_aux=False))
         if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
@@ -1319,7 +1325,8 @@ def run_real(args) -> int:
     e2e_rate = done_ex / dt
 
     rec = {
-        "metric": "criteo_real_examples_per_sec",
+        "metric": "criteo_real_examples_per_sec"
+        + (f"_q{args.pull_bytes}" if args.pull_bytes else ""),
         "unit": "examples/sec",
         "e2e_stream": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
@@ -1380,6 +1387,19 @@ def main() -> int:
         "amortizes the tunnel round trip",
     )
     ap.add_argument(
+        "--pull-bytes",
+        type=int,
+        default=0,
+        choices=(0, 1, 2),
+        help="FIXING_FLOAT pull filter width: servers send n-byte "
+        "quantized weights and the step gathers codes+mask, "
+        "dequantizing post-gather (pull_gather auto => narrow for 1 "
+        "byte — the reference's production criteo pull, "
+        "example/linear/ctr/online_l1lr.conf). Metric name gains a "
+        "_qN suffix so captures pool separately from the exact-pull "
+        "headline",
+    )
+    ap.add_argument(
         "--profile",
         default=None,
         metavar="DIR",
@@ -1429,7 +1449,7 @@ def main() -> int:
         "criteo_real_examples_per_sec"
         if args.real
         else "criteo_sparse_lr_examples_per_sec"
-    )
+    ) + (f"_q{args.pull_bytes}" if args.pull_bytes else "")
     if not args.smoke:
         # Provisional record: the driver keeps whatever stdout holds
         # when it loses patience, and it parses the LAST JSON line.
@@ -1527,6 +1547,10 @@ def run_synthetic(args) -> int:
         # minimal wire: 22-bit slot stream + 1-bit labels, fused C++
         # hash→pack — both bytes and host cycles are the bottleneck here
         wire="bits",
+        pull_filter=(
+            [{"type": "fixing_float", "num_bytes": args.pull_bytes}]
+            if args.pull_bytes else []
+        ),
     )
     worker = AsyncSGDWorker(conf, mesh=po.mesh)
 
@@ -1646,10 +1670,12 @@ def run_synthetic(args) -> int:
     # device steps the main thread is waiting on (see UploadPipeline)
     for dev_sb, _n_ex, nb in UploadPipeline(host_parts(), T):
         wire_counter["bytes"] += nb
-        pending.append(worker._submit_prepped(dev_sb, with_aux=False))
         done += 1
         win_done += 1
         _beat()
+        # the wait below may pay the staged transfer's wire time
+        _grace_for_transfer(nb)
+        pending.append(worker._submit_prepped(dev_sb, with_aux=False))
         if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
         if win_done >= window:
@@ -1669,7 +1695,8 @@ def run_synthetic(args) -> int:
     e2e_rate = float(np.median(rates)) if rates else avg_rate
 
     rec = {
-        "metric": "criteo_sparse_lr_examples_per_sec",
+        "metric": "criteo_sparse_lr_examples_per_sec"
+        + (f"_q{args.pull_bytes}" if args.pull_bytes else ""),
         "unit": "examples/sec",
         "e2e_median_window": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),

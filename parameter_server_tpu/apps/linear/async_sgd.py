@@ -564,27 +564,58 @@ def make_push_touched(push_quant: int, noise=None):
     return run
 
 
-def make_pull_weights(updater, pull_quant: int, noise=None):
-    """Server-side weight derivation for the pull path, optionally
-    through the quantized wire (FIXING_FLOAT pull_filter): each server
-    shard derives its dense weight vector from its live state — the
-    reference's servers send WEIGHTS, not raw state — and, when
-    ``pull_quant`` is set, stochastically rounds it to n-byte fixed point
-    (per-shard scale) before workers gather it. Exact zeros (L1-pruned
-    coordinates) stay exactly zero, as under the sparse_filter chain.
-    ``noise`` applies ADD_NOISE to the sent weights (pull_filter), the
-    server→worker direction of src/filter/add_noise.h."""
+def make_pull_lookup(updater, pull_quant: int, noise=None,
+                     narrow: "bool | None" = None):
+    """Server-side weight derivation + per-slot lookup for the pull
+    path, optionally through the quantized wire (FIXING_FLOAT
+    pull_filter): each server shard derives its dense weight vector
+    from its live state — the reference's servers send WEIGHTS, not raw
+    state — and, when ``pull_quant`` is set, stochastically rounds it
+    to n-byte fixed point (per-shard scale) before workers gather it.
+    Exact zeros (L1-pruned coordinates) stay exactly zero, as under the
+    sparse_filter chain. ``noise`` applies ADD_NOISE to the sent
+    weights (pull_filter), the server→worker direction of
+    src/filter/add_noise.h.
+
+    Returns ``(derive, lookup)``:
+
+    - ``derive(pulled, seed)`` — once per shard per step: the
+      representation workers gather from.
+    - ``lookup(rep, rel, ok)`` — flat f32 weights at gather indices
+      ``rel``, zero where ``ok`` is False.
+
+    ``narrow`` (default: on exactly for 1-byte quantized pulls)
+    gathers the quantized CODES plus a 1-byte zero-mask and
+    dequantizes AFTER the gather, instead of materializing and
+    gathering a dense f32 shard. The random gather is
+    granularity/bandwidth-bound on TPU, so halving the gathered bytes
+    (u8 code + bool vs f32) is the step's main gather lever — and this
+    is the reference's own production configuration, a 1-byte
+    fixing_float pull filter (example/linear/ctr/online_l1lr.conf).
+    Exactness-preserving: dequantize is elementwise with per-shard
+    scalar lo/hi, so dequantize(gather(q)) == gather(dequantize(q))
+    bit-for-bit, and the gathered zero-mask reproduces the exact-zero
+    rule."""
     perturb = _make_perturb(noise, 0xA015F)
+
+    def wide_lookup(w, rel, ok):
+        return jnp.where(ok, w[rel], 0.0)
+
     if not pull_quant:
-        if perturb is None:
-            return lambda pulled, seed: updater.weights(pulled)
-        return lambda pulled, seed: perturb(updater.weights(pulled), seed)
+        def derive_plain(pulled, seed):
+            w = updater.weights(pulled)
+            return w if perturb is None else perturb(w, seed)
+
+        return derive_plain, wide_lookup
+
+    if narrow is None:
+        narrow = pull_quant == 1
     from ...filter.fixing_float import dequantize_jax, quantize_jax
     from ...ops import quantize as qops
 
     use_pallas = qops.use_pallas()
 
-    def pull(pulled, seed):
+    def quantized(pulled, seed):
         w = updater.weights(pulled)
         if perturb is not None:
             w = perturb(w, seed)
@@ -597,10 +628,26 @@ def make_pull_weights(updater, pull_quant: int, noise=None):
             key = jax.random.fold_in(jax.random.PRNGKey(0xF00D), seed)
             key = jax.random.fold_in(key, jax.lax.axis_index(SERVER_AXIS))
             q, lo, hi = quantize_jax(w, pull_quant, key)
+        return w, q, lo, hi
+
+    if narrow:
+        def derive_narrow(pulled, seed):
+            w, q, lo, hi = quantized(pulled, seed)
+            return q, w != 0, lo, hi
+
+        def narrow_lookup(rep, rel, ok):
+            q, nz, lo, hi = rep
+            dec = dequantize_jax(q[rel], lo, hi, pull_quant)
+            return jnp.where(ok & nz[rel], dec, 0.0)
+
+        return derive_narrow, narrow_lookup
+
+    def derive_wide(pulled, seed):
+        w, q, lo, hi = quantized(pulled, seed)
         dec = dequantize_jax(q, lo, hi, pull_quant)
         return jnp.where(w != 0, dec, 0.0)
 
-    return pull
+    return derive_wide, wide_lookup
 
 
 def _progress_metrics(loss, y, xw, mask, with_aux: bool):
@@ -669,6 +716,7 @@ def make_train_step_ell(
     pull_quant: int = 0,
     push_noise=None,
     pull_noise=None,
+    pull_narrow: "bool | None" = None,
 ):
     """Fused SPMD step over ELL batches: Xw is a lane reduction (no row
     scatter); only the push keeps a scatter-add. ``packed`` accepts the
@@ -676,7 +724,9 @@ def make_train_step_ell(
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
     push_touched = make_push_touched(push_quant, noise=push_noise)
-    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
+    pull_derive, pull_lookup = make_pull_lookup(
+        updater, pull_quant, noise=pull_noise, narrow=pull_narrow
+    )
 
     def local_step(live, pulled, seed, y, mask, slots, vals):
         y, mask, slots = y[0], mask[0], slots[0]
@@ -689,11 +739,11 @@ def make_train_step_ell(
         rel = jnp.clip(flat - lo, 0, shard - 1)
         ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
 
-        # pull: each server derives (and optionally quantizes) its dense
-        # weight shard once, workers gather entries + assemble via psum
-        w_shard = pull_weights(pulled, seed)
+        # pull: each server derives (and optionally quantizes) its
+        # representation once, workers gather entries + assemble via psum
+        w_rep = pull_derive(pulled, seed)
         w_e = jax.lax.psum(
-            jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS
+            pull_lookup(w_rep, rel, ok), SERVER_AXIS
         ).reshape(slots.shape)  # [R, K]
         x = w_e if binary else w_e * vals
         xw = x.sum(axis=1)
@@ -736,13 +786,15 @@ def make_train_step_ell(
 
 def _make_bits_mini_step(
     updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant,
-    pull_quant, push_noise=None, pull_noise=None,
+    pull_quant, push_noise=None, pull_noise=None, pull_narrow=None,
 ):
     """Shared single-minibatch body for the bits-wire step builders:
     (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
     bits = slot_bits(num_slots)
     push_touched = make_push_touched(push_quant, noise=push_noise)
-    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
+    pull_derive, pull_lookup = make_pull_lookup(
+        updater, pull_quant, noise=pull_noise, narrow=pull_narrow
+    )
 
     def mini_step(live, pulled, seed, y_bits, count, words):
         # named_scope phases: HLO op metadata carries these, so a
@@ -760,9 +812,9 @@ def _make_bits_mini_step(
             ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
 
         with jax.named_scope("ps_pull"):
-            w_shard = pull_weights(pulled, seed)
+            w_rep = pull_derive(pulled, seed)
             w_e = jax.lax.psum(
-                jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS
+                pull_lookup(w_rep, rel, ok), SERVER_AXIS
             ).reshape(slots.shape)  # [R, K]
         with jax.named_scope("ps_compute"):
             xw = w_e.sum(axis=1)
@@ -805,6 +857,7 @@ def make_train_step_ell_bits(
     pull_quant: int = 0,
     push_noise=None,
     pull_noise=None,
+    pull_narrow: "bool | None" = None,
 ):
     """Fused SPMD step over the minimal-wire ELLBitsBatch (binary,
     uniform-row): slot ids unpack from the bitstream, labels from sign
@@ -814,7 +867,7 @@ def make_train_step_ell_bits(
     shard = num_slots // n_server
     mini_step = _make_bits_mini_step(
         updater, loss, num_slots, shard, rows, lanes, with_aux,
-        push_quant, pull_quant, push_noise, pull_noise,
+        push_quant, pull_quant, push_noise, pull_noise, pull_narrow,
     )
 
     def local_step(live, pulled, seed, y_bits, counts, words):
@@ -847,6 +900,7 @@ def make_train_step_ell_bits_scan(
     pull_quant: int = 0,
     push_noise=None,
     pull_noise=None,
+    pull_narrow: "bool | None" = None,
 ):
     """Scan-fused superstep: T bits-wire minibatches per launch.
 
@@ -859,7 +913,7 @@ def make_train_step_ell_bits_scan(
     shard = num_slots // n_server
     mini_step = _make_bits_mini_step(
         updater, loss, num_slots, shard, rows, lanes, with_aux,
-        push_quant, pull_quant, push_noise, pull_noise,
+        push_quant, pull_quant, push_noise, pull_noise, pull_narrow,
     )
 
     def local_step(live, pulled, seed, y_bits, counts, words):
@@ -906,7 +960,7 @@ def make_train_step_ell_bits_scan(
 def make_train_step_hashed(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
-    pull_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
 ):
     """Per-entry fused SPMD step (hashed fast path): gather state at each
     nnz slot, segment-sum Xw by row, scatter per-entry gradients densely —
@@ -914,7 +968,9 @@ def make_train_step_hashed(
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
     push_touched = make_push_touched(push_quant, noise=push_noise)
-    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
+    pull_derive, pull_lookup = make_pull_lookup(
+        updater, pull_quant, noise=pull_noise, narrow=pull_narrow
+    )
 
     def local_step(live, pulled, seed, y, mask, rows, slots, vals):
         y, mask, rows, slots, vals = y[0], mask[0], rows[0], slots[0], vals[0]
@@ -924,8 +980,8 @@ def make_train_step_hashed(
 
         # sentinel/padding slots are owned by no shard -> gathered weight 0,
         # and their vals are 0, so they vanish from Xw and g
-        w_shard = pull_weights(pulled, seed)
-        w_e = jax.lax.psum(jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS)
+        w_rep = pull_derive(pulled, seed)
+        w_e = jax.lax.psum(pull_lookup(w_rep, rel, ok), SERVER_AXIS)
 
         xw = jax.ops.segment_sum(vals * w_e, rows, num_segments=y.shape[0])
         gr = loss.row_grad(y, xw) * mask
@@ -971,7 +1027,7 @@ def make_train_step_hashed(
 def make_train_step(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
-    pull_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
 ):
     """Build the fused SPMD train step. Returns jitted
     ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
@@ -979,7 +1035,9 @@ def make_train_step(
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
     push_touched = make_push_touched(push_quant, noise=push_noise)
-    pull_weights = make_pull_weights(updater, pull_quant, noise=pull_noise)
+    pull_derive, pull_lookup = make_pull_lookup(
+        updater, pull_quant, noise=pull_noise, narrow=pull_narrow
+    )
 
     def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
         # squeeze the per-shard leading dim added by stacking
@@ -998,9 +1056,9 @@ def make_train_step(
         # of roofline go" question needs this attribution
         # -- pull (server-side weight derivation, gather + psum assembly) --
         with jax.named_scope("ps_pull"):
-            w_shard = pull_weights(pulled, seed)
+            w_rep = pull_derive(pulled, seed)
             w_u = (
-                jax.lax.psum(jnp.where(ok, w_shard[rel], 0.0), SERVER_AXIS)
+                jax.lax.psum(pull_lookup(w_rep, rel, ok), SERVER_AXIS)
                 * umask
             )
 
@@ -1152,6 +1210,15 @@ class AsyncSGDWorker(ISGDCompNode):
         # ADD_NOISE push filter -> device-side per-worker gradient noise
         self._push_noise = _add_noise_params(sgd.push_filter)
         self._pull_noise = _add_noise_params(sgd.pull_filter)
+        try:
+            self._pull_narrow = {
+                "auto": None, "narrow": True, "wide": False
+            }[sgd.pull_gather]
+        except KeyError:
+            raise ValueError(
+                f"unknown SGDConfig.pull_gather {sgd.pull_gather!r}; "
+                "expected 'auto', 'narrow', or 'wide'"
+            ) from None
         self._seed_counter = 0
         self._warned_ell_overflow = False
         self._warned_scan_fallback = False
@@ -1355,6 +1422,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
                 push_noise=self._push_noise, pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
             )
         elif isinstance(prepped, ELLBitsBatch):
             key = ("ell_bits", prepped.rows, with_aux)
@@ -1363,6 +1431,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 rows=prepped.rows, lanes=self.sgd.ell_lanes, with_aux=with_aux,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
                 push_noise=self._push_noise, pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
             )
         elif isinstance(prepped, (ELLBatch, ELLPackedBatch)):
             packed = isinstance(prepped, ELLPackedBatch)
@@ -1372,6 +1441,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 binary=prepped.vals is None, with_aux=with_aux, packed=packed,
                 push_quant=self._push_quant, pull_quant=self._pull_quant,
                 push_noise=self._push_noise, pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
             )
         elif isinstance(prepped, HashedBatch):
             key = ("hashed", False, with_aux)
@@ -1380,6 +1450,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
             )
         else:
             key = ("exact", False, with_aux)
@@ -1388,6 +1459,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
             )
         if key not in self._steps:
             self._steps[key] = builder()

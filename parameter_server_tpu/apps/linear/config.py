@@ -62,6 +62,12 @@ class SGDConfig:
     push_filter: list = dataclasses.field(default_factory=list)
     pull_filter: list = dataclasses.field(default_factory=list)
     # TPU extensions
+    # pull-gather formulation for quantized pulls: "auto" (narrow iff
+    # the pull_filter is 1-byte FIXING_FLOAT — the reference's own
+    # production config, example/linear/ctr/online_l1lr.conf), or an
+    # explicit "narrow"/"wide". Narrow gathers the quantized codes +
+    # zero-mask and dequantizes post-gather; exactness-equal to wide.
+    pull_gather: str = "auto"
     num_slots: int = 1 << 22  # hashed weight table size
     rows_pad: int = 0  # 0 = minibatch size
     nnz_pad: int = 0  # 0 = auto from first batch
@@ -297,6 +303,7 @@ def parse_conf(text: str) -> Config:
             ),
             push_filter=_filter_list(s.get("push_filter")),
             pull_filter=_filter_list(s.get("pull_filter")),
+            pull_gather=str(s.get("pull_gather", "auto")),
         )
     if "darlin" in d:
         b = d["darlin"]

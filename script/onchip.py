@@ -66,6 +66,11 @@ TASKS = [
     # observed; bench now carries 600s compile graces): a legitimately
     # slow success must not be killed by its own timeout
     ("bench", [sys.executable, "bench.py"], 3600),
+    # the reference's production pull config (1-byte fixing_float,
+    # example/linear/ctr/online_l1lr.conf): narrow codes+mask gather,
+    # the candidate for unthrottling the gather-bound step — captured
+    # under its own _q1 metric so headline medians stay exact-pull
+    ("bench_q1", [sys.executable, "bench.py", "--pull-bytes", "1"], 3600),
     ("lm", None, 5400),
     ("scale", None, 2400),
     ("serve", None, 5400),
@@ -1255,7 +1260,7 @@ def task_gatherx() -> int:
         # codes + u8 zero-mask (2 B/entry vs 4), dequantize per entry
         # after the gather — what SGDConfig's pull filter would run if
         # the narrow gathers win; L1-pruned exact zeros survive via
-        # the mask, matching make_pull_weights' where(w != 0) semantic
+        # the mask, matching make_pull_lookup's where(w != 0) semantic
         # UNSIGNED codes, like the production quantizer emits
         # (filter/fixing_float.py): affine dequant over 0..255
         qu8 = jax.device_put(

@@ -429,3 +429,22 @@ def test_expired_operation_budget_fires():
         "time.sleep(30)\n"
     )
     assert r.returncode == 2
+
+
+def test_sigterm_handler_clears_priority_marker():
+    """A SIGTERM during the device-lock WAIT must not leave a priority
+    marker behind: the watcher honors fresh markers from dead pids for
+    up to 30 minutes (observed ~11 idle minutes from two killed test
+    benches, 2026-08-01)."""
+    import os
+
+    import pytest
+
+    import bench
+    from parameter_server_tpu.utils import device_lock as dl
+
+    dl.request_priority("test-kill")
+    assert os.path.exists(dl._request_path())
+    with pytest.raises(SystemExit):
+        bench._sigterm_handler(15, None)
+    assert not os.path.exists(dl._request_path())
