@@ -121,10 +121,10 @@ class Watchdog:
         transfer grace used to die the instant the main thread beat on
         an unrelated item, re-arming the false-wedge kill mid-transfer.
         The watchdog holds fire while any operation's budget is
-        unexpired; exit removes the marker (and refreshes the idle
-        clock), restoring full sensitivity immediately — no lingering
-        insensitivity window after a long op completes, which is what
-        the beat-snaps-grace-back rule exists to guarantee."""
+        unexpired; exit removes the marker and refreshes the idle
+        clock (without shrinking any LARGER grace() deadline the main
+        thread armed — monotone, like grace itself), so an op leaves
+        no insensitivity window of its own behind."""
         tok = object()
         with self._lock:
             self._ops[tok] = time.monotonic() + max(0.0, budget_s)
@@ -1214,7 +1214,13 @@ def run_real(args) -> int:
     )
     ll_dev = dev_obj / parity_ex
     ll_orc = orc_obj / parity_ex
-    parity_ok = abs(ll_dev - ll_orc) <= max(0.01, 0.02 * ll_orc)
+    # under a quantized pull (--pull-bytes) the oracle stays EXACT while
+    # the device trains on stochastically rounded weights; the rounding
+    # is unbiased (measured drift ~1e-5 on smoke) but the gate widens
+    # 2x to absorb compounding over the full parity window, disclosed
+    # in the record
+    tol_scale = 2.0 if args.pull_bytes else 1.0
+    parity_ok = abs(ll_dev - ll_orc) <= tol_scale * max(0.01, 0.02 * ll_orc)
     assert parity_ok, (
         f"logloss parity FAILED: device {ll_dev:.5f} vs oracle {ll_orc:.5f}"
     )
@@ -1268,6 +1274,8 @@ def run_real(args) -> int:
             "logloss_device": round(ll_dev, 5),
             "logloss_oracle": round(ll_orc, 5),
             "parity_ok": parity_ok,
+            **({"parity_tol_relaxed_for_quantized_pull": tol_scale}
+               if args.pull_bytes else {}),
             "parse_only_examples_per_sec": parse_only_ex_s,
         },
     )
@@ -1325,8 +1333,10 @@ def run_real(args) -> int:
     e2e_rate = done_ex / dt
 
     rec = {
-        "metric": "criteo_real_examples_per_sec"
-        + (f"_q{args.pull_bytes}" if args.pull_bytes else ""),
+        # the ONE metric-name definition lives in main() (the watchdog
+        # was armed with it); re-deriving the _qN suffix here could
+        # silently diverge from the provisional/partial records
+        "metric": _WATCHDOG.metric,
         "unit": "examples/sec",
         "e2e_stream": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
@@ -1695,8 +1705,7 @@ def run_synthetic(args) -> int:
     e2e_rate = float(np.median(rates)) if rates else avg_rate
 
     rec = {
-        "metric": "criteo_sparse_lr_examples_per_sec"
-        + (f"_q{args.pull_bytes}" if args.pull_bytes else ""),
+        "metric": _WATCHDOG.metric,  # see run_real's note
         "unit": "examples/sec",
         "e2e_median_window": round(e2e_rate, 1),
         "e2e_vs_baseline": round(e2e_rate / REF_8NODE_EXAMPLES_PER_SEC, 3),
