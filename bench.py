@@ -43,6 +43,8 @@ import traceback
 
 import numpy as np
 
+from parameter_server_tpu.utils.concurrent import iter_on_thread
+
 REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
 
 
@@ -605,36 +607,6 @@ def timed_upload(prepped):
     for leaf in jax.tree.leaves(dev):
         np.asarray(leaf.ravel()[:1])
     return dev, time.perf_counter() - t0
-
-
-def iter_on_thread(it, maxsize: int):
-    """Run iterator ``it`` on a daemon thread, yielding its items
-    through a bounded queue. Exceptions raised by the producer
-    propagate to the consumer. One definition of the
-    thread/queue/sentinel plumbing — UploadPipeline and the --real
-    parse producer both ride on this pattern, and its subtleties
-    (exception forwarding, clean termination) were duplicated once."""
-    import queue as _queue
-
-    q: "_queue.Queue" = _queue.Queue(maxsize=maxsize)
-    done = object()
-
-    def run():
-        try:
-            for x in it:
-                q.put(x)
-            q.put(done)
-        except BaseException as e:
-            q.put(e)
-
-    threading.Thread(target=run, daemon=True).start()
-    while True:
-        x = q.get()
-        if x is done:
-            return
-        if isinstance(x, BaseException):
-            raise x
-        yield x
 
 
 class UploadPipeline:

@@ -1546,17 +1546,16 @@ class AsyncSGDWorker(ISGDCompNode):
             )
         return self._submit_fused(prepped, with_aux)
 
-    def submit_group(self, batches: List[SparseBatch], with_aux: bool = True):
-        """Tolerant grouping for the training loop: scan-fuse when every
-        batch takes the bits wire, fall back to per-minibatch steps
-        otherwise (ragged rows, valued features, ...). Returns
-        ``[(timestamp, n_ministeps), ...]`` so callers can bound
-        in-flight work in MINISTEPS, not launches."""
+    def _prep_group(self, batches: List[SparseBatch]):
+        """Host side of tolerant grouping (prep + stack, no device
+        work ordering constraints — safe to run on a pipeline thread):
+        one scan superbatch when every batch takes the bits wire, else
+        per-minibatch parts. Returns ``[(host_prepped, n_ministeps)]``."""
         prepped = [self.prep(b, device_put=False) for b in batches]
         if len(prepped) > 1 and all(
             isinstance(p, ELLBitsBatch) for p in prepped
         ):
-            return [(self._submit_fused(prepped, with_aux), len(prepped))]
+            return [(stack_bits_batches(prepped), len(prepped))]
         if len(prepped) > 1 and not self._warned_scan_fallback:
             import logging
 
@@ -1567,23 +1566,112 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.sgd.steps_per_launch,
             )
             self._warned_scan_fallback = True
+        return [(p, 1) for p in prepped]
+
+    def submit_group(self, batches: List[SparseBatch], with_aux: bool = True):
+        """Tolerant grouping for the training loop: scan-fuse when every
+        batch takes the bits wire, fall back to per-minibatch steps
+        otherwise (ragged rows, valued features, ...). Returns
+        ``[(timestamp, n_ministeps), ...]`` so callers can bound
+        in-flight work in MINISTEPS, not launches."""
         return [
-            (self._submit_prepped(self.upload(p), with_aux=with_aux), 1)
-            for p in prepped
+            (self._submit_prepped(self.upload(p), with_aux=with_aux), n)
+            for p, n in self._prep_group(batches)
         ]
 
     # collect: inherited from ISGDCompNode (shared worker plumbing, incl.
     # the scan-superstep per-ministep AUC layout)
 
-    def train(self, batches: Iterator[SparseBatch]) -> SGDProgress:
+    def train(
+        self,
+        batches: Iterator[SparseBatch],
+        pipelined: "bool | None" = None,
+    ) -> SGDProgress:
         """Drive a pass over an iterator of minibatches.
 
         With ``steps_per_launch > 1`` (and the bits wire) minibatches are
         grouped into scan-fused supersteps — one device launch per T
         steps; a trailing group smaller than T still runs (its own scan
-        length). Weights advance every ministep either way."""
+        length). Weights advance every ministep either way.
+
+        ``pipelined`` (default: on when T > 1) moves prep + stack +
+        device staging onto a daemon thread behind a bounded queue, so
+        localization CPU time and the host→device wire overlap the
+        device steps this thread is collecting — the same three-stage
+        split bench.py's timed loops use, and the TPU twin of the
+        reference's MinibatchReader producer/consumer overlap
+        (src/learner/sgd.h:60-143). Submission still happens HERE, in
+        order, so seeds, snapshot scheduling (max_delay), and therefore
+        the entire trajectory are bit-identical to the unpipelined
+        path (asserted in tests)."""
         T = max(1, self.sgd.steps_per_launch)
+        if pipelined is None:
+            pipelined = T > 1
+        try:
+            return self._train_impl(batches, T, pipelined)
+        except BaseException:
+            # a poisoned reader or mid-run failure must not leave
+            # in-flight device steps behind: interpreter teardown would
+            # kill the executor thread inside a C++ device wait
+            # ('terminate called / FATAL: exception not rethrown')
+            import contextlib
+
+            with contextlib.suppress(Exception):
+                self.executor.wait_all(pop=False)
+            raise
+
+    def _train_impl(
+        self, batches: Iterator[SparseBatch], T: int, pipelined: bool
+    ) -> SGDProgress:
         pending: List[Tuple[int, int]] = []  # (ts, n_ministeps)
+        # backpressure in MINISTEPS (aux memory scales with them), while
+        # always allowing at least one full launch in flight
+        bound = max(T, self.sgd.max_delay + 1)
+
+        if pipelined:
+            from ...utils.concurrent import iter_on_thread
+
+            def staged():
+                # pipeline thread: localize/pack (CPU), group-stack,
+                # stage to device (wire). No submission here — ordered
+                # device dispatch stays on the training thread.
+                group: List[SparseBatch] = []
+
+                def flush():
+                    out = [
+                        (self.upload(p), n)
+                        for p, n in self._prep_group(group)
+                    ]
+                    group.clear()
+                    return out
+
+                for batch in batches:
+                    group.append(batch)
+                    if len(group) >= T:
+                        yield from flush()
+                if group:
+                    yield from flush()
+
+            src = iter_on_thread(staged(), maxsize=2)
+            try:
+                for staged_batch, n in src:
+                    pending.append(
+                        (self._submit_prepped(staged_batch, with_aux=True),
+                         n)
+                    )
+                    while sum(n for _, n in pending) > bound:
+                        self.collect(pending.pop(0)[0])
+            finally:
+                # close BEFORE the exception propagates out of this
+                # frame: the traceback would otherwise pin the
+                # generator (and its producer thread) alive past
+                # train()'s cleanup, letting teardown kill the thread
+                # mid-device-call
+                src.close()
+            for ts, _ in pending:
+                self.collect(ts)
+            return self.progress
+
         group: List[SparseBatch] = []
 
         def flush_group():
@@ -1592,9 +1680,6 @@ class AsyncSGDWorker(ISGDCompNode):
             pending.extend(self.submit_group(list(group), with_aux=True))
             group.clear()
 
-        # backpressure in MINISTEPS (aux memory scales with them), while
-        # always allowing at least one full launch in flight
-        bound = max(T, self.sgd.max_delay + 1)
         for batch in batches:
             group.append(batch)
             if len(group) >= T:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Generic, Iterator, Optional, TypeVar
 
 T = TypeVar("T")
@@ -135,3 +136,69 @@ class ThreadPool:
         self._tasks.clear()
         if errors:
             raise errors[0]
+
+
+def iter_on_thread(it, maxsize: int, close_join_s: float = 2.5):
+    """Run iterator ``it`` on a daemon thread, yielding its items
+    through a bounded queue (backpressure: the producer blocks once
+    ``maxsize`` items are staged ahead). Exceptions raised by the
+    producer propagate to the consumer at the point of iteration.
+
+    The generator-returning sibling of :class:`ProducerConsumer` (ref
+    producer_consumer.h), adding the two contracts the training/bench
+    pipelines need: producer exceptions forwarded to the consumer, and
+    abandonment handling — when the consumer stops iterating early (an
+    exception in its loop body, a break, an explicit ``close()``), the
+    producer is signalled to stop and briefly joined, because a thread
+    left blocked in ``q.put`` forever would be killed mid-call by
+    interpreter teardown (observed as 'terminate called / FATAL:
+    exception not rethrown' from inside a jax device call). The join
+    is bounded by ``close_join_s``: a producer wedged inside the
+    SOURCE iterator itself (a stuck read, a wedged tunnel transfer)
+    cannot be interrupted from here, and close() must not hold up the
+    consumer's own error propagation waiting for it."""
+    q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+    done = object()
+    stop = threading.Event()
+
+    def _put(x) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(x, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for x in it:
+                if not _put(x):
+                    return
+            _put(done)
+        except BaseException as e:
+            _put(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        while True:
+            x = q.get()
+            if x is done:
+                return
+            if isinstance(x, BaseException):
+                raise x
+            yield x
+    finally:
+        stop.set()
+        # drain so a producer mid-put unblocks at its next timeout
+        # tick, then give it a bounded window to finish its current
+        # item; a producer stuck in the source iterator stays alive
+        # (nothing can stop it) and is disclosed to teardown as-is
+        deadline = time.monotonic() + max(0.0, close_join_s)
+        while t.is_alive() and time.monotonic() < deadline:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.1)

@@ -807,3 +807,61 @@ class TestNarrowPullGather:
             assert lookup.__name__ == expected, (quant, lookup.__name__)
         _, forced = make_pull_lookup(U(), 2, narrow=True)
         assert forced.__name__ == "narrow_lookup"
+
+
+class TestPipelinedTrain:
+    """train(pipelined=True): prep/stack/upload on a daemon thread,
+    ordered submits on the training thread — trajectories must be
+    BIT-identical to the unpipelined path (same submission order ⇒
+    same seeds and snapshot schedule)."""
+
+    def _run(self, w_true, pipelined, T=4, wire="bits", delay=2):
+        conf = make_conf(num_slots=2048, max_delay=delay)
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = wire
+        conf.async_sgd.steps_per_launch = T
+        mesh = Postoffice.instance().start().mesh
+        worker = AsyncSGDWorker(conf, mesh=mesh)
+        prog = worker.train(synth_binary(9, w_true), pipelined=pipelined)
+        return worker.weights_dense(), prog
+
+    def test_bitwise_equal_supersteps(self, mesh8, w_true):
+        w_p, prog_p = self._run(w_true, True)
+        Postoffice.reset()
+        w_s, prog_s = self._run(w_true, False)
+        np.testing.assert_array_equal(w_p, w_s)
+        assert (
+            prog_p.num_examples_processed == prog_s.num_examples_processed
+        )
+        np.testing.assert_allclose(prog_p.objective, prog_s.objective)
+        assert np.abs(w_p).max() > 0
+
+    def test_bitwise_equal_fallback_path(self, mesh8, w_true):
+        # valued batches are not bits-wire eligible: the pipeline must
+        # take the per-minibatch fallback and still match exactly
+        def run(pipelined):
+            conf = make_conf(num_slots=2048, max_delay=1)
+            conf.async_sgd.steps_per_launch = 3
+            mesh = Postoffice.instance().start().mesh
+            worker = AsyncSGDWorker(conf, mesh=mesh)
+            worker.train(synth(6, w_true), pipelined=pipelined)
+            return worker.weights_dense()
+
+        w_p = run(True)
+        Postoffice.reset()
+        w_s = run(False)
+        np.testing.assert_array_equal(w_p, w_s)
+
+    def test_producer_exception_reaches_caller(self, mesh8, w_true):
+        conf = make_conf(num_slots=2048)
+        conf.async_sgd.steps_per_launch = 2
+        conf.async_sgd.ell_lanes = 8
+        conf.async_sgd.wire = "bits"
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+
+        def poisoned():
+            yield from synth_binary(2, w_true)
+            raise RuntimeError("reader died")
+
+        with pytest.raises(RuntimeError, match="reader died"):
+            worker.train(poisoned(), pipelined=True)

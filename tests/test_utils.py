@@ -794,3 +794,50 @@ class TestRunGracefulInterruptDuringGrace:
         with pytest.raises(KeyboardInterrupt):
             subproc.run_graceful(["x"], timeout_s=0.1, term_grace_s=0.1)
         assert events == ["terminate", "kill", "reaped"]
+
+
+class TestIterOnThread:
+    def test_items_and_order(self):
+        from parameter_server_tpu.utils.concurrent import iter_on_thread
+
+        assert list(iter_on_thread(iter(range(20)), maxsize=3)) == list(
+            range(20)
+        )
+
+    def test_producer_exception_propagates(self):
+        from parameter_server_tpu.utils.concurrent import iter_on_thread
+
+        def boom():
+            yield 1
+            raise ValueError("dead")
+
+        it = iter_on_thread(boom(), maxsize=2)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="dead"):
+            list(it)
+
+    def test_abandonment_stops_and_joins_producer(self):
+        import threading
+        import time
+
+        from parameter_server_tpu.utils.concurrent import iter_on_thread
+
+        alive = {"n": 0}
+        started = threading.Event()
+
+        def slow():
+            alive["n"] += 1
+            started.wait(5)
+            for i in range(1000):
+                yield i
+            # unreachable when abandoned early
+
+        before = threading.active_count()
+        it = iter_on_thread(slow(), maxsize=1)
+        started.set()
+        next(it)
+        it.close()  # consumer abandons; producer must stop promptly
+        t0 = time.time()
+        while threading.active_count() > before and time.time() - t0 < 5:
+            time.sleep(0.05)
+        assert threading.active_count() <= before
