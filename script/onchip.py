@@ -1612,6 +1612,23 @@ def run_task(name: str, argv, timeout_s: int) -> "bool | None":
     return ok
 
 
+def _state_stale(rec, max_age_s: float = 86400.0) -> bool:
+    """A task-state entry older than ``max_age_s`` (or unparseable) no
+    longer gates scheduling — same freshness horizon as
+    ``_fresh_capture``."""
+    if not isinstance(rec, dict):
+        return True
+    try:
+        t = time.mktime(
+            time.strptime(rec.get("last_start", ""), "%Y-%m-%d %H:%M:%S")
+        )
+    except (TypeError, ValueError, OverflowError):
+        # TypeError: a null/numeric last_start from a hand-edited or
+        # repaired state file must read as stale, not kill the watcher
+        return True
+    return time.time() - t > max_age_s
+
+
 def watch(args) -> int:
     _wlog(
         f"watcher started (interval {args.interval}s, "
@@ -1630,8 +1647,16 @@ def watch(args) -> int:
         last_diag = None
         _wlog("probe: device UP")
         # re-read state every cycle: a concurrent `make bench-all` may
-        # have completed tasks since the last iteration
-        st = _load_state()
+        # have completed tasks since the last iteration. Entries older
+        # than a day are treated as ABSENT: a stale "ok" from a prior
+        # session must not starve fresh captures at the next window
+        # (observed: link ok from 08-01 would have been skipped on
+        # 08-02), and a task that burned its attempt budget against
+        # yesterday's wedge deserves a fresh budget today.
+        st = {
+            n: rec for n, rec in _load_state().items()
+            if not _state_stale(rec)
+        }
         pending = [
             (n, a, t)
             for n, a, t in TASKS
@@ -1653,6 +1678,11 @@ def watch(args) -> int:
         for name, argv, to in pending:
             st = _load_state()  # freshest view before mutating
             rec = st.setdefault(name, {"attempts": 0})
+            if _state_stale(rec):
+                # prior-session attempts aged out of scheduling above;
+                # age them out of the BUDGET too, or a task that burned
+                # its budget yesterday gets exactly one retry today
+                rec["attempts"] = 0
             rec["attempts"] += 1
             rec["last_start"] = _now()
             _save_state(st)
@@ -1724,6 +1754,10 @@ def main() -> int:
             st.setdefault(name, {"attempts": 0})
             st[name]["attempts"] = st[name].get("attempts", 0) + 1
             st[name]["status"] = "ok" if ok else "fail"
+            # last_start: without it the watcher's staleness filter
+            # treats this entry as aged-out and re-runs a task a
+            # concurrent bench-all just finished
+            st[name]["last_start"] = _now()
             _save_state(st)
             rc |= 0 if ok else 1
         return rc

@@ -289,3 +289,20 @@ def test_summarize_evidence_table(onchip, tmp_path, capsys, monkeypatch):
     # stale success + fresh error -> flagged as live failure
     assert "m_stalefail" in out
     assert "stale success above" in out
+
+
+def test_state_stale_ages_out_prior_sessions(onchip):
+    import time
+
+    fresh = {"attempts": 3,
+             "last_start": time.strftime("%Y-%m-%d %H:%M:%S")}
+    old = {"attempts": 5, "status": "ok",
+           "last_start": time.strftime(
+               "%Y-%m-%d %H:%M:%S",
+               time.localtime(time.time() - 2 * 86400))}
+    assert not onchip._state_stale(fresh)
+    assert onchip._state_stale(old)
+    assert onchip._state_stale({})          # unparseable
+    assert onchip._state_stale("bogus")     # wrong type
+    assert onchip._state_stale({"last_start": None})  # null from a
+    # hand-edited state file must read stale, not raise
