@@ -19,6 +19,7 @@ pure-jnp elsewhere (bit-identical math in f32; tests compare both).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -153,9 +154,27 @@ def _kernel_bf16(z_ref, n_ref, g_ref, t_ref, seed_ref, z_out, n_out, *,
         ).astype(jnp.bfloat16)
 
 
+def _choose_block_rows(rows: int, requested: "int | None" = None) -> int:
+    """Resolve the Pallas tile height: the requested value (arg, else
+    PS_FTRL_BLOCK_ROWS, else 2048) rounded DOWN to a power of two ≥ 8,
+    then halved until it divides ``rows``. Pure so the selection is
+    directly testable — a naive halving loop preserved odd factors
+    (1536 → ... → 3 → 1) and could emit a sub-(8,128)-tile block."""
+    if requested is None:
+        try:
+            requested = int(os.environ.get("PS_FTRL_BLOCK_ROWS", 2048))
+        except ValueError:
+            requested = 2048
+    br = 1 << max(3, int(requested).bit_length() - 1)
+    while rows % br and br > 8:
+        br //= 2
+    return br
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("alpha", "beta", "l1", "l2", "force_pallas", "interpret"),
+    static_argnames=("alpha", "beta", "l1", "l2", "force_pallas",
+                     "interpret", "block_rows"),
 )
 def ftrl_update(
     z: jax.Array,
@@ -170,11 +189,20 @@ def ftrl_update(
     seed=None,
     force_pallas: bool = False,
     interpret: bool = False,
+    block_rows: "int | None" = None,
 ):
     """Fused update over a 1-D slot shard. touched: bool/float mask.
     ``seed`` (traced uint32 scalar) drives the stochastic narrow when
     ``sqrt_n`` is stored bf16; without it the bf16 narrow truncates
     (callers that care about long-horizon LR decay must pass one).
+
+    ``block_rows`` tiles the slot dimension (default 2048 = 1 MB/ref;
+    env ``PS_FTRL_BLOCK_ROWS`` overrides so a cross-process on-chip
+    block-size sweep needs no code edit); non-dividing values round
+    down to the largest dividing power-of-two slice. The env value is
+    baked at FIRST trace of the ``block_rows=None`` variant (jit
+    static caching) — an in-process sweep must pass ``block_rows``
+    explicitly, which retraces per value.
 
     Falls back to the jnp reference path off-TPU and for shards that are not
     tile-aligned, so any caller can use it unconditionally.
@@ -199,9 +227,7 @@ def ftrl_update(
     # (8,128) block makes the grid enormous on multi-M-slot tables (2^26
     # slots -> 65536 steps) and grid overhead swamps the math. 2048x128
     # = 1MB/ref keeps the grid <= a few hundred steps at every real size.
-    block_rows = 2048
-    while rows % block_rows:
-        block_rows //= 2
+    block_rows = _choose_block_rows(rows, block_rows)
     grid = (rows // block_rows,)
     t2d = touched.astype(jnp.float32).reshape(shape2d)
     spec = pl.BlockSpec(

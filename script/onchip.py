@@ -1681,8 +1681,13 @@ def watch(args) -> int:
             if _state_stale(rec):
                 # prior-session attempts aged out of scheduling above;
                 # age them out of the BUDGET too, or a task that burned
-                # its budget yesterday gets exactly one retry today
+                # its budget yesterday gets exactly one retry today.
+                # Drop the status as well: last_start is refreshed
+                # below, and a deferred/preempted re-run would
+                # otherwise leave a RE-FRESHENED 'ok' that skips the
+                # task for another 24h without it ever running
                 rec["attempts"] = 0
+                rec.pop("status", None)
             rec["attempts"] += 1
             rec["last_start"] = _now()
             _save_state(st)
@@ -1744,6 +1749,11 @@ def main() -> int:
         st = _load_state()
         rc = 0
         for name, argv, to in TASKS:
+            if _state_stale(st.get(name, {})):
+                # same staleness semantics as watch(): a day-old 'ok'
+                # must not skip the task, and yesterday's burned
+                # attempt budget resets
+                st[name] = {"attempts": 0}
             if st.get(name, {}).get("status") == "ok":
                 continue
             ok = run_task(name, argv, to)

@@ -157,3 +157,43 @@ class TestQuantizeOp:
         q, lo, hi = quantize(x, seed=11, num_bytes=1)
         back = dequantize(q, lo, hi, 1)
         assert abs(float(back[2:].mean()) - 0.37) < 2e-3
+
+
+def test_ftrl_block_rows_knob_is_math_invariant(monkeypatch):
+    """block_rows (arg or PS_FTRL_BLOCK_ROWS) only retiles the grid —
+    results must match the reference bit-for-bit at every block size
+    (the on-chip sweep relies on this being a pure perf knob)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    p = 8 * 1024
+    z = jnp.asarray(rng.normal(size=p), jnp.float32)
+    n = jnp.abs(jnp.asarray(rng.normal(size=p), jnp.float32))
+    g = jnp.asarray(rng.normal(size=p), jnp.float32)
+    t = jnp.asarray(rng.random(p) < 0.5, jnp.float32)
+    kw = dict(alpha=0.5, beta=1.0, l1=0.1, l2=0.01)
+    zr, nr = ftrl_update_ref(z, n, g, t > 0, **kw)
+    # retiling must be bit-invariant KERNEL-vs-KERNEL (the math per
+    # element is identical; only the grid changes) and track the jnp
+    # reference to normal fp tolerance
+    z0, n0 = ftrl_update(z, n, g, t, force_pallas=True, interpret=True,
+                         block_rows=2048, **kw)
+    for br in (64, 512, 4096):
+        zk, nk = ftrl_update(z, n, g, t, force_pallas=True,
+                             interpret=True, block_rows=br, **kw)
+        np.testing.assert_array_equal(np.asarray(zk), np.asarray(z0))
+        np.testing.assert_array_equal(np.asarray(nk), np.asarray(n0))
+    np.testing.assert_allclose(np.asarray(z0), np.asarray(zr), rtol=2e-5,
+                               atol=2e-6)
+    # the selection helper is the observable seam for the env knob
+    # (bit-equality across block sizes makes an end-to-end env assert
+    # vacuous by construction)
+    from parameter_server_tpu.ops.ftrl import _choose_block_rows
+
+    assert _choose_block_rows(4096, 1536) == 1024  # pow2 round-down
+    assert _choose_block_rows(4096, 4096) == 4096
+    assert _choose_block_rows(24, 2048) == 8       # halves to a divisor
+    monkeypatch.setenv("PS_FTRL_BLOCK_ROWS", "512")
+    assert _choose_block_rows(4096) == 512         # env honored
+    monkeypatch.setenv("PS_FTRL_BLOCK_ROWS", "bogus")
+    assert _choose_block_rows(4096) == 2048        # bad env falls back
