@@ -160,6 +160,11 @@ def _choose_block_rows(rows: int, requested: "int | None" = None) -> int:
     then halved until it divides ``rows``. Pure so the selection is
     directly testable — a naive halving loop preserved odd factors
     (1536 → ... → 3 → 1) and could emit a sub-(8,128)-tile block."""
+    # loud, not partial: a non-multiple-of-8 rows cannot be tiled by
+    # any power-of-two ≥ 8 and grid=rows//br would silently skip the
+    # tail (ftrl_update's p % _TILE gate guarantees this; direct
+    # callers get the assert)
+    assert rows % 8 == 0, f"rows={rows} not a multiple of 8"
     if requested is None:
         try:
             requested = int(os.environ.get("PS_FTRL_BLOCK_ROWS", 2048))
