@@ -162,9 +162,11 @@ def _choose_block_rows(rows: int, requested: "int | None" = None) -> int:
     (1536 → ... → 3 → 1) and could emit a sub-(8,128)-tile block."""
     # loud, not partial: a non-multiple-of-8 rows cannot be tiled by
     # any power-of-two ≥ 8 and grid=rows//br would silently skip the
-    # tail (ftrl_update's p % _TILE gate guarantees this; direct
-    # callers get the assert)
-    assert rows % 8 == 0, f"rows={rows} not a multiple of 8"
+    # tail. ValueError, not assert: input validation must survive
+    # python -O (ftrl_update's p % _TILE gate guarantees it; direct
+    # callers get the error)
+    if rows % 8:
+        raise ValueError(f"rows={rows} not a multiple of 8")
     if requested is None:
         try:
             requested = int(os.environ.get("PS_FTRL_BLOCK_ROWS", 2048))

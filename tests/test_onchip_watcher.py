@@ -123,19 +123,31 @@ def test_run_task_preempts_running_child_on_request(onchip, tmp_path):
 
     import parameter_server_tpu.utils.device_lock as dl
 
+    sentinel = tmp_path / "child_printed"
     child = (
-        "import json, time; "
+        "import json, pathlib, time; "
         "print(json.dumps({'metric': 'partial', 'value': 1}), flush=True); "
+        f"pathlib.Path({str(sentinel)!r}).write_text('up'); "
         "time.sleep(120)"
     )
 
     def make_request():
+        # fire the preemption only once the child has DEMONSTRABLY
+        # printed: a fixed timer raced interpreter startup (~2.5s idle,
+        # >6s under a loaded core) and killed the child pre-print
+        deadline = _t.monotonic() + 60
+        while not sentinel.exists() and _t.monotonic() < deadline:
+            _t.sleep(0.2)
+        if not sentinel.exists():
+            # child never came up: let the test fail on its own
+            # asserts rather than writing a request that (a) conflates
+            # the failure cause and (b) could land under a LATER
+            # test's lock dir from this unjoined thread
+            return
         with open(dl._request_path(), "w") as f:
             f.write(f"{os.getpid() + 1} {_t.time():.0f} bench\n")
 
-    # python -c startup is ~2.5s in this image (sitecustomize); let the
-    # child reach its print before the preempting request lands
-    threading.Timer(6.0, make_request).start()
+    threading.Thread(target=make_request, daemon=True).start()
     t0 = _t.monotonic()
     out = onchip.run_task("link", [sys.executable, "-c", child],
                           timeout_s=300)

@@ -166,7 +166,8 @@ def test_ftrl_block_rows_knob_is_math_invariant(monkeypatch):
     import numpy as np
 
     rng = np.random.default_rng(7)
-    p = 512 * 1024  # rows = 4096: the block sweep below retiles for real
+    p = 64 * 1024  # rows = 512: small enough for interpret mode, big
+    # enough that the sweep below genuinely retiles (grids 64 and 8)
     z = jnp.asarray(rng.normal(size=p), jnp.float32)
     n = jnp.abs(jnp.asarray(rng.normal(size=p), jnp.float32))
     g = jnp.asarray(rng.normal(size=p), jnp.float32)
@@ -177,8 +178,8 @@ def test_ftrl_block_rows_knob_is_math_invariant(monkeypatch):
     # element is identical; only the grid changes) and track the jnp
     # reference to normal fp tolerance
     z0, n0 = ftrl_update(z, n, g, t, force_pallas=True, interpret=True,
-                         block_rows=2048, **kw)
-    for br in (8, 512, 4096):
+                         block_rows=512, **kw)
+    for br in (8, 64):
         zk, nk = ftrl_update(z, n, g, t, force_pallas=True,
                              interpret=True, block_rows=br, **kw)
         np.testing.assert_array_equal(np.asarray(zk), np.asarray(z0))
@@ -195,7 +196,7 @@ def test_ftrl_block_rows_knob_is_math_invariant(monkeypatch):
     assert _choose_block_rows(24, 2048) == 8       # halves to a divisor
     import pytest as _pytest
 
-    with _pytest.raises(AssertionError):
+    with _pytest.raises(ValueError):
         _choose_block_rows(12, 2048)  # untileable rows fail loud
     monkeypatch.setenv("PS_FTRL_BLOCK_ROWS", "512")
     assert _choose_block_rows(4096) == 512         # env honored
