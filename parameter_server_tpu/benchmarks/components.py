@@ -379,3 +379,40 @@ def _step_phases_at(
         rows / total,
         "examples/sec",
     )
+
+
+@benchmark("executor")
+def executor_perf(smoke: bool = False) -> None:
+    """Host-side dispatch overhead of the executor runtime (the
+    counterpart of the reference's per-message Customer/Executor path,
+    src/system/executor.cc) — CPU-measurable: how many trivial steps
+    per second the submit → dependency-check → dispatch-thread →
+    wait machinery moves, with and without dependency chains. The
+    device-facing loops batch T minibatches per submit precisely
+    because this ceiling exists; the number prices that design
+    choice."""
+    from ..system.executor import Executor, Task
+
+    n = 500 if smoke else 5000
+
+    ex = Executor("bench")
+
+    def burst_independent():
+        ts = [ex.submit(lambda: None) for _ in range(n)]
+        ex.wait(ts[-1])
+        for t in ts[:-1]:
+            ex.wait(t)
+
+    sec = timeit(burst_independent, 1 if smoke else 3)
+    report("executor_dispatch_steps_per_sec", n / sec, "steps/sec")
+
+    ex2 = Executor("bench-chain")
+
+    def burst_chained():
+        prev = ex2.submit(lambda: None)
+        for _ in range(n - 1):
+            prev = ex2.submit(lambda: None, task=Task(wait_time=[prev]))
+        ex2.wait(prev)
+
+    sec = timeit(burst_chained, 1 if smoke else 3)
+    report("executor_chained_steps_per_sec", n / sec, "steps/sec")

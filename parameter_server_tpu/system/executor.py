@@ -21,6 +21,7 @@ reference throttles identically through its message clocks).
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -35,14 +36,22 @@ class TaskTracker:
     def __init__(self) -> None:
         self._finished: set[int] = set()
         self._started: set[int] = set()
+        # in-flight is tracked incrementally: the set difference the
+        # old in_flight() computed is O(all steps ever), and it ran
+        # once per dispatched step — quadratic across a training run
+        self._inflight = 0
         self._lock = threading.Lock()
 
     def start(self, ts: int) -> None:
         with self._lock:
+            if ts not in self._started and ts not in self._finished:
+                self._inflight += 1
             self._started.add(ts)
 
     def finish(self, ts: int) -> None:
         with self._lock:
+            if ts in self._started and ts not in self._finished:
+                self._inflight -= 1
             self._finished.add(ts)
 
     def is_finished(self, ts: int) -> bool:
@@ -54,9 +63,9 @@ class TaskTracker:
             return ts in self._started
 
     def in_flight(self) -> int:
-        """Started (dispatched) but not yet finished."""
+        """Started (dispatched) but not yet finished. O(1)."""
         with self._lock:
-            return len(self._started - self._finished)
+            return self._inflight
 
 
 class Executor:
@@ -64,6 +73,16 @@ class Executor:
         self.name = name
         self._time = 0
         self._pending: Dict[int, Tuple[Callable[[], Any], List[int]]] = {}
+        # dependency-counted readiness (round 5): the original picker
+        # re-sorted and re-scanned every pending step per dispatch —
+        # O(n² log n) across an n-step burst, measured at 2.7k steps/s
+        # for a 5000-step burst vs 33k for 500 (benchmarks executor).
+        # Now: unmet-dep counts + a dep→dependents map maintained at
+        # submit/finish, and a min-heap of ready timestamps — each
+        # step is pushed and popped once.
+        self._unmet: Dict[int, int] = {}  # pending ts -> unmet dep count
+        self._dependents: Dict[int, List[int]] = {}  # dep ts -> waiters
+        self._ready: List[int] = []  # heap of dispatchable timestamps
         self._running: Optional[int] = None  # picked, step() executing now
         self._ran: set[int] = set()  # ran, not finished yet (pruned on finish)
         self._futures: Dict[int, Any] = {}  # ts -> pytree (run, maybe async)
@@ -96,6 +115,10 @@ class Executor:
         this step runs (ref executor.cc PickActiveMsg dependency check).
         Dependencies must reference already-submitted steps — the reference
         allocates timestamps at Submit, so a dep can never be in the future.
+        A dep naming a timestamp that was NEVER submitted counts as
+        satisfied, evaluated once at submit time: backfilling that
+        timestamp later (an explicit ``Task(time=...)``) does not
+        retroactively block this step.
         The step runs on the executor's dispatch thread, possibly after
         later-submitted steps whose dependencies cleared earlier.
         """
@@ -121,6 +144,18 @@ class Executor:
                     raise ValueError(f"dependency {dep} is not before step {ts}")
                 deps.append(dep)
             self._pending[ts] = (step, deps)
+            # readiness accounting: a dep not yet done registers this
+            # step as its dependent; _finish(dep) decrements the count
+            # and promotes the step to the ready heap at zero. A dep
+            # that is done (or was never submitted) never transitions
+            # again, so checking it exactly once here is sound.
+            unmet = [d for d in deps if not self._dep_done_locked(d)]
+            if unmet:
+                self._unmet[ts] = len(unmet)
+                for d in unmet:
+                    self._dependents.setdefault(d, []).append(ts)
+            else:
+                heapq.heappush(self._ready, ts)
             if callback is not None:
                 self._callbacks[ts] = callback
             self._ensure_thread()
@@ -174,8 +209,18 @@ class Executor:
                         None,
                     )
                     if dep is None:
-                        # a concurrent wait() finished the dep between the
-                        # ready-pick and here — re-evaluate
+                        # every dep of the oldest blocked step is in
+                        # fact done, yet the step is not in the ready
+                        # heap: either a concurrent wait() finished the
+                        # dep between the ready-pick and here, or the
+                        # dep was finished through an EXTERNAL
+                        # tracker.finish (Customer.reply does this) that
+                        # bypasses _finish's promotion. Promote it
+                        # directly — without this the loop would spin
+                        # forever on a step no _finish will ever push
+                        # (duplicate heap entries are skipped lazily).
+                        self._unmet.pop(oldest, None)
+                        heapq.heappush(self._ready, oldest)
                         continue
                     if dep in self._futures:
                         dep_fut = self._futures[dep]  # materialize below
@@ -227,21 +272,39 @@ class Executor:
         )
 
     def _pick_ready_locked(self) -> Optional[Tuple[int, Callable[[], Any]]]:
-        """Lowest-timestamp pending step whose deps are all finished
-        (PickActiveMsg: any ready message may overtake blocked ones)."""
-        for ts in sorted(self._pending):
-            step, deps = self._pending[ts]
-            if all(self._dep_done_locked(d) for d in deps):
-                del self._pending[ts]
-                return ts, step
+        """Lowest-timestamp READY step (PickActiveMsg: any ready message
+        may overtake blocked ones). O(log n) via the ready heap. Lazy
+        skips: entries whose step is gone (run or cancelled), and
+        entries whose timestamp has an unmet-dep count — a stale heap
+        entry must never dispatch a REUSED explicit timestamp past its
+        fresh dependencies."""
+        while self._ready:
+            if self._ready[0] in self._unmet:
+                heapq.heappop(self._ready)
+                continue
+            ts = heapq.heappop(self._ready)
+            entry = self._pending.pop(ts, None)
+            if entry is not None:
+                return ts, entry[0]
         return None
 
     def _finish(self, ts: int) -> None:
-        """Mark finished (results materialized), prune, fire callback once."""
+        """Mark finished (results materialized), prune, fire callback
+        once, and promote dependents whose last unmet dep this was."""
         if self.tracker.was_started(ts):
             self.tracker.finish(ts)
         with self._cv:
             self._ran.discard(ts)
+            for t in self._dependents.pop(ts, ()):
+                left = self._unmet.get(t)
+                if left is None:
+                    continue  # cancelled by stop()
+                if left <= 1:
+                    del self._unmet[t]
+                    if t in self._pending:
+                        heapq.heappush(self._ready, t)
+                else:
+                    self._unmet[t] = left - 1
             cb = self._callbacks.pop(ts, None)
             self._cv.notify_all()
         if cb is not None:
@@ -312,9 +375,26 @@ class Executor:
         its state mutation cannot be torn). Idempotent."""
         with self._cv:
             if cancel_pending:
-                for ts in list(self._pending):
+                cancelled = set(self._pending)
+                for ts in cancelled:
                     self._pending.pop(ts)
                     self._callbacks.pop(ts, None)
+                    self._unmet.pop(ts, None)
+                # purge, don't lazy-skip: an explicit timestamp may be
+                # REUSED after cancellation, and a stale heap entry
+                # (or a stale _dependents registration decrementing
+                # the reincarnation's fresh unmet count) would let the
+                # new step dispatch before its dependencies
+                self._ready = [t for t in self._ready if t not in cancelled]
+                heapq.heapify(self._ready)
+                for d in list(self._dependents):
+                    kept = [
+                        t for t in self._dependents[d] if t not in cancelled
+                    ]
+                    if kept:
+                        self._dependents[d] = kept
+                    else:
+                        del self._dependents[d]
             self._stopped = True
             self._cv.notify_all()
             thread = self._thread
