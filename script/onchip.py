@@ -601,6 +601,41 @@ def _lm_base() -> dict:
     return base
 
 
+def _mfu_modes(base: dict) -> list:
+    """The queued MFU-push mode list as (name, LMConfig kwargs,
+    {seq, batch, spl} overrides). Module-level ON PURPOSE:
+    tests/test_lm_app.py trace+lowers the EXACT queued shapes from
+    this one definition — these configs have never executed anywhere
+    (smoke shrinks shapes) and drift between task and test would void
+    that protection (same reasoning as _lm_base).
+
+    d1024: d_head 128 (n_heads 8), seq 4096 with the token count kept
+    via batch 8 — attention drops to ~1/4 of step FLOPs; the noremat
+    variant removes recompute (MFU counts USEFUL flops, so remat
+    deflates it ~25-30%), b4 keeps activations ~2 GB. d2048 (~400M
+    params, d_ff 8192): attention falls to ~1/6 of step FLOPs, so the
+    matmul share — the MXU's home turf — sets MFU almost alone; SGD +
+    donation keeps params+grads at 1.6 GB transient. The s2048
+    variant halves the attention share again at the same tokens/step
+    — insurance against the flash kernel underperforming at mid
+    sequence lengths (the 04:27 capture showed s=4096 flash at 1/3
+    the s=8192 rate)."""
+    big = {**base, "d_model": 1024, "n_layers": 12, "d_ff": 4096}
+    d2048 = {**base, "d_model": 2048, "n_heads": 16, "n_layers": 8,
+             "d_ff": 8192}
+    return [
+        ("mfu_d1024_s4096", dict(attention="ring_flash", **big),
+         {"seq": 4096, "batch": 8}),
+        ("mfu_d1024_s4096_noremat",
+         dict(attention="ring_flash", **{**big, "remat": False}),
+         {"seq": 4096, "batch": 4}),
+        ("mfu_d2048_s4096", dict(attention="ring_flash", **d2048),
+         {"seq": 4096, "batch": 4, "spl": 4}),
+        ("mfu_d2048_s2048", dict(attention="ring_flash", **d2048),
+         {"seq": 2048, "batch": 8, "spl": 4}),
+    ]
+
+
 def task_lm() -> int:
     """Byte-LM train step on one chip at seq 8192: tokens/s + MFU for
     each attention mode (VERDICT r2 item 4)."""
@@ -653,43 +688,11 @@ def task_lm() -> int:
             ("ring_flash_d1024", LMConfig(attention="ring_flash", **big), {})
         )
         # the MFU headline configs (r3 verdict item 2: capture a
-        # >=100M-param MFU and push toward 15%+). d_head 128 (n_heads 8
-        # at d_model 1024), seq 4096 with the token count kept via
-        # batch 8: attention drops to ~1/4 of the step FLOPs. The
-        # noremat variant removes recompute (MFU counts USEFUL flops,
-        # so remat deflates it ~25-30%); b4 keeps activations ~2 GB.
-        modes.append(
-            ("mfu_d1024_s4096",
-             LMConfig(attention="ring_flash", **big),
-             {"seq": 4096, "batch": 8})
-        )
-        modes.append(
-            ("mfu_d1024_s4096_noremat",
-             LMConfig(attention="ring_flash", **{**big, "remat": False}),
-             {"seq": 4096, "batch": 4})
-        )
-        # ~400M params (d 2048, 8 layers, d_ff 8192, d_head 128):
-        # attention falls to ~1/6 of step FLOPs, so the matmul share —
-        # the MXU's home turf — sets MFU almost alone. SGD + donation:
-        # 1.6 GB params + grads transiently, remat activations; fits
-        # one 16 GB chip with room
-        d2048 = {**base, "d_model": 2048, "n_heads": 16,
-                 "n_layers": 8, "d_ff": 8192}
-        modes.append(
-            ("mfu_d2048_s4096",
-             LMConfig(attention="ring_flash", **d2048),
-             {"seq": 4096, "batch": 4, "spl": 4})
-        )
-        # same model, seq 2048 at batch 8 (same tokens/step): attention
-        # time is ~proportional to T*S at fixed tokens, so halving S
-        # halves the attention share again — insurance against the
-        # flash kernel underperforming at mid sequence lengths (the
-        # 04:27 capture showed s=4096 flash at 1/3 the s=8192 rate)
-        modes.append(
-            ("mfu_d2048_s2048",
-             LMConfig(attention="ring_flash", **d2048),
-             {"seq": 2048, "batch": 8, "spl": 4})
-        )
+        # >=100M-param MFU and push toward 15%+) — shapes live in
+        # _mfu_modes, shared with the CI trace+lower test so the
+        # queued configs can never drift unvalidated
+        for mname, mkw, mov in _mfu_modes(base):
+            modes.append((mname, LMConfig(**mkw), mov))
     rng = np.random.default_rng(0)
 
     dev = jax.devices()[0]

@@ -329,3 +329,59 @@ def test_lm_cli_invalid_config_is_a_flag_error(mesh8, argv):
     with pytest.raises(SystemExit) as e:
         main(["--steps", "1", *argv])
     assert e.value.code == 2
+
+
+def test_mfu_queue_configs_trace_and_lower():
+    """The queued MFU-push configs (script/onchip.py _mfu_modes — the
+    ONE definition the on-chip task also consumes) must build and
+    lower at their REAL shapes on a SINGLE-device mesh, exactly as
+    task_lm will run them: they have never executed anywhere (smoke
+    shrinks shapes), and a latent shape bug would burn a scarce
+    tunnel window. Abstract tracing only — no 151M/403M-param
+    allocation."""
+    import importlib.util
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from parameter_server_tpu.models.transformer import (
+        LMConfig,
+        init_lm,
+        make_lm_train_step,
+    )
+    from parameter_server_tpu.system.postoffice import Postoffice
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "onchip_for_mfu", os.path.join(repo, "script", "onchip.py")
+    )
+    onchip = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(onchip)
+    base = dict(vocab=256, d_model=512, n_heads=8, n_layers=8,
+                d_ff=2048, remat=True, compute_dtype="bfloat16")
+    modes = onchip._mfu_modes(base)
+    assert len(modes) == 4
+    # single-device mesh: the queued task runs on ONE chip, and the
+    # per-device chunk shapes (where shape bugs live) must match it
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+    Postoffice.reset()
+    try:
+        for _name, kw, ov in modes:
+            cfg = LMConfig(**kw)
+            spl = ov.get("spl", 8)
+            params = jax.eval_shape(
+                lambda k, c=cfg: init_lm(k, c), jax.random.PRNGKey(0)
+            )
+            step = make_lm_train_step(
+                cfg, mesh, donate=True, steps_per_launch=spl
+            )
+            toks = jax.ShapeDtypeStruct(
+                (spl, ov["batch"], ov["seq"]), jnp.int32
+            )
+            step.lower(params, toks)  # raises on any shape bug
+    finally:
+        Postoffice.reset()
