@@ -132,6 +132,33 @@ def _iter_trace_events(log_dir: str):
         yield pnames, tnames, events
 
 
+def _self_times(track_events: "list[dict]"):
+    """Yield ``(event, self_us)`` for complete events of ONE trace
+    track, where self_us is the event's duration minus the duration of
+    child events nested inside it on the same track (Chrome-trace
+    nesting: a child starts at/after the parent and ends at/before it).
+    Sorting by (start, -duration) makes parents precede their children;
+    a span stack then attributes each event's time to the innermost
+    enclosing span, which is exactly per-op self time."""
+    evs = sorted(
+        track_events,
+        key=lambda e: (e.get("ts", 0), -(e.get("dur") or 0)),
+    )
+    stack: list = []  # [event, end_ts, child_us]
+    for ev in evs:
+        ts = ev.get("ts", 0)
+        dur = ev.get("dur") or 0
+        while stack and ts >= stack[-1][1]:
+            top_ev, _, child_us = stack.pop()
+            yield top_ev, (top_ev.get("dur") or 0) - child_us
+        if stack:
+            stack[-1][2] += dur
+        stack.append([ev, ts + dur, 0.0])
+    while stack:
+        top_ev, _, child_us = stack.pop()
+        yield top_ev, (top_ev.get("dur") or 0) - child_us
+
+
 def summarize_trace(
     log_dir: str, top: int = 12
 ) -> "dict | None":
@@ -144,7 +171,12 @@ def summarize_trace(
     tracks would report wall-clock as device time). Only op-level
     tracks are summed — a device pid also carries "XLA Modules"/
     "Steps" spans that cover the sum of their ops, and including them
-    would double device_ms. Never raises: result-path code."""
+    would double device_ms. Within the op track, control-flow spans
+    (``while``/``fusion`` parents) NEST their body ops as child events
+    on the same track; each event is therefore credited only its SELF
+    time (duration minus time covered by its children), so a scan
+    wrapper no longer double-counts its body into a phantom "other"
+    bucket. Never raises: result-path code."""
     try:
         phases: dict = {}
         ops: dict = {}
@@ -179,6 +211,7 @@ def summarize_trace(
                 if key[0] in device_pids
                 and any(k in nm for k in ("Module", "Step", "module"))
             }
+            tracks: dict = {}
             for ev in events:
                 if not isinstance(ev, dict) or ev.get("ph") != "X":
                     continue
@@ -194,25 +227,31 @@ def summarize_trace(
                 dur = ev.get("dur")
                 if not dur:
                     continue
-                args = ev.get("args") or {}
-                label = (
-                    args.get("name")
-                    or args.get("tf_op")
-                    or args.get("long_name")
-                    or ev.get("name")
-                    or "?"
-                )
-                label = str(label)
-                seen = True
-                total_us += dur
-                phase = next(
-                    (p for p in _PHASE_PREFIXES if p in label), "other"
-                )
-                phases[phase] = phases.get(phase, 0.0) + dur
-                short = str(ev.get("name") or label)[:80]
-                rec = ops.setdefault(short, [0.0, 0])
-                rec[0] += dur
-                rec[1] += 1
+                tracks.setdefault(key, []).append(ev)
+            for track_events in tracks.values():
+                for ev, self_us in _self_times(track_events):
+                    if self_us <= 0:
+                        continue
+                    args = ev.get("args") or {}
+                    label = (
+                        args.get("name")
+                        or args.get("tf_op")
+                        or args.get("long_name")
+                        or ev.get("name")
+                        or "?"
+                    )
+                    label = str(label)
+                    seen = True
+                    total_us += self_us
+                    phase = next(
+                        (p for p in _PHASE_PREFIXES if p in label),
+                        "other",
+                    )
+                    phases[phase] = phases.get(phase, 0.0) + self_us
+                    short = str(ev.get("name") or label)[:80]
+                    rec = ops.setdefault(short, [0.0, 0])
+                    rec[0] += self_us
+                    rec[1] += 1
         if not seen:
             return None
         out = {

@@ -519,6 +519,61 @@ class TestTraceSummary:
         assert "$main.py:1 run" not in names
         assert "jit_mini_step" not in names
 
+    def test_nested_control_flow_spans_credit_self_time_only(
+        self, tmp_path
+    ):
+        """A while/scan wrapper span on the op track NESTS its body ops
+        as child events; the parent must be credited only its self time
+        (dur minus children) or device_ms double-counts the scan body
+        into a phantom 'other' bucket (observed live: while.3 248ms
+        over 8 scan steps re-counted the whole step)."""
+        import gzip
+        import json
+
+        from parameter_server_tpu.utils.profiling import summarize_trace
+
+        events = [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+             "args": {"name": "XLA Ops"}},
+            # parent scan wrapper: 10ms, of which 9ms is children
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 10000,
+             "name": "while.3", "args": {}},
+            # two body iterations: a pull fusion and a nested update,
+            # the update itself containing a grandchild kernel
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 500, "dur": 4000,
+             "name": "fusion.44",
+             "args": {"name": "jit(step)/ps_pull/gather"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 5000, "dur": 5000,
+             "name": "fusion.48",
+             "args": {"name": "jit(step)/ps_update/scatter"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 6000, "dur": 2000,
+             "name": "ftrl_update.7",
+             "args": {"name": "jit(step)/ps_update/custom_call"}},
+            # op after the scan, top level
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 10000, "dur": 1000,
+             "name": "copy.9", "args": {}},
+        ]
+        run = tmp_path / "plugins" / "profile" / "r"
+        run.mkdir(parents=True)
+        with gzip.open(run / "t.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+        s = summarize_trace(str(tmp_path))
+        assert s is not None
+        # total = 10ms scan + 1ms copy, NOT 10+9+1
+        assert s["device_ms"] == 11.0
+        assert s["phases"]["ps_pull"] == 4.0
+        # update = 5ms span, of which grandchild 2ms — both ps_update
+        assert s["phases"]["ps_update"] == 5.0
+        # other = scan self (1ms) + copy (1ms)
+        assert s["phases"]["other"] == 2.0
+        ops = {o["name"]: o["ms"] for o in s["top_ops"]}
+        assert ops["while.3"] == 1.0
+        assert ops["fusion.48"] == 3.0
+        assert ops["ftrl_update.7"] == 2.0
+
     def test_summarize_newest_run_only_and_host_only_none(self, tmp_path):
         """A reused profile dir accumulates runs — only the newest
         plugins/profile/<ts> run is summed; a trace with no
