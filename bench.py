@@ -404,7 +404,7 @@ def probe_device(timeout_s: float = 150.0, attempts: int = 4,
             time.sleep(retry_wait_s)
         request_priority("bench-probe")
         try:
-            rc, perr = run_graceful(
+            rc, perr, _ = run_graceful(
                 [sys.executable, "-c", PROBE_CHILD_SRC], timeout_s
             )
             if rc == 0:

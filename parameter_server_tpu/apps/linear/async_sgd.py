@@ -550,8 +550,11 @@ def make_push_touched(push_quant: int, noise=None):
     if not push_quant:
 
         def run(g_shard, seed):
-            g = push_reduce(g_shard, seed)
-            return g, g != 0
+            # touched=None: membership IS the reduced gradient's
+            # support; updaters derive it on the fly (the FTRL kernel
+            # in-block), so no table-sized mask array ever
+            # materializes — 4 GB of the 2^30-table OOM budget
+            return push_reduce(g_shard, seed), None
 
     else:
 

@@ -78,6 +78,8 @@ class FTRLUpdater:
                 seed=seed,
             )
             return {"z": z_new, "sqrt_n": n_new}
+        if touched is None:  # unquantized push: membership == support
+            touched = grad != 0
         sqrt_n = state["sqrt_n"].astype(jnp.float32)
         w = self.weights(state)
         sqrt_n_new = jnp.sqrt(sqrt_n * sqrt_n + grad * grad)
@@ -114,6 +116,8 @@ class AdaGradUpdater:
         return state["w"]
 
     def apply(self, state, grad, touched, seed=None):
+        if touched is None:  # unquantized push: membership == support
+            touched = grad != 0
         sum_sq = state["sum_sq"] + grad * grad
         eta = self.lr.eval(jnp.sqrt(sum_sq))
         w = self.penalty.proximal(state["w"] - eta * grad, eta)
@@ -141,6 +145,8 @@ class SGDUpdater:
         return state["w"]
 
     def apply(self, state, grad, touched, seed=None):
+        if touched is None:  # unquantized push: membership == support
+            touched = grad != 0
         t = state["t"] + 1.0
         eta = self.lr.eval(jnp.sqrt(t))
         w = self.penalty.proximal(state["w"] - eta * grad, eta)

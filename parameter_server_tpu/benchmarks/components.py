@@ -321,9 +321,6 @@ def _step_phases_at(
     rel = jax.device_put(slots_host.astype(np.int32))
     gr = jax.device_put(rng.normal(size=rows).astype(np.float32))
     grad = jax.device_put(rng.normal(size=num_slots).astype(np.float32))
-    touched = jax.device_put(
-        (rng.random(num_slots) < 0.01).astype(bool)
-    )
 
     def timed_phase(name, fn, *args):
         jf = jax.jit(fn)
@@ -368,11 +365,27 @@ def _step_phases_at(
         .add(jnp.broadcast_to(g[:, None], (rows, lanes)).reshape(-1)),
         rel, gr,
     )
-    total += timed_phase(
-        "ftrl_update",
-        lambda st, g, t: updater.apply(st, g, t, seed=np.uint32(1)),
-        state, grad, touched,
+    # the ftrl phase must time the PRODUCTION configuration: the fused
+    # step donates the table and the kernel updates it in place
+    # (ops/ftrl.py input_output_aliases), with membership derived from
+    # grad's support (touched=None, the unquantized-push contract). A
+    # non-donated call would instead time kernel + XLA's defensive
+    # whole-table copies — a different program than the one shipped.
+    jf_ftrl = jax.jit(
+        lambda st, g: updater.apply(st, g, None, seed=np.uint32(1)),
+        donate_argnums=(0,),
     )
+    st_ftrl = jax.tree.map(jnp.copy, state)
+    st_ftrl = jax.block_until_ready(jf_ftrl(st_ftrl, grad))
+    _st_box = [st_ftrl]
+
+    def _ftrl_once():
+        _st_box[0] = jf_ftrl(_st_box[0], grad)
+        jax.block_until_ready(_st_box[0])
+
+    sec = timeit(_ftrl_once, 3 if smoke else 10, budget_s=25.0)
+    report(f"step_phase_ftrl_update{tag}_ms", sec * 1e3, "ms")
+    total += sec
     report(f"step_phase_sum{tag}_ms", total * 1e3, "ms")
     report(
         f"step_phase_sum{tag}_equiv_examples_per_sec",

@@ -75,7 +75,11 @@ def ftrl_update_ref(z, sqrt_n, grad, touched, *, alpha, beta, l1, l2,
                     seed=None):
     """Pure-jnp reference (identical to updaters.FTRLUpdater.apply math).
     bf16 sqrt_n widens for math; the narrow is stochastically rounded
-    when ``seed`` is given, else deterministically."""
+    when ``seed`` is given, else deterministically. ``touched=None``
+    derives membership as ``grad != 0`` (the unquantized-push
+    contract, async_sgd.make_push_touched)."""
+    if touched is None:
+        touched = grad != 0
     store_dtype = sqrt_n.dtype
     sqrt_n = sqrt_n.astype(jnp.float32)
     z_new, sqrt_n_new = _ftrl_math(
@@ -88,14 +92,22 @@ def ftrl_update_ref(z, sqrt_n, grad, touched, *, alpha, beta, l1, l2,
 
 
 def _kernel(z_ref, n_ref, g_ref, t_ref, z_out, n_out, *, alpha, beta, l1, l2):
+    # t_ref=None: membership derived in-block as g != 0 (the
+    # unquantized-push contract) — at 2^30 slots the f32 mask operand
+    # alone is 4 GB of HBM, so deriving it is what lets the table fit
     z = z_ref[:]
     n = n_ref[:]
     g = g_ref[:]
-    t = t_ref[:]
     z_new, n_new = _ftrl_math(z, n, g, alpha=alpha, beta=beta, l1=l1, l2=l2)
-    keep = t > 0
+    keep = (t_ref[:] > 0) if t_ref is not None else (g != 0)
     z_out[:] = jnp.where(keep, z_new, z)
     n_out[:] = jnp.where(keep, n_new, n)
+
+
+def _kernel_nomask(z_ref, n_ref, g_ref, z_out, n_out, *, alpha, beta, l1,
+                   l2):
+    _kernel(z_ref, n_ref, g_ref, None, z_out, n_out,
+            alpha=alpha, beta=beta, l1=l1, l2=l2)
 
 
 def _hash_dither_bits(seed_scalar, shape):
@@ -122,16 +134,16 @@ def _kernel_bf16(z_ref, n_ref, g_ref, t_ref, seed_ref, z_out, n_out, *,
     narrow with the on-core PRNG (per-block stream — block-correlated
     rounding noise is biased in aggregate, ops/quantize.py note).
     ``dither_fn``: interpret-mode substitute for the PRNG (see
-    :func:`_hash_dither_bits`)."""
+    :func:`_hash_dither_bits`). ``t_ref=None``: membership derived
+    in-block as ``g != 0`` (see :func:`_kernel`)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     z = z_ref[:]
     n = n_ref[:].astype(jnp.float32)
     g = g_ref[:]
-    t = t_ref[:]
     z_new, n_new = _ftrl_math(z, n, g, alpha=alpha, beta=beta, l1=l1, l2=l2)
-    keep = t > 0
+    keep = (t_ref[:] > 0) if t_ref is not None else (g != 0)
     z_out[:] = jnp.where(keep, z_new, z)
     n_keep = jnp.where(keep, n_new, n)
     # stochastic f32->bf16: dither the low 16 bits, truncate. An
@@ -152,6 +164,13 @@ def _kernel_bf16(z_ref, n_ref, g_ref, t_ref, seed_ref, z_out, n_out, *,
         n_out[:] = jax.lax.bitcast_convert_type(
             rounded, jnp.float32
         ).astype(jnp.bfloat16)
+
+
+def _kernel_bf16_nomask(z_ref, n_ref, g_ref, seed_ref, z_out, n_out, *,
+                        alpha, beta, l1, l2, dither_fn=None):
+    _kernel_bf16(z_ref, n_ref, g_ref, None, seed_ref, z_out, n_out,
+                 alpha=alpha, beta=beta, l1=l1, l2=l2,
+                 dither_fn=dither_fn)
 
 
 def _choose_block_rows(rows: int, requested: "int | None" = None) -> int:
@@ -198,10 +217,23 @@ def ftrl_update(
     interpret: bool = False,
     block_rows: "int | None" = None,
 ):
-    """Fused update over a 1-D slot shard. touched: bool/float mask.
+    """Fused update over a 1-D slot shard. touched: bool/float mask,
+    or ``None`` to derive membership in-kernel as ``grad != 0`` (valid
+    exactly when the push is unquantized — async_sgd.make_push_touched
+    — and worth it: no table-sized mask operand, which at 2^30 slots
+    saves 4 GB of HBM).
     ``seed`` (traced uint32 scalar) drives the stochastic narrow when
     ``sqrt_n`` is stored bf16; without it the bf16 narrow truncates
     (callers that care about long-horizon LR decay must pass one).
+
+    The Pallas kernel updates z/sqrt_n IN PLACE (input_output_aliases
+    — what lets one chip hold a 2^30 table). Callers whose enclosing
+    jit DONATES the state (the fused production step, max_delay=0)
+    get the update copy-free; at a non-donating call site XLA inserts
+    defensive whole-table copies of z/sqrt_n to preserve the caller's
+    buffers — correct, but one extra table read+write. Benchmarks
+    must therefore time the donated form (benchmarks/components.py
+    ftrl phase).
 
     ``block_rows`` tiles the slot dimension (default 2048 = 1 MB/ref;
     env ``PS_FTRL_BLOCK_ROWS`` overrides so a cross-process on-chip
@@ -223,7 +255,8 @@ def ftrl_update(
         or (bf16_n and seed is None)
     ):
         return ftrl_update_ref(
-            z, sqrt_n, grad, touched.astype(jnp.float32) > 0,
+            z, sqrt_n, grad,
+            None if touched is None else touched.astype(jnp.float32) > 0,
             alpha=alpha, beta=beta, l1=l1, l2=l2, seed=seed,
         )
     from jax.experimental import pallas as pl
@@ -236,7 +269,6 @@ def ftrl_update(
     # = 1MB/ref keeps the grid <= a few hundred steps at every real size.
     block_rows = _choose_block_rows(rows, block_rows)
     grid = (rows // block_rows,)
-    t2d = touched.astype(jnp.float32).reshape(shape2d)
     spec = pl.BlockSpec(
         (block_rows, _LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
@@ -244,32 +276,39 @@ def ftrl_update(
         jax.ShapeDtypeStruct(shape2d, z.dtype),
         jax.ShapeDtypeStruct(shape2d, sqrt_n.dtype),
     )
+    # z/sqrt_n update IN PLACE (input_output_aliases): without the
+    # alias the call materializes fresh z'/n' buffers next to the live
+    # table — at 2^30 slots that extra 8 GB is the difference between
+    # one chip holding the table or RESOURCE_EXHAUSTED (the donated
+    # step's own aliasing only covers program input->output, not this
+    # call's operands). Block i is read before it is written, so the
+    # grid pipeline never observes its own output.
+    operands = [z.reshape(shape2d), sqrt_n.reshape(shape2d),
+                grad.reshape(shape2d)]
+    in_specs = [spec, spec, spec]
+    if touched is not None:
+        operands.append(touched.astype(jnp.float32).reshape(shape2d))
+        in_specs.append(spec)
     if bf16_n:
         kernel = functools.partial(
-            _kernel_bf16, alpha=alpha, beta=beta, l1=l1, l2=l2,
+            _kernel_bf16 if touched is not None else _kernel_bf16_nomask,
+            alpha=alpha, beta=beta, l1=l1, l2=l2,
             dither_fn=_hash_dither_bits if interpret else None,
         )
-        z_new, n_new = pl.pallas_call(
-            kernel,
-            grid=grid,
-            out_shape=out_shape,
-            in_specs=[spec, spec, spec, spec,
-                      pl.BlockSpec(memory_space=pltpu.SMEM)],
-            out_specs=(spec, spec),
-            interpret=interpret,
-        )(
-            z.reshape(shape2d), sqrt_n.reshape(shape2d),
-            grad.reshape(shape2d), t2d,
-            jnp.asarray(seed, jnp.int32).reshape(1),
+        operands.append(jnp.asarray(seed, jnp.int32).reshape(1))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    else:
+        kernel = functools.partial(
+            _kernel if touched is not None else _kernel_nomask,
+            alpha=alpha, beta=beta, l1=l1, l2=l2,
         )
-        return z_new.reshape(p), n_new.reshape(p)
-    kernel = functools.partial(_kernel, alpha=alpha, beta=beta, l1=l1, l2=l2)
     z_new, n_new = pl.pallas_call(
         kernel,
         grid=grid,
         out_shape=out_shape,
-        in_specs=[spec, spec, spec, spec],
+        in_specs=in_specs,
         out_specs=(spec, spec),
+        input_output_aliases={0: 0, 1: 1},
         interpret=interpret,
-    )(z.reshape(shape2d), sqrt_n.reshape(shape2d), grad.reshape(shape2d), t2d)
+    )(*operands)
     return z_new.reshape(p), n_new.reshape(p)

@@ -21,28 +21,34 @@ def run_graceful(
     argv,
     timeout_s: float,
     term_grace_s: float = 10.0,
+    capture_stdout: bool = False,
     **popen_kw,
-) -> "tuple[int | None, bytes]":
+) -> "tuple[int | None, bytes, bytes]":
     """Run ``argv`` to completion with a graceful timeout.
 
-    Returns ``(returncode, stderr_bytes)``; raises
-    ``subprocess.TimeoutExpired`` after the graceful shutdown
-    completes. On ANY exception (including KeyboardInterrupt while
-    blocked in communicate) the child is killed and reaped before the
-    exception propagates — subprocess.run's guarantee, which a naive
-    Popen/communicate port silently drops: an orphaned live tunnel
-    client outliving its parent's device-lock scope is exactly the
-    two-concurrent-clients collision the lock exists to prevent."""
+    Returns ``(returncode, stderr_bytes, stdout_bytes)`` —
+    ``stdout_bytes`` is ``b""`` unless ``capture_stdout=True`` (the
+    default discards stdout so a chatty child can't deadlock an
+    unread pipe). Raises ``subprocess.TimeoutExpired`` after the
+    graceful shutdown completes; the exception's ``.output`` carries
+    any captured stdout so callers can forward records the child
+    emitted before wedging. On ANY exception (including
+    KeyboardInterrupt while blocked in communicate) the child is
+    killed and reaped before the exception propagates —
+    subprocess.run's guarantee, which a naive Popen/communicate port
+    silently drops: an orphaned live tunnel client outliving its
+    parent's device-lock scope is exactly the two-concurrent-clients
+    collision the lock exists to prevent."""
     p = subprocess.Popen(
         argv,
-        stdout=subprocess.DEVNULL,
+        stdout=subprocess.PIPE if capture_stdout else subprocess.DEVNULL,
         stderr=subprocess.PIPE,
         **popen_kw,
     )
     try:
-        _, err = p.communicate(timeout=timeout_s)
-        return p.returncode, err
-    except subprocess.TimeoutExpired:
+        out, err = p.communicate(timeout=timeout_s)
+        return p.returncode, err, out if capture_stdout else b""
+    except subprocess.TimeoutExpired as te:
         # the terminate/grace sequence needs its own interrupt guard:
         # a KeyboardInterrupt raised while blocked in the grace-window
         # communicate would escape BOTH handlers (the outer
@@ -53,14 +59,18 @@ def run_graceful(
         try:
             p.terminate()
             try:
-                p.communicate(timeout=term_grace_s)
+                out, err = p.communicate(timeout=term_grace_s)
             except subprocess.TimeoutExpired:
                 p.kill()
-                p.communicate()
+                out, err = p.communicate()
         except BaseException:
             p.kill()
             p.communicate()
             raise
+        # hand the pre-wedge stdout/stderr to the caller: records the
+        # child emitted before timing out are evidence, not garbage
+        te.output = out if capture_stdout else b""
+        te.stderr = err
         raise
     except BaseException:
         p.kill()

@@ -234,6 +234,11 @@ def test_fresh_capture_resume_logic(onchip):
         # not treated as a fresh success (r4 advisor finding)
         json.dumps({"metric": "lm_decode_overpeak", "value": 5.0,
                     "exceeds_physical_peak": True, **kind}),
+        # non-finite numeric anywhere = degenerate capture (a NaN
+        # target_loss means the model diverged; its tok/s is not
+        # evidence and must be re-measured, not skipped-as-fresh)
+        json.dumps({"metric": "lm_spec_nan", "value": 5.0,
+                    "target_loss": float("nan"), **kind}),
     ]
     with open(onchip.LOG_MD, "w") as f:
         f.write("\n".join(lines) + "\n")
@@ -246,6 +251,7 @@ def test_fresh_capture_resume_logic(onchip):
     assert not onchip._fresh_capture("lm_train_nokind")
     assert not onchip._fresh_capture("lm_decode_noisy")
     assert not onchip._fresh_capture("lm_decode_overpeak")
+    assert not onchip._fresh_capture("lm_spec_nan")
     # a tighter window rejects even the fresh one
     assert not onchip._fresh_capture("lm_train_good", within_s=0.0)
 

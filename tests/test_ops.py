@@ -125,6 +125,82 @@ class TestFtrlOp:
         )
         del down
 
+    def test_touched_none_equals_support_mask(self):
+        """touched=None (the unquantized-push contract: membership IS
+        grad's support, derived in-kernel so no table-sized mask
+        operand exists — the 2^30 single-chip fit depends on it) must
+        be BIT-identical to passing touched=(g != 0) explicitly, on
+        the ref path, the f32 kernel, and the bf16 kernel."""
+        rng = np.random.default_rng(3)
+        p = 2048
+        z = jnp.asarray(rng.normal(size=p), jnp.float32)
+        n = jnp.abs(jnp.asarray(rng.normal(size=p), jnp.float32))
+        g = jnp.asarray(
+            rng.normal(size=p) * (rng.random(p) < 0.2), jnp.float32
+        )
+        kw = dict(alpha=0.5, beta=1.0, l1=0.1, l2=0.01)
+        for extra in (
+            {},  # ref fallback (cpu)
+            {"force_pallas": True, "interpret": True},  # f32 kernel
+        ):
+            za, na = ftrl_update(z, n, g, g != 0, **kw, **extra)
+            zb, nb = ftrl_update(z, n, g, None, **kw, **extra)
+            np.testing.assert_array_equal(np.asarray(za), np.asarray(zb))
+            np.testing.assert_array_equal(np.asarray(na), np.asarray(nb))
+        nb16 = n.astype(jnp.bfloat16)
+        za, na = ftrl_update(z, nb16, g, g != 0, seed=jnp.uint32(7),
+                             force_pallas=True, interpret=True, **kw)
+        zb, nb = ftrl_update(z, nb16, g, None, seed=jnp.uint32(7),
+                             force_pallas=True, interpret=True, **kw)
+        np.testing.assert_array_equal(np.asarray(za), np.asarray(zb))
+        np.testing.assert_array_equal(
+            np.asarray(na.astype(jnp.float32)),
+            np.asarray(nb.astype(jnp.float32)),
+        )
+
+    def test_kernel_in_place_aliasing_keeps_results(self):
+        """input_output_aliases={z,sqrt_n} makes the kernel update in
+        place (the alias is why one chip holds a 2^30 table: no fresh
+        8 GB z'/n' next to the live table). Two halves: (a) interpret
+        mode reproduces the reference numerics under the donation
+        contract, (b) the alias ACTUALLY SURVIVES into the lowered TPU
+        program — asserted on the exported StableHLO, because the
+        numeric half alone would still pass if the alias were dropped
+        (and 2^30 would quietly OOM again)."""
+        rng = np.random.default_rng(5)
+        p = 4096
+        z = jnp.asarray(rng.normal(size=p), jnp.float32)
+        n = jnp.abs(jnp.asarray(rng.normal(size=p), jnp.float32))
+        g = jnp.asarray(
+            rng.normal(size=p) * (rng.random(p) < 0.3), jnp.float32
+        )
+        kw = dict(alpha=0.5, beta=1.0, l1=0.1, l2=0.01)
+        zr, nr = ftrl_update_ref(z, n, g, g != 0, **kw)
+        zk, nk = ftrl_update(z, n, g, None, force_pallas=True,
+                             interpret=True, **kw)
+        np.testing.assert_allclose(np.asarray(zk), np.asarray(zr),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nk), np.asarray(nr),
+                                   atol=1e-6)
+        # (b) lowering contract, f32 and bf16-state variants
+        import re
+
+        for n_in, seed in ((n, None), (n.astype(jnp.bfloat16), 7)):
+            exp = jax.export.export(
+                jax.jit(lambda z, n, g: ftrl_update(
+                    z, n, g, None, seed=(None if seed is None
+                                         else jnp.uint32(seed)),
+                    force_pallas=True, **kw)),
+                platforms=["tpu"],
+            )(z, n_in, g)
+            aliases = re.findall(
+                r"output_operand_alias<output_tuple_indices = \[(\d)\], "
+                r"operand_index = (\d)", exp.mlir_module()
+            )
+            assert ("0", "0") in aliases and ("1", "1") in aliases, (
+                f"z/sqrt_n not aliased in lowered TPU program: {aliases}"
+            )
+
     def test_bf16_stochastic_rounding_unbiased(self):
         """Across many seeds the bf16 narrow must average to the exact
         f32 value (unbiased walk) — deterministic truncation would
