@@ -125,11 +125,21 @@ def stream_to_words(stream: np.ndarray, n: int, bits: int) -> np.ndarray:
 
 
 def unpack_bits(words, n: int, bits: int):
-    """Jit-side inverse: uint32 word array → int32 [n].
+    """Jit-side inverse: uint32 word array → int32 [n]; ``bits`` <= 31.
 
-    Two gathers + shifts per value; defined for ``bits`` <= 31. Shift
-    amounts stay in [0, 31] (the ``sh == 0`` lane is masked by the where).
-    """
+    Dispatches to the gather-free tiled unpack whenever ``n`` is a
+    multiple of the stream's value period (every production wire is:
+    rows_pad*lanes is 2^14*39, divisible by both 16 and 32); the
+    two-gather form remains as the general fallback."""
+    per_vals = _bit_period(bits)[0]
+    if n and n % per_vals == 0:
+        return _unpack_bits_tiled(words, n, bits)
+    return _unpack_bits_gather(words, n, bits)
+
+
+def _unpack_bits_gather(words, n: int, bits: int):
+    """General-n unpack: two GATHERS + shifts per value. Shift amounts
+    stay in [0, 31] (the ``sh == 0`` lane is masked by the where)."""
     import jax.numpy as jnp
 
     i = jnp.arange(n, dtype=jnp.int32)
@@ -141,6 +151,53 @@ def unpack_bits(words, n: int, bits: int):
     hi = w1 << ((jnp.uint32(32) - sh) & jnp.uint32(31))
     v = (w0 >> sh) | jnp.where(sh == jnp.uint32(0), jnp.uint32(0), hi)
     return (v & jnp.uint32((1 << bits) - 1)).astype(jnp.int32)
+
+
+def _bit_period(bits: int):
+    """(values, words) in one period of the bitstream: bit offsets
+    repeat every lcm(bits, 32) bits — 16 values for even ``bits``, 32
+    for odd (and trivially 32/bits for powers of two)."""
+    import math
+
+    lcm = bits * 32 // math.gcd(bits, 32)
+    return lcm // bits, lcm // 32
+
+
+def _unpack_bits_tiled(words, n: int, bits: int):
+    """Gather-free unpack for ``n`` a multiple of the value period.
+
+    The decode phase of the fused step spent its time on the fallback's
+    1.28M random word-gathers per batch (step_phase_decode ~74 ms at
+    the headline shapes, tying gather/scatter — BENCH_ONCHIP 08-02
+    04:22). But (lo, sh) as a function of value index is periodic:
+    viewing the stream as [n/V, W] word tiles (V values per W words per
+    lcm(bits,32)-bit period), every value is a STATIC column pair +
+    static shift — V strided loads, no gather, which is exactly what
+    the TPU's vector unit wants. No cross-tile carry exists: a period
+    ends exactly on a word boundary (lcm is a multiple of 32), so the
+    last value's high bits live in column w_per-1, never the next
+    tile."""
+    import jax.numpy as jnp
+
+    v_per, w_per = _bit_period(bits)
+    nper = n // v_per
+    cols = words[: nper * w_per].reshape(nper, w_per)
+    mask = jnp.uint32((1 << bits) - 1)
+    lanes = []
+    for j in range(v_per):
+        off = j * bits
+        lo, sh = off >> 5, off & 31
+        w0 = cols[:, lo]
+        if sh == 0:
+            v = w0
+        elif sh + bits <= 32:  # value lives entirely in w0
+            v = w0 >> jnp.uint32(sh)
+        else:
+            v = (w0 >> jnp.uint32(sh)) | (
+                cols[:, lo + 1] << jnp.uint32(32 - sh)
+            )
+        lanes.append(v & mask)
+    return jnp.stack(lanes, axis=1).reshape(-1).astype(jnp.int32)
 
 
 def unpack_sign_bits(bits_u8, n: int):

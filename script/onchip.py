@@ -637,7 +637,15 @@ def _mfu_modes(base: dict) -> list:
     variant halves the attention share again at the same tokens/step
     — insurance against the flash kernel underperforming at mid
     sequence lengths (the 04:27 capture showed s=4096 flash at 1/3
-    the s=8192 rate)."""
+    the s=8192 rate).
+
+    The two _noremat push variants (added after the 08-02 captures
+    landed d1024_s4096_noremat at 53.9% MFU, the round's best) apply
+    the same remat removal to the shapes that measured best with it:
+    d2048_s2048 (51.4% WITH remat; ~3.6 GB noremat activations at
+    batch 4 + 1.6 GB params/grads fits comfortably) and d1024_s2048
+    at batch 8 (halved attention share AND doubled rows vs the 53.9%
+    capture; ~6.5 GB activations + 1.2 GB params)."""
     big = {**base, "d_model": 1024, "n_layers": 12, "d_ff": 4096}
     d2048 = {**base, "d_model": 2048, "n_heads": 16, "n_layers": 8,
              "d_ff": 8192}
@@ -651,6 +659,12 @@ def _mfu_modes(base: dict) -> list:
          {"seq": 4096, "batch": 4, "spl": 4}),
         ("mfu_d2048_s2048", dict(attention="ring_flash", **d2048),
          {"seq": 2048, "batch": 8, "spl": 4}),
+        ("mfu_d2048_s2048_noremat",
+         dict(attention="ring_flash", **{**d2048, "remat": False}),
+         {"seq": 2048, "batch": 4, "spl": 4}),
+        ("mfu_d1024_s2048_noremat_b8",
+         dict(attention="ring_flash", **{**big, "remat": False}),
+         {"seq": 2048, "batch": 8}),
     ]
 
 
@@ -1425,6 +1439,32 @@ def task_gatherx() -> int:
                 * m[i].astype(jnp.float32)
             ).sum(),
             qu8, zmask, idx,
+        )
+        # wire-decode formulations: the production tiled unpack
+        # (static strided column loads — utils/bitpack.py
+        # _unpack_bits_tiled) vs the original two-random-gathers-per
+        # -value form it replaced; step_phase_decode measures the
+        # integrated phase, this pair isolates the formulation delta
+        from parameter_server_tpu.utils import bitpack
+        from parameter_server_tpu.utils.bitpack import slot_bits
+
+        bits = slot_bits(num_slots)
+        words = jax.device_put(bitpack.stream_to_words(
+            bitpack.pack_bits(idx_np, bits), n_idx, bits
+        ))
+        timed(
+            f"unpack_tiled{tag}",
+            lambda w, n=n_idx, b=bits: (
+                bitpack._unpack_bits_tiled(w, n, b).sum()
+            ),
+            words,
+        )
+        timed(
+            f"unpack_gather{tag}",
+            lambda w, n=n_idx, b=bits: (
+                bitpack._unpack_bits_gather(w, n, b).sum()
+            ),
+            words,
         )
     if skipped_fresh:
         emit({"metric": "gatherx_task_resume", "value": len(skipped_fresh),

@@ -362,7 +362,7 @@ def test_mfu_queue_configs_trace_and_lower():
     base = dict(vocab=256, d_model=512, n_heads=8, n_layers=8,
                 d_ff=2048, remat=True, compute_dtype="bfloat16")
     modes = onchip._mfu_modes(base)
-    assert len(modes) == 4
+    assert len(modes) == 6
     # single-device mesh: the queued task runs on ONE chip, and the
     # per-device chunk shapes (where shape bugs live) must match it
     from jax.sharding import Mesh

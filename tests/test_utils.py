@@ -272,6 +272,39 @@ class TestBitpack:
             )(words)
             np.testing.assert_array_equal(np.asarray(out), vals)
 
+    def test_tiled_unpack_matches_gather_all_widths(self, rng):
+        """The gather-free tiled unpack (the production decode path:
+        rows_pad*lanes is always period-aligned) must be bit-exact with
+        the general two-gather form at EVERY wire width, including the
+        carry lanes that straddle word boundaries."""
+        import jax
+
+        from parameter_server_tpu.utils import bitpack
+
+        for bits in range(1, 32):
+            v_per, _ = bitpack._bit_period(bits)
+            for nper in (1, 7):
+                n = v_per * nper
+                vals = rng.integers(0, 1 << bits, n, endpoint=False)
+                vals = vals.astype(np.int64).astype(np.int32)
+                words = bitpack.stream_to_words(
+                    bitpack.pack_bits_np(vals, bits), n, bits
+                )
+                tiled = jax.jit(
+                    lambda w, n=n, b=bits: bitpack._unpack_bits_tiled(
+                        w, n, b
+                    )
+                )(words)
+                gath = jax.jit(
+                    lambda w, n=n, b=bits: bitpack._unpack_bits_gather(
+                        w, n, b
+                    )
+                )(words)
+                np.testing.assert_array_equal(np.asarray(tiled), vals)
+                np.testing.assert_array_equal(
+                    np.asarray(tiled), np.asarray(gath)
+                )
+
     def test_sign_bits_roundtrip(self, rng):
         import jax
 
