@@ -1374,10 +1374,11 @@ def main() -> int:
         default=0,
         choices=(0, 1, 2),
         help="FIXING_FLOAT pull filter width: servers send n-byte "
-        "quantized weights and the step gathers codes+mask, "
-        "dequantizing post-gather (pull_gather auto => narrow for 1 "
-        "byte — the reference's production criteo pull, "
-        "example/linear/ctr/online_l1lr.conf). Metric name gains a "
+        "quantized weights (the reference's production criteo pull, "
+        "example/linear/ctr/online_l1lr.conf). The step dequantizes "
+        "shard-wide then gathers f32 (pull_gather auto => wide; the "
+        "narrow codes+mask gather measured SLOWER on TPU — "
+        "BENCH_ONCHIP 08-02). Metric name gains a "
         "_qN suffix so captures pool separately from the exact-pull "
         "headline",
     )
@@ -1402,6 +1403,17 @@ def main() -> int:
         args.minibatch, args.steps, args.warmup = 1024, 10, 2
         args.num_slots = 1 << 16
         args.real_mb = min(args.real_mb, 8)
+        # a smoke run is a CPU correctness pass: keep it off the
+        # tunnel entirely unless the operator explicitly forced a
+        # platform. Before this, a smoke run still PROBED the device
+        # below, and the probe's priority marker preempted a live
+        # watcher capture task (observed 08-02 07:01) — a toy run
+        # must never cost chip time. Unconditional: even an ambient
+        # JAX_PLATFORMS=axon (this host's shell default) must not put
+        # a toy run on the tunnel — there is no legitimate smoke-on-
+        # chip use, and the honor_jax_platforms() hook makes this
+        # effective even though jax is already imported
+        os.environ["JAX_PLATFORMS"] = "cpu"
     # one tunneled chip, one client at a time: wait for a concurrent
     # holder — e.g. the evidence watcher mid-task — instead of
     # colliding with it. The wait bound exceeds every WATCHER-side
@@ -1415,8 +1427,14 @@ def main() -> int:
         device_lock,
     )
 
+    # a CPU-platform run (every smoke run — forced above — or an
+    # explicit JAX_PLATFORMS=cpu sanity run) never touches the
+    # tunnel: no device lock, no priority marker, no probe. A
+    # priority marker from a CPU run would preempt the watcher's
+    # in-flight on-chip capture for nothing (observed 08-02 07:01).
+    cpu_run = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     lock = (
-        contextlib.nullcontext(True) if args.smoke  # CPU-bound: no lock
+        contextlib.nullcontext(True) if cpu_run
         # priority_note announces BEFORE waiting on the flock (and
         # keeps the marker fresh however long the wait runs): the
         # watcher yields — preempting its running task child — within
@@ -1458,7 +1476,11 @@ def main() -> int:
                     )
                     _raw_emit(_PENDING_REC)
 
-            diagnosis = probe_device(on_retry=_refresh)
+            # CPU-platform runs have nothing to probe: probing would
+            # touch the tunnel and preempt a live watcher capture
+            diagnosis = (
+                None if cpu_run else probe_device(on_retry=_refresh)
+            )
             if diagnosis is not None:
                 # reuse the staged provisional (same heavyweight
                 # diagnostics) rather than rebuilding it from scratch.

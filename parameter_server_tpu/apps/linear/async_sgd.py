@@ -587,18 +587,21 @@ def make_pull_lookup(updater, pull_quant: int, noise=None,
     - ``lookup(rep, rel, ok)`` — flat f32 weights at gather indices
       ``rel``, zero where ``ok`` is False.
 
-    ``narrow`` (default: on exactly for 1-byte quantized pulls)
-    gathers the quantized CODES plus a 1-byte zero-mask and
-    dequantizes AFTER the gather, instead of materializing and
-    gathering a dense f32 shard. The random gather is
-    granularity/bandwidth-bound on TPU, so halving the gathered bytes
-    (u8 code + bool vs f32) is the step's main gather lever — and this
-    is the reference's own production configuration, a 1-byte
-    fixing_float pull filter (example/linear/ctr/online_l1lr.conf).
-    Exactness-preserving: dequantize is elementwise with per-shard
-    scalar lo/hi, so dequantize(gather(q)) == gather(dequantize(q))
-    bit-for-bit, and the gathered zero-mask reproduces the exact-zero
-    rule."""
+    ``narrow`` gathers the quantized CODES plus a 1-byte zero-mask
+    and dequantizes AFTER the gather, instead of materializing and
+    gathering a dense f32 shard — the byte-economy instinct behind
+    the reference's production 1-byte fixing_float pull
+    (example/linear/ctr/online_l1lr.conf). MEASURED NEGATIVE on TPU
+    (BENCH_ONCHIP 08-02: u8+mask gather 23.6 ms vs f32 18.0 ms at
+    640k indices; bench `_q1` 585k vs 632k ex/s): v5e gathers are
+    row-granularity-bound, not byte-bound, so two narrow gathers lose
+    to one wide one. ``narrow=None`` therefore resolves to the WIDE
+    path for every width; narrow stays selectable
+    (``pull_gather: "narrow"``) for parts where bytes do bind.
+    Exactness-preserving either way: dequantize is elementwise with
+    per-shard scalar lo/hi, so dequantize(gather(q)) ==
+    gather(dequantize(q)) bit-for-bit, and the gathered zero-mask
+    reproduces the exact-zero rule."""
     perturb = _make_perturb(noise, 0xA015F)
 
     def wide_lookup(w, rel, ok):
@@ -612,7 +615,7 @@ def make_pull_lookup(updater, pull_quant: int, noise=None,
         return derive_plain, wide_lookup
 
     if narrow is None:
-        narrow = pull_quant == 1
+        narrow = False  # wide wins on TPU at every width (docstring)
     from ...filter.fixing_float import dequantize_jax, quantize_jax
     from ...ops import quantize as qops
 

@@ -62,11 +62,13 @@ class SGDConfig:
     push_filter: list = dataclasses.field(default_factory=list)
     pull_filter: list = dataclasses.field(default_factory=list)
     # TPU extensions
-    # pull-gather formulation for quantized pulls: "auto" (narrow iff
-    # the pull_filter is 1-byte FIXING_FLOAT — the reference's own
-    # production config, example/linear/ctr/online_l1lr.conf), or an
-    # explicit "narrow"/"wide". Narrow gathers the quantized codes +
-    # zero-mask and dequantizes post-gather; exactness-equal to wide.
+    # pull-gather formulation for quantized pulls: "auto" (resolves
+    # to wide — the on-chip A/B measured TPU gathers as row-
+    # granularity-bound, so the narrow codes+mask gather is SLOWER;
+    # BENCH_ONCHIP 08-02), or an explicit "narrow"/"wide". Narrow
+    # gathers the quantized codes + zero-mask and dequantizes
+    # post-gather; exactness-equal to wide, worth forcing only on
+    # parts where gathered bytes, not rows, bind.
     pull_gather: str = "auto"
     num_slots: int = 1 << 22  # hashed weight table size
     rows_pad: int = 0  # 0 = minibatch size

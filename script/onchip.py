@@ -68,9 +68,11 @@ TASKS = [
     # slow success must not be killed by its own timeout
     ("bench", [sys.executable, "bench.py"], 3600),
     # the reference's production pull config (1-byte fixing_float,
-    # example/linear/ctr/online_l1lr.conf): narrow codes+mask gather,
-    # the candidate for unthrottling the gather-bound step — captured
-    # under its own _q1 metric so headline medians stay exact-pull
+    # example/linear/ctr/online_l1lr.conf), captured under its own
+    # _q1 metric so headline medians stay exact-pull. The narrow
+    # codes+mask gather it was built to test measured SLOWER than
+    # wide on TPU (08-02 A/B), so auto now realizes this config as
+    # quantize → dequantize shard-wide → wide f32 gather
     ("bench_q1", [sys.executable, "bench.py", "--pull-bytes", "1"], 3600),
     ("lm", None, 5400),
     ("scale", None, 2400),
