@@ -1793,7 +1793,7 @@ def task_scale() -> int:
             # old table (up to 8.6 GB) is still alive while the new one
             # materializes — 2^29 + 800M together overflow a 16 GB chip
             # even though each fits alone
-            worker = subs = pend = None  # noqa: F841
+            worker = staged = pend = None  # noqa: F841
             gc.collect()
             Postoffice.reset()
             po = Postoffice.instance().start()
@@ -1817,34 +1817,51 @@ def task_scale() -> int:
                     np.random.default_rng(1).random(16384) - 0.5
                 ).astype(np.float32)
             worker._padding(raw[0])
-            subs = [
-                worker._submit_prepped(
-                    jax.device_put(worker.prep(b, device_put=False)),
-                    with_aux=False,
-                )
-                for b in raw
-            ]
-            for ts in subs:
-                worker.executor.wait(ts)
+            # pre-stage the batches ON DEVICE before the timed loop —
+            # the same device-only discipline as the headline bench.
+            # The first scale sessions uploaded each 2.2 MB wire batch
+            # INSIDE the loop, so step_ms tracked tunnel weather, not
+            # the table: three same-code 2^28 sessions drifted 86 →
+            # 146 → 206 ms as the link throttled (08-02), while a 2 GB
+            # dense FTRL pass is ~10 ms of device work. Batches are
+            # read-only to the step (donation applies to the table
+            # state), so resubmitting staged trees is sound.
+            from parameter_server_tpu.apps.linear.async_sgd import (
+                stack_bits_batches,
+            )
+
+            # stack the 4 minibatches into ONE scan superbatch (the
+            # headline bench's T lever): under per-step dispatch a
+            # ~75 ms/submit tunnel-RTT floor hid the table's actual
+            # cost — 2^29 timed IDENTICAL to 2^28 (76 vs 75 ms,
+            # interactive 08-02 session). _submit_prepped scan-steps
+            # a superbatch regardless of SGDConfig.steps_per_launch
+            staged = jax.device_put(stack_bits_batches(
+                [worker.prep(b, device_put=False) for b in raw]
+            ))
+            worker.executor.wait(
+                worker._submit_prepped(staged, with_aux=False)
+            )
             _flush(worker.state)
-            n = 12
+            n_launch = 3
             t0 = time.perf_counter()
             pend = []
-            for i in range(n):
+            for i in range(n_launch):
                 pend.append(
-                    worker._submit_prepped(
-                        jax.device_put(
-                            worker.prep(raw[i % 4], device_put=False)
-                        ),
-                        with_aux=False,
-                    )
+                    worker._submit_prepped(staged, with_aux=False)
                 )
                 if len(pend) > 2:
                     worker.executor.wait(pend.pop(0))
             for ts in pend:
                 worker.executor.wait(ts)
             _flush(worker.state)
-            sec = (time.perf_counter() - t0) / n
+            # divide by the launch's ACTUAL scan depth, not the
+            # config knob: _submit_prepped runs staged.steps
+            # ministeps regardless of steps_per_launch (only train()
+            # consumes the config), so the two could silently diverge
+            sec = (time.perf_counter() - t0) / (
+                n_launch * staged.steps
+            )
             stats = dev.memory_stats() or {}
             bytes_per_slot = 6 if state_dtype == "bfloat16" else 8
             emit(
