@@ -1650,6 +1650,36 @@ def task_gatherx() -> int:
             ),
             words,
         )
+    # dense-FTRL formulation A/B at BIG-table scale (runs once, not
+    # per size-loop): the 08-02 attribution session measured the
+    # Pallas update kernel at ~295 GB/s effective on a 2^28 table
+    # while the XLA dense derive hit ~770 (≈ peak) — if the pure-XLA
+    # update matches or beats the kernel at scale, the dense update
+    # should flip to XLA above a size threshold the way spmv stayed
+    # XLA by measurement. In-process block_rows variants compile
+    # ~30-40 s each through the remote-compile helper, so only the
+    # seeded default and one large block are swept.
+    from parameter_server_tpu.ops.ftrl import ftrl_update, ftrl_update_ref
+
+    S_big = 1 << 14 if SMOKE else 1 << 28
+    rngb = np.random.default_rng(3)
+    zb = jax.device_put(rngb.normal(size=S_big).astype(np.float32))
+    nb = jax.device_put((rngb.random(S_big) * 3).astype(np.float32))
+    gb = jax.device_put(np.zeros(S_big, np.float32))
+    for nm, fn in (
+        ("ftrl_dense_pallas_2e28",
+         lambda z, n, g: ftrl_update(
+             z, n, g, None, alpha=0.1, beta=1.0, l1=1.0)[0].sum()),
+        ("ftrl_dense_pallas_br32k_2e28",
+         lambda z, n, g: ftrl_update(
+             z, n, g, None, alpha=0.1, beta=1.0, l1=1.0,
+             block_rows=32768)[0].sum()),
+        ("ftrl_dense_xla_2e28",
+         lambda z, n, g: ftrl_update_ref(
+             z, n, g, None, alpha=0.1, beta=1.0, l1=1.0,
+             l2=0.0)[0].sum()),
+    ):
+        timed(nm, fn, zb, nb, gb)
     if skipped_fresh:
         emit({"metric": "gatherx_task_resume", "value": len(skipped_fresh),
               "unit": "variants_skipped_fresh", "skipped": skipped_fresh})
