@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -38,6 +39,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...learner.sgd import ISGDCompNode, ISGDScheduler, SGDProgress
+from ...ops.kv_ops import localize, slot_sentinel, valid_slots
 from ...parallel import mesh as meshlib
 from ...parallel.mesh import DATA_AXIS, SERVER_AXIS
 from ...system.message import Task
@@ -109,13 +111,112 @@ def prep_batch(
         rows[: local.nnz] = local.row_ids()
         ucols[: local.nnz] = local.indices
         vals[: local.nnz] = local.value_array()
-        uslots = np.full(uniq_pad, num_slots, np.int32)  # sentinel
+        uslots = np.full(uniq_pad, slot_sentinel(num_slots), np.int32)
         umask = np.zeros(uniq_pad, np.float32)
         uslots[: len(keys)] = directory.slots(keys)
         umask[: len(keys)] = 1.0
         shards.append((y, mask, rows, ucols, vals, uslots, umask))
     stack = [np.stack(x) for x in zip(*shards)]
     return PreppedBatch(*stack)
+
+
+def prep_batch_shared(
+    batch: SparseBatch,
+    directory,
+    num_shards: int,
+    rows_pad: int,
+    nnz_pad: int,
+    uniq_pad: int,
+    num_slots: int,
+) -> PreppedBatch:
+    """Globally-deduped prep for the sparse-update formulation: ONE
+    slot-unique table for the whole minibatch, replicated to every data
+    shard (identical ``uslots``/``umask`` rows), so the device step can
+    aggregate per-slot gradients with an elementwise data-axis psum and
+    scatter state rows back without cross-shard duplicates.
+
+    Dedup happens at SLOT level (after the directory hash), not key
+    level: two keys hash-colliding into one slot must have their
+    gradients summed before the nonlinear entry update — the same
+    aggregation the dense scatter-add performs implicitly. Vectorized
+    (unique + searchsorted), no per-shard Localizer sort."""
+    keys_all = np.unique(np.asarray(batch.indices))
+    slots_of_key = directory.slots(keys_all)
+    uniq_slots, key_to_ucol = np.unique(slots_of_key, return_inverse=True)
+    u = len(uniq_slots)
+    if u > uniq_pad:
+        raise ValueError(f"batch exceeds padding: uniq {u}>{uniq_pad}")
+    uslots = np.full(uniq_pad, slot_sentinel(num_slots), np.int32)
+    uslots[:u] = uniq_slots
+    umask = np.zeros(uniq_pad, np.float32)
+    umask[:u] = 1.0
+    key_to_ucol = key_to_ucol.astype(np.int32)
+
+    shards = []
+    per = -(-batch.n // num_shards)
+    for d in range(num_shards):
+        lo_r = min(d * per, batch.n)
+        hi_r = min((d + 1) * per, batch.n)
+        lo, hi = batch.indptr[lo_r], batch.indptr[hi_r]
+        nsub, nnz = hi_r - lo_r, hi - lo
+        if nnz > nnz_pad or nsub > rows_pad:
+            raise ValueError(
+                f"batch exceeds padding: nnz {nnz}>{nnz_pad} or "
+                f"rows {nsub}>{rows_pad}"
+            )
+        y = np.zeros(rows_pad, np.float32)
+        y[:nsub] = batch.y[lo_r:hi_r]
+        mask = np.zeros(rows_pad, np.float32)
+        mask[:nsub] = 1.0
+        counts = np.diff(batch.indptr[lo_r : hi_r + 1])
+        rows = np.zeros(nnz_pad, np.int32)
+        rows[:nnz] = np.repeat(np.arange(nsub, dtype=np.int32), counts)
+        ucols = np.zeros(nnz_pad, np.int32)
+        ucols[:nnz] = key_to_ucol[
+            np.searchsorted(keys_all, batch.indices[lo:hi])
+        ]
+        vals = np.zeros(nnz_pad, np.float32)
+        vals[:nnz] = batch.values[lo:hi] if not batch.binary else 1.0
+        shards.append((y, mask, rows, ucols, vals, uslots, umask))
+    stack = [np.stack(x) for x in zip(*shards)]
+    return PreppedBatch(*stack)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PreppedSuperBatch:
+    """T stacked PreppedBatches — the exact wire's scan superbatch
+    (fields [T, D, ...]; one device launch scans T sequential
+    ministeps, the ELLBitsSuperBatch twin for the dedup wire)."""
+
+    y: np.ndarray
+    mask: np.ndarray
+    rows: np.ndarray
+    ucols: np.ndarray
+    vals: np.ndarray
+    uslots: np.ndarray
+    umask: np.ndarray
+
+    @property
+    def steps(self) -> int:
+        return int(self.y.shape[0])
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.mask.sum())
+
+
+def stack_prepped_batches(batches: "List[PreppedBatch]") -> PreppedSuperBatch:
+    """Stack T localized exact-wire minibatches along a new leading T
+    axis for one scan-fused launch."""
+    if not batches:
+        raise ValueError("empty superbatch")
+    return PreppedSuperBatch(
+        *(
+            np.stack([getattr(b, f.name) for b in batches])
+            for f in dataclasses.fields(PreppedBatch)
+        )
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -164,7 +265,7 @@ def prep_batch_hashed(
         counts = np.diff(batch.indptr[lo_r : hi_r + 1])
         rows = np.zeros(nnz_pad, np.int32)
         rows[:nnz] = np.repeat(np.arange(nsub, dtype=np.int32), counts)
-        slots = np.full(nnz_pad, num_slots, np.int32)
+        slots = np.full(nnz_pad, slot_sentinel(num_slots), np.int32)
         slots[:nnz] = directory.slots(batch.indices[lo:hi])
         vals = np.zeros(nnz_pad, np.float32)
         vals[:nnz] = (
@@ -352,7 +453,7 @@ def prep_batch_ell(
             )
             shards.append((y, mask, slots, vals))
             continue
-        slots = np.full((rows_pad, lanes), num_slots, np.int32)
+        slots = np.full((rows_pad, lanes), slot_sentinel(num_slots), np.int32)
         vals = None if binary else np.zeros((rows_pad, lanes), np.float32)
         if uniform:
             # uniform rows (fixed-width data): ELL packing is a reshape
@@ -741,9 +842,7 @@ def make_train_step_ell(
             mask = mask.astype(jnp.float32)
             slots = unpack_u24(slots)
         flat = slots.reshape(-1)
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel = jnp.clip(flat - lo, 0, shard - 1)
-        ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
+        rel, ok = localize(flat, shard)
 
         # pull: each server derives (and optionally quantizes) its
         # representation once, workers gather entries + assemble via psum
@@ -756,7 +855,7 @@ def make_train_step_ell(
 
         gr = loss.row_grad(y, xw) * mask  # [R]
         g_e = gr[:, None] if binary else gr[:, None] * vals  # [R, K]
-        valid = (slots < num_slots) if binary else (vals != 0)
+        valid = valid_slots(slots, num_slots) if binary else (vals != 0)
         g_flat = jnp.where(valid, g_e, 0.0).reshape(-1)
 
         g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
@@ -812,10 +911,8 @@ def _make_bits_mini_step(
             slots = unpack_bits(words, rows * lanes, bits).reshape(rows, lanes)
             # slot-localization arithmetic belongs to decode: it turns
             # wire slots into shard-relative gather indices
-            lo = jax.lax.axis_index(SERVER_AXIS) * shard
             flat = slots.reshape(-1)
-            rel = jnp.clip(flat - lo, 0, shard - 1)
-            ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
+            rel, ok = localize(flat, shard)
 
         with jax.named_scope("ps_pull"):
             w_rep = pull_derive(pulled, seed)
@@ -980,9 +1077,7 @@ def make_train_step_hashed(
 
     def local_step(live, pulled, seed, y, mask, rows, slots, vals):
         y, mask, rows, slots, vals = y[0], mask[0], rows[0], slots[0], vals[0]
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel = jnp.clip(slots - lo, 0, shard - 1)
-        ok = ((slots - lo) >= 0) & ((slots - lo) < shard)
+        rel, ok = localize(slots, shard)
 
         # sentinel/padding slots are owned by no shard -> gathered weight 0,
         # and their vals are 0, so they vanish from Xw and g
@@ -1030,30 +1125,101 @@ def make_train_step_hashed(
     return _donation_variants(step_impl)
 
 
-def make_train_step(
-    updater, loss, mesh, num_slots: int, with_aux: bool = True,
-    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
-    pull_noise=None, pull_narrow: "bool | None" = None,
+def sparse_update_min_slots() -> int:
+    """``SGDConfig.update="auto"`` flip point, in PER-SERVER shard
+    slots: below it the dense sweep wins (the whole-shard Pallas pass
+    is cheap — 2^28 trains at 446k ex/s); at and above it the
+    gather→apply→scatter row formulation wins (the sweep alone costs
+    ~130 ms at 2^30 while four 640k-row gathers/scatters cost ~80 ms,
+    BENCH_ONCHIP component medians) — and 2^31 REQUIRES it (the dense
+    gradient temp alone is 8.6 GB). Env ``PS_SPARSE_UPDATE_MIN_SLOTS``
+    overrides while on-chip captures refine the default."""
+    try:
+        return int(os.environ.get("PS_SPARSE_UPDATE_MIN_SLOTS", 1 << 30))
+    except ValueError:
+        return 1 << 30
+
+
+def _make_exact_mini_step(
+    updater, loss, shard, with_aux, update, push_quant, pull_quant,
+    push_noise, pull_noise, pull_narrow,
 ):
-    """Build the fused SPMD train step. Returns jitted
-    ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
+    """Shared single-minibatch body for the exact (host-dedup) wire:
+    (live, pulled, seed, per-device y/mask/rows/ucols/vals/uslots/umask)
+    -> (state, metrics). Two update formulations:
+
+    - ``"dense"``: scatter per-unique gradients into a dense shard
+      vector, psum over the data axis (inside push_reduce), run the
+      updater over the WHOLE shard with a touched mask. O(shard) HBM
+      traffic per ministep — wins while the table sweep is cheap.
+    - ``"sparse"``: psum the per-unique-slot gradients directly (prep
+      guarantees every data shard carries the SAME globally-deduped
+      ``uslots``, so the psum is elementwise-aligned), then
+      gather→apply→scatter only the touched rows
+      (updaters.apply_state_rows). O(unique) traffic — the 2^30+/2^31
+      formulation, and the only one that fits 2^31 on one chip (no
+      dense gradient temp). The reference's servers likewise only run
+      entry ``Set`` on received keys (async_sgd.h:131-151).
+
+    The sparse form composes with the EXACT wire only: quantized/noisy
+    push/pull filters are defined on dense shard vectors (per-shard
+    scale factors), so they stay with ``"dense"``.
     """
-    n_server = meshlib.num_servers(mesh)
-    shard = num_slots // n_server
+    if update == "sparse":
+        if push_quant or pull_quant or push_noise or pull_noise:
+            raise ValueError(
+                "update='sparse' composes with the exact (unfiltered) "
+                "wire only; quantized/noisy filters need update='dense'"
+            )
+        from .updaters import apply_state_rows
+
+        def mini_step_sparse(live, pulled, seed, y, mask, rows, ucols,
+                             vals, uslots, umask):
+            rel, ok = localize(uslots, shard)
+            with jax.named_scope("ps_pull"):
+                # derive weights from the GATHERED rows of the pull
+                # state — no whole-table weight derivation. Exact:
+                # updater.weights is elementwise, so gather∘derive ==
+                # derive∘gather bit-for-bit.
+                pulled_u = jax.tree.map(
+                    lambda a: a[rel] if a.ndim >= 1 else a, pulled
+                )
+                w_own = jnp.where(ok, updater.weights(pulled_u), 0.0)
+                w_u = jax.lax.psum(w_own, SERVER_AXIS) * umask
+            with jax.named_scope("ps_compute"):
+                xw = jax.ops.segment_sum(
+                    vals * w_u[ucols], rows, num_segments=y.shape[0]
+                )
+                gr = loss.row_grad(y, xw) * mask
+                g_u = jax.ops.segment_sum(
+                    vals * gr[rows], ucols, num_segments=uslots.shape[0]
+                )
+                g_u = g_u * umask
+            with jax.named_scope("ps_push"):
+                # workers share one global uslots table, so gradient
+                # aggregation is an elementwise psum of the U-vector —
+                # no dense scatter, no shard-sized temp
+                g_u = jax.lax.psum(g_u, DATA_AXIS)
+            with jax.named_scope("ps_update"):
+                new_state = apply_state_rows(
+                    updater, live, rel, ok, g_u, seed=seed
+                )
+            with jax.named_scope("ps_metrics"):
+                metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+            return new_state, metrics
+
+        return mini_step_sparse
+
+    if update != "dense":
+        raise ValueError(f"unknown update mode {update!r}")
     push_touched = make_push_touched(push_quant, noise=push_noise)
     pull_derive, pull_lookup = make_pull_lookup(
         updater, pull_quant, noise=pull_noise, narrow=pull_narrow
     )
 
-    def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
-        # squeeze the per-shard leading dim added by stacking
-        y, mask = y[0], mask[0]
-        rows, ucols, vals = rows[0], ucols[0], vals[0]
-        uslots, umask = uslots[0], umask[0]
-
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel = jnp.clip(uslots - lo, 0, shard - 1)
-        ok = ((uslots - lo) >= 0) & ((uslots - lo) < shard)
+    def mini_step(live, pulled, seed, y, mask, rows, ucols, vals,
+                  uslots, umask):
+        rel, ok = localize(uslots, shard)
 
         # named_scope: phase names reach HLO op metadata, so a
         # --profile trace (utils/profiling.summarize_trace) can bucket
@@ -1093,6 +1259,113 @@ def make_train_step(
         with jax.named_scope("ps_metrics"):
             metrics = _progress_metrics(loss, y, xw, mask, with_aux)
         return new_state, metrics
+
+    return mini_step
+
+
+def make_train_step_scan(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
+    update: str = "dense",
+):
+    """Scan-fused superstep over the exact wire: T host-dedup'd
+    minibatches per launch (the PreppedSuperBatch twin of
+    make_train_step_ell_bits_scan — one dispatch/transfer round trip
+    for T sequential ministeps, weights advancing every ministep)."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_exact_mini_step(
+        updater, loss, shard, with_aux, update, push_quant, pull_quant,
+        push_noise, pull_noise, pull_narrow,
+    )
+
+    def local_step(live, pulled, seed, y, mask, rows, ucols, vals,
+                   uslots, umask):
+        del pulled  # staleness 0 inside the superstep (≤ any delay bound)
+        t_steps = y.shape[0]
+
+        def body(carry, xs):
+            state, i = carry
+            yb, mb, rb, ub, vb, usb, umb = xs
+            new_state, metrics = mini_step(
+                state, state, seed + i, yb[0], mb[0], rb[0], ub[0],
+                vb[0], usb[0], umb[0],
+            )
+            return (new_state, i + np.uint32(1)), metrics
+
+        (new_state, _), metrics = jax.lax.scan(
+            body, (live, np.uint32(0)),
+            (y, mask, rows, ucols, vals, uslots, umask),
+            length=t_steps,
+        )
+        if not with_aux:
+            metrics = jax.tree.map(lambda m: m.sum(axis=0), metrics)
+        else:
+            # scalars fold; per-example aux stays stacked per ministep
+            metrics = {
+                k: (v.sum(axis=0) if v.ndim == 1 else v)
+                for k, v in metrics.items()
+            }
+        return new_state, metrics
+
+    def state_spec(state):
+        return jax.tree.map(
+            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
+        )
+
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = state_spec(live_state)
+        batch_specs = tuple(P(None, DATA_AXIS) for _ in range(7))
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), *batch_specs),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(
+            live_state,
+            pull_state,
+            seed,
+            batch.y,
+            batch.mask,
+            batch.rows,
+            batch.ucols,
+            batch.vals,
+            batch.uslots,
+            batch.umask,
+        )
+
+    return _donation_variants(step_impl)
+
+
+def make_train_step(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
+    update: str = "dense",
+):
+    """Build the fused SPMD train step. Returns jitted
+    ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
+
+    ``update="sparse"`` swaps the dense scatter+whole-shard sweep for
+    the gather→apply→scatter row formulation (see
+    updaters.apply_state_rows) — the big-table mode the scale captures
+    flip to above ``sparse_update_min_slots``.
+    """
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_exact_mini_step(
+        updater, loss, shard, with_aux, update, push_quant, pull_quant,
+        push_noise, pull_noise, pull_narrow,
+    )
+
+    def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
+        # squeeze the per-shard leading dim added by stacking
+        return mini_step(
+            live, pulled, seed, y[0], mask[0], rows[0], ucols[0],
+            vals[0], uslots[0], umask[0],
+        )
 
     def state_spec(state):
         return jax.tree.map(
@@ -1229,6 +1502,7 @@ class AsyncSGDWorker(ISGDCompNode):
         self._warned_ell_overflow = False
         self._warned_scan_fallback = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
+        self._update_mode = self._resolve_update_mode(sgd)
         # the hash modulus is the CONFIGURED slot count, not the padded
         # table size: padding depends on the server count, and keys must
         # keep their slots across elastic resizes (the reference's key
@@ -1271,6 +1545,44 @@ class AsyncSGDWorker(ISGDCompNode):
         self._pads: Optional[Tuple[int, int, int]] = None
         self._num_shards_cache: Optional[int] = None
         self.progress = SGDProgress()
+
+    def _resolve_update_mode(self, sgd: SGDConfig) -> str:
+        """``SGDConfig.update`` → concrete formulation. "auto" flips to
+        sparse at big per-server shards (sparse_update_min_slots)
+        unless push/pull filters are configured — those are defined on
+        dense shard vectors, so auto quietly stays dense; an EXPLICIT
+        "sparse" + filters is a config error (raised in the builder)."""
+        mode = sgd.update or "auto"
+        if mode not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                f"unknown SGDConfig.update {mode!r}; expected "
+                "'auto', 'dense', or 'sparse'"
+            )
+        filtered = bool(
+            self._push_quant or self._pull_quant
+            or self._push_noise or self._pull_noise
+        )
+        from ...parallel import distributed
+
+        multi = distributed.is_multiprocess()
+        if mode == "auto":
+            shard = self.num_slots // meshlib.num_servers(self.mesh)
+            if (
+                shard >= sparse_update_min_slots()
+                and not filtered
+                and not multi
+            ):
+                return "sparse"
+            return "dense"
+        if mode == "sparse" and multi:
+            # each host preps its own data partition, so hosts would
+            # build DIFFERENT global-unique slot tables and the
+            # elementwise gradient psum would misalign
+            raise ValueError(
+                "update='sparse' is single-process for now; multi-host "
+                "big tables shard the dense update over servers instead"
+            )
+        return mode
 
     def _num_shards(self) -> int:
         """Data shards THIS process preps. Single-process: the whole data
@@ -1329,13 +1641,30 @@ class AsyncSGDWorker(ISGDCompNode):
         axis sits at dim 1 for scan superbatches, after the T axis)."""
         from ...parallel import distributed
 
-        axis_dim = 1 if isinstance(prepped, ELLBitsSuperBatch) else 0
+        axis_dim = (
+            1
+            if isinstance(prepped, (ELLBitsSuperBatch, PreppedSuperBatch))
+            else 0
+        )
         return distributed.global_from_local(self.mesh, prepped, axis_dim=axis_dim)
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
         rows_pad, nnz_pad, uniq_pad = self._padding(batch)
         num_shards = self._num_shards()
+        if self._update_mode == "sparse":
+            # the sparse row-update needs globally slot-unique batches
+            # (scatter-set correctness) — one shared dedup table for
+            # all data shards, regardless of wire/ELL settings. Padded
+            # to a (8,128)-tileable length so the row-apply can take
+            # the Pallas kernel.
+            uniq = min(nnz_pad * num_shards, self.num_slots)
+            uniq = -(-uniq // 1024) * 1024
+            out = prep_batch_shared(
+                batch, self.directory, num_shards, rows_pad, nnz_pad,
+                uniq, self.num_slots,
+            )
+            return self.upload(out) if device_put else out
         out = None
         use_ell = self.sgd.ell_lanes > 0 and self.directory.hashed
         if use_ell and batch.n:
@@ -1421,7 +1750,16 @@ class AsyncSGDWorker(ISGDCompNode):
         return self.upload(out) if device_put else out
 
     def _get_step(self, prepped, with_aux: bool):
-        if isinstance(prepped, ELLBitsSuperBatch):
+        if isinstance(prepped, PreppedSuperBatch):
+            key = ("exact_scan", (prepped.steps, self._update_mode), with_aux)
+            builder = lambda: make_train_step_scan(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                with_aux=with_aux, push_quant=self._push_quant,
+                pull_quant=self._pull_quant, push_noise=self._push_noise,
+                pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
+                update=self._update_mode,
+            )
+        elif isinstance(prepped, ELLBitsSuperBatch):
             key = ("ell_bits_scan", (prepped.rows, prepped.steps), with_aux)
             builder = lambda: make_train_step_ell_bits_scan(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
@@ -1459,13 +1797,14 @@ class AsyncSGDWorker(ISGDCompNode):
                 pull_narrow=self._pull_narrow,
             )
         else:
-            key = ("exact", False, with_aux)
+            key = ("exact", self._update_mode, with_aux)
             builder = lambda: make_train_step(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise,
                 pull_narrow=self._pull_narrow,
+                update=self._update_mode,
             )
         if key not in self._steps:
             self._steps[key] = builder()
@@ -1488,7 +1827,11 @@ class AsyncSGDWorker(ISGDCompNode):
         tau = self.sgd.max_delay
         # a scan superbatch advances the weights n_steps times in one
         # submission (staleness 0 inside it — within any delay bound)
-        n_steps = prepped.steps if isinstance(prepped, ELLBitsSuperBatch) else 1
+        n_steps = (
+            prepped.steps
+            if isinstance(prepped, (ELLBitsSuperBatch, PreppedSuperBatch))
+            else 1
+        )
         # snapshot *scheduling* happens at submit time (deterministic in
         # submission order), but the snapshot itself must be taken when the
         # step RUNS on the executor's dispatch thread — self.state is only
@@ -1545,12 +1888,19 @@ class AsyncSGDWorker(ISGDCompNode):
         raises on ineligible batches (the training loop's submit_group is
         the tolerant variant)."""
         prepped = [self.prep(b, device_put=False) for b in batches]
-        if not all(isinstance(p, ELLBitsBatch) for p in prepped):
-            raise ValueError(
-                "superbatch needs the bits wire (hashed directory, binary "
-                "uniform-row batches); got a fallback encoding"
+        if all(isinstance(p, ELLBitsBatch) for p in prepped):
+            return self._submit_fused(prepped, with_aux)
+        if all(isinstance(p, PreppedBatch) for p in prepped):
+            # exact-wire superbatch (the sparse-update big-table path)
+            return self._submit_prepped(
+                self.upload(stack_prepped_batches(prepped)),
+                with_aux=with_aux,
             )
-        return self._submit_fused(prepped, with_aux)
+        raise ValueError(
+            "superbatch needs the bits wire (hashed directory, binary "
+            "uniform-row batches) or the exact wire (sparse-update "
+            "mode); got a mixed/fallback encoding"
+        )
 
     def _prep_group(self, batches: List[SparseBatch]):
         """Host side of tolerant grouping (prep + stack, no device
@@ -1562,6 +1912,12 @@ class AsyncSGDWorker(ISGDCompNode):
             isinstance(p, ELLBitsBatch) for p in prepped
         ):
             return [(stack_bits_batches(prepped), len(prepped))]
+        if len(prepped) > 1 and all(
+            isinstance(p, PreppedBatch) for p in prepped
+        ):
+            # the exact wire scan-fuses too (sparse-update mode preps
+            # PreppedBatches regardless of the configured wire)
+            return [(stack_prepped_batches(prepped), len(prepped))]
         if len(prepped) > 1 and not self._warned_scan_fallback:
             import logging
 

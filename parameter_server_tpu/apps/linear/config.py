@@ -103,6 +103,16 @@ class SGDConfig:
     # convergence holds to ~1e-3 logloss (tested) while z — the actual
     # model accumulator — stays f32
     ftrl_state_dtype: str = "float32"
+    # server-update formulation: "dense" (scatter + whole-shard sweep,
+    # wins at small tables), "sparse" (gather→apply→scatter only the
+    # batch's unique slots — O(touched) HBM traffic instead of
+    # O(shard); the 2^30+ mode, and the only one whose 2^31 table fits
+    # one chip), or "auto" (sparse iff the per-server shard is ≥
+    # PS_SPARSE_UPDATE_MIN_SLOTS, default 2^30 — set from the on-chip
+    # dense-sweep vs gather/scatter measurements). Sparse runs on the
+    # exact wire (host-dedup'd slots) and composes with unfiltered
+    # push/pull only.
+    update: str = "auto"
 
 
 @dataclasses.dataclass

@@ -38,6 +38,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...learner.sgd import ISGDCompNode, SGDProgress
+from ...ops.kv_ops import localize, valid_slots
 from ...parallel import mesh as meshlib
 from ...parallel.mesh import DATA_AXIS, SERVER_AXIS
 from ...parameter.parameter import KeyDirectory, pad_slots
@@ -78,9 +79,7 @@ def make_deep_ctr_step(
     def local_step(state, y, mask, slots):
         y, mask, slots = y[0], mask[0], slots[0]  # [R], [R], [R, K]
         flat = slots.reshape(-1)
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel = jnp.clip(flat - lo, 0, shard - 1)
-        ok = ((flat - lo) >= 0) & ((flat - lo) < shard)
+        rel, ok = localize(flat, shard)
 
         # -- pull: gather w and V entries from the owning shard --
         w_e = jax.lax.psum(
@@ -89,7 +88,7 @@ def make_deep_ctr_step(
         v_e = jax.lax.psum(
             jnp.where(ok[:, None], state["table"]["v"][rel], 0.0), SERVER_AXIS
         ).reshape(slots.shape + (k,))  # [R, K, k]
-        live = (slots < num_slots).astype(jnp.float32)  # sentinel lanes -> 0
+        live = valid_slots(slots, num_slots).astype(jnp.float32)  # sentinels -> 0
         mlp = state["mlp"]
 
         def fwd(v_e, mlp):

@@ -153,6 +153,48 @@ class SGDUpdater:
         return {"w": jnp.where(touched, w, state["w"]), "t": t}
 
 
+def apply_state_rows(updater, state, rel, ok, g_u, seed=None):
+    """Sparse-touched update: run ``updater.apply`` on just the gathered
+    rows ``rel`` of a server shard and scatter the results back.
+
+    The big-table formulation — the reference's servers only ever run
+    the per-key entry ``Set`` on RECEIVED keys (async_sgd.h:131-151,
+    kv_map's per-message loop); the dense whole-shard sweep is the
+    TPU-friendly variant that wins at small tables, but per ministep it
+    moves O(shard) HBM traffic and needs a dense gradient temp — at
+    2^30 slots that sweep is ~130 ms and at 2^31 the f32 temp alone
+    (8.6 GB) pushes the table off-chip. This form moves
+    O(unique-touched) state instead: gather the touched rows, update
+    them with the SAME per-row math (so every updater and the Pallas
+    FTRL kernel apply unchanged), scatter the new rows back.
+
+    ``rel`` must be unique among ``ok`` entries — host prep dedups at
+    slot level (hash collisions included) because the update is
+    nonlinear in the summed gradient. Non-owned/padding entries
+    (``ok`` False) are routed to the one-past-the-end row in UNSIGNED
+    index space and dropped by the scatter (``mode='drop'``): a signed
+    -1 would WRAP to the shard's real last row and scatter-set a stale
+    value over its genuine update (observed: the last slot of every
+    shard losing its step), and uint32 both never wraps and still
+    represents one-past-end for the maximal 2^31-row shard. Their
+    gradient is zeroed so the rows they DO gather (clipped indices)
+    can't perturb anything. Scalar state leaves (e.g. SGDUpdater's
+    step count) take the updated value directly — there is nothing to
+    scatter.
+    """
+    state_u = jax.tree.map(lambda a: a[rel] if a.ndim >= 1 else a, state)
+    new_u = updater.apply(state_u, jnp.where(ok, g_u, 0.0), None, seed=seed)
+    rel_u32 = rel.astype(jnp.uint32)
+
+    def _scatter(full, new_leaf):
+        if full.ndim < 1:
+            return new_leaf
+        oob = jnp.where(ok, rel_u32, jnp.uint32(full.shape[0]))
+        return full.at[oob].set(new_leaf.astype(full.dtype), mode="drop")
+
+    return jax.tree.map(_scatter, state, new_u)
+
+
 def create_updater(algo: str, ada_grad: bool, lr: LearningRate,
                    penalty: ElasticNet, ftrl_state_dtype: str = "float32"):
     """ref AsyncSGDServer ctor dispatch (async_sgd.h:46-58)."""

@@ -34,6 +34,43 @@ def _use_pallas() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+def xla_min_slots() -> int:
+    """Dense-update formulation flip point, in slots — DISABLED by
+    default (2^62 ≈ never) because the honest A/B says the Pallas
+    kernel wins at every size. Measurement history, kept because the
+    wrong version is instructive: a single-pass non-donated A/B
+    (BENCH_ONCHIP 2026-08-02 16:12) showed XLA 17.8 ms vs Pallas
+    29.3 ms at 2^28 — but that form charges the Pallas arm defensive
+    whole-table copies for its input_output_aliases (the ftrl_update
+    docstring's own warning) and buries both arms under a ~14.5 ms
+    dispatch floor. The corrected 8-deep in-program chain
+    (ftrl_dense_*_chain_* captures, 16:54) has Pallas AHEAD at every
+    size: 2.82 vs 3.05 ms at 2^25 through 10.82 vs 12.81 ms at 2^28.
+    Env ``PS_FTRL_XLA_MIN_SLOTS`` remains as the sweep override; the
+    value is baked at trace time per shape (jit static caching)."""
+    try:
+        return int(os.environ.get("PS_FTRL_XLA_MIN_SLOTS", 1 << 62))
+    except ValueError:
+        return 1 << 62
+
+
+def use_ref_path(p: int, bf16_n: bool, has_seed: bool,
+                 force_pallas: bool) -> bool:
+    """Pure path-selection predicate for ``ftrl_update`` (testable off
+    device): the jnp/XLA reference path runs off-TPU, for non-tileable
+    shards, for an unseeded bf16 narrow, and — by measurement — for
+    big tables (``xla_min_slots``). ``force_pallas`` pins the kernel
+    for A/B sweeps and kernel tests, but never onto a shard the kernel
+    cannot tile or narrow correctly."""
+    if not force_pallas and not _use_pallas():
+        return True
+    if p % _TILE != 0 or (bf16_n and not has_seed):
+        return True
+    if force_pallas:
+        return False
+    return p >= xla_min_slots()
+
+
 def stochastic_round_bf16(x: jnp.ndarray, seed) -> jnp.ndarray:
     """Unbiased f32 -> bf16 narrowing (jnp path): add hash-derived
     uniform dither in [0, 2^16) to the f32 bits, then truncate the low
@@ -248,11 +285,8 @@ def ftrl_update(
     """
     p = z.shape[0]
     bf16_n = sqrt_n.dtype == jnp.bfloat16
-    if (
-        not (force_pallas or _use_pallas())
-        or z.ndim != 1
-        or p % _TILE != 0
-        or (bf16_n and seed is None)
+    if z.ndim != 1 or use_ref_path(
+        p, bf16_n, seed is not None, force_pallas
     ):
         return ftrl_update_ref(
             z, sqrt_n, grad,

@@ -32,11 +32,47 @@ from jax import shard_map
 from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
 
 
-def _owned(idx: jnp.ndarray, lo: jnp.ndarray, shard: int):
-    """relative index + ownership mask for a server shard [lo, lo+shard)."""
+def localize(idx: jnp.ndarray, shard: int):
+    """Shard-relative index + ownership mask for this server's key range.
+
+    Computes ``lo = axis_index(server) * shard`` internally, so it must be
+    called inside a ``shard_map`` over SERVER_AXIS. int32-safe up to
+    ``shard == 2**31``: a single-server 2^31-slot table's ids occupy the
+    whole non-negative int32 lattice, but the Python constant ``2**31``
+    overflows jnp's operand parsing (jnp ops are jitted; an int operand
+    above int32max raises OverflowError before tracing), so the one-shard
+    case short-circuits to ``lo = 0`` and masks sentinels by sign alone —
+    any padding/foreign id is negative there (see ``slot_sentinel``).
+    """
+    if shard > (1 << 31):
+        raise ValueError(
+            f"shard of {shard} slots exceeds int32 slot ids; "
+            "spread the table over more server shards"
+        )
+    if shard == (1 << 31):
+        ok = idx >= 0
+        return jnp.clip(idx, 0, (1 << 31) - 1), ok
+    lo = jax.lax.axis_index(SERVER_AXIS) * shard
     rel = idx - lo
     ok = (rel >= 0) & (rel < shard)
     return jnp.clip(rel, 0, shard - 1), ok
+
+
+def slot_sentinel(num_slots: int) -> int:
+    """Padding slot id for host-side preps: one-past-the-end when that
+    fits int32 (the documented sentinel), else -1 — a 2^31-slot table's
+    ``num_slots`` overflows np.int32, and any un-owned id works because
+    every shard's ownership mask (``localize``) drops it."""
+    return num_slots if num_slots < (1 << 31) else -1
+
+
+def valid_slots(slots: jnp.ndarray, num_slots: int) -> jnp.ndarray:
+    """Mask of non-sentinel slot ids, int32-safe at ``num_slots == 2**31``
+    (where the sentinel is -1 and the comparison against ``num_slots``
+    would overflow operand parsing)."""
+    if num_slots >= (1 << 31):
+        return slots >= 0
+    return slots < num_slots
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))
@@ -53,8 +89,7 @@ def pull(table: jax.Array, idx: jax.Array, *, mesh: Mesh, batch_sharded: bool = 
     idx_spec = P(DATA_AXIS) if batch_sharded else P()
 
     def local(tbl, ix):
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel, ok = _owned(ix, lo, shard)
+        rel, ok = localize(ix, shard)
         vals = jnp.where(ok[:, None], tbl[rel], 0)
         return jax.lax.psum(vals, SERVER_AXIS)
 
@@ -102,8 +137,7 @@ def push(
         if average and combined:
             # average only when contributions were actually combined
             v = v / n_data
-        lo = jax.lax.axis_index(SERVER_AXIS) * shard
-        rel, ok = _owned(ix, lo, shard)
+        rel, ok = localize(ix, shard)
         v = jnp.where(ok[:, None], v, 0)
         return tbl.at[rel].add(v, mode="drop")
 

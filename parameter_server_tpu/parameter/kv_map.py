@@ -30,6 +30,7 @@ from ..utils import file as psfile
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..ops.kv_ops import localize
 from ..parallel import mesh as meshlib
 from ..parallel.mesh import SERVER_AXIS
 from ..system.message import Task
@@ -116,9 +117,7 @@ class KVMap(Parameter):
         entry = self.entry
 
         def local(state, ix, v):
-            lo = jax.lax.axis_index(SERVER_AXIS) * shard
-            rel = jnp.clip(ix - lo, 0, shard - 1)
-            ok = ((ix - lo) >= 0) & ((ix - lo) < shard)
+            rel, ok = localize(ix, shard)
             g = jnp.zeros((shard, v.shape[-1]), v.dtype)
             g = g.at[rel].add(jnp.where(ok[:, None], v, 0))
             touched = jnp.zeros((shard,), jnp.bool_).at[rel].max(ok)

@@ -278,3 +278,29 @@ def test_ftrl_block_rows_knob_is_math_invariant(monkeypatch):
     assert _choose_block_rows(4096) == 512         # env honored
     monkeypatch.setenv("PS_FTRL_BLOCK_ROWS", "bogus")
     assert _choose_block_rows(4096) == 2048        # bad env falls back
+
+
+def test_ftrl_path_selection_predicate(monkeypatch):
+    """Path selection is a pure predicate: Pallas everywhere by default
+    (the corrected chained A/B has the kernel ahead at every size —
+    see ops.ftrl.xla_min_slots), the XLA path only via the env sweep
+    knob, and force_pallas pinning the kernel except where it cannot
+    run (misaligned tile, unseeded bf16 narrow)."""
+    from parameter_server_tpu.ops import ftrl
+
+    monkeypatch.setattr(ftrl, "_use_pallas", lambda: True)
+    assert not ftrl.use_ref_path(1 << 20, False, False, False)
+    assert not ftrl.use_ref_path(1 << 28, False, False, False)
+    assert not ftrl.use_ref_path(1 << 30, True, True, False)
+    # correctness gates hold regardless of force_pallas
+    assert ftrl.use_ref_path((1 << 20) + 8, False, False, True)  # tile
+    assert ftrl.use_ref_path(1 << 20, True, False, True)  # unseeded bf16
+    # off-TPU always ref unless forced
+    monkeypatch.setattr(ftrl, "_use_pallas", lambda: False)
+    assert ftrl.use_ref_path(1 << 20, False, False, False)
+    # env override enables the flip for crossover sweeps
+    monkeypatch.setattr(ftrl, "_use_pallas", lambda: True)
+    monkeypatch.setenv("PS_FTRL_XLA_MIN_SLOTS", str(1 << 16))
+    assert ftrl.use_ref_path(1 << 16, False, False, False)
+    assert not ftrl.use_ref_path(1 << 15, False, False, False)
+    assert not ftrl.use_ref_path(1 << 16, False, False, True)  # forced

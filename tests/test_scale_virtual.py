@@ -108,3 +108,99 @@ def test_training_step_2e28(mesh8):
     ev = worker.evaluate(random_sparse(1000, 512, 8, seed=99, w_true=w_true))
     assert np.isfinite(ev["logloss"])
     assert ev["auc"] > 0.6  # it actually learns against the 2^28 table
+
+
+class TestInt32Boundary:
+    """2^31-slot addressing: slot ids occupy the full non-negative int32
+    lattice, so every Python-int operand derived from ``num_slots`` (the
+    ``axis_index * shard`` localization, the one-past-the-end sentinel,
+    the ``slots < num_slots`` valid mask) overflows jnp/np int32 parsing
+    at exactly this size. These tests pin the int32-safe forms without
+    allocating any table (the 2^31 SPEED capture is script/onchip.py's
+    ``2e31_bf16n_sparse`` on-chip task)."""
+
+    def test_localize_one_shard_2e31(self):
+        import jax
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.ops.kv_ops import localize
+
+        ids = jnp.array([0, 5, (1 << 31) - 1, -1], jnp.int32)
+        rel, ok = jax.jit(lambda i: localize(i, 1 << 31))(ids)
+        np.testing.assert_array_equal(
+            np.asarray(rel), [0, 5, (1 << 31) - 1, 0]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ok), [True, True, True, False]
+        )
+
+    def test_localize_rejects_beyond_int32(self):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from parameter_server_tpu.ops.kv_ops import localize
+
+        with _pytest.raises(ValueError, match="int32"):
+            localize(jnp.array([0], jnp.int32), 1 << 32)
+
+    def test_localize_matches_reference_formula_sharded(self, mesh8):
+        """On real shards (< 2^31) localize must equal the original
+        ``clip(idx - lo)`` arithmetic, per server shard."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from parameter_server_tpu.ops.kv_ops import localize
+        from parameter_server_tpu.parallel.mesh import SERVER_AXIS
+
+        shard = 16
+
+        def local(ix):
+            rel, ok = localize(ix, shard)
+            lo = jax.lax.axis_index(SERVER_AXIS) * shard
+            rel_ref = jnp.clip(ix - lo, 0, shard - 1)
+            ok_ref = ((ix - lo) >= 0) & ((ix - lo) < shard)
+            return (
+                (rel == rel_ref).all() & (ok == ok_ref).all()
+            ).astype(jnp.int32)[None]
+
+        ids = jnp.array([0, 3, 15, 16, 31, 32, -1], jnp.int32)
+        out = shard_map(
+            local, mesh=mesh8, in_specs=P(), out_specs=P(SERVER_AXIS),
+        )(ids)
+        assert np.asarray(out).all()
+
+    def test_sentinel_and_valid_mask(self):
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.ops.kv_ops import slot_sentinel, valid_slots
+
+        assert slot_sentinel(1 << 24) == 1 << 24
+        assert slot_sentinel((1 << 31) - 8) == (1 << 31) - 8
+        assert slot_sentinel(1 << 31) == -1
+        np.testing.assert_array_equal(
+            np.asarray(
+                valid_slots(jnp.array([0, 7, -1], jnp.int32), 1 << 31)
+            ),
+            [True, True, False],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(valid_slots(jnp.array([0, 8], jnp.int32), 8)),
+            [True, False],
+        )
+
+    def test_prep_batch_2e31_host_side(self):
+        """Host prep at num_slots = 2^31 must produce int32 slot arrays
+        with the -1 sentinel (np.full with 2^31 would raise)."""
+        from parameter_server_tpu.apps.linear.async_sgd import prep_batch
+        from parameter_server_tpu.parameter.parameter import KeyDirectory
+        from parameter_server_tpu.utils.sparse import random_sparse
+
+        d = KeyDirectory(1 << 31, hashed=True)
+        batch = random_sparse(64, 1 << 20, 8, seed=0, binary=True)
+        out = prep_batch(batch, d, 1, 64, 1024, 1024, 1 << 31)
+        assert out.uslots.dtype == np.int32
+        assert (out.uslots[out.umask == 0] == -1).all()
+        valid = out.uslots[out.umask > 0]
+        assert (valid >= 0).all()

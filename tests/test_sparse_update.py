@@ -1,0 +1,233 @@
+"""Sparse-touched update formulation (SGDConfig.update='sparse').
+
+The big-table mode: gather the batch's unique slot rows, run the SAME
+per-row updater math, scatter the rows back — O(touched) HBM traffic
+instead of the dense whole-shard sweep, no dense gradient temp (what
+lets a 2^31-slot table fit one chip; reference parity: servers only run
+entry Set on received keys, async_sgd.h:131-151).
+
+Equivalence basis: the dense and sparse formulations aggregate
+per-slot gradients identically (scatter-add vs host dedup + psum), so
+FTRL/AdaGrad trajectories must match to fp-reassociation tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.apps.linear.config import (
+    Config,
+    LearningRateConfig,
+    PenaltyConfig,
+    SGDConfig,
+)
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.utils.sparse import random_sparse
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def _conf(update: str, num_slots: int = 1 << 14, algo: str = "ftrl",
+          state_dtype: str = "float32", ada_grad: bool = True) -> Config:
+    conf = Config()
+    conf.penalty = PenaltyConfig(type="l1", lambda_=[0.05])
+    conf.learning_rate = LearningRateConfig(type="decay", alpha=0.5, beta=1.0)
+    conf.async_sgd = SGDConfig(
+        algo=algo, ada_grad=ada_grad, minibatch=256, num_slots=num_slots,
+        max_delay=0, update=update, ftrl_state_dtype=state_dtype,
+    )
+    return conf
+
+
+def _train_pair(mesh8, num_slots=1 << 14, algo="ftrl", n_batches=6,
+                state_dtype="float32", ada_grad=True):
+    from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+
+    rng = np.random.default_rng(1)
+    w_true = (rng.normal(size=512) * (rng.random(512) < 0.3)).astype(
+        np.float32
+    )
+    batches = [
+        random_sparse(256, 512, 8, seed=i, w_true=w_true)
+        for i in range(n_batches)
+    ]
+    test = random_sparse(1000, 512, 8, seed=99, w_true=w_true)
+    out = {}
+    for update in ("dense", "sparse"):
+        Postoffice.reset()
+        worker = AsyncSGDWorker(
+            _conf(update, num_slots, algo, state_dtype, ada_grad),
+            mesh=mesh8,
+        )
+        assert worker._update_mode == update
+        worker.train(iter(batches))
+        out[update] = (worker.evaluate(test), worker.state)
+    return out
+
+
+class TestSparseDenseEquivalence:
+    def test_ftrl_trajectory_matches_dense(self, mesh8):
+        out = _train_pair(mesh8)
+        ev_d, st_d = out["dense"]
+        ev_s, st_s = out["sparse"]
+        assert np.isfinite(ev_s["logloss"])
+        np.testing.assert_allclose(
+            ev_s["logloss"], ev_d["logloss"], rtol=1e-5
+        )
+        # state equality on the actual tables (z, sqrt_n), not just the
+        # scalar objective: fp reassociation only
+        for k in st_d:
+            np.testing.assert_allclose(
+                np.asarray(st_s[k], np.float32),
+                np.asarray(st_d[k], np.float32),
+                rtol=2e-5, atol=2e-6, err_msg=k,
+            )
+
+    def test_hash_collisions_aggregate_identically(self, mesh8):
+        """num_slots far below the key count forces hash collisions;
+        the sparse prep's slot-level re-unique must reproduce the
+        dense scatter-add's implicit aggregation."""
+        out = _train_pair(mesh8, num_slots=256)
+        ev_d, st_d = out["dense"]
+        ev_s, st_s = out["sparse"]
+        for k in st_d:
+            np.testing.assert_allclose(
+                np.asarray(st_s[k], np.float32),
+                np.asarray(st_d[k], np.float32),
+                rtol=2e-5, atol=2e-6, err_msg=k,
+            )
+
+    def test_adagrad_trajectory_matches_dense(self, mesh8):
+        out = _train_pair(mesh8, algo="standard", ada_grad=True)
+        _, st_d = out["dense"]
+        _, st_s = out["sparse"]
+        for k in st_d:
+            np.testing.assert_allclose(
+                np.asarray(st_s[k], np.float32),
+                np.asarray(st_d[k], np.float32),
+                rtol=2e-5, atol=2e-6, err_msg=k,
+            )
+
+    def test_bf16_state_logloss_tracks_dense(self, mesh8):
+        """bf16 sqrt_n: the two formulations draw different stochastic
+        dither (position-hash over shard vs gathered rows), so only
+        statistical agreement holds."""
+        out = _train_pair(mesh8, state_dtype="bfloat16", n_batches=8)
+        ev_d, _ = out["dense"]
+        ev_s, _ = out["sparse"]
+        assert abs(ev_s["logloss"] - ev_d["logloss"]) < 5e-3
+
+
+class TestSparseSuperbatch:
+    def test_scan_matches_per_step(self, mesh8):
+        from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+
+        rng = np.random.default_rng(2)
+        w_true = (rng.normal(size=512) * (rng.random(512) < 0.3)).astype(
+            np.float32
+        )
+        batches = [
+            random_sparse(256, 512, 8, seed=i, w_true=w_true)
+            for i in range(4)
+        ]
+        states = {}
+        for fused in (False, True):
+            Postoffice.reset()
+            worker = AsyncSGDWorker(_conf("sparse"), mesh=mesh8)
+            if fused:
+                worker.executor.wait(worker.submit_superbatch(batches))
+            else:
+                for b in batches:
+                    worker.executor.wait(worker.process_minibatch(b))
+            worker.executor.wait_all()
+            states[fused] = worker.state
+        for k in states[False]:
+            np.testing.assert_allclose(
+                np.asarray(states[True][k], np.float32),
+                np.asarray(states[False][k], np.float32),
+                rtol=1e-6, atol=1e-7, err_msg=k,
+            )
+
+
+class TestSparseConfigGates:
+    def test_explicit_sparse_with_filters_raises(self, mesh8):
+        from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+
+        conf = _conf("sparse")
+        conf.async_sgd.push_filter = [
+            {"type": "fixing_float", "num_bytes": 1}
+        ]
+        worker = AsyncSGDWorker(conf, mesh=mesh8)
+        with pytest.raises(ValueError, match="sparse"):
+            worker.process_minibatch(
+                random_sparse(256, 512, 8, seed=0)
+            )
+            worker.executor.wait_all()
+
+    def test_auto_resolution(self, mesh8, monkeypatch):
+        from parameter_server_tpu.apps.linear.async_sgd import AsyncSGDWorker
+
+        monkeypatch.setenv("PS_SPARSE_UPDATE_MIN_SLOTS", str(1 << 14))
+        w = AsyncSGDWorker(_conf("auto", num_slots=1 << 15), mesh=mesh8)
+        # per-server shard = 2^15/2 = 2^14 >= threshold -> sparse
+        assert w._update_mode == "sparse"
+        Postoffice.reset()
+        w = AsyncSGDWorker(_conf("auto", num_slots=1 << 13), mesh=mesh8)
+        assert w._update_mode == "dense"
+        Postoffice.reset()
+        # filters pin auto to dense (quietly)
+        conf = _conf("auto", num_slots=1 << 15)
+        conf.async_sgd.pull_filter = [
+            {"type": "fixing_float", "num_bytes": 2}
+        ]
+        w = AsyncSGDWorker(conf, mesh=mesh8)
+        assert w._update_mode == "dense"
+
+
+class TestApplyStateRows:
+    def test_matches_dense_apply_on_touched_rows(self):
+        import jax.numpy as jnp
+
+        from parameter_server_tpu.apps.linear.learning_rate import (
+            LearningRate,
+        )
+        from parameter_server_tpu.apps.linear.penalty import ElasticNet
+        from parameter_server_tpu.apps.linear.updaters import (
+            FTRLUpdater,
+            apply_state_rows,
+        )
+
+        lr = LearningRate("decay", alpha=0.5, beta=1.0)
+        up = FTRLUpdater(lr, ElasticNet(0.05, 0.0))
+        rng = np.random.default_rng(0)
+        n = 1024
+        state = {
+            "z": jnp.asarray(rng.normal(size=n).astype(np.float32)),
+            "sqrt_n": jnp.asarray(
+                (rng.random(n) * 2).astype(np.float32)
+            ),
+        }
+        rel = jnp.asarray([3, 100, 1023, 7, 0], jnp.int32)
+        ok = jnp.asarray([True, True, True, False, True])
+        g_u = jnp.asarray([0.5, -1.25, 0.01, 9.9, 0.3], jnp.float32)
+        # dense oracle: scatter the ok gradients, dense apply
+        g_dense = np.zeros(n, np.float32)
+        for r, o, g in zip([3, 100, 1023, 7, 0], [1, 1, 1, 0, 1],
+                           [0.5, -1.25, 0.01, 9.9, 0.3]):
+            if o:
+                g_dense[r] += g
+        want = up.apply(state, jnp.asarray(g_dense), None)
+        got = apply_state_rows(up, state, rel, ok, g_u)
+        for k in want:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want[k]),
+                rtol=1e-6, err_msg=k,
+            )
+        # the not-ok entry (row 7) must be untouched
+        np.testing.assert_array_equal(
+            np.asarray(got["z"])[7], np.asarray(state["z"])[7]
+        )
