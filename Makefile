@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke clean
+.PHONY: all native test bench bench-all bench-watch smoke metrics-lint clean
 
 all: native
 
@@ -27,6 +27,12 @@ bench-watch: native
 
 smoke: native
 	python bench.py --smoke
+
+# validate the telemetry metric catalog: duplicate / non-snake_case
+# names, naming-convention drift, unparseable exposition (fast, no
+# accelerator; also runs as a tier-1 test in tests/test_telemetry.py)
+metrics-lint:
+	python script/metrics_lint.py
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

@@ -334,9 +334,30 @@ def _transfer_op(nbytes: int):
         yield
 
 
+def telemetry_snapshot() -> "dict | None":
+    """Best-effort host-side telemetry snapshot for the bench record.
+
+    The process registry (parameter_server_tpu.telemetry) collects
+    executor step phases, Van byte counters and push/pull latency during
+    the run; persisting the snapshot next to summarize_trace's device
+    phases gives every BENCH_*.json host-side counters alongside the
+    device trace. Never allowed to break a record."""
+    try:
+        from parameter_server_tpu.telemetry import default_registry
+
+        snap = default_registry().snapshot()
+        return snap or None
+    except Exception:
+        return None
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
+    if "telemetry" not in rec:
+        snap = telemetry_snapshot()
+        if snap is not None:
+            rec["telemetry"] = snap
     if _WATCHDOG is not None:
         _WATCHDOG.finish(rec)
     else:

@@ -35,7 +35,7 @@ import numpy as np
 
 from ...utils import file as psfile
 
-from jax import shard_map
+from ...utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...learner.sgd import ISGDCompNode, ISGDScheduler, SGDProgress

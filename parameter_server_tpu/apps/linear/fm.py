@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax import shard_map
+from ...utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...learner.sgd import ISGDCompNode, SGDProgress

@@ -94,7 +94,7 @@ def network_perf(smoke: bool = False) -> None:
     hop) and the in-mesh psum collective."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS

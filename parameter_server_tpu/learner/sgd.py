@@ -102,6 +102,18 @@ class ISGDCompNode(App, Checkpointable):
     def __init__(self, name: str = "sgd_comp", monitor: Optional[MonitorMaster] = None):
         super().__init__(name=name)
         self.reporter: MonitorSlaver[SGDProgress] = MonitorSlaver(monitor, name)
+        # app-layer telemetry (doc/OBSERVABILITY.md): device-confirmed
+        # training volume, counted in collect() where the step's metrics
+        # land — a cold path shared by every SGD-family worker
+        self._examples_counter = None
+        from ..telemetry import registry as telemetry_registry
+
+        if telemetry_registry.enabled():
+            from ..telemetry.instruments import app_instruments
+
+            self._examples_counter = app_instruments(
+                telemetry_registry.default_registry()
+            )["examples"]
 
     def attach_monitor(self, scheduler: ISGDScheduler) -> None:
         self.reporter = MonitorSlaver(scheduler.monitor, self.name)
@@ -120,6 +132,8 @@ class ISGDCompNode(App, Checkpointable):
             hb.stop_timer()
         if metrics is None:
             return self.progress
+        if self._examples_counter is not None:
+            self._examples_counter.inc(int(metrics["num_ex"]))
         prog = SGDProgress(
             objective=[float(metrics["objective"])],
             num_examples_processed=int(metrics["num_ex"]),

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.ring import ring_next

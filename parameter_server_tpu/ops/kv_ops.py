@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map
+from ..utils.compat import shard_map
 
 from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
 

@@ -107,7 +107,9 @@ class KVLayer(Parameter):
             self.layers[key] = self._update_fn(key)(self.layers[key], recv)
             return self.layers[key]
 
-        return self.submit(step, task, callback)
+        # layers are whole-tensor channels: key-count 1 per request, the
+        # layer name as the channel label
+        return self.instrumented_submit("push", key, 1, step, task, callback)
 
     def pull(self, task: Task, key, callback=None) -> int:
         """Pull the layer (ref KVLayer::Pull; data lands in layer_ / user buf)."""
@@ -115,7 +117,7 @@ class KVLayer(Parameter):
         def step():
             return self.layers[key]
 
-        return self.submit(step, task, callback)
+        return self.instrumented_submit("pull", key, 1, step, task, callback)
 
     def wait_pull(self, ts: int):
         return self.executor.pop_result(ts)

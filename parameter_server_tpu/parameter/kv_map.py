@@ -27,7 +27,7 @@ import numpy as np
 
 from ..utils import file as psfile
 
-from jax import shard_map
+from ..utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..ops.kv_ops import localize
@@ -154,7 +154,9 @@ class KVMap(Parameter):
             self.state = self._push_fn(self.state, slots, vals)
             return self.state
 
-        return self.submit(step, task, callback)
+        return self.instrumented_submit(
+            "push", task.key_channel, len(slots), step, task, callback
+        )
 
     def pull(self, task: Task, keys, callback=None) -> int:
         slots = self.slots(keys)
@@ -165,7 +167,9 @@ class KVMap(Parameter):
             values = self.entry.get(self.state)
             return kv_ops.pull(values, slots, mesh=self.mesh, batch_sharded=False)
 
-        return self.submit(step, task, callback)
+        return self.instrumented_submit(
+            "pull", task.key_channel, len(slots), step, task, callback
+        )
 
     def wait_pull(self, ts: int) -> jax.Array:
         return self.executor.pop_result(ts)

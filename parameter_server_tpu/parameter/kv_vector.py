@@ -123,7 +123,9 @@ class KVVector(Parameter):
         def step():
             return kv_ops.pull(c.table, slots, mesh=self.mesh, batch_sharded=False)
 
-        return self.submit(step, task, callback)
+        return self.instrumented_submit(
+            "pull", ch, len(slots), step, task, callback
+        )
 
     def wait_pull(self, ts: int) -> jax.Array:
         return self.executor.pop_result(ts)
@@ -163,7 +165,9 @@ class KVVector(Parameter):
                 )
                 return c.table
 
-        return self.submit(step, task, callback)
+        return self.instrumented_submit(
+            "push", ch, len(slots), step, task, callback
+        )
 
     def buffer(self, ch: int, ts: int) -> Optional[jax.Array]:
         """Staged pushes for a timestamp (ref KVVector::buffer)."""

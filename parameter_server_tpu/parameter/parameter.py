@@ -18,12 +18,14 @@ Key directories come in two modes, both host-side:
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from ..system.customer import Customer
 from ..system.message import INVALID_TIME, FilterSpec, Task
+from ..telemetry import registry as telemetry_registry
 from ..utils.murmur import hash_slots
 from ..utils.range import Range
 
@@ -31,6 +33,48 @@ from ..utils.range import Range
 class Parameter(Customer):
     def __init__(self, id: Optional[int] = None, name: str = ""):
         super().__init__(id=id, name=name)
+        # push/pull telemetry (doc/OBSERVABILITY.md): latency histograms
+        # + key-volume counters per (store, channel); cached here so the
+        # request path pays one attribute test when disabled
+        self._tel = None
+        if telemetry_registry.enabled():
+            from ..telemetry.instruments import parameter_instruments
+
+            self._tel = parameter_instruments(
+                telemetry_registry.default_registry()
+            )
+
+    def instrumented_submit(
+        self,
+        kind: str,
+        channel,
+        num_keys: int,
+        step,
+        task: Optional[Task] = None,
+        callback=None,
+    ) -> int:
+        """Submit a push/pull step with latency + key-count telemetry.
+
+        Latency is submit→finished (queueing + run + materialize — the
+        user-visible request latency, ref Parameter::Request round trip),
+        observed from the executor's completion callback; ``callback``
+        still fires after it. ``kind`` is "push" or "pull"."""
+        tel = self._tel
+        if tel is None:
+            return self.submit(step, task, callback)
+        ch = str(channel)
+        tel[f"{kind}_keys"].labels(store=self.name, channel=ch).inc(
+            max(0, int(num_keys))
+        )
+        hist = tel[f"{kind}_latency"].labels(store=self.name, channel=ch)
+        t0 = time.perf_counter()
+
+        def record_then(cb=callback):
+            hist.observe(time.perf_counter() - t0)
+            if cb is not None:
+                cb()
+
+        return self.submit(step, task, record_then)
 
     @staticmethod
     def request(

@@ -88,6 +88,26 @@ def stop_system() -> None:
 # registry keyed by (node, customer id); here one process hosts every node).
 _app_registry: List[App] = []
 
+# RPC counter cached per registry epoch — re-resolved after a
+# Postoffice.reset swaps the default registry, one .inc() otherwise
+_rpc_counter = None
+_rpc_registry = None
+
+
+def _count_rpc() -> None:
+    global _rpc_counter, _rpc_registry
+    from .telemetry import registry as telemetry_registry
+
+    if not telemetry_registry.enabled():
+        return
+    reg = telemetry_registry.default_registry()
+    if reg is not _rpc_registry:
+        from .telemetry.instruments import app_instruments
+
+        _rpc_counter = app_instruments(reg)["rpcs"]
+        _rpc_registry = reg
+    _rpc_counter.inc()
+
 _GROUP_ROLES = {
     NodeGroups.SERVER_GROUP: {Node.SERVER},
     NodeGroups.WORKER_GROUP: {Node.WORKER},
@@ -131,6 +151,7 @@ def submit(
     # on the executor's dispatch thread (out-of-order engine), whose
     # thread-local node is not the submitting worker's
     me = _current_node()
+    _count_rpc()
 
     def step() -> None:
         _set_current_node(me)

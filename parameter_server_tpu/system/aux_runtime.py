@@ -36,9 +36,21 @@ class AuxRuntime:
         print_fn: Callable[[str], None] = print,
     ):
         self.collector = HeartbeatCollector(timeout=heartbeat_timeout)
-        self.dashboard = Dashboard()
+        # "default": the dashboard's telemetry section renders whatever
+        # the process default registry holds at report time (the spine
+        # every layer records into — doc/OBSERVABILITY.md)
+        self.dashboard = Dashboard(registry="default")
         self.coordinator = RecoveryCoordinator(self.collector)
         self.print_fn = print_fn
+        self._tel = None
+        from ..telemetry import registry as telemetry_registry
+
+        if telemetry_registry.enabled():
+            from ..telemetry.instruments import heartbeat_instruments
+
+            self._tel = heartbeat_instruments(
+                telemetry_registry.default_registry()
+            )
         self._infos: Dict[str, HeartbeatInfo] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -69,6 +81,10 @@ class AuxRuntime:
         report = info.get()
         self.collector.report(node_id, report)
         self.dashboard.add_report(node_id, report)
+        if self._tel is not None:
+            self._tel["reports"].labels(node=node_id).inc()
+            self._tel["net_in_mb"].labels(node=node_id).set(report.net_in_mb)
+            self._tel["net_out_mb"].labels(node=node_id).set(report.net_out_mb)
         # a node beating again after being declared dead is back — allow
         # future re-detection (ref manager re-adding a returned node)
         self.coordinator.revive(node_id)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Dict
 
+from ..telemetry import registry as telemetry_registry
 from .heartbeat import HeartbeatReport
 
 _COLUMNS = [
@@ -30,10 +31,16 @@ def _node_sort_key(node_id: str):
 
 
 class Dashboard:
-    def __init__(self) -> None:
+    def __init__(self, registry=None) -> None:
         self._data: Dict[str, HeartbeatReport] = {}
         self._tasks: Dict[str, int] = {}
         self._events: list = []  # cluster events (resizes, recoveries)
+        # telemetry source for the report's metrics section: None keeps
+        # the bare node table (unit-test dashboards), a MetricsRegistry
+        # pins one, and "default" resolves the process default registry
+        # at RENDER time so a Postoffice.reset between construction and
+        # report never shows a stale spine. AuxRuntime passes "default".
+        self._registry = registry
 
     def add_report(self, node_id: str, report: HeartbeatReport) -> None:
         self._data[node_id] = report
@@ -70,4 +77,35 @@ class Dashboard:
                 "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
             )
         lines.extend(f"event: {e}" for e in self._events)
+        lines.extend(self._telemetry_lines())
         return "\n".join(lines)
+
+    def _telemetry_lines(self) -> list:
+        """Registry snapshot rendered for humans: one line per series,
+        histograms compressed to count/avg/p50/p99. Empty when no
+        registry is wired or nothing has been recorded."""
+        if self._registry is None:
+            return []
+        reg = (
+            telemetry_registry.default_registry()
+            if self._registry == "default"
+            else self._registry
+        )
+        snap = reg.snapshot()
+        lines = []
+        for name, entry in snap.items():  # snapshot() is name-sorted
+            for labelstr, val in entry["values"].items():
+                series = f"{name}{{{labelstr}}}" if labelstr else name
+                if entry["type"] == "histogram":
+                    if not val["count"]:
+                        continue
+                    lines.append(
+                        f"  {series} count={val['count']} "
+                        f"avg={val['avg']:.6g} p50={val['p50']:.6g} "
+                        f"p99={val['p99']:.6g}"
+                    )
+                else:
+                    lines.append(f"  {series} {val:.6g}")
+        if lines:
+            lines.insert(0, "telemetry:")
+        return lines

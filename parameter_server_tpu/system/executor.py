@@ -23,11 +23,88 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
+from ..telemetry import registry as telemetry_registry
+from ..telemetry import spans as telemetry_spans
 from .message import INVALID_TIME, Message, Task
+
+
+class _ExecutorTelemetry:
+    """Per-executor bridge into the process registry (telemetry spine).
+
+    The dispatch loop must stay hardware-speed, so the per-step path is
+    ONE buffer append under one small lock; the buffered phase records
+    flush into the registry instruments lazily — on the registry's
+    collector hook (every ``snapshot()``/``render_text()`` read) or when
+    the buffer fills. Instrument children are bound once here so the
+    flush path does no name/label lookups either.
+    """
+
+    __slots__ = (
+        "queue_wait", "run", "materialize", "total",
+        "steps", "in_flight", "pending", "name",
+        "_buf", "_buf_lock", "__weakref__",
+    )
+
+    _FLUSH_AT = 4096  # bound buffered memory between registry reads
+
+    def __init__(self, name: str):
+        from ..telemetry.instruments import executor_instruments
+
+        reg = telemetry_registry.default_registry()
+        insts = executor_instruments(reg)
+        self.name = name
+        self.queue_wait = insts["queue_wait"].labels(executor=name)
+        self.run = insts["run"].labels(executor=name)
+        self.materialize = insts["materialize"].labels(executor=name)
+        self.total = insts["total"].labels(executor=name)
+        self.steps = insts["steps"].labels(executor=name)
+        self.in_flight = insts["in_flight"].labels(executor=name)
+        self.pending = insts["pending"].labels(executor=name)
+        self._buf: list = []
+        self._buf_lock = threading.Lock()
+        reg.add_collector(self.flush)
+
+    def record(
+        self,
+        queue_wait: float,
+        run_s: float,
+        mat_s: float,
+        total: float,
+        in_flight: int,
+        pending: int,
+    ) -> None:
+        """Hot path: one lock, one append (~1µs); flush is amortized."""
+        with self._buf_lock:
+            self._buf.append(
+                (queue_wait, run_s, mat_s, total, in_flight, pending)
+            )
+            if len(self._buf) < self._FLUSH_AT:
+                return
+            buf, self._buf = self._buf, []
+        self._flush_records(buf)
+
+    def flush(self) -> None:
+        """Drain buffered step records into the registry (collector hook)."""
+        with self._buf_lock:
+            buf, self._buf = self._buf, []
+        if buf:
+            self._flush_records(buf)
+
+    def _flush_records(self, buf: list) -> None:
+        for qw, run_s, mat_s, total, _, _ in buf:
+            self.queue_wait.observe(qw)
+            self.run.observe(run_s)
+            self.materialize.observe(mat_s)
+            self.total.observe(total)
+        self.steps.inc(len(buf))
+        # gauges are point-in-time: the newest record wins
+        self.in_flight.set(buf[-1][4])
+        self.pending.set(buf[-1][5])
 
 
 class TaskTracker:
@@ -69,9 +146,26 @@ class TaskTracker:
 
 
 class Executor:
-    def __init__(self, name: str = "", max_in_flight: int = 0):
+    def __init__(
+        self,
+        name: str = "",
+        max_in_flight: int = 0,
+        telemetry: Optional[bool] = None,
+    ):
         self.name = name
         self._time = 0
+        # telemetry spine (doc/OBSERVABILITY.md): per-step phase
+        # histograms + depth gauges, and one JSONL span event per
+        # finished step correlating host time to the logical clock.
+        # ``telemetry=None`` follows the process-wide switch; the
+        # decision is cached here so the hot path tests one attribute.
+        if telemetry is None:
+            telemetry = telemetry_registry.enabled()
+        self._tel: Optional[_ExecutorTelemetry] = (
+            _ExecutorTelemetry(name) if telemetry else None
+        )
+        # ts -> [t_submit, t_dispatch, run_s, materialize_s] (perf_counter)
+        self._step_times: Dict[int, List[float]] = {}
         self._pending: Dict[int, Tuple[Callable[[], Any], List[int]]] = {}
         # dependency-counted readiness (round 5): the original picker
         # re-sorted and re-scanned every pending step per dispatch —
@@ -144,6 +238,10 @@ class Executor:
                     raise ValueError(f"dependency {dep} is not before step {ts}")
                 deps.append(dep)
             self._pending[ts] = (step, deps)
+            if self._tel is not None:
+                # [t_submit, t_dispatch (0 = not picked yet),
+                #  run_s (-1 = run not completed yet), materialize_s]
+                self._step_times[ts] = [time.perf_counter(), 0.0, -1.0, 0.0]
             # readiness accounting: a dep not yet done registers this
             # step as its dependent; _finish(dep) decrements the count
             # and promotes the step to the ready heap at zero. A dep
@@ -235,7 +333,9 @@ class Executor:
                     self._running = ts
             if pick is None:
                 if dep_fut is not None:
+                    t_mat0 = time.perf_counter()
                     jax.block_until_ready(dep_fut)
+                    self._note_materialize(dep, time.perf_counter() - t_mat0)
                 self._finish(dep)
                 continue
             # run the step outside the lock (it may dispatch device work,
@@ -244,11 +344,19 @@ class Executor:
             self.max_dispatched_in_flight = max(
                 self.max_dispatched_in_flight, self.tracker.in_flight()
             )
+            tel = self._tel
+            if tel is not None:
+                t_run0 = time.perf_counter()
+                times = self._step_times.get(ts)
+                if times is not None:
+                    times[1] = t_run0  # dispatch pickup: queue wait ends
             try:
                 result = step()
                 err = None
             except BaseException as e:  # propagate to the waiter
                 result, err = None, e
+            if tel is not None and times is not None:
+                times[2] = time.perf_counter() - t_run0
             with self._cv:
                 self._running = None
                 self._ran.add(ts)
@@ -288,11 +396,64 @@ class Executor:
                 return ts, entry[0]
         return None
 
+    def _note_materialize(self, ts: int, seconds: float) -> None:
+        """Accumulate block_until_ready wall time onto the step's record
+        (a step may be forced from several waiters; the phases sum)."""
+        if self._tel is None:
+            return
+        times = self._step_times.get(ts)
+        if times is not None:
+            times[3] += seconds
+
+    def _record_finished(self, ts: int) -> None:
+        """Record the finished step's phases into the registry and emit
+        the per-step span event (one line per step, popped exactly once)."""
+        tel = self._tel
+        if tel is None:
+            return
+        times = self._step_times.get(ts)
+        if times is None or times[1] == 0.0 or times[2] < 0.0:
+            # not dispatched here, or the step body is still executing
+            # (an external tracker.finish — Customer.reply — can satisfy
+            # a waiter mid-run): leave the record in place so the finish
+            # that observes the completed run emits it exactly once
+            return
+        times = self._step_times.pop(ts, None)
+        if times is None:
+            return  # a concurrent finish won the pop; it emitted
+        now = time.perf_counter()
+        t_submit, t_dispatch, run_s, mat_s = times
+        queue_wait = max(0.0, t_dispatch - t_submit)
+        total = max(0.0, now - t_submit)
+        tel.record(
+            queue_wait,
+            run_s,
+            mat_s,
+            total,
+            self.tracker.in_flight(),
+            len(self._pending),
+        )
+        if telemetry_spans.get_sink() is not None:
+            telemetry_spans.emit(
+                {
+                    "kind": "span",
+                    "name": "executor.step",
+                    "executor": tel.name,
+                    "ts": ts,
+                    "t_wall": time.time(),
+                    "queue_wait_s": queue_wait,
+                    "run_s": run_s,
+                    "materialize_s": mat_s,
+                    "total_s": total,
+                }
+            )
+
     def _finish(self, ts: int) -> None:
         """Mark finished (results materialized), prune, fire callback
         once, and promote dependents whose last unmet dep this was."""
         if self.tracker.was_started(ts):
             self.tracker.finish(ts)
+        self._record_finished(ts)
         with self._cv:
             self._ran.discard(ts)
             for t in self._dependents.pop(ts, ()):
@@ -343,7 +504,9 @@ class Executor:
             self._finish(ts)
             raise err
         if fut is not None:
+            t_mat0 = time.perf_counter()
             jax.block_until_ready(fut)
+            self._note_materialize(ts, time.perf_counter() - t_mat0)
         self._finish(ts)
         return fut
 
@@ -380,6 +543,7 @@ class Executor:
                     self._pending.pop(ts)
                     self._callbacks.pop(ts, None)
                     self._unmet.pop(ts, None)
+                    self._step_times.pop(ts, None)  # never dispatched
                 # purge, don't lazy-skip: an explicit timestamp may be
                 # REUSED after cancellation, and a stale heap entry
                 # (or a stale _dependents registration decrementing
@@ -402,6 +566,10 @@ class Executor:
             thread is not threading.current_thread()
         ):
             thread.join(timeout=60)
+        if self._tel is not None:
+            # push buffered step records out before this executor (and
+            # its collector registration) can be garbage-collected
+            self._tel.flush()
 
 
 class NodeGroups:

@@ -43,6 +43,11 @@ class HeartbeatInfo:
         self._start = time.time()
         self._in_bytes = 0
         self._out_bytes = 0
+        # lifetime totals: ``get()`` drains the per-report deltas above
+        # (the dashboard's in(MB)/out(MB) are per-interval), so tests and
+        # telemetry snapshots need a counter that never resets
+        self._total_in_bytes = 0
+        self._total_out_bytes = 0
         self._last = resource_usage.sample()
         self._lock = threading.Lock()
 
@@ -59,10 +64,22 @@ class HeartbeatInfo:
     def increase_in_bytes(self, delta: int) -> None:
         with self._lock:
             self._in_bytes += delta
+            self._total_in_bytes += delta
 
     def increase_out_bytes(self, delta: int) -> None:
         with self._lock:
             self._out_bytes += delta
+            self._total_out_bytes += delta
+
+    @property
+    def total_in_bytes(self) -> int:
+        with self._lock:
+            return self._total_in_bytes
+
+    @property
+    def total_out_bytes(self) -> int:
+        with self._lock:
+            return self._total_out_bytes
 
     def get(self) -> HeartbeatReport:
         cur = resource_usage.sample()

@@ -15,6 +15,8 @@ from typing import Optional
 from jax.sharding import Mesh
 
 from ..parallel import mesh as meshlib
+from ..telemetry import registry as telemetry_registry
+from ..telemetry import spans as telemetry_spans
 from ..utils.range import Range
 from .manager import Manager
 from .van import Van, init_distributed
@@ -29,6 +31,9 @@ class Postoffice:
         self.mesh: Optional[Mesh] = None
         self.van: Optional[Van] = None
         self.aux = None  # AuxRuntime once start_aux() is called
+        # the process telemetry spine: every layer's instruments register
+        # here (doc/OBSERVABILITY.md); reset() swaps in a fresh registry
+        self.metrics = telemetry_registry.default_registry()
         self._started = False
 
     @classmethod
@@ -40,9 +45,13 @@ class Postoffice:
 
     @classmethod
     def reset(cls) -> None:
-        """Test helper — tear down the singleton (ref Postoffice::Stop)."""
+        """Test helper — tear down the singleton (ref Postoffice::Stop).
+        Also resets the telemetry spine (fresh default registry, span
+        sink closed) so metrics never leak across hermetic tests."""
         with cls._lock:
             cls._instance = None
+        telemetry_registry.reset_default_registry()
+        telemetry_spans.close_sink()
 
     def start(
         self,

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as meshlib
+from ..telemetry import registry as telemetry_registry
 from .message import Message
 
 
@@ -37,24 +38,41 @@ class Van:
         # send_bytes_/recv_bytes_ count wire frames)
         self.wire_sent_bytes = 0
         self.wire_recv_bytes = 0
+        # registry mirrors of the counters above (telemetry spine): one
+        # process-wide series each, shared with dashboard/bench snapshots
+        self._tel = None
+        if telemetry_registry.enabled():
+            from ..telemetry.instruments import van_instruments
+
+            self._tel = van_instruments(telemetry_registry.default_registry())
+        # ident -> node id, for heartbeat traffic attribution: app names
+        # resolve through the manager's customer table (a linear scan) —
+        # cache positive resolutions so chatty RPC traffic pays it once
+        # per peer, not per frame
+        self._ident_nodes: dict = {}
 
     # -- placement (addressing) --
+
+    def _count_placed(self, nbytes: int) -> None:
+        self.placed_bytes += nbytes
+        if self._tel is not None:
+            self._tel["placed_bytes"].inc(nbytes)
 
     def put_table(self, arr) -> jax.Array:
         """Place a parameter table sharded by key range over servers."""
         out = jax.device_put(arr, meshlib.table_sharding(self.mesh))
-        self.placed_bytes += arr.nbytes
+        self._count_placed(arr.nbytes)
         return out
 
     def put_batch(self, arr) -> jax.Array:
         """Place a batch sharded over the data (worker) axis."""
         out = jax.device_put(arr, meshlib.batch_sharding(self.mesh))
-        self.placed_bytes += arr.nbytes
+        self._count_placed(arr.nbytes)
         return out
 
     def put_replicated(self, arr) -> jax.Array:
         out = jax.device_put(arr, meshlib.replicated(self.mesh))
-        self.placed_bytes += arr.nbytes
+        self._count_placed(arr.nbytes)
         return out
 
     # -- host wire (control plane) --
@@ -68,11 +86,63 @@ class Van:
         process-level byte counters (ref Van send_bytes_/recv_bytes_);
         the per-peer counters live on the RemoteNodes.
 
-        Every ps.py group RPC — request AND response — crosses here."""
+        Every ps.py group RPC — request AND response — crosses here.
+
+        Byte accounting is side-correct: sent bytes are counted at
+        serialization, recv bytes only after ``from_wire`` actually ran
+        on the receiving endpoint (measured as that endpoint's counter
+        delta) — a decode failure, or a multi-host split where the
+        receiving process does its own ``from_wire``, never inflates
+        this process's recv counter with sender-side frame lengths.
+        Both directions also feed the nodes' HeartbeatInfo so the
+        dashboard reports true traffic."""
         blob = sender.to_wire(msg)
-        self.wire_sent_bytes += len(blob)
-        self.wire_recv_bytes += len(blob)
-        return recver.from_wire(blob)
+        sent = len(blob)
+        self.wire_sent_bytes += sent
+        self._account(msg.sender, out_bytes=sent)
+        recv_before = recver.wire_recv_bytes
+        out = recver.from_wire(blob)
+        recv = recver.wire_recv_bytes - recv_before
+        self.wire_recv_bytes += recv
+        self._account(msg.recver, in_bytes=recv)
+        if self._tel is not None:
+            self._tel["wire_sent_bytes"].inc(sent)
+            self._tel["wire_recv_bytes"].inc(recv)
+            self._tel["transfers"].inc()
+        return out
+
+    def _account(self, ident: str, in_bytes: int = 0, out_bytes: int = 0) -> None:
+        """Feed a transfer's bytes into the node's HeartbeatInfo (ref
+        heartbeat_info.cc: Van::Send/Recv bump the traffic counters the
+        dashboard's in(MB)/out(MB) columns report). ``ident`` may be a
+        node id ("W0") or a customer/app name — resolved best-effort;
+        silently skipped before start_aux or for unregistered nodes."""
+        if not ident or (not in_bytes and not out_bytes):
+            return
+        from .postoffice import Postoffice
+
+        po = Postoffice._instance  # never create the singleton from here
+        if po is None or po.aux is None:
+            return
+        info = po.aux.info(ident)
+        if info is None:
+            # app names differ from node ids (ps.py submits under the
+            # customer name); map through the registered customer's node
+            node_id = self._ident_nodes.get(ident)
+            if node_id is None:
+                cust = po.manager.find_customer_by_name(ident)
+                node = getattr(cust, "node", None)
+                if node is None:
+                    return  # unresolved now; may register later — no
+                    # negative caching
+                node_id = self._ident_nodes[ident] = node.id
+            info = po.aux.info(node_id)
+            if info is None:
+                return
+        if in_bytes:
+            info.increase_in_bytes(in_bytes)
+        if out_bytes:
+            info.increase_out_bytes(out_bytes)
 
     def send(self, msg: Message, filters: Optional[Sequence] = None) -> Message:
         from ..filter.base import encode_chain

@@ -1,0 +1,45 @@
+"""Unified telemetry: metrics registry + logical-clock span tracing.
+
+One low-overhead spine for every layer's observability (see
+``doc/OBSERVABILITY.md`` for the metric catalog and how to read it):
+
+- :mod:`registry` — named Counter/Gauge/Histogram instruments,
+  process-default registry (hung off ``Postoffice``), JSON snapshots and
+  Prometheus text exposition;
+- :mod:`spans` — ``span(name, ts=...)`` host intervals correlated to
+  executor logical timestamps, appended to a JSONL sink;
+- :mod:`instruments` — the canonical catalog of metric names each layer
+  records (executor phases, van bytes, parameter push/pull, app volume,
+  heartbeat traffic).
+"""
+
+from .registry import (
+    Counter,
+    DuplicateMetricError,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    enabled,
+    reset_default_registry,
+    set_enabled,
+)
+from .spans import JsonlSink, close_sink, emit, get_sink, install_sink, span
+
+__all__ = [
+    "Counter",
+    "DuplicateMetricError",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "close_sink",
+    "default_registry",
+    "emit",
+    "enabled",
+    "get_sink",
+    "install_sink",
+    "reset_default_registry",
+    "set_enabled",
+    "span",
+]

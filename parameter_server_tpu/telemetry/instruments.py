@@ -1,0 +1,173 @@
+"""Canonical metric catalog — the ONE place metric names are declared.
+
+Instrumentation call sites fetch their instruments through these
+accessors (idempotent ``ensure_*``: per-instance components — every
+Executor, every parameter store — share the process-wide series and
+distinguish themselves by label). ``install_all`` instantiates every
+family against a registry; ``script/metrics_lint.py`` runs it on a fresh
+registry to fail the build on duplicate or non-snake_case names, and
+``doc/OBSERVABILITY.md`` documents the same names.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+
+# fine low-end buckets for host dispatch phases (queue-wait on an idle
+# executor is single-digit microseconds)
+PHASE_BUCKETS = (
+    1e-6, 1e-5, 1e-4, 3.2e-4, 1e-3, 3.2e-3, 1e-2, 3.2e-2,
+    1e-1, 3.2e-1, 1.0, 3.2, 10.0, 32.0, 100.0,
+)
+
+
+def executor_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Per-step executor phases + depth gauges (labeled by executor)."""
+    return {
+        "queue_wait": reg.ensure_histogram(
+            "executor_queue_wait_seconds",
+            "submit to dispatch-thread pickup, per step",
+            labelnames=("executor",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "run": reg.ensure_histogram(
+            "executor_run_seconds",
+            "step body wall time on the dispatch thread (XLA dispatch, "
+            "not device completion)",
+            labelnames=("executor",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "materialize": reg.ensure_histogram(
+            "executor_materialize_seconds",
+            "block_until_ready wall time when the step's futures were "
+            "forced (0 when nothing blocked)",
+            labelnames=("executor",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "total": reg.ensure_histogram(
+            "executor_step_total_seconds",
+            "submit to finished (materialized), per step",
+            labelnames=("executor",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "steps": reg.ensure_counter(
+            "executor_steps_finished_total",
+            "steps finished (ran + materialized)",
+            labelnames=("executor",),
+        ),
+        "in_flight": reg.ensure_gauge(
+            "executor_in_flight",
+            "started (dispatched) but unfinished steps",
+            labelnames=("executor",),
+        ),
+        "pending": reg.ensure_gauge(
+            "executor_pending",
+            "submitted steps not yet picked by the dispatch thread",
+            labelnames=("executor",),
+        ),
+    }
+
+
+def van_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Transport-layer byte counters (ref van.cc send_bytes_/recv_bytes_)."""
+    return {
+        "placed_bytes": reg.ensure_counter(
+            "van_placed_bytes_total",
+            "host arrays placed onto the device mesh (put_*)",
+        ),
+        "wire_sent_bytes": reg.ensure_counter(
+            "van_wire_sent_bytes_total",
+            "serialized frames leaving through transfer(), sender side",
+        ),
+        "wire_recv_bytes": reg.ensure_counter(
+            "van_wire_recv_bytes_total",
+            "serialized frames decoded by from_wire(), receiver side",
+        ),
+        "transfers": reg.ensure_counter(
+            "van_transfers_total",
+            "host wire transfers (request or response frames)",
+        ),
+    }
+
+
+def parameter_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Push/Pull latency + key volume per store/channel (parameter layer)."""
+    return {
+        "push_latency": reg.ensure_histogram(
+            "ps_push_latency_seconds",
+            "push submit to finished, per request",
+            labelnames=("store", "channel"),
+        ),
+        "pull_latency": reg.ensure_histogram(
+            "ps_pull_latency_seconds",
+            "pull submit to finished, per request",
+            labelnames=("store", "channel"),
+        ),
+        "push_keys": reg.ensure_counter(
+            "ps_push_keys_total",
+            "keys carried by push requests",
+            labelnames=("store", "channel"),
+        ),
+        "pull_keys": reg.ensure_counter(
+            "ps_pull_keys_total",
+            "keys carried by pull requests",
+            labelnames=("store", "channel"),
+        ),
+    }
+
+
+def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Application layer: RPC fan-out and training volume."""
+    return {
+        "rpcs": reg.ensure_counter(
+            "ps_rpc_total",
+            "ps.submit group RPCs delivered (request+auto-ack pairs)",
+        ),
+        "examples": reg.ensure_counter(
+            "app_examples_total",
+            "training examples submitted to device steps",
+        ),
+    }
+
+
+def heartbeat_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Node liveness/traffic as last-report gauges (aux_runtime.beat)."""
+    return {
+        "reports": reg.ensure_counter(
+            "heartbeat_reports_total",
+            "heartbeat reports collected",
+            labelnames=("node",),
+        ),
+        "net_in_mb": reg.ensure_gauge(
+            "node_net_in_mb",
+            "bytes received since the node's previous report (MB)",
+            labelnames=("node",),
+        ),
+        "net_out_mb": reg.ensure_gauge(
+            "node_net_out_mb",
+            "bytes sent since the node's previous report (MB)",
+            labelnames=("node",),
+        ),
+    }
+
+
+INSTRUMENT_FAMILIES = (
+    executor_instruments,
+    van_instruments,
+    parameter_instruments,
+    app_instruments,
+    heartbeat_instruments,
+)
+
+
+def install_all(reg: MetricsRegistry) -> Dict[str, object]:
+    """Instantiate every declared instrument (metrics-lint entry point).
+    Raises on duplicate names or declaration mismatches across families;
+    returns name → instrument."""
+    out: Dict[str, object] = {}
+    for family in INSTRUMENT_FAMILIES:
+        for inst in family(reg).values():
+            out[inst.name] = inst
+    return out

@@ -1,0 +1,109 @@
+"""Logical-clock span tracing: host wall time correlated to executor time.
+
+The executor's logical clocks (``Task.time``) order every step but carry
+no timing; ``bench.py`` can summarize an XLA device trace but sees
+nothing host-side. A *span* bridges the two: a host wall-time interval
+stamped with the logical timestamp it serves, emitted as one JSONL line
+through the process sink. The executor emits one ``executor.step`` event
+per finished step carrying all three phases (queue-wait from submit to
+dispatch, run, materialize) so a trace reader can reconstruct the
+pipeline without joining records.
+
+Sink contract: append-only JSONL, one event per line, thread-safe,
+best-effort (a tracing failure must never take down the step it was
+measuring). ``install_sink(None)`` (the default) makes ``emit`` a cheap
+None check — the hot path pays nothing when tracing is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class JsonlSink:
+    """Append-only JSONL event sink (one dict per line)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f: Optional[io.TextIOWrapper] = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()  # readers (tests, tail -f) see events live
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_sink_lock = threading.Lock()
+_sink: Optional[JsonlSink] = None
+
+
+def install_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
+    """Install the process event sink; returns the previous one (NOT
+    closed — the caller owns both)."""
+    global _sink
+    with _sink_lock:
+        prev, _sink = _sink, sink
+        return prev
+
+
+def get_sink() -> Optional[JsonlSink]:
+    return _sink
+
+
+def close_sink() -> None:
+    """Close and uninstall the process sink (Postoffice.reset hook)."""
+    global _sink
+    with _sink_lock:
+        sink, _sink = _sink, None
+    if sink is not None:
+        sink.close()
+
+
+def emit(event: Dict[str, Any]) -> None:
+    """Best-effort emit to the installed sink (no-op when none)."""
+    sink = _sink
+    if sink is None:
+        return
+    with contextlib.suppress(Exception):
+        sink.emit(event)
+
+
+@contextlib.contextmanager
+def span(name: str, ts: Optional[int] = None, histogram=None, **attrs):
+    """Time a host-side block and emit it as one JSONL event.
+
+    ``ts`` is the executor logical timestamp the block serves — the
+    correlation key between host spans and device steps. ``histogram``
+    (a telemetry Histogram or labeled child) additionally records the
+    duration, so the same interval feeds both the trace and the
+    registry. Extra keyword attrs ride along verbatim.
+    """
+    t_wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        if histogram is not None:
+            with contextlib.suppress(Exception):
+                histogram.observe(dur)
+        event = {"kind": "span", "name": name, "t_wall": t_wall, "dur_s": dur}
+        if ts is not None:
+            event["ts"] = ts
+        event.update(attrs)
+        emit(event)

@@ -1,0 +1,32 @@
+"""Version compatibility shims for the jax API surface we depend on.
+
+``jax.shard_map`` graduated from ``jax.experimental.shard_map`` in newer
+jax releases, and its replication-check kwarg was renamed along the way
+(``check_rep`` → ``check_vma``). Older environments (e.g. jax 0.4.x)
+only ship the experimental path with the old kwarg. Import from here so
+the whole package runs on both: call sites use the NEW spelling
+(``check_vma``) and the shim translates for old jax.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map
+except ImportError:  # jax 0.4.x/0.5.x: experimental namespace, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if "check_vma" in inspect.signature(_shard_map).parameters:
+        shard_map = _shard_map
+    else:
+
+        @functools.wraps(_shard_map)
+        def shard_map(*args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

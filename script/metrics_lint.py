@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""metrics-lint: validate the telemetry metric catalog (fast, CPU-only).
+
+Instantiates every instrument family from
+``parameter_server_tpu.telemetry.instruments`` against a fresh registry
+and fails on:
+
+- duplicate metric names, or one name re-declared with a different
+  kind/labels/buckets across families (the registry raises);
+- non-snake_case metric or label names (the registry raises);
+- counters missing the ``_total`` suffix / histograms missing a
+  ``_seconds`` or ``_bytes`` unit suffix (naming-convention drift);
+- a render_text() exposition that does not parse as Prometheus text.
+
+Run via ``make metrics-lint`` or directly; exercised as a tier-1 test in
+tests/test_telemetry.py so catalog drift fails CI before it ships.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+EXPOSITION_LINE = re.compile(
+    r"^[a-z_][a-z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})? [^ ]+$"
+)
+
+
+def lint() -> list:
+    """Returns a list of problem strings (empty = clean)."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from parameter_server_tpu.telemetry.instruments import install_all
+    from parameter_server_tpu.telemetry.registry import MetricsRegistry
+
+    problems = []
+    reg = MetricsRegistry()
+    try:
+        instruments = install_all(reg)  # raises on dup / bad names
+        install_all(reg)  # second pass must be idempotent
+    except Exception as e:
+        return [f"catalog failed to install: {type(e).__name__}: {e}"]
+
+    for name, inst in sorted(instruments.items()):
+        if inst.kind == "counter" and not name.endswith("_total"):
+            problems.append(f"counter {name!r} should end in '_total'")
+        if inst.kind == "histogram" and not (
+            name.endswith("_seconds") or name.endswith("_bytes")
+        ):
+            problems.append(
+                f"histogram {name!r} should carry a unit suffix "
+                "('_seconds' or '_bytes')"
+            )
+
+    # exposition must parse even with every series present: record one
+    # sample per instrument (labeled instruments get a probe label set)
+    for inst in instruments.values():
+        target = (
+            inst.labels(**{ln: "probe" for ln in inst.labelnames})
+            if inst.labelnames
+            else inst
+        )
+        if inst.kind == "histogram":
+            target.observe(0.001)
+        elif inst.kind == "gauge":
+            target.set(1.0)
+        else:
+            target.inc()
+    for line in reg.render_text().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not EXPOSITION_LINE.match(line):
+            problems.append(f"unparseable exposition line: {line!r}")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}", file=sys.stderr)
+        print(f"metrics-lint: FAILED ({len(problems)} problems)", file=sys.stderr)
+        return 1
+    print("metrics-lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from jax import shard_map
+from parameter_server_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from parameter_server_tpu.models.attention import dense_attention, ring_attention

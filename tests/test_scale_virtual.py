@@ -148,7 +148,7 @@ class TestInt32Boundary:
         ``clip(idx - lo)`` arithmetic, per server shard."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from parameter_server_tpu.utils.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from parameter_server_tpu.ops.kv_ops import localize
