@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke metrics-lint clean
+.PHONY: all native test bench bench-all bench-watch smoke metrics-lint donation-lint clean
 
 all: native
 
@@ -33,6 +33,13 @@ smoke: native
 # accelerator; also runs as a tier-1 test in tests/test_telemetry.py)
 metrics-lint:
 	python script/metrics_lint.py
+
+# statically verify every data-plane jit site either donates its table
+# buffers or justifies not doing so (# no-donate:) — the defensive-copy
+# trap guard (fast, no accelerator; also a tier-1 test in
+# tests/test_donation.py)
+donation-lint:
+	python script/donation_lint.py
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

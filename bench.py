@@ -351,6 +351,101 @@ def telemetry_snapshot() -> "dict | None":
         return None
 
 
+def kv_dataplane_microbench(mesh, smoke: bool) -> dict:
+    """Zero-copy data-plane A/B at the kernel level, on the live backend:
+    the seed's copying push (fresh [P, k] table output per call) vs the
+    donated in-place push, and the fused single-dispatch push→pull vs
+    push-then-pull as two launches (ops/kv_ops). Ticks the PR's
+    telemetry counters (ps_kvops_donated_pushes_total, fused-dispatch
+    histogram) so they land in the record's telemetry snapshot; the
+    returned dict embeds under ``kv_dataplane``. Cheap by construction
+    (seconds), guarded at the call site. Deliberately kernel-level
+    (raw kv_ops on this worker's live mesh, watchdog-beaten, no
+    Postoffice reset); the STORE-level twin — executor round trips
+    included — lives in benchmarks/components.py kv_vector_perf; keep
+    their A/B shapes in sync when either changes."""
+    import jax
+    import jax.numpy as jnp
+
+    from parameter_server_tpu.ops import kv_ops
+    from parameter_server_tpu.parallel import mesh as meshlib
+
+    n_keys = 1 << (10 if smoke else 16)
+    k = 4
+    p = 2 * n_keys
+    rng = np.random.default_rng(0)
+    slots = jax.device_put(rng.integers(0, p, n_keys).astype(np.int32))
+    vals = jax.device_put(rng.normal(size=(n_keys, k)).astype(np.float32))
+    table0 = jax.device_put(
+        jnp.zeros((p, k), jnp.float32), meshlib.table_sharding(mesh)
+    )
+    jax.block_until_ready(table0)
+    reps = 3 if smoke else 20
+
+    def timed(fn):
+        fn()  # warm (compile)
+        _beat()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps
+
+    tbl_nd = jax.block_until_ready(jnp.array(table0, copy=True))
+
+    def push_nodonate():
+        jax.block_until_ready(
+            kv_ops.push(tbl_nd, slots, vals, mesh=mesh, batch_sharded=False)
+        )
+
+    box = [jnp.array(table0, copy=True)]
+
+    def push_donated():
+        box[0] = kv_ops.push_donated(
+            box[0], slots, vals, mesh=mesh, batch_sharded=False
+        )
+        jax.block_until_ready(box[0])
+
+    def push_then_pull():
+        t = kv_ops.push(tbl_nd, slots, vals, mesh=mesh, batch_sharded=False)
+        jax.block_until_ready(
+            kv_ops.pull(t, slots, mesh=mesh, batch_sharded=False)
+        )
+
+    def push_pull_fused():
+        box[0], out = kv_ops.push_pull_donated(
+            box[0], slots, vals, mesh=mesh, batch_sharded=False
+        )
+        jax.block_until_ready(out)
+
+    sec_nd = timed(push_nodonate)
+    sec_d = timed(push_donated)
+    sec_seq = timed(push_then_pull)
+    sec_f = timed(push_pull_fused)
+    return {
+        "n_keys": n_keys,
+        "table_shape": [p, k],
+        "push_nodonate_steps_per_sec": round(1.0 / sec_nd, 1),
+        "push_donated_steps_per_sec": round(1.0 / sec_d, 1),
+        "push_donated_speedup": round(sec_nd / sec_d, 3),
+        "push_then_pull_rt_per_sec": round(1.0 / sec_seq, 1),
+        "push_pull_fused_rt_per_sec": round(1.0 / sec_f, 1),
+        "push_pull_fused_speedup": round(sec_seq / sec_f, 3),
+        # structural: the [P, k] output buffer the donated path never
+        # materializes — bytes NOT moved per push, by construction
+        "table_copy_bytes_avoided_per_push": int(p * k * 4),
+    }
+
+
+def attach_kv_dataplane(rec_or_headline: dict, mesh, smoke: bool) -> None:
+    """Guarded embed of the kv data-plane A/B (never breaks a record)."""
+    try:
+        rec_or_headline["kv_dataplane"] = kv_dataplane_microbench(mesh, smoke)
+    except Exception as e:
+        rec_or_headline["kv_dataplane_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
@@ -1287,6 +1382,8 @@ def run_real(args) -> int:
         ))
     except Exception as e:
         headline["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    _beat("kv_dataplane")
+    attach_kv_dataplane(headline, worker.mesh, args.smoke)
     _beat("e2e", **headline)
 
     def host_prepped():
@@ -1668,6 +1765,11 @@ def run_synthetic(args) -> int:
         ))
     except Exception as e:
         headline["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+    # zero-copy data-plane A/B rides along in the record (donated vs
+    # copying push, fused vs sequenced round trip) + ticks the kvops
+    # telemetry counters for the snapshot
+    _beat("kv_dataplane")
+    attach_kv_dataplane(headline, po.mesh, args.smoke)
     _beat("e2e", **headline)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes

@@ -796,6 +796,8 @@ def _donation_variants(step_impl):
     def snap_impl(live_state, batch, seed):
         return step_impl(live_state, live_state, batch, seed)
 
+    # no-donate: the snapshot buffer must survive for future delayed
+    # steps (max_delay > 0); the donate_ok path below covers delay 0
     step_snap = jax.jit(snap_impl)
     step_snap_donate = functools.partial(
         jax.jit, donate_argnums=(0,)
@@ -1517,6 +1519,8 @@ class AsyncSGDWorker(ISGDCompNode):
         )
         # step functions cached per (encoding, binary, with_aux)
         self._steps: Dict[Tuple[str, bool, bool], object] = {}
+        # no-donate: weights_dense derives FROM the live state, which
+        # keeps training afterwards
         self._weights_fn = jax.jit(self.updater.weights)
         # max_delay=0 still bounds in-flight work to one step ahead — 0 here
         # would mean *unbounded* (executor semantics), pinning every metrics

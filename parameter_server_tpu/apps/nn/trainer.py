@@ -85,6 +85,11 @@ class NNTrainer(Checkpointable):
         self.steps_done = 0
 
     def _pack(self):
+        # drain in-flight KVLayer pushes first: they donate layer
+        # buffers on the store's executor thread (donate=True default),
+        # and packing must never read — or feed into the donating train
+        # step — a buffer a queued push is about to consume
+        self.kv.executor.wait_all(pop=False)
         leaves = [self.kv.layers[k] for k in sorted(self.kv.layers)]
         return jax.tree.unflatten(self._param_struct, leaves)
 
@@ -117,7 +122,14 @@ class NNTrainer(Checkpointable):
             }
             return new_params, new_opt, metrics
 
-        @jax.jit
+        import functools
+
+        # the trainer owns params (the KVLayer arrays it re-installs via
+        # _unpack) and opt_state, and replaces both every step — donate
+        # them so the fused step updates weights/momenta in place instead
+        # of materializing a full parameter copy per step (the KVLayer
+        # donation contract; checkpoints copy to host first)
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, x, y):
             specs = jax.tree.map(lambda _: P(), params)
             opt_specs = jax.tree.map(lambda _: P(), opt_state)
@@ -191,3 +203,11 @@ class NNTrainer(Checkpointable):
 
     def pull(self, key, task: Optional[Task] = None):
         return self.kv.wait_pull(self.kv.pull(task or self.kv.request(), key))
+
+    def push_pull(self, key, grad, task: Optional[Task] = None):
+        """Fused gradient push + weight refresh: one submitted step
+        returns the post-update layer (KVLayer.push_pull) — the worker's
+        push-then-pull-same-key round trip in a single dispatch."""
+        return self.kv.wait_pull(
+            self.kv.push_pull(task or self.kv.request(), key, grad)
+        )

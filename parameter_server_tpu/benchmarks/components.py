@@ -17,9 +17,16 @@ def _mesh():
 @benchmark("kv_vector")
 def kv_vector_perf(smoke: bool = False) -> None:
     """Push/pull throughput of the sharded dense table
-    (ref src/test/kv_vector_perf_ps.cc)."""
+    (ref src/test/kv_vector_perf_ps.cc).
+
+    Three paths are A/B'd at the kernel level on the SAME shapes:
+    the seed's copying push (fresh [P, k] output per call), the donated
+    in-place push, and the fused push→pull single-dispatch round trip —
+    the zero-copy data plane's two wins, quoted with the structural
+    bytes each donated push stops moving."""
     import jax
 
+    from ..ops import kv_ops
     from ..parameter.kv_vector import KVVector
 
     mesh = _mesh()
@@ -35,12 +42,55 @@ def kv_vector_perf(smoke: bool = False) -> None:
     def pull():
         jax.block_until_ready(kv.wait_pull(kv.pull(kv.request(channel=0), keys=keys)))
 
+    def push_pull_fused():
+        jax.block_until_ready(
+            kv.wait_pull(kv.push_pull(kv.request(channel=0), keys=keys, values=vals))
+        )
+
+    def push_then_pull():
+        kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+        jax.block_until_ready(kv.wait_pull(kv.pull(kv.request(channel=0), keys=keys)))
+
     n = 3 if smoke else 10
     sec = timeit(push, n)
     report("kv_vector_push_keys_per_sec", n_keys / sec, "keys/sec")
     report("kv_vector_push_mb_per_sec", vals.nbytes / sec / 1e6, "MB/s")
     sec = timeit(pull, n)
     report("kv_vector_pull_keys_per_sec", n_keys / sec, "keys/sec")
+
+    # fused vs sequenced round trip (same store-level machinery both ways)
+    sec = timeit(push_pull_fused, n)
+    report("kv_vector_push_pull_fused_rt_per_sec", 1.0 / sec, "rt/sec")
+    sec = timeit(push_then_pull, n)
+    report("kv_vector_push_then_pull_rt_per_sec", 1.0 / sec, "rt/sec")
+
+    # kernel-level donate/copy A/B: same jitted scatter-add, only the
+    # aliasing differs — the delta IS the [P, k] table copy
+    slots = jax.block_until_ready(kv.slots(0, keys))
+    vjnp = jax.block_until_ready(jax.device_put(vals))
+    table_copy = jax.block_until_ready(kv.table(0, copy=True))
+    tbl_box = [kv.table(0, copy=True)]
+
+    def push_nodonate():
+        jax.block_until_ready(
+            kv_ops.push(table_copy, slots, vjnp, mesh=mesh, batch_sharded=False)
+        )
+
+    def push_donated():
+        tbl_box[0] = kv_ops.push_donated(
+            tbl_box[0], slots, vjnp, mesh=mesh, batch_sharded=False
+        )
+        jax.block_until_ready(tbl_box[0])
+
+    sec_nd = timeit(push_nodonate, n)
+    report("kv_vector_push_nodonate_keys_per_sec", n_keys / sec_nd, "keys/sec")
+    sec_d = timeit(push_donated, n)
+    report("kv_vector_push_donated_keys_per_sec", n_keys / sec_d, "keys/sec")
+    report(
+        "kv_vector_push_copy_bytes_avoided_per_push",
+        float(table_copy.nbytes),
+        "bytes",
+    )
 
 
 @benchmark("kv_map")
@@ -64,7 +114,10 @@ def kv_map_perf(smoke: bool = False) -> None:
 
 @benchmark("kv_layer")
 def kv_layer_perf(smoke: bool = False) -> None:
-    """Dense-layer push/pull throughput (ref src/test/kv_layer_perf_ps.cc)."""
+    """Dense-layer push/pull throughput (ref src/test/kv_layer_perf_ps.cc).
+
+    A/B: donated in-place updater (the default) vs the seed's copying
+    updater (``donate=False``), plus the fused push_pull round trip."""
     import jax
 
     from ..parameter.kv_layer import KVLayer, SGDUpdater
@@ -82,9 +135,40 @@ def kv_layer_perf(smoke: bool = False) -> None:
     def pull():
         jax.block_until_ready(layer.wait_pull(layer.pull(layer.request(), "w")))
 
+    def push_pull_fused():
+        jax.block_until_ready(
+            layer.wait_pull(layer.push_pull(layer.request(), "w", grad))
+        )
+
     n = 3 if smoke else 10
     report("kv_layer_push_mb_per_sec", nbytes / timeit(push, n) / 1e6, "MB/s")
     report("kv_layer_pull_mb_per_sec", nbytes / timeit(pull, n) / 1e6, "MB/s")
+    sec = timeit(push_pull_fused, n)
+    report("kv_layer_push_pull_fused_rt_per_sec", 1.0 / sec, "rt/sec")
+    report("kv_layer_push_copy_bytes_avoided_per_push", float(nbytes), "bytes")
+
+    # copying-mode A/B (the seed path): same updater, donation off
+    nodon = KVLayer(
+        partition_thr=1024, updater=SGDUpdater(lr=0.1), mesh=mesh,
+        donate=False,
+    )
+    nodon.init_layer("w", shape)
+
+    def push_nodonate():
+        nodon.wait(nodon.push(nodon.request(), "w", grad))
+
+    report(
+        "kv_layer_push_nodonate_mb_per_sec",
+        nbytes / timeit(push_nodonate, n) / 1e6,
+        "MB/s",
+    )
+
+    def push_then_pull():
+        layer.wait(layer.push(layer.request(), "w", grad))
+        jax.block_until_ready(layer.wait_pull(layer.pull(layer.request(), "w")))
+
+    sec = timeit(push_then_pull, n)
+    report("kv_layer_push_then_pull_rt_per_sec", 1.0 / sec, "rt/sec")
 
 
 @benchmark("network")

@@ -234,8 +234,13 @@ def _choose_block_rows(rows: int, requested: "int | None" = None) -> int:
     return br
 
 
+# The public z/n entry point is used by parity tests and snapshot
+# paths that keep their inputs; the fused train steps donate at THEIR
+# boundary (and the Pallas path aliases in-block via
+# input_output_aliases), so jit-level donation here would only poison
+# callers' buffers without removing a copy.
 @functools.partial(
-    jax.jit,
+    jax.jit,  # no-donate: see above — callers keep their z/n inputs
     static_argnames=("alpha", "beta", "l1", "l2", "force_pallas",
                      "interpret", "block_rows"),
 )

@@ -17,11 +17,28 @@ This is where the reference's ``KVVector::Push/Pull`` message traffic
 All shapes are static: indices are int32 slot ids produced by the host-side
 localizer/directory; out-of-range or padding entries use slot id ``P``
 (one-past-the-end sentinel) and are dropped by range masking.
+
+**Donation (the zero-copy data plane).** ``push``/``push_pull`` come in
+two flavors per update: the plain entry points leave the input table
+alive (XLA materializes a fresh ``[P, k]`` output — a full HBM table
+copy per push), and the ``*_donated`` entry points alias input→output
+(``donate_argnums``) so the scatter-add happens in place. Callers that
+OWN their table (KVVector/KVMap channel tables, staged push buffers)
+use the donated path; anyone still holding the input array afterwards
+gets jax's read-after-donate ``RuntimeError`` rather than silent
+staleness. Checkpoint/replica paths must therefore copy BEFORE the
+push dispatches — see doc/PERFORMANCE.md "Donation rules".
+
+``push_pull`` fuses the reference's server-side "aggregate then reply"
+round trip (push message + pull reply) into ONE dispatched program:
+scatter-add, then gather from the freshly-updated shard, bit-identical
+to ``push`` followed by ``pull``.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map
 
 from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
+from ..telemetry.instruments import cached_kvops_instruments as _tel
 
 
 def localize(idx: jnp.ndarray, shard: int):
@@ -75,14 +93,7 @@ def valid_slots(slots: jnp.ndarray, num_slots: int) -> jnp.ndarray:
     return slots < num_slots
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))
-def pull(table: jax.Array, idx: jax.Array, *, mesh: Mesh, batch_sharded: bool = True):
-    """Gather rows ``table[idx]`` from a server-sharded table.
-
-    table: [P, k] sharded P(SERVER, None); idx: [n] int32, sharded over DATA
-    if batch_sharded (each worker pulls its own key set — the common case)
-    else replicated. Returns [n, k] with the same batch sharding.
-    """
+def _pull_impl(table, idx, *, mesh: Mesh, batch_sharded: bool = True):
     p_total, _ = table.shape
     n_server = mesh.shape[SERVER_AXIS]
     shard = p_total // n_server
@@ -101,34 +112,21 @@ def pull(table: jax.Array, idx: jax.Array, *, mesh: Mesh, batch_sharded: bool = 
     )(table, idx)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("mesh", "batch_sharded", "average", "combine_data")
+# no-donate: pull reads the table; the store keeps serving it afterwards
+pull = functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))(
+    _pull_impl
 )
-def push(
-    table: jax.Array,
-    idx: jax.Array,
-    vals: jax.Array,
-    *,
-    mesh: Mesh,
-    batch_sharded: bool = True,
-    average: bool = False,
-    combine_data: bool = True,
-):
-    """Scatter-add ``vals`` at ``idx`` into the server-sharded table.
+pull.__doc__ = """Gather rows ``table[idx]`` from a server-sharded table.
 
-    table: [P, k] sharded P(SERVER, None); idx: [n] int32; vals: [n, k].
-    With batch_sharded, each worker contributes its own (idx, vals): entries
-    are all-gathered over the DATA axis so every server shard sees every
-    contribution (the reference's sliced push messages to each server).
-    ``average`` divides by the worker count (scaled gradient aggregation).
-    """
-    p_total, k = table.shape
-    n_server = mesh.shape[SERVER_AXIS]
-    n_data = mesh.shape[DATA_AXIS]
-    shard = p_total // n_server
-    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+table: [P, k] sharded P(SERVER, None); idx: [n] int32, sharded over DATA
+if batch_sharded (each worker pulls its own key set — the common case)
+else replicated. Returns [n, k] with the same batch sharding.
+"""
 
-    combined = batch_sharded and combine_data and n_data > 1
+
+def _push_local_fn(shard, n_data, average, combined):
+    """Per-shard push body shared by push and push_pull (bit-identical
+    aggregation between the plain and fused dispatches)."""
 
     def local(tbl, ix, v):
         if combined:
@@ -141,12 +139,144 @@ def push(
         v = jnp.where(ok[:, None], v, 0)
         return tbl.at[rel].add(v, mode="drop")
 
+    return local
+
+
+def _push_impl(
+    table,
+    idx,
+    vals,
+    *,
+    mesh: Mesh,
+    batch_sharded: bool = True,
+    average: bool = False,
+    combine_data: bool = True,
+):
+    p_total, k = table.shape
+    n_server = mesh.shape[SERVER_AXIS]
+    n_data = mesh.shape[DATA_AXIS]
+    shard = p_total // n_server
+    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+    combined = batch_sharded and combine_data and n_data > 1
+    local = _push_local_fn(shard, n_data, average, combined)
+
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(SERVER_AXIS, None), idx_spec, idx_spec),
         out_specs=P(SERVER_AXIS, None),
     )(table, idx, vals)
+
+
+_PUSH_STATICS = ("mesh", "batch_sharded", "average", "combine_data")
+
+# no-donate: the copying path — for callers whose input table must
+# survive the push (checkpoint staging, A/B benches); owners use
+# push_donated
+push = functools.partial(jax.jit, static_argnames=_PUSH_STATICS)(_push_impl)
+push.__doc__ = """Scatter-add ``vals`` at ``idx`` into the server-sharded table.
+
+table: [P, k] sharded P(SERVER, None); idx: [n] int32; vals: [n, k].
+With batch_sharded, each worker contributes its own (idx, vals): entries
+are all-gathered over the DATA axis so every server shard sees every
+contribution (the reference's sliced push messages to each server).
+``average`` divides by the worker count (scaled gradient aggregation).
+
+This entry point COPIES: XLA materializes a fresh table output. Callers
+that own their table should use :func:`push_donated` (in-place).
+"""
+
+_push_donated_jit = functools.partial(
+    jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
+)(_push_impl)
+
+
+def push_donated(table, idx, vals, **kw):
+    """In-place :func:`push`: the input table buffer is DONATED to the
+    update (XLA aliases input→output; no ``[P, k]`` copy). The caller
+    must own ``table`` exclusively — any other live reference to it
+    raises on next use (read-after-donate). Same math as ``push``."""
+    tel = _tel()
+    if tel is not None:
+        tel["donated_pushes"].inc()
+    return _push_donated_jit(table, idx, vals, **kw)
+
+
+def _push_pull_impl(
+    table,
+    idx,
+    vals,
+    pull_idx,
+    *,
+    mesh: Mesh,
+    batch_sharded: bool = True,
+    average: bool = False,
+    combine_data: bool = True,
+):
+    p_total, k = table.shape
+    n_server = mesh.shape[SERVER_AXIS]
+    n_data = mesh.shape[DATA_AXIS]
+    shard = p_total // n_server
+    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+    combined = batch_sharded and combine_data and n_data > 1
+    push_local = _push_local_fn(shard, n_data, average, combined)
+
+    def local(tbl, ix, v, pix):
+        new = push_local(tbl, ix, v)
+        rel, ok = localize(pix, shard)
+        out = jnp.where(ok[:, None], new[rel], 0)
+        return new, jax.lax.psum(out, SERVER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SERVER_AXIS, None), idx_spec, idx_spec, idx_spec),
+        out_specs=(P(SERVER_AXIS, None), idx_spec),
+    )(table, idx, vals, pull_idx)
+
+
+# no-donate: the copying fused path (A/B benches, shared-table callers)
+_push_pull_jit = functools.partial(
+    jax.jit, static_argnames=_PUSH_STATICS
+)(_push_pull_impl)
+_push_pull_donated_jit = functools.partial(
+    jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
+)(_push_pull_impl)
+
+
+def _dispatch_fused(jit_fn, table, idx, vals, pull_idx, kw):
+    if pull_idx is None:
+        pull_idx = idx
+    tel = _tel()
+    if tel is None:
+        return jit_fn(table, idx, vals, pull_idx, **kw)
+    t0 = time.perf_counter()
+    out = jit_fn(table, idx, vals, pull_idx, **kw)
+    # dispatch wall time (host side), not device completion — the win
+    # this kernel buys is one launch instead of two
+    tel["fused_dispatch"].observe(time.perf_counter() - t0)
+    return out
+
+
+def push_pull(table, idx, vals, pull_idx=None, **kw):
+    """Fused scatter-add + gather in ONE dispatched program: returns
+    ``(new_table, pulled)`` where ``pulled = pull(push(table, idx, vals),
+    pull_idx)`` bit-for-bit. ``pull_idx`` defaults to ``idx`` (the
+    common push→pull-same-keys round trip — the reference's server-side
+    "aggregate then reply" in one launch). This entry point copies the
+    table; owners use :func:`push_pull_donated`."""
+    return _dispatch_fused(_push_pull_jit, table, idx, vals, pull_idx, kw)
+
+
+def push_pull_donated(table, idx, vals, pull_idx=None, **kw):
+    """:func:`push_pull` with the table donated (in-place update, no
+    ``[P, k]`` copy). Caller must own ``table`` exclusively."""
+    tel = _tel()
+    if tel is not None:
+        tel["donated_pushes"].inc()
+    return _dispatch_fused(
+        _push_pull_donated_jit, table, idx, vals, pull_idx, kw
+    )
 
 
 def scatter_grad_dense(

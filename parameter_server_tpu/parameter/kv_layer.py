@@ -11,6 +11,17 @@ replicated. Push = cross-worker psum of gradients + Updater application
 (one fused jitted step); pull = return the (already resident) array.
 ``zero_copy`` parity: device buffers are donated through the updater so no
 copy is made.
+
+**Donation contract** (``donate=True``, the default): the store owns its
+layer arrays, so the updater runs IN PLACE — each push consumes the
+previous weight buffer instead of materializing a same-sized copy. A
+pulled layer is therefore a zero-copy view valid until the NEXT push to
+that key (after which reading it raises — jax read-after-donate);
+callers that must hold weights across pushes copy them, and
+``get_replica`` snapshots to host before any later push can land.
+``donate=False`` restores the seed's copying behavior (pull results
+stay valid forever at one full-layer HBM copy per push). See
+doc/PERFORMANCE.md "Donation rules".
 """
 
 from __future__ import annotations
@@ -49,6 +60,7 @@ class KVLayer(Parameter):
         partition_thr: int = 1000,
         updater=None,
         mesh=None,
+        donate: bool = True,
         id: Optional[int] = None,
         name: str = "",
     ):
@@ -59,6 +71,7 @@ class KVLayer(Parameter):
         self.mesh = mesh
         self.partition_thr = int(partition_thr)
         self.updater = updater or SGDUpdater()
+        self.donate = bool(donate)
         self.layers: Dict[object, jax.Array] = {}
         self._update_fns: Dict[object, Callable] = {}
 
@@ -91,38 +104,76 @@ class KVLayer(Parameter):
             def fn(weight, recv):
                 return updater.update(key, weight, recv)
 
-            # no buffer donation here: a pending pull future may still alias
-            # the current weight array; donating it would poison that future
-            self._update_fns[key] = jax.jit(fn)
+            if self.donate:
+                # in-place updater: the store owns the weight buffer and
+                # replaces it, so donation is legal — a previously pulled
+                # view of THIS layer dies with the push (module contract)
+                self._update_fns[key] = jax.jit(fn, donate_argnums=(0,))
+            else:
+                # no-donate: copying mode — pull futures must outlive
+                # pushes (donate=False construction)
+                self._update_fns[key] = jax.jit(fn)
         return self._update_fns[key]
+
+    def _push_step(self, key, data):
+        """The one update-step body both push and push_pull submit:
+        donated-push accounting, receive, updater apply, reinstall."""
+
+        def step():
+            if self.donate:
+                from ..telemetry.instruments import cached_kvops_instruments
+
+                tel = cached_kvops_instruments()
+                if tel is not None:
+                    tel["donated_pushes"].inc()
+            recv = jnp.asarray(data)
+            self.layers[key] = self._update_fn(key)(self.layers[key], recv)
+            return self.layers[key]
+
+        return step
 
     def push(self, task: Task, key, data: jax.Array, zero_copy: bool = False, callback=None) -> int:
         """Push a gradient/update for a layer; the updater runs server-side
         (ref KVLayer::Push → SetValue → updater_->Update)."""
         if key not in self.layers:
             self.init_layer(key, data.shape, data.dtype)
-
-        def step():
-            recv = jnp.asarray(data)
-            self.layers[key] = self._update_fn(key)(self.layers[key], recv)
-            return self.layers[key]
-
         # layers are whole-tensor channels: key-count 1 per request, the
         # layer name as the channel label
-        return self.instrumented_submit("push", key, 1, step, task, callback)
+        return self.instrumented_submit(
+            "push", key, 1, self._push_step(key, data), task, callback
+        )
 
     def pull(self, task: Task, key, callback=None) -> int:
-        """Pull the layer (ref KVLayer::Pull; data lands in layer_ / user buf)."""
+        """Pull the layer (ref KVLayer::Pull; data lands in layer_ / user buf).
+        Under ``donate=True`` the result is a zero-copy view valid until
+        the next push to ``key`` (module docstring)."""
 
         def step():
             return self.layers[key]
 
         return self.instrumented_submit("pull", key, 1, step, task, callback)
 
+    def push_pull(self, task: Task, key, data: jax.Array, callback=None) -> int:
+        """Fused push→pull: apply the updater and hand back the freshly
+        updated layer in ONE submitted step — the reference server's
+        "aggregate then reply" round trip without a second executor
+        round trip (used by the nn trainer's parameter refresh). Result
+        via ``wait_pull``; bit-identical to ``push`` then ``pull``.
+        Accounted under ``ps_push_pull_*`` (store level) only — a layer
+        pull returns the resident array, so no extra device launch is
+        saved and the kv_ops fused-dispatch histogram stays honest."""
+        if key not in self.layers:
+            self.init_layer(key, data.shape, data.dtype)
+        return self.instrumented_submit(
+            "push_pull", key, 1, self._push_step(key, data), task, callback
+        )
+
     def wait_pull(self, ts: int):
         return self.executor.pop_result(ts)
 
     def get_replica(self) -> dict:
+        # drain in-flight (donated) pushes, then host copies
+        self.executor.wait_all(pop=False)
         return {k: np.asarray(v) for k, v in self.layers.items()}
 
     def set_replica(self, snapshot: dict) -> None:

@@ -19,6 +19,7 @@ bandwidth-bound) — this is the server-side compute of the parameter server.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Protocol
 
 import jax
@@ -132,7 +133,11 @@ class KVMap(Parameter):
 
         state_specs = {k_: P(SERVER_AXIS) for k_ in self.state}
 
-        @jax.jit
+        # the store owns self.state exclusively and replaces it on every
+        # push, so the state buffers are donated: the entry update runs
+        # in place instead of materializing a fresh struct-of-arrays
+        # copy per push (zero-copy contract, doc/PERFORMANCE.md)
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def push_fn(state, ix, v):
             return shard_map(
                 local,
@@ -144,13 +149,19 @@ class KVMap(Parameter):
         return push_fn
 
     def slots(self, keys: np.ndarray) -> jnp.ndarray:
-        return jnp.asarray(self.directory.slots(keys))
+        # signature-cached host mapping + device upload (KeyDirectory)
+        return self.directory.slots_device(keys)
 
     def push(self, task: Task, keys, values, callback=None) -> int:
         slots = self.slots(keys)
         vals = jnp.asarray(values, jnp.float32).reshape(-1, self.k)
 
         def step():
+            from ..telemetry.instruments import cached_kvops_instruments
+
+            tel = cached_kvops_instruments()
+            if tel is not None:
+                tel["donated_pushes"].inc()
             self.state = self._push_fn(self.state, slots, vals)
             return self.state
 
@@ -180,6 +191,7 @@ class KVMap(Parameter):
 
     def write_to_file(self, path: str) -> None:
         """Nonzero weights as text (ref KVMap::WriteToFile)."""
+        self.executor.wait_all(pop=False)  # donated pushes settle first
         vals = np.asarray(self.entry.get(self.state))
         keys = (
             self.directory.keys
@@ -193,6 +205,9 @@ class KVMap(Parameter):
                 f.write(f"{key}\t" + "\t".join(repr(float(x)) for x in val) + "\n")
 
     def get_replica(self) -> dict:
+        # drain in-flight (donated) pushes, then host copies — the
+        # snapshot is immune to later in-place updates
+        self.executor.wait_all(pop=False)
         return {k_: np.asarray(v) for k_, v in self.state.items()}
 
     def set_replica(self, snapshot: dict) -> None:

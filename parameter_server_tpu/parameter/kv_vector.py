@@ -14,6 +14,16 @@ and push/pull are the collective kernels in ``ops/kv_ops.py``. The
 for later merge — used by BCD servers to aggregate worker gradients before
 an update) maps to ``pull_buffered``/``buffer``: pushes land in a staging
 table instead of the live one.
+
+**Zero-copy contract.** Channel tables (and staging buffers) are updated
+IN PLACE: pushes dispatch through ``kv_ops.push_donated``, so no
+``[P, k]`` copy is materialized per push. Consequently ``table()`` /
+``buffer()`` return live views that the NEXT push to that channel
+invalidates (read-after-donate raises) — snapshot paths must copy
+first, which ``get_replica``/``write_to_file`` do (host ``np.asarray``)
+and ``table(copy=True)`` offers on device. Pull results never alias the
+table (gathers materialize fresh rows), so pulled values stay valid
+across later pushes. See doc/PERFORMANCE.md "Donation rules".
 """
 
 from __future__ import annotations
@@ -94,16 +104,25 @@ class KVVector(Parameter):
 
     def set_keys(self, ch: int, keys: np.ndarray) -> None:
         """Install an exact ordered key set for a channel (ref: the worker
-        assigns ``model_[ch].key = key`` before pulling)."""
+        assigns ``model_[ch].key = key`` before pulling).
+
+        The input is sorted and de-duplicated (``np.unique``) before
+        install: exact directories look keys up with ``searchsorted``,
+        which SILENTLY corrupts the mapping on unsorted/duplicate input
+        (the regression this guards: caller-order keys landing in wrong
+        slots). The installed, canonical key array is kept on
+        ``channel(ch).key``."""
         c = self.channel(ch)
-        keys = np.asarray(keys, dtype=np.int64)
+        keys = np.unique(np.asarray(keys, dtype=np.int64))
         c.directory = KeyDirectory(self.num_slots, keys=keys, hashed=False)
         c.key = keys
 
     # -- push/pull --
 
     def slots(self, ch: int, keys: np.ndarray) -> jnp.ndarray:
-        return jnp.asarray(self.channel(ch).directory.slots(keys))
+        # signature-cached: a repeated key set skips hash/searchsorted
+        # AND the host->device index upload (KeyDirectory slot cache)
+        return self.channel(ch).directory.slots_device(keys)
 
     def pull(
         self,
@@ -147,12 +166,14 @@ class KVVector(Parameter):
         vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
 
         if self.buffer_value and task.time >= 0:
-            # stage into a per-timestamp buffer (ref buffer_[timestamp])
+            # stage into a per-timestamp buffer (ref buffer_[timestamp]);
+            # the channel owns its staging buffers, so they update in
+            # place too (donated) — merge_buffer readers copy on read
             def step():
                 buf = c.buffers.get(task.time)
                 if buf is None:
                     buf = self._zeros()
-                c.buffers[task.time] = kv_ops.push(
+                c.buffers[task.time] = kv_ops.push_donated(
                     buf, slots, vals, mesh=self.mesh, batch_sharded=False
                 )
                 return c.buffers[task.time]
@@ -160,13 +181,61 @@ class KVVector(Parameter):
         else:
 
             def step():
-                c.table = kv_ops.push(
+                # in-place: the channel owns its table; the previous
+                # table buffer is consumed (zero-copy contract above)
+                c.table = kv_ops.push_donated(
                     c.table, slots, vals, mesh=self.mesh, batch_sharded=False
                 )
                 return c.table
 
         return self.instrumented_submit(
             "push", ch, len(slots), step, task, callback
+        )
+
+    def push_pull(
+        self,
+        task: Task,
+        keys: Optional[np.ndarray] = None,
+        values: Optional[jax.Array] = None,
+        slots: Optional[jax.Array] = None,
+        pull_keys: Optional[np.ndarray] = None,
+        callback=None,
+    ) -> int:
+        """Fused push→pull round trip: aggregate ``values`` into the
+        channel table and return the freshly-updated rows in ONE device
+        dispatch (the reference server's "aggregate then reply",
+        kv_ops.push_pull). ``pull_keys`` defaults to the pushed keys.
+        Bit-identical to ``push`` + ``pull``; result via ``wait_pull``.
+
+        Incompatible with buffered staging: a ``buffer_value`` store
+        with a timestamped request stages pushes for later merge, while
+        the fused round trip applies-and-reads the LIVE table — raising
+        here beats silently corrupting the staged aggregation."""
+        if self.buffer_value and task.time >= 0:
+            raise ValueError(
+                "push_pull applies to the live table; a buffer_value "
+                "store with task.time >= 0 stages pushes instead — use "
+                "push() + buffer()/pull"
+            )
+        ch = task.key_channel
+        c = self.channel(ch)
+        if slots is None:
+            assert keys is not None
+            slots = self.slots(ch, keys)
+        pull_slots = (
+            None if pull_keys is None else self.slots(ch, pull_keys)
+        )
+        vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
+
+        def step():
+            c.table, pulled = kv_ops.push_pull_donated(
+                c.table, slots, vals, pull_slots,
+                mesh=self.mesh, batch_sharded=False,
+            )
+            return pulled
+
+        return self.instrumented_submit(
+            "push_pull", ch, len(slots), step, task, callback
         )
 
     def buffer(self, ch: int, ts: int) -> Optional[jax.Array]:
@@ -182,8 +251,13 @@ class KVVector(Parameter):
         ts = self.pull(self.request(channel=ch), keys=keys)
         return np.asarray(self.wait_pull(ts))
 
-    def table(self, ch: int = 0) -> jax.Array:
-        return self.channel(ch).table
+    def table(self, ch: int = 0, copy: bool = False) -> jax.Array:
+        """The channel table. Default is the LIVE array — a zero-copy
+        view that the next (donated) push to this channel invalidates;
+        ``copy=True`` returns a private snapshot that survives pushes
+        (the checkpoint-path contract, doc/PERFORMANCE.md)."""
+        t = self.channel(ch).table
+        return jnp.array(t, copy=True) if copy else t
 
     def set_table(self, ch: int, table: jax.Array) -> None:
         self.channel(ch).table = table
@@ -191,6 +265,11 @@ class KVVector(Parameter):
     # -- replica hooks --
 
     def get_replica(self) -> dict:
+        # drain in-flight pushes (they donate table buffers on the
+        # executor thread — a concurrent host read could hit a freshly
+        # deleted buffer), then take host COPIES: the snapshot is immune
+        # to every later donated push
+        self.executor.wait_all(pop=False)
         return {ch: np.asarray(c.table) for ch, c in self._channels.items()}
 
     def set_replica(self, snapshot: dict) -> None:
@@ -202,6 +281,7 @@ class KVVector(Parameter):
 
     def write_to_file(self, path: str, ch: int = 0) -> None:
         """Dump nonzero (key, value) pairs as text (ref WriteToFile)."""
+        self.executor.wait_all(pop=False)  # donated pushes settle first
         c = self.channel(ch)
         tbl = np.asarray(c.table)
         if c.directory.keys is not None:

@@ -19,6 +19,7 @@ Key directories come in two modes, both host-side:
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Optional, Sequence
 
 import numpy as np
@@ -26,6 +27,8 @@ import numpy as np
 from ..system.customer import Customer
 from ..system.message import INVALID_TIME, FilterSpec, Task
 from ..telemetry import registry as telemetry_registry
+from ..telemetry.instruments import cached_kvops_instruments as _dir_tel
+from ..utils import crc32c
 from ..utils.murmur import hash_slots
 from ..utils.range import Range
 
@@ -108,7 +111,26 @@ class Parameter(Customer):
 
 
 class KeyDirectory:
-    """Host-side key → slot mapping for one channel."""
+    """Host-side key → slot mapping for one channel.
+
+    **Exact directories require sorted unique keys**: slot lookup is
+    ``np.searchsorted``, which silently mismatches on unsorted input
+    (the reference keeps ordered unique key arrays for the same reason,
+    kv_vector.h). The constructor raises on violations; callers with
+    raw key sets sort+unique first (``KVVector.set_keys`` does).
+
+    **Slot cache** (device analog of the reference's key-caching filter,
+    src/filter/key_caching.h): repeated calls with the SAME key array
+    skip the hash/searchsorted pass and — via :meth:`slots_device` — the
+    host→device index upload. Entries are keyed by the crc32c prefix
+    signature the wire filter already uses (utils/crc32c
+    .array_signature) and verified exactly against a retained copy of
+    the keys (memcmp-speed), so a signature collision can never serve
+    wrong slots. LRU over ``CACHE_SLOTS`` entries.
+    """
+
+    MAX_SIG_LEN = 2048  # same signature prefix budget as KeyCachingFilter
+    CACHE_SLOTS = 8
 
     def __init__(
         self,
@@ -121,11 +143,47 @@ class KeyDirectory:
         self.keys = None if keys is None else np.asarray(keys, dtype=np.int64)
         if self.keys is not None and len(self.keys) > num_slots:
             raise ValueError(f"{len(self.keys)} keys exceed {num_slots} slots")
+        if self.keys is not None and len(self.keys) > 1:
+            d = np.diff(self.keys)
+            if not (d > 0).all():
+                kind = "unsorted" if (d < 0).any() else "duplicate"
+                raise ValueError(
+                    f"exact KeyDirectory requires sorted unique keys "
+                    f"({kind} input): searchsorted would silently map "
+                    "keys to wrong slots — np.unique the key set first"
+                )
+        # sig -> [keys_copy, slots, device_slots|None]; MRU at the end
+        self._slot_cache: "OrderedDict[tuple, list]" = OrderedDict()
 
-    def slots(self, keys: np.ndarray) -> np.ndarray:
-        """Map global keys to dense int32 slot ids; misses map to the
-        sentinel slot ``num_slots`` (dropped by device range masks)."""
-        keys = np.asarray(keys)
+    def _signature(self, keys: np.ndarray) -> tuple:
+        return (
+            crc32c.array_signature(keys, self.MAX_SIG_LEN),
+            keys.shape[0],
+            keys.dtype.str,
+        )
+
+    def _cache_entry(self, keys: np.ndarray) -> list:
+        """Cache row for this key array: ``[keys_copy, slots, device]``.
+        Hits verify the full array against the retained copy, so the
+        prefix signature only routes — it never decides."""
+        sig = self._signature(keys)
+        entry = self._slot_cache.get(sig)
+        tel = _dir_tel()
+        if entry is not None and np.array_equal(keys, entry[0]):
+            self._slot_cache.move_to_end(sig)
+            if tel is not None:
+                tel["slot_cache_hits"].inc()
+            return entry
+        if tel is not None:
+            tel["slot_cache_misses"].inc()
+        entry = [np.array(keys, copy=True), self._compute_slots(keys), None]
+        self._slot_cache[sig] = entry
+        self._slot_cache.move_to_end(sig)
+        while len(self._slot_cache) > self.CACHE_SLOTS:
+            self._slot_cache.popitem(last=False)
+        return entry
+
+    def _compute_slots(self, keys: np.ndarray) -> np.ndarray:
         if self.hashed:
             return hash_slots(keys, self.num_slots)
         assert self.keys is not None, "exact directory requires keys"
@@ -137,6 +195,23 @@ class KeyDirectory:
             else np.zeros(len(keys), dtype=bool)
         )
         return np.where(hit, pos, self.num_slots).astype(np.int32)
+
+    def slots(self, keys: np.ndarray) -> np.ndarray:
+        """Map global keys to dense int32 slot ids; misses map to the
+        sentinel slot ``num_slots`` (dropped by device range masks).
+        Cached per key-array signature — treat the result as read-only."""
+        return self._cache_entry(np.asarray(keys))[1]
+
+    def slots_device(self, keys: np.ndarray):
+        """:meth:`slots` as a device array, cached: a repeated key set
+        skips the host→device index upload too (jnp.asarray is the
+        transfer the pull/push request path pays per call otherwise)."""
+        import jax.numpy as jnp
+
+        entry = self._cache_entry(np.asarray(keys))
+        if entry[2] is None:
+            entry[2] = jnp.asarray(entry[1])
+        return entry[2]
 
 
 def pad_slots(num_slots: int, num_shards: int) -> int:

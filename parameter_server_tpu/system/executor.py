@@ -333,9 +333,7 @@ class Executor:
                     self._running = ts
             if pick is None:
                 if dep_fut is not None:
-                    t_mat0 = time.perf_counter()
-                    jax.block_until_ready(dep_fut)
-                    self._note_materialize(dep, time.perf_counter() - t_mat0)
+                    self._materialize_fut(dep, dep_fut)
                 self._finish(dep)
                 continue
             # run the step outside the lock (it may dispatch device work,
@@ -404,6 +402,35 @@ class Executor:
         times = self._step_times.get(ts)
         if times is not None:
             times[3] += seconds
+
+    def _materialize_fut(self, ts: int, fut: Any) -> None:
+        """block_until_ready tolerant of DONATED futures.
+
+        The zero-copy data plane stores live table handles as step
+        results; a LATER step may consume (donate) that buffer in
+        place. The dispatch thread is serial, so donation implies the
+        producing step already completed — a deleted/donated buffer
+        here means 'materialized long ago', not an error. The waiter
+        still receives the dead handle; READING it raises jax's
+        read-after-donate, which is the documented contract
+        (doc/PERFORMANCE.md "Donation rules"). Without this guard a
+        fire-and-forget push pipeline crashed (and then wedged — see
+        wait()) the moment a snapshot waited on a superseded future.
+
+        Known tradeoff: the message match cannot distinguish a
+        legitimately superseded future from an erroneously
+        double-donated buffer — the latter is only caught when its
+        VALUE is read (which still raises). Narrowing this would need
+        the stores to mark superseded timestamps explicitly.
+        """
+        t0 = time.perf_counter()
+        try:
+            jax.block_until_ready(fut)
+        except RuntimeError as e:
+            msg = str(e)
+            if "deleted" not in msg and "donated" not in msg:
+                raise
+        self._note_materialize(ts, time.perf_counter() - t0)
 
     def _record_finished(self, ts: int) -> None:
         """Record the finished step's phases into the registry and emit
@@ -504,9 +531,14 @@ class Executor:
             self._finish(ts)
             raise err
         if fut is not None:
-            t_mat0 = time.perf_counter()
-            jax.block_until_ready(fut)
-            self._note_materialize(ts, time.perf_counter() - t_mat0)
+            try:
+                self._materialize_fut(ts, fut)
+            except BaseException:
+                # the step DID run; mark it finished even when forcing
+                # its value fails, or every later wait()/wait_all() on
+                # this ts would spin forever on a future that is gone
+                self._finish(ts)
+                raise
         self._finish(ts)
         return fut
 

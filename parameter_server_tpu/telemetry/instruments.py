@@ -105,6 +105,11 @@ def parameter_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "pull submit to finished, per request",
             labelnames=("store", "channel"),
         ),
+        "push_pull_latency": reg.ensure_histogram(
+            "ps_push_pull_latency_seconds",
+            "fused push_pull submit to finished, per request",
+            labelnames=("store", "channel"),
+        ),
         "push_keys": reg.ensure_counter(
             "ps_push_keys_total",
             "keys carried by push requests",
@@ -114,6 +119,42 @@ def parameter_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "ps_pull_keys_total",
             "keys carried by pull requests",
             labelnames=("store", "channel"),
+        ),
+        "push_pull_keys": reg.ensure_counter(
+            "ps_push_pull_keys_total",
+            "keys carried by fused push_pull requests",
+            labelnames=("store", "channel"),
+        ),
+    }
+
+
+def kvops_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Device data-plane counters (ops/kv_ops + KeyDirectory slot cache).
+
+    The donated-push counter and the fused-dispatch histogram size the
+    zero-copy wins (doc/PERFORMANCE.md "Donation rules"); the slot-cache
+    pair is the device analog of the reference's key-caching filter hit
+    rate (src/filter/key_caching.h)."""
+    return {
+        "donated_pushes": reg.ensure_counter(
+            "ps_kvops_donated_pushes_total",
+            "table updates dispatched through a donated (in-place) "
+            "push/push_pull — each one avoids a full [P, k] HBM copy",
+        ),
+        "fused_dispatch": reg.ensure_histogram(
+            "ps_kvops_fused_dispatch_seconds",
+            "host-side dispatch wall time of fused push_pull programs "
+            "(one launch instead of a push + a pull)",
+            buckets=PHASE_BUCKETS,
+        ),
+        "slot_cache_hits": reg.ensure_counter(
+            "ps_directory_slot_cache_hits_total",
+            "KeyDirectory.slots calls answered from the signature cache "
+            "(hash/searchsorted and the device index upload skipped)",
+        ),
+        "slot_cache_misses": reg.ensure_counter(
+            "ps_directory_slot_cache_misses_total",
+            "KeyDirectory.slots calls that computed the slot mapping",
         ),
     }
 
@@ -153,10 +194,32 @@ def heartbeat_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+# (registry, instruments) pair shared by every kv_ops/KeyDirectory call
+# site — re-ensured when tests swap the default registry
+# (Postoffice.reset); None while telemetry is disabled
+_KVOPS_CACHE = (None, None)
+
+
+def cached_kvops_instruments():
+    """Process-default kvops instruments, or None when telemetry is
+    off. The ONE cache for the data-plane hot paths (kv_ops pushes,
+    KVMap/KVLayer steps, KeyDirectory slot cache)."""
+    from . import registry as telemetry_registry
+
+    if not telemetry_registry.enabled():
+        return None
+    reg = telemetry_registry.default_registry()
+    global _KVOPS_CACHE
+    if _KVOPS_CACHE[0] is not reg:
+        _KVOPS_CACHE = (reg, kvops_instruments(reg))
+    return _KVOPS_CACHE[1]
+
+
 INSTRUMENT_FAMILIES = (
     executor_instruments,
     van_instruments,
     parameter_instruments,
+    kvops_instruments,
     app_instruments,
     heartbeat_instruments,
 )

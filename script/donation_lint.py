@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""donation-lint: keep the zero-copy data plane zero-copy (fast, static).
+
+Every ``jax.jit`` site in the data-plane modules below must either
+declare ``donate_argnums`` (any value — an explicit empty tuple is a
+recorded decision) or carry a ``# no-donate: <reason>`` comment within
+three lines of the call. The rule exists because the defensive-copy
+trap is silent: a jitted table update WITHOUT donation compiles, runs,
+and quietly materializes a full ``[P, k]`` copy in HBM per call — the
+exact regression PR 2 removed (doc/PERFORMANCE.md "Donation rules").
+The lint makes the choice explicit at every site instead of trusting
+review to notice a missing kwarg.
+
+Purely syntactic (ast + source lines): no jax import, no tracing.
+Run via ``make donation-lint`` or directly; exercised as a tier-1 test
+in tests/test_donation.py so drift fails CI before it ships.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# the data-plane surface: modules whose jits touch parameter tables /
+# optimizer state on the hot path
+SCOPE = (
+    "parameter_server_tpu/ops/kv_ops.py",
+    "parameter_server_tpu/ops/ftrl.py",
+    "parameter_server_tpu/parameter/parameter.py",
+    "parameter_server_tpu/parameter/kv_vector.py",
+    "parameter_server_tpu/parameter/kv_map.py",
+    "parameter_server_tpu/parameter/kv_layer.py",
+    "parameter_server_tpu/apps/linear/async_sgd.py",
+    "parameter_server_tpu/apps/linear/updaters.py",
+    "parameter_server_tpu/apps/nn/trainer.py",
+)
+
+MARKER = "no-donate:"
+COMMENT_REACH = 3  # lines above the statement the justification may sit
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` as a reference (not a call)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_keywords(node: ast.Call):
+    """If ``node`` is a jit(...) or partial(jax.jit, ...) call, return
+    its keyword list; else None."""
+    if _is_jit_ref(node.func):
+        return node.keywords
+    # functools.partial(jax.jit, ...) — keywords live on the partial
+    if (
+        isinstance(node.func, ast.Attribute) and node.func.attr == "partial"
+        or isinstance(node.func, ast.Name) and node.func.id == "partial"
+    ):
+        if node.args and _is_jit_ref(node.args[0]):
+            return node.keywords
+    return None
+
+
+def _declares_donation(keywords) -> bool:
+    return any(kw.arg == "donate_argnums" for kw in keywords)
+
+
+def _has_marker(lines, lineno: int, end_lineno: int) -> bool:
+    lo = max(1, lineno - COMMENT_REACH)
+    hi = min(len(lines), end_lineno)
+    return any(MARKER in lines[i - 1] for i in range(lo, hi + 1))
+
+
+def _lint_file(path: str, rel: str) -> list:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    problems = []
+    tree = ast.parse(src, filename=rel)
+    for node in ast.walk(tree):
+        sites = []
+        if isinstance(node, ast.Call):
+            kws = _jit_call_keywords(node)
+            if kws is not None and not _declares_donation(kws):
+                sites.append(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # bare @jax.jit decorator (no call — can't carry kwargs)
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    sites.append(dec)
+        for site in sites:
+            end = getattr(site, "end_lineno", site.lineno) or site.lineno
+            if not _has_marker(lines, site.lineno, end):
+                problems.append(
+                    f"{rel}:{site.lineno}: jit site neither declares "
+                    f"donate_argnums nor carries a '# {MARKER} <reason>' "
+                    "justification"
+                )
+    return problems
+
+
+def lint(root: str | None = None) -> list:
+    """Returns a list of problem strings (empty = clean)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    problems = []
+    for rel in SCOPE:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: scoped data-plane module is missing")
+            continue
+        try:
+            problems.extend(_lint_file(path, rel))
+        except SyntaxError as e:
+            problems.append(f"{rel}: failed to parse: {e}")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    if problems:
+        for p in problems:
+            print(f"donation-lint: {p}", file=sys.stderr)
+        print(
+            f"donation-lint: FAILED ({len(problems)} problems)",
+            file=sys.stderr,
+        )
+        return 1
+    print("donation-lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,6 +270,11 @@ def test_bench_main_sigterm_during_probe_leaves_record():
     parseable failure record on stdout (the exact r4 silent death)."""
     snippet = """
 import contextlib, os, signal, sys, threading, time
+# the test NEEDS main() to take the probe path: an ambient
+# JAX_PLATFORMS=cpu (the tier-1 harness exports it) flips main's
+# cpu_run shortcut and skips the probe entirely — clear it; the probe
+# is mocked below so no device is ever touched either way
+os.environ.pop("JAX_PLATFORMS", None)
 sys.path.insert(0, {repo!r})
 import bench
 import parameter_server_tpu.utils.device_lock as dl

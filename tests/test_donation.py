@@ -1,0 +1,351 @@
+"""Zero-copy data plane: donation semantics, fused push_pull parity,
+slot-directory caching, and the donation lint.
+
+The contract under test (doc/PERFORMANCE.md "Donation rules"):
+
+- owners update tables IN PLACE (donated buffers) — stale references
+  raise instead of silently reading old data;
+- checkpoint/replica paths copy BEFORE donation can land, so snapshots
+  are immune to later pushes;
+- the fused ``push_pull`` kernel is bit-identical to push-then-pull;
+- ``KeyDirectory`` caches slot mappings by key-array signature and can
+  never serve wrong slots on a signature collision.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parameter_server_tpu.ops import kv_ops
+from parameter_server_tpu.parameter.kv_layer import KVLayer, SGDUpdater
+from parameter_server_tpu.parameter.kv_map import AddEntry, KVMap
+from parameter_server_tpu.parameter.kv_vector import KVVector
+from parameter_server_tpu.parameter.parameter import KeyDirectory
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.telemetry import registry as telemetry_registry
+
+
+@pytest.fixture(autouse=True)
+def fresh_po():
+    Postoffice.reset()
+    yield
+    Postoffice.reset()
+
+
+def _counter(name: str) -> float:
+    inst = telemetry_registry.default_registry().get(name)
+    return 0.0 if inst is None else inst.value()
+
+
+class TestDonatedPush:
+    def test_read_after_donate_raises(self, mesh8):
+        """Pushing twice through the donated path must not alias stale
+        buffers: the consumed input raises, it never serves old data."""
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        t0 = jax.device_put(
+            jnp.zeros((16, 1), jnp.float32), meshlib.table_sharding(mesh8)
+        )
+        idx = jnp.array([1, 9], jnp.int32)
+        vals = jnp.ones((2, 1), jnp.float32)
+        t1 = kv_ops.push_donated(t0, idx, vals, mesh=mesh8, batch_sharded=False)
+        t2 = kv_ops.push_donated(t1, idx, vals, mesh=mesh8, batch_sharded=False)
+        expect = np.zeros((16, 1))
+        expect[[1, 9]] = 2.0
+        np.testing.assert_allclose(np.asarray(t2), expect)
+        for stale in (t0, t1):
+            with pytest.raises(RuntimeError, match="deleted|donated"):
+                np.asarray(stale)
+
+    def test_kv_vector_updates_table_in_place(self, mesh8):
+        """The live table buffer is consumed per push (zero-copy), and
+        the store's values stay correct across repeated pushes."""
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([2, 7], dtype=np.int64)
+        kv.set_keys(0, keys)
+        before = kv.table(0)  # live view
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.ones((2, 1), np.float32)))
+        with pytest.raises(RuntimeError, match="deleted|donated"):
+            np.asarray(before)  # the old buffer was donated
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.ones((2, 1), np.float32)))
+        np.testing.assert_allclose(kv.values(0, keys), 2 * np.ones((2, 1)))
+
+    def test_table_copy_survives_pushes(self, mesh8):
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([3], dtype=np.int64)
+        kv.set_keys(0, keys)
+        snap = kv.table(0, copy=True)
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.ones((1, 1), np.float32)))
+        np.testing.assert_allclose(np.asarray(snap), np.zeros((32, 1)))
+
+    def test_replica_snapshot_unaffected_by_later_push(self, mesh8):
+        """get_replica taken BEFORE a push must capture the pre-push
+        state and stay readable after the donated update."""
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([4, 8], dtype=np.int64)
+        kv.set_keys(0, keys)
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.ones((2, 1), np.float32)))
+        snap = kv.get_replica()
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.full((2, 1), 5.0, np.float32)))
+        slots = kv.channel(0).directory.slots(keys)
+        np.testing.assert_allclose(snap[0][slots], np.ones((2, 1)))
+        # and restoring it really rolls back
+        kv.set_replica(snap)
+        np.testing.assert_allclose(kv.values(0, keys), np.ones((2, 1)))
+
+    def test_kv_map_replica_unaffected_and_push_correct(self, mesh8):
+        m = KVMap(AddEntry(), mesh=mesh8, k=1, num_slots=32,
+                  keys=np.array([1, 2]))
+        m.wait(m.push(m.request(), np.array([1, 2]),
+                      np.ones((2, 1), np.float32)))
+        snap = m.get_replica()
+        m.wait(m.push(m.request(), np.array([1, 2]),
+                      np.ones((2, 1), np.float32)))
+        np.testing.assert_allclose(m.values(np.array([1, 2])),
+                                   2 * np.ones((2, 1)))
+        # the snapshot captured the one-push state and is still live
+        assert float(snap["value"][0, 0]) == 1.0
+
+    def test_kv_layer_donated_pull_view_dies_with_next_push(self, mesh8):
+        layer = KVLayer(partition_thr=4, updater=SGDUpdater(lr=0.5),
+                        mesh=mesh8)
+        layer.init_layer("w", (8,))
+        grad = jnp.ones(8)
+        layer.wait(layer.push(layer.request(), "w", grad))
+        view = layer.wait_pull(layer.pull(layer.request(), "w"))
+        np.testing.assert_allclose(np.asarray(view), -0.5 * np.ones(8))
+        snap = layer.get_replica()  # host copy, pre-second-push
+        layer.wait(layer.push(layer.request(), "w", grad))
+        with pytest.raises(RuntimeError, match="deleted|donated"):
+            np.asarray(view)
+        np.testing.assert_allclose(snap["w"], -0.5 * np.ones(8))
+
+    def test_kv_layer_donate_false_keeps_pull_views(self, mesh8):
+        layer = KVLayer(partition_thr=4, updater=SGDUpdater(lr=0.5),
+                        mesh=mesh8, donate=False)
+        layer.init_layer("w", (8,))
+        grad = jnp.ones(8)
+        layer.wait(layer.push(layer.request(), "w", grad))
+        view = layer.wait_pull(layer.pull(layer.request(), "w"))
+        layer.wait(layer.push(layer.request(), "w", grad))
+        # copying mode: the earlier pull view stays valid
+        np.testing.assert_allclose(np.asarray(view), -0.5 * np.ones(8))
+
+    def test_fire_and_forget_pushes_then_snapshot(self, mesh8):
+        """Regression (review finding): push steps store the live table
+        as their executor future; a later push donates that buffer.
+        wait()/wait_all() on the superseded future must treat the
+        donated buffer as materialized — not raise, not wedge — so a
+        snapshot under training load works."""
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([2, 7], dtype=np.int64)
+        kv.set_keys(0, keys)
+        ones = np.ones((2, 1), np.float32)
+        tss = [
+            kv.push(kv.request(channel=0), keys=keys, values=ones)
+            for _ in range(3)
+        ]
+        snap = kv.get_replica()  # wait_all over superseded futures
+        assert float(snap[0].sum()) == 6.0
+        kv.wait(tss[0])  # explicit wait on a donated future: no error
+        np.testing.assert_allclose(kv.values(0, keys), 3 * ones)
+
+    def test_push_pull_rejects_buffered_staging(self, mesh8):
+        """Regression (review finding): the fused round trip applies to
+        the LIVE table; on a buffer_value store with a staging timestamp
+        it must raise, not silently bypass the staging buffer."""
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False,
+                      buffer_value=True)
+        keys = np.array([4], dtype=np.int64)
+        kv.set_keys(0, keys)
+        with pytest.raises(ValueError, match="buffer_value"):
+            kv.push_pull(
+                kv.request(channel=0, ts=5), keys=keys,
+                values=np.ones((1, 1), np.float32),
+            )
+
+    def test_donated_push_counter_ticks(self, mesh8):
+        before = _counter("ps_kvops_donated_pushes_total")
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        keys = np.array([5], dtype=np.int64)
+        kv.set_keys(0, keys)
+        kv.wait(kv.push(kv.request(channel=0), keys=keys,
+                        values=np.ones((1, 1), np.float32)))
+        assert _counter("ps_kvops_donated_pushes_total") >= before + 1
+
+
+class TestFusedPushPull:
+    def test_kernel_bit_identical_to_push_then_pull(self, mesh8):
+        """push_pull == push; pull — exactly, including duplicate
+        indices (scatter-add order) and sentinel drops."""
+        from parameter_server_tpu.parallel import mesh as meshlib
+
+        p, k = 32, 3
+        rng = np.random.default_rng(0)
+        base = rng.normal(size=(p, k)).astype(np.float32)
+        idx = jnp.array([2, 2, 31, 30, 9, 32], jnp.int32)  # dup + sentinel
+        vals = jnp.asarray(rng.normal(size=(6, k)).astype(np.float32))
+        pull_idx = jnp.array([2, 9, 32, 0], jnp.int32)
+
+        t_seq = jax.device_put(jnp.asarray(base),
+                               meshlib.table_sharding(mesh8))
+        t_seq = kv_ops.push(t_seq, idx, vals, mesh=mesh8, batch_sharded=False)
+        want = kv_ops.pull(t_seq, pull_idx, mesh=mesh8, batch_sharded=False)
+
+        t_f = jax.device_put(jnp.asarray(base),
+                             meshlib.table_sharding(mesh8))
+        t_f, got = kv_ops.push_pull(
+            t_f, idx, vals, pull_idx, mesh=mesh8, batch_sharded=False
+        )
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+        assert np.array_equal(np.asarray(t_f), np.asarray(t_seq))
+
+    def test_kv_vector_push_pull_matches_sequenced(self, mesh8):
+        keys = np.array([3, 17, 40, 99], dtype=np.int64)
+        vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+        kv_a = KVVector(mesh=mesh8, k=2, num_slots=64, hashed=False)
+        kv_a.set_keys(0, keys)
+        kv_a.wait(kv_a.push(kv_a.request(channel=0), keys=keys, values=vals))
+        want = kv_a.values(0, keys)
+
+        kv_b = KVVector(mesh=mesh8, k=2, num_slots=64, hashed=False)
+        kv_b.set_keys(0, keys)
+        got = np.asarray(kv_b.wait_pull(
+            kv_b.push_pull(kv_b.request(channel=0), keys=keys, values=vals)
+        ))
+        assert np.array_equal(got, want)
+        # fused result aggregates on REPEAT too (push adds)
+        got2 = np.asarray(kv_b.wait_pull(
+            kv_b.push_pull(kv_b.request(channel=0), keys=keys, values=vals)
+        ))
+        np.testing.assert_allclose(got2, 2 * vals)
+
+    def test_kv_vector_push_pull_distinct_pull_keys(self, mesh8):
+        keys = np.array([1, 5], dtype=np.int64)
+        all_keys = np.array([1, 5, 9], dtype=np.int64)
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        kv.set_keys(0, all_keys)
+        got = np.asarray(kv.wait_pull(kv.push_pull(
+            kv.request(channel=0), keys=keys,
+            values=np.ones((2, 1), np.float32), pull_keys=all_keys,
+        )))
+        np.testing.assert_allclose(got, [[1.0], [1.0], [0.0]])
+
+    def test_kv_layer_push_pull_matches_sequenced(self, mesh8):
+        a = KVLayer(partition_thr=4, updater=SGDUpdater(lr=0.5), mesh=mesh8)
+        a.init_layer("w", (8, 2))
+        a.wait(a.push(a.request(), "w", jnp.ones((8, 2))))
+        want = np.asarray(a.wait_pull(a.pull(a.request(), "w")))
+
+        b = KVLayer(partition_thr=4, updater=SGDUpdater(lr=0.5), mesh=mesh8)
+        b.init_layer("w", (8, 2))
+        got = np.asarray(b.wait_pull(
+            b.push_pull(b.request(), "w", jnp.ones((8, 2)))
+        ))
+        assert np.array_equal(got, want)
+
+    def test_fused_dispatch_histogram_observes(self, mesh8):
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        kv.set_keys(0, np.array([2], dtype=np.int64))
+        hist = telemetry_registry.default_registry().get(
+            "ps_kvops_fused_dispatch_seconds"
+        )
+        before = hist.count() if hist is not None else 0
+        kv.wait_pull(kv.push_pull(
+            kv.request(channel=0), keys=np.array([2], dtype=np.int64),
+            values=np.ones((1, 1), np.float32),
+        ))
+        hist = telemetry_registry.default_registry().get(
+            "ps_kvops_fused_dispatch_seconds"
+        )
+        assert hist is not None and hist.count() >= before + 1
+
+
+class TestSlotDirectoryCache:
+    def test_repeat_key_set_hits_and_reuses_device_upload(self, mesh8):
+        kv = KVVector(mesh=mesh8, k=1, num_slots=64, hashed=True)
+        keys = np.random.default_rng(0).integers(0, 1 << 30, 256)
+        h0 = _counter("ps_directory_slot_cache_hits_total")
+        m0 = _counter("ps_directory_slot_cache_misses_total")
+        s1 = kv.slots(0, keys)
+        s2 = kv.slots(0, keys)
+        assert s2 is s1  # same cached device array — no re-upload
+        assert _counter("ps_directory_slot_cache_hits_total") == h0 + 1
+        assert _counter("ps_directory_slot_cache_misses_total") == m0 + 1
+
+    def test_signature_collision_cannot_serve_wrong_slots(self):
+        """Two key arrays identical in the signed PREFIX but different
+        beyond it must not alias cache entries: hits verify the full
+        array, so the second lookup recomputes."""
+        d = KeyDirectory(1 << 20, hashed=True)
+        n = (d.MAX_SIG_LEN // 8) + 64  # int64 keys: prefix covers 256
+        a = np.arange(n, dtype=np.int64)
+        b = a.copy()
+        b[-1] = 1 << 40  # differs past the signature prefix only
+        sa = d.slots(a)
+        sb = d.slots(b)
+        assert sa[-1] != sb[-1] or not np.array_equal(a, b)
+        np.testing.assert_array_equal(sb, d._compute_slots(b))
+
+    def test_exact_directory_cache_correct(self):
+        d = KeyDirectory(16, keys=np.array([2, 5, 9]))
+        q = np.array([5, 9, 7])
+        np.testing.assert_array_equal(d.slots(q), [1, 2, 16])
+        np.testing.assert_array_equal(d.slots(q), [1, 2, 16])  # cached
+
+    def test_lru_eviction_bounded(self):
+        d = KeyDirectory(1 << 16, hashed=True)
+        for i in range(3 * d.CACHE_SLOTS):
+            d.slots(np.arange(i, i + 4, dtype=np.int64))
+        assert len(d._slot_cache) <= d.CACHE_SLOTS
+
+
+class TestSetKeysValidation:
+    def test_set_keys_canonicalizes_unsorted_duplicates(self, mesh8):
+        """Regression: exact directories require sorted unique keys for
+        searchsorted; raw caller order used to corrupt lookups silently."""
+        kv = KVVector(mesh=mesh8, k=1, num_slots=32, hashed=False)
+        kv.set_keys(0, np.array([40, 3, 99, 3, 17], dtype=np.int64))
+        np.testing.assert_array_equal(
+            kv.channel(0).key, [3, 17, 40, 99]
+        )
+        keys = np.array([3, 17, 40, 99], dtype=np.int64)
+        vals = np.arange(4, dtype=np.float32).reshape(4, 1)
+        kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+        np.testing.assert_allclose(kv.values(0, keys), vals)
+        # a key NOT in the set maps to the sentinel and is dropped
+        np.testing.assert_allclose(kv.values(0, np.array([7])), [[0.0]])
+
+    def test_key_directory_rejects_unsorted(self):
+        with pytest.raises(ValueError, match="unsorted"):
+            KeyDirectory(16, keys=np.array([5, 2, 9]))
+
+    def test_key_directory_rejects_duplicates(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            KeyDirectory(16, keys=np.array([2, 2, 9]))
+
+
+def test_donation_lint_passes():
+    """Tier-1 guard: every data-plane jit site either donates or carries
+    an explicit '# no-donate:' justification (script/donation_lint.py —
+    same pattern as metrics-lint)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "script",
+        "donation_lint.py",
+    )
+    spec = importlib.util.spec_from_file_location("_donation_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.lint()
+    assert problems == [], "\n".join(problems)

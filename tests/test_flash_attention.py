@@ -25,6 +25,11 @@ from parameter_server_tpu.ops.flash_attention import (
 )
 from parameter_server_tpu.parallel.mesh import make_mesh
 
+# Promoted to the slow tier (PR 2, per the PR-1 ROADMAP note): the
+# shard_map-shim unlock made the full 'not slow' suite overrun the
+# 870s tier-1 budget on a 2-core host. Run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 def _rand(shape, seed=0):
     return jnp.asarray(

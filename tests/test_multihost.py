@@ -14,6 +14,11 @@ import sys
 
 import pytest
 
+# Promoted to the slow tier (PR 2, per the PR-1 ROADMAP note): the
+# shard_map-shim unlock made the full 'not slow' suite overrun the
+# 870s tier-1 budget on a 2-core host. Run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -185,6 +185,10 @@ class TestFtrlOp:
         # (b) lowering contract, f32 and bf16-state variants
         import re
 
+        # jax 0.4.x only materializes jax.export on explicit submodule
+        # import (lazy attr access raises AttributeError)
+        import jax.export  # noqa: F401
+
         for n_in, seed in ((n, None), (n.astype(jnp.bfloat16), 7)):
             exp = jax.export.export(
                 jax.jit(lambda z, n, g: ftrl_update(

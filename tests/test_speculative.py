@@ -17,6 +17,11 @@ from parameter_server_tpu.models.transformer import (
     lm_generate,
 )
 
+# Promoted to the slow tier (PR 2, per the PR-1 ROADMAP note): the
+# shard_map-shim unlock made the full 'not slow' suite overrun the
+# 870s tier-1 budget on a 2-core host. Run via `pytest -m slow`.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def tcfg():
