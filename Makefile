@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke metrics-lint donation-lint clean
+.PHONY: all native test bench bench-all bench-watch smoke metrics-lint donation-lint ingest-bench clean
 
 all: native
 
@@ -40,6 +40,13 @@ metrics-lint:
 # tests/test_donation.py)
 donation-lint:
 	python script/donation_lint.py
+
+# serial-vs-pipelined host-ingest A/B (components bench): one JSON
+# summary line per metric — serial/pipelined examples/sec + the median
+# paired speedup (fast, CPU-only, no accelerator; the same A/B is
+# embedded in every bench.py record under "host_ingest")
+ingest-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks host_ingest
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

@@ -446,6 +446,23 @@ def attach_kv_dataplane(rec_or_headline: dict, mesh, smoke: bool) -> None:
         )
 
 
+def attach_host_ingest(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the serial-vs-pipelined host-ingest A/B
+    (benchmarks/components.host_ingest_ab — the PR3 ingest plane) so
+    every bench record carries the ingest win under ``host_ingest``,
+    next to the ps_ingest_* counters in the telemetry snapshot. Host
+    CPU only (no device), seconds of wall time; never breaks a
+    record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import host_ingest_ab
+
+        rec_or_headline["host_ingest"] = host_ingest_ab(smoke)
+    except Exception as e:
+        rec_or_headline["host_ingest_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
@@ -1384,6 +1401,8 @@ def run_real(args) -> int:
         headline["breakdown_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     _beat("kv_dataplane")
     attach_kv_dataplane(headline, worker.mesh, args.smoke)
+    _beat("host_ingest")
+    attach_host_ingest(headline, args.smoke)
     _beat("e2e", **headline)
 
     def host_prepped():
@@ -1770,6 +1789,10 @@ def run_synthetic(args) -> int:
     # telemetry counters for the snapshot
     _beat("kv_dataplane")
     attach_kv_dataplane(headline, po.mesh, args.smoke)
+    # host-ingest serial-vs-pipelined A/B rides along too (PR3): the
+    # ingest plane is the post-zero-copy bottleneck this record tracks
+    _beat("host_ingest")
+    attach_host_ingest(headline, args.smoke)
     _beat("e2e", **headline)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes

@@ -1447,6 +1447,65 @@ def _fixing_float_bytes(filters, where: str) -> int:
     return nb
 
 
+class DeviceUploader:
+    """Double-buffered host→device stage of the ingest pipeline.
+
+    Issues ``upload_fn`` (a ``jax.device_put`` under the hood) for
+    batch t+1 on its own thread while the consumer runs step t, so the
+    host→device transfer — the pipeline's scarce resource — overlaps
+    device compute instead of serializing in front of it. ``depth``
+    bounds the staged-ahead window (default 2: the classic double
+    buffer — one batch on the wire while one is being consumed), which
+    also bounds the extra device memory pinned by staged batches.
+
+    Donation-safety: batch buffers are only ever INPUTS to the jitted
+    steps (never donated — only the table state is, via
+    ``donate_argnums=(0,)``), and submission stays on the consumer
+    thread under the executor's ``max_in_flight`` bound, so staging
+    ahead can never alias a donated buffer.
+
+    Exceptions from the upload thread forward to the consumer;
+    ``close()`` stops and joins the thread (also called when iteration
+    ends)."""
+
+    def __init__(self, source, upload_fn, depth: int = 2):
+        from ...learner.ingest import pipeline_instruments
+        from ...utils.concurrent import iter_on_thread
+
+        tel = pipeline_instruments()
+
+        def uploaded():
+            for prepped, n in source:
+                t0 = time.perf_counter()
+                if tel is not None:
+                    tel["batches"].labels(pipeline="device_uploader").inc()
+                    tel["examples"].labels(pipeline="device_uploader").inc(
+                        int(prepped.num_examples)
+                    )
+                    tel["uploaded_bytes"].inc(
+                        sum(
+                            int(getattr(leaf, "nbytes", 0))
+                            for leaf in jax.tree.leaves(prepped)
+                        )
+                    )
+                staged = upload_fn(prepped)
+                if tel is not None:
+                    tel["stage_seconds"].labels(stage="upload").observe(
+                        time.perf_counter() - t0
+                    )
+                yield staged, n
+
+        # maxsize = depth - 1 staged in the queue + 1 held by the
+        # consumer = `depth` device-staged batches in flight
+        self._it = iter_on_thread(uploaded(), maxsize=max(1, depth - 1))
+
+    def __iter__(self):
+        return self._it
+
+    def close(self) -> None:
+        self._it.close()
+
+
 class AsyncSGDWorker(ISGDCompNode):
     """Fused worker+server node (ref AsyncSGDWorker + AsyncSGDServer).
 
@@ -1587,6 +1646,18 @@ class AsyncSGDWorker(ISGDCompNode):
                 "big tables shard the dense update over servers instead"
             )
         return mode
+
+    def _ingest_workers(self) -> int:
+        """Prep-pool width for the pipelined train path.
+        ``SGDConfig.ingest_workers`` wins when set; the default scales
+        to the host: cores-1 (capped at 4) so the feeder thread (parse
+        + filter) and the trainer keep a core to breathe on — on a
+        2-core host that is ONE prep worker, which still moves all
+        localize/pack work off this thread (doc/PERFORMANCE.md,
+        "Host-ingest pipeline")."""
+        if self.sgd.ingest_workers > 0:
+            return self.sgd.ingest_workers
+        return max(1, min(4, (os.cpu_count() or 2) - 1))
 
     def _num_shards(self) -> int:
         """Data shards THIS process preps. Single-process: the whole data
@@ -1995,32 +2066,51 @@ class AsyncSGDWorker(ISGDCompNode):
         bound = max(T, self.sgd.max_delay + 1)
 
         if pipelined:
-            from ...utils.concurrent import iter_on_thread
+            # staged ingest (learner/ingest.py): grouping runs on the
+            # pipeline's feeder thread, localize/pack fans out over the
+            # ordered prep pool, and the double-buffered DeviceUploader
+            # issues the device_put for batch t+1 while step t runs —
+            # prep_batch work leaves this thread entirely. No
+            # submission off-thread: ordered device dispatch (seeds,
+            # snapshot schedule) stays HERE, so the trajectory is
+            # bit-identical to the serial path.
+            from ...learner.ingest import IngestPipeline
 
-            def staged():
-                # pipeline thread: localize/pack (CPU), group-stack,
-                # stage to device (wire). No submission here — ordered
-                # device dispatch stays on the training thread.
+            def grouped():
                 group: List[SparseBatch] = []
-
-                def flush():
-                    out = [
-                        (self.upload(p), n)
-                        for p, n in self._prep_group(group)
-                    ]
-                    group.clear()
-                    return out
-
                 for batch in batches:
+                    # padding is derived from the FIRST batch exactly as
+                    # on the serial path — pin it before parallel preps
+                    # could race to different pads
+                    if self._pads is None:
+                        self._padding(batch)
                     group.append(batch)
                     if len(group) >= T:
-                        yield from flush()
+                        yield group
+                        group = []
                 if group:
-                    yield from flush()
+                    yield group
 
-            src = iter_on_thread(staged(), maxsize=2)
+            workers = self._ingest_workers()
+            pipe = IngestPipeline(
+                grouped(),
+                prep_fn=self._prep_group,
+                workers=workers,
+                # the in-flight window must scale with the pool or the
+                # extra workers idle (the pool admits at most `capacity`
+                # groups); each staged group holds T prepped host
+                # batches, so this is also the host-memory bound
+                capacity=2 * workers,
+                name="train_ingest",
+            ).start()
+
+            def flattened():
+                for parts in pipe:
+                    yield from parts
+
+            uploader = DeviceUploader(flattened(), self.upload, depth=2)
             try:
-                for staged_batch, n in src:
+                for staged_batch, n in uploader:
                     pending.append(
                         (self._submit_prepped(staged_batch, with_aux=True),
                          n)
@@ -2030,10 +2120,11 @@ class AsyncSGDWorker(ISGDCompNode):
             finally:
                 # close BEFORE the exception propagates out of this
                 # frame: the traceback would otherwise pin the
-                # generator (and its producer thread) alive past
-                # train()'s cleanup, letting teardown kill the thread
+                # generators (and their pipeline threads) alive past
+                # train()'s cleanup, letting teardown kill a thread
                 # mid-device-call
-                src.close()
+                uploader.close()
+                pipe.close()
             for ts, _ in pending:
                 self.collect(ts)
             return self.progress

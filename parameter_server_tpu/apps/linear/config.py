@@ -95,6 +95,11 @@ class SGDConfig:
     # launch (lax.scan inside one jitted program; needs wire="bits") —
     # the dominant throughput lever on high-latency host<->device links
     steps_per_launch: int = 1
+    # prep-pool width for the pipelined ingest path (learner/ingest.py):
+    # 0 = auto (cores-1, capped at 4 — leaves the feeder thread and the
+    # trainer a core each on small hosts); batch order and therefore
+    # the training trajectory are identical at any width (ordered pool)
+    ingest_workers: int = 0
     # FTRL sqrt_n storage dtype: "float32" (default, bit-exact vs the
     # reference) or "bfloat16" — halves that half of the table state
     # (16 B/slot -> 12 B/slot), raising the single-chip slot ceiling

@@ -108,7 +108,8 @@ def _run_app(conf, aux, args) -> int:
                 reader.init_filter(
                     sgd.countmin_n, sgd.countmin_k, sgd.tail_feature_freq
                 )
-            worker.train(iter(reader))
+            with reader:  # start() the producer thread; close() joins it
+                worker.train(iter(reader))
             sched.workload_pool.finish(load.id)
         sched.monitor.maybe_print(force=True)
         if conf.model_output is not None and conf.model_output.file:

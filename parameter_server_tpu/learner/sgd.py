@@ -5,7 +5,9 @@
   (ref ISGDScheduler::Run / ShowProgress / MergeProgress).
 - ``ISGDCompNode``: computation node base with a reporter slaver.
 - ``MinibatchReader``: prefetching minibatch source with countmin
-  tail-feature filtering and key localization (ref MinibatchReader<V>).
+  tail-feature filtering and key localization (ref MinibatchReader<V>),
+  running read + filter on an ``learner.ingest.IngestPipeline`` feeder
+  thread (the staged-parallel host-ingest plane).
 """
 
 from __future__ import annotations
@@ -20,8 +22,7 @@ from ..filter.frequency import FrequencyFilter
 from ..parameter.replica import Checkpointable
 from ..system.customer import App
 from ..system.monitor import MonitorMaster, MonitorSlaver
-from ..utils.concurrent import ProducerConsumer
-from ..utils.localizer import Localizer, count_uniq_keys
+from ..utils.localizer import Localizer
 from ..utils.sparse import SparseBatch
 from .workload_pool import WorkloadPool
 
@@ -203,12 +204,41 @@ class ISGDCompNode(App, Checkpointable):
         )
 
 
+def apply_tail_filter(
+    batch: SparseBatch, filter_: FrequencyFilter, freq: int
+) -> SparseBatch:
+    """One batch through the countmin tail-feature filter: insert this
+    batch's unique keys, drop entries whose estimated frequency is
+    below ``freq`` (ref MinibatchReader::Read, sgd.h:117-135). STATEFUL
+    — batches must pass through in stream order for a deterministic
+    result, which is why the ingest pipeline keeps this stage serial on
+    the feeder thread."""
+    loc = Localizer()
+    # one unique pass serves both the sketch update and the remap
+    # (count_uniq_index == count_uniq_keys + the retained inverse)
+    keys, cnt = loc.count_uniq_index(batch)
+    filter_.insert_keys(keys, cnt)
+    keep = filter_.query_keys(keys, freq)
+    local = loc.remap_index(keep)
+    # restore global key ids so downstream sees a normal batch
+    local.indices = keep[local.indices]
+    local.num_cols = batch.num_cols
+    return local
+
+
 class MinibatchReader:
     """Prefetching minibatch reader (ref MinibatchReader<V>, sgd.h:60-143).
 
-    Streams SparseBatches from files, filters tail features with a countmin
-    sketch, and yields (batch, uniq_keys) with keys still global — the
-    worker's ``prep_batch`` does the final remap to table slots.
+    Streams SparseBatches from files and filters tail features with a
+    countmin sketch, both OFF the trainer thread: reading and filtering
+    run on an :class:`~..learner.ingest.IngestPipeline` feeder thread
+    behind a bounded queue, so the consumer only pays a queue pop. Keys
+    stay global — the worker's ``prep_batch`` does the final remap to
+    table slots.
+
+    Lifecycle (enforced): call :meth:`start` before reading (``start``
+    is idempotent), and :meth:`close` when done — it stops and joins
+    the producer thread. Usable as a context manager.
     """
 
     def __init__(
@@ -222,45 +252,75 @@ class MinibatchReader:
         self._source: Optional[Iterator[SparseBatch]] = batches
         if self._source is None:
             reader = StreamReader(files or [], data_format)
-            self._source = reader.minibatches(minibatch_size)
+            # chunked byte parse: raw line-aligned chunks go straight
+            # into the GIL-releasing native parser on a small pool
+            # (falls back to the line path for formats without one);
+            # bit-identical to minibatches() — tests/test_data.py
+            # TestByteStreaming
+            self._source = reader.minibatches_bytes(
+                minibatch_size, threads=2
+            )
         self._filter: Optional[FrequencyFilter] = None
         self._freq = 0
-        self._pc: ProducerConsumer[SparseBatch] = ProducerConsumer(capacity)
-        self._started = False
+        self._capacity = capacity
+        self._pipe: Optional["IngestPipeline"] = None
+        self._it: Optional[Iterator[SparseBatch]] = None
+        self._closed = False
 
     def init_filter(self, n: int, k: int, freq: int) -> None:
-        """Countmin tail-feature filter (ref InitFilter)."""
+        """Countmin tail-feature filter (ref InitFilter); set before
+        :meth:`start`."""
+        if self._pipe is not None:
+            raise RuntimeError("init_filter() after start()")
         self._filter = FrequencyFilter(n, k)
         self._freq = freq
 
-    def start(self) -> None:
-        src = self._source
+    def start(self) -> "MinibatchReader":
+        """Start the producer thread. Idempotent: a second call is a
+        no-op (the reference's _started flag, now enforced)."""
+        if self._closed:
+            raise RuntimeError("MinibatchReader.start() after close()")
+        if self._pipe is not None:
+            return self
+        from .ingest import IngestPipeline
 
-        def produce() -> Optional[SparseBatch]:
-            return next(src, None)
-
-        self._pc.start_producer(produce)
-        self._started = True
+        filter_fn = None
+        if self._filter is not None and self._freq > 0:
+            filt, freq = self._filter, self._freq
+            filter_fn = lambda b: apply_tail_filter(b, filt, freq)  # noqa: E731
+        self._pipe = IngestPipeline(
+            self._source,
+            filter_fn=filter_fn,
+            capacity=self._capacity,
+            name="minibatch_reader",
+        ).start()
+        self._it = iter(self._pipe)
+        return self
 
     def read(self) -> Optional[SparseBatch]:
-        """Next minibatch with tail features dropped (ref Read)."""
-        if not self._started:
-            self.start()
-        batch = self._pc.pop()
-        if batch is None:
-            return None
-        if self._filter is not None and self._freq > 0:
-            keys, cnt = count_uniq_keys(batch)
-            self._filter.insert_keys(keys, cnt)
-            keep = self._filter.query_keys(keys, self._freq)
-            loc = Localizer()
-            loc.count_uniq_index(batch)
-            local = loc.remap_index(keep)
-            # restore global key ids so downstream sees a normal batch
-            local.indices = keep[local.indices]
-            local.num_cols = batch.num_cols
-            return local
-        return batch
+        """Next minibatch with tail features dropped (ref Read), or
+        None at end of stream. Raises if the reader was never started
+        or already closed, and re-raises producer exceptions."""
+        if self._pipe is None or self._it is None:
+            raise RuntimeError(
+                "MinibatchReader.read() before start(): call start() "
+                "first, or use the reader as a context manager"
+            )
+        if self._closed:
+            raise RuntimeError("MinibatchReader.read() after close()")
+        return next(self._it, None)
+
+    def close(self) -> None:
+        """Stop the pipeline and join the producer thread; idempotent."""
+        self._closed = True
+        if self._pipe is not None:
+            self._pipe.close()
+
+    def __enter__(self) -> "MinibatchReader":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def __iter__(self) -> Iterator[SparseBatch]:
         while True:

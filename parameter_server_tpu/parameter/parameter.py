@@ -18,6 +18,7 @@ Key directories come in two modes, both host-side:
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 from typing import Optional, Sequence
@@ -152,8 +153,12 @@ class KeyDirectory:
                     f"({kind} input): searchsorted would silently map "
                     "keys to wrong slots — np.unique the key set first"
                 )
-        # sig -> [keys_copy, slots, device_slots|None]; MRU at the end
+        # sig -> [keys_copy, slots, device_slots|None]; MRU at the end.
+        # Lock: the parallel ingest pipeline's prep workers call
+        # slots() concurrently (learner/ingest.py) — the LRU
+        # move_to_end/popitem sequence is not atomic on its own.
         self._slot_cache: "OrderedDict[tuple, list]" = OrderedDict()
+        self._slot_cache_lock = threading.Lock()
 
     def _signature(self, keys: np.ndarray) -> tuple:
         return (
@@ -167,20 +172,24 @@ class KeyDirectory:
         Hits verify the full array against the retained copy, so the
         prefix signature only routes — it never decides."""
         sig = self._signature(keys)
-        entry = self._slot_cache.get(sig)
         tel = _dir_tel()
-        if entry is not None and np.array_equal(keys, entry[0]):
-            self._slot_cache.move_to_end(sig)
-            if tel is not None:
-                tel["slot_cache_hits"].inc()
-            return entry
+        with self._slot_cache_lock:
+            entry = self._slot_cache.get(sig)
+            if entry is not None and np.array_equal(keys, entry[0]):
+                self._slot_cache.move_to_end(sig)
+                if tel is not None:
+                    tel["slot_cache_hits"].inc()
+                return entry
         if tel is not None:
             tel["slot_cache_misses"].inc()
+        # compute OUTSIDE the lock: the hash/searchsorted pass is the
+        # expensive part, and it must not serialize parallel prep workers
         entry = [np.array(keys, copy=True), self._compute_slots(keys), None]
-        self._slot_cache[sig] = entry
-        self._slot_cache.move_to_end(sig)
-        while len(self._slot_cache) > self.CACHE_SLOTS:
-            self._slot_cache.popitem(last=False)
+        with self._slot_cache_lock:
+            self._slot_cache[sig] = entry
+            self._slot_cache.move_to_end(sig)
+            while len(self._slot_cache) > self.CACHE_SLOTS:
+                self._slot_cache.popitem(last=False)
         return entry
 
     def _compute_slots(self, keys: np.ndarray) -> np.ndarray:

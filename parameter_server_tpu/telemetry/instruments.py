@@ -159,6 +159,55 @@ def kvops_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+#: stage labels the ingest pipeline records (doc/OBSERVABILITY.md):
+#: read (source next/parse), filter (countmin tail-filter), prep
+#: (localize/pack in the worker pool), upload (host→device staging)
+INGEST_STAGES = ("read", "filter", "prep", "upload")
+
+
+def ingest_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Host-ingest pipeline: per-stage latency, queue depth, volume.
+
+    The ingest plane is the post-PR2 bottleneck (the device step is
+    ~100x faster than the host→device transfer): these size where a
+    training run's host seconds go — parse vs filter vs prep vs upload
+    — and how full the pipeline's bounded queues run (a persistently
+    empty queue means the stage upstream of it is the bottleneck)."""
+    return {
+        "stage_seconds": reg.ensure_histogram(
+            "ps_ingest_stage_seconds",
+            "per-minibatch wall time inside one ingest stage "
+            "(read/filter/prep/upload)",
+            labelnames=("stage",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "queue_depth": reg.ensure_gauge(
+            "ps_ingest_queue_depth",
+            "batches staged ahead of the consumer in an ingest queue, "
+            "sampled at each emission",
+            labelnames=("queue",),
+        ),
+        "examples": reg.ensure_counter(
+            "ps_ingest_examples_total",
+            "examples emitted by one ingest pipeline stage (host-side "
+            "count, before device confirmation); chained pipelines — a "
+            "reader feeding a train ingest — report each hop under its "
+            "own label",
+            labelnames=("pipeline",),
+        ),
+        "batches": reg.ensure_counter(
+            "ps_ingest_batches_total",
+            "minibatches emitted by one ingest pipeline stage",
+            labelnames=("pipeline",),
+        ),
+        "uploaded_bytes": reg.ensure_counter(
+            "ps_ingest_uploaded_bytes_total",
+            "host bytes staged onto the device mesh by the ingest "
+            "uploader (double-buffered device_put)",
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -220,6 +269,7 @@ INSTRUMENT_FAMILIES = (
     van_instruments,
     parameter_instruments,
     kvops_instruments,
+    ingest_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -39,10 +39,27 @@ class ThreadsafeQueue(Generic[T]):
         return self._q.empty()
 
 
+class _ProducerError:
+    """Wrapper carrying a producer exception through the queue."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class ProducerConsumer(Generic[T]):
     """Bounded producer/consumer with a capacity budget (ref
     producer_consumer.h: startProducer(fn) where fn fills an item and reports
-    its size; pop() blocks until data or producer end)."""
+    its size; pop() blocks until data or producer end).
+
+    Contracts the ingest pipelines rely on (tested in
+    tests/test_ingest.py): an exception raised by ``produce`` is
+    forwarded to the consumer — ``pop()`` re-raises it instead of
+    hanging or silently truncating the stream — and :meth:`close` stops
+    and joins the producer threads, so a consumer that exits early
+    leaks no threads blocked in ``q.put`` (interpreter teardown would
+    kill such a thread mid-call)."""
 
     _END = object()
 
@@ -51,6 +68,18 @@ class ProducerConsumer(Generic[T]):
         self._threads: list[threading.Thread] = []
         self._live = 0
         self._live_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def _put(self, item) -> bool:
+        """Stop-aware put: returns False when close() was requested."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def start_producer(
         self, produce: Callable[[], Optional[T]], num_threads: int = 1
@@ -65,15 +94,20 @@ class ProducerConsumer(Generic[T]):
         self._live = num_threads
 
         def run():
-            while True:
-                item = produce()
-                if item is None:
-                    with self._live_lock:
-                        self._live -= 1
-                        if self._live == 0:
-                            self._q.put(self._END)
-                    return
-                self._q.put(item)
+            try:
+                while not self._stop.is_set():
+                    item = produce()
+                    if item is None:
+                        break
+                    if not self._put(item):
+                        return
+            except BaseException as e:  # forward to the consumer
+                self._put(_ProducerError(e))
+                return
+            with self._live_lock:
+                self._live -= 1
+                if self._live == 0:
+                    self._put(self._END)
 
         for _ in range(num_threads):
             t = threading.Thread(target=run, daemon=True)
@@ -81,13 +115,24 @@ class ProducerConsumer(Generic[T]):
             t.start()
 
     def pop(self) -> Optional[T]:
+        # a poisoned stream stays poisoned: once an error surfaced,
+        # every later pop() re-raises immediately (held in an attribute
+        # rather than re-queued — a blocking re-put could deadlock
+        # against still-live producers on a full queue)
+        if self._error is not None:
+            raise self._error
         item = self._q.get()
         if item is self._END:
             # re-queue the sentinel so every later pop() (another consumer,
             # a second iteration) also sees end-of-stream instead of hanging —
             # matches the reference pop() returning false repeatedly at end.
+            # Safe: END is only put once ALL producers finished, so no
+            # producer can race this slot.
             self._q.put(self._END)
             return None
+        if isinstance(item, _ProducerError):
+            self._error = item.exc
+            raise item.exc
         return item
 
     def __iter__(self) -> Iterator[T]:
@@ -96,6 +141,206 @@ class ProducerConsumer(Generic[T]):
             if item is None:
                 return
             yield item
+
+    def close(self, join_s: float = 2.5) -> None:
+        """Stop producers and join their threads (bounded): the early-
+        consumer-exit path. A producer wedged inside ``produce`` itself
+        cannot be interrupted and is left to daemon teardown."""
+        self._stop.set()
+        deadline = time.monotonic() + max(0.0, join_s)
+        while time.monotonic() < deadline and any(
+            t.is_alive() for t in self._threads
+        ):
+            # drain so a producer mid-put unblocks at its next timeout tick
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            for t in self._threads:
+                t.join(timeout=0.05)
+
+
+class _Slot:
+    """One in-flight item of an OrderedStagePool: the ordering token the
+    consumer waits on."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+
+class OrderedStagePool(Generic[T]):
+    """Ordered parallel stage: N workers apply ``fn`` to items pulled
+    from ``source``, and results are emitted IN SOURCE ORDER through a
+    bounded window — the pipeline building block the staged host-ingest
+    path needs (parallel localize/pack with a deterministic batch
+    stream; ref threadpool.h applied to the MinibatchReader role).
+
+    Structure: a feeder thread iterates ``source`` (so a slow source —
+    parsing, filtering — runs OFF the consumer thread too), assigning
+    each item a slot that enters the bounded output queue in source
+    order; workers fill slots as they finish. ``capacity`` bounds the
+    in-flight window (completed-but-unconsumed + in-progress items), so
+    the feeder backpressures instead of racing ahead.
+
+    Exception contract (tested): an exception raised by ``source``
+    ends the stream and re-raises at the consumer; an exception raised
+    by ``fn`` on item k re-raises when the consumer reaches position k
+    — deterministic either way. ``close()`` (also called when the
+    consumer's iteration ends or breaks early) stops and joins the
+    feeder and workers, so early exit leaks no threads.
+    """
+
+    _END = object()
+    _WSTOP = object()
+
+    def __init__(
+        self,
+        fn: Callable[[T], object],
+        source,
+        num_workers: int = 2,
+        capacity: Optional[int] = None,
+        name: str = "stage",
+        close_join_s: float = 2.5,
+    ):
+        self._fn = fn
+        self._source = iter(source)
+        self._num = max(1, int(num_workers))
+        cap = capacity if capacity is not None else 2 * self._num
+        self._capacity = max(1, int(cap))
+        self._name = name
+        self._close_join_s = close_join_s
+        self._out_q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        self._work_q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._started = False
+
+    # -- internals ----------------------------------------------------
+
+    def _put(self, q: "queue.Queue", item) -> bool:
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _feed(self) -> None:
+        try:
+            for item in self._source:
+                slot = _Slot()
+                # out_q first: the slot takes its ordinal position in
+                # the emission order before any worker can touch it
+                if not self._put(self._out_q, slot):
+                    return
+                if not self._put(self._work_q, (item, slot)):
+                    return
+            self._put(self._out_q, self._END)
+        except BaseException as e:  # source exception -> ordered re-raise
+            slot = _Slot()
+            slot.error = e
+            slot.event.set()
+            self._put(self._out_q, slot)
+
+    def _work(self) -> None:
+        while True:
+            task = self._work_q.get()
+            if task is self._WSTOP:
+                return
+            item, slot = task
+            if self._stop.is_set():
+                # consumer is gone: don't burn CPU on abandoned items,
+                # but mark the slot so no one can block on it
+                slot.event.set()
+                continue
+            try:
+                slot.value = self._fn(item)
+            except BaseException as e:
+                slot.error = e
+            slot.event.set()
+
+    # -- public surface ----------------------------------------------
+
+    def start(self) -> "OrderedStagePool[T]":
+        """Idempotent: spin up the feeder + worker threads once."""
+        if self._started:
+            return self
+        self._started = True
+        feeder = threading.Thread(
+            target=self._feed, daemon=True, name=f"{self._name}-feed"
+        )
+        self._threads.append(feeder)
+        for i in range(self._num):
+            w = threading.Thread(
+                target=self._work, daemon=True, name=f"{self._name}-w{i}"
+            )
+            self._threads.append(w)
+        for t in self._threads:
+            t.start()
+        return self
+
+    def qsize(self) -> int:
+        """Completed-or-in-progress items staged ahead of the consumer."""
+        return self._out_q.qsize()
+
+    def __iter__(self) -> Iterator:
+        self.start()
+        try:
+            while True:
+                slot = self._out_q.get()
+                if slot is self._END:
+                    return
+                slot.event.wait()
+                if slot.error is not None:
+                    raise slot.error
+                yield slot.value
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop feeder + workers and join them (bounded). Safe to call
+        more than once; a worker wedged inside ``fn`` stays alive
+        (daemon) and is disclosed to teardown as-is."""
+        self._stop.set()
+        deadline = time.monotonic() + max(0.0, self._close_join_s)
+        # wake idle workers immediately: one stop sentinel each. A full
+        # queue drains fast once stop is set (workers skip fn and just
+        # mark slots), so a short blocking put suffices — draining here
+        # instead could swallow a sentinel another worker never saw.
+        workers = self._threads[1:]
+        for _ in range(self._num):
+            while time.monotonic() < deadline and any(
+                t.is_alive() for t in workers
+            ):
+                try:
+                    self._work_q.put(self._WSTOP, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+        while time.monotonic() < deadline and any(
+            t.is_alive() for t in self._threads
+        ):
+            # drain the output so a feeder mid-put unblocks at its next
+            # timeout tick...
+            try:
+                self._out_q.get_nowait()
+            except queue.Empty:
+                pass
+            # ...and re-seed an END sentinel so a CONSUMER on another
+            # thread blocked in out_q.get() (the DeviceUploader nesting)
+            # wakes and terminates instead of waiting forever on a slot
+            # this drain may have stolen
+            try:
+                self._out_q.put_nowait(self._END)
+            except queue.Full:
+                pass
+            for t in self._threads:
+                t.join(timeout=0.05)
 
 
 class ThreadPool:

@@ -208,16 +208,17 @@ class TestReaderPipeline:
                         )
                     )
                     f.write(f"{int(b.y[i])} {feats}\n")
-        reader = MinibatchReader(files=[str(path)], minibatch_size=256)
         worker = AsyncSGDWorker(make_conf(num_slots=4096), mesh=mesh8)
-        prog = worker.train(iter(reader))
+        with MinibatchReader(files=[str(path)], minibatch_size=256) as reader:
+            prog = worker.train(iter(reader))
         assert prog.num_examples_processed == 4 * 256
 
     def test_tail_filter_reduces_features(self, mesh8, w_true):
         batches = list(synth(3, w_true))
         reader = MinibatchReader(batches=iter(batches))
         reader.init_filter(1 << 14, 2, freq=100)  # absurd threshold drops all
-        out = reader.read()
+        with reader:
+            out = reader.read()
         assert out.nnz < batches[0].nnz
 
 
