@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke metrics-lint donation-lint ingest-bench clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench clean
 
 all: native
 
@@ -28,18 +28,30 @@ bench-watch: native
 smoke: native
 	python bench.py --smoke
 
-# validate the telemetry metric catalog: duplicate / non-snake_case
-# names, naming-convention drift, unparseable exposition (fast, no
-# accelerator; also runs as a tier-1 test in tests/test_telemetry.py)
-metrics-lint:
-	python script/metrics_lint.py
+# the full static-analysis suite (script/pslint/, doc/STATIC_ANALYSIS.md):
+# lock-discipline race detector (+ lock-order deadlock cycles),
+# thread-lifecycle, jit-purity, donation, metrics — one engine, one
+# findings report (`path:line rule message`, editor-clickable), exit 1
+# on any unsuppressed finding (fast, no accelerator; also a tier-1
+# test in tests/test_pslint.py)
+pslint:
+	python script/pslint/cli.py
 
-# statically verify every data-plane jit site either donates its table
-# buffers or justifies not doing so (# no-donate:) — the defensive-copy
-# trap guard (fast, no accelerator; also a tier-1 test in
+# all static checks (currently = the pslint suite)
+lint: pslint
+
+# alias: the telemetry-catalog pass alone (duplicate / non-snake_case
+# names, naming drift, unparseable exposition; also a tier-1 test in
+# tests/test_telemetry.py)
+metrics-lint:
+	python script/pslint/cli.py --rules metrics
+
+# alias: the donation pass alone — every data-plane jit site either
+# donates its table buffers or justifies not doing so (# no-donate:),
+# the defensive-copy trap guard (also a tier-1 test in
 # tests/test_donation.py)
 donation-lint:
-	python script/donation_lint.py
+	python script/pslint/cli.py --rules donation
 
 # serial-vs-pipelined host-ingest A/B (components bench): one JSON
 # summary line per metric — serial/pipelined examples/sec + the median

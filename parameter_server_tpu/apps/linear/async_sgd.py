@@ -1496,7 +1496,10 @@ class DeviceUploader:
                 yield staged, n
 
         # maxsize = depth - 1 staged in the queue + 1 held by the
-        # consumer = `depth` device-staged batches in flight
+        # consumer = `depth` device-staged batches in flight.
+        # No locks here (pslint lock-pass scope, nothing guarded):
+        # iter_on_thread owns the cross-thread queue + join contract,
+        # and _it is only touched from the consumer thread.
         self._it = iter_on_thread(uploaded(), maxsize=max(1, depth - 1))
 
     def __iter__(self):

@@ -84,6 +84,10 @@ class IngestPipeline:
         self._capacity = max(1, int(capacity))
         self._name = name
         self._tel = pipeline_instruments()
+        # Single-consumer lifecycle state — deliberately lock-free (in
+        # pslint's lock-pass scope, nothing guarded): start()/__iter__/
+        # close() all run on the consumer thread; the pool and thread
+        # iterator own their cross-thread synchronization internally.
         self._pool: Optional[OrderedStagePool] = None
         self._thread_it = None
         self._it: Optional[Iterator] = None

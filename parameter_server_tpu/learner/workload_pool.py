@@ -36,9 +36,10 @@ class _Info:
 
 class WorkloadPool:
     def __init__(self, load: Optional[Workload] = None):
-        self._loads: List[_Info] = []
-        self._num_finished = 0
+        self._loads: List[_Info] = []  # guarded-by: _lock
+        self._num_finished = 0  # guarded-by: _lock
         self._lock = threading.Lock()
+        # _done shares _lock, so `with self._done:` guards the same state
         self._done = threading.Condition(self._lock)
         if load is not None:
             self.set(load)

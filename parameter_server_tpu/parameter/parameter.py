@@ -157,7 +157,7 @@ class KeyDirectory:
         # Lock: the parallel ingest pipeline's prep workers call
         # slots() concurrently (learner/ingest.py) — the LRU
         # move_to_end/popitem sequence is not atomic on its own.
-        self._slot_cache: "OrderedDict[tuple, list]" = OrderedDict()
+        self._slot_cache: "OrderedDict[tuple, list]" = OrderedDict()  # guarded-by: _slot_cache_lock
         self._slot_cache_lock = threading.Lock()
 
     def _signature(self, keys: np.ndarray) -> tuple:

@@ -51,7 +51,7 @@ class AuxRuntime:
             self._tel = heartbeat_instruments(
                 telemetry_registry.default_registry()
             )
-        self._infos: Dict[str, HeartbeatInfo] = {}
+        self._infos: Dict[str, HeartbeatInfo] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

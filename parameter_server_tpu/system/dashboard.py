@@ -7,6 +7,7 @@ as the reference's dashboard output.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict
 
 from ..telemetry import registry as telemetry_registry
@@ -32,9 +33,16 @@ def _node_sort_key(node_id: str):
 
 class Dashboard:
     def __init__(self, registry=None) -> None:
-        self._data: Dict[str, HeartbeatReport] = {}
-        self._tasks: Dict[str, int] = {}
-        self._events: list = []  # cluster events (resizes, recoveries)
+        # Lock: AuxRuntime.beat() feeds reports from every node's
+        # reporter/hot-loop thread while the aux poller thread renders
+        # report() — an unlocked dict iteration there raised
+        # "dictionary changed size during iteration" under load
+        # (pslint guarded-access; regression test in
+        # tests/test_system_aux.py).
+        self._data: Dict[str, HeartbeatReport] = {}  # guarded-by: _lock
+        self._tasks: Dict[str, int] = {}  # guarded-by: _lock
+        self._events: list = []  # guarded-by: _lock — cluster events (resizes, recoveries)
+        self._lock = threading.Lock()
         # telemetry source for the report's metrics section: None keeps
         # the bare node table (unit-test dashboards), a MetricsRegistry
         # pins one, and "default" resolves the process default registry
@@ -43,26 +51,35 @@ class Dashboard:
         self._registry = registry
 
     def add_report(self, node_id: str, report: HeartbeatReport) -> None:
-        self._data[node_id] = report
+        with self._lock:
+            self._data[node_id] = report
 
     def add_task(self, node_id: str, task_id: int) -> None:
-        self._tasks[node_id] = task_id
+        with self._lock:
+            self._tasks[node_id] = task_id
 
     def add_event(self, line: str, keep: int = 8) -> None:
         """Record a cluster event (elastic resize with its measured
         stop-the-world pause, recovery, ...) shown under the node table
         — the reference's dashboard prints NodeChange notes the same
         way (ref dashboard.cc)."""
-        self._events.append(line)
-        del self._events[:-keep]
+        with self._lock:
+            self._events.append(line)
+            del self._events[:-keep]
 
     def title(self) -> str:
         return "  ".join(name.ljust(width) for name, width in _COLUMNS)
 
     def report(self) -> str:
+        # snapshot under the lock, render outside it (rendering calls
+        # into the telemetry registry, which has locks of its own —
+        # keep the dashboard leaf-level in the lock order)
+        with self._lock:
+            data = dict(self._data)
+            events = list(self._events)
         lines = [self.title()]
-        for nid in sorted(self._data, key=_node_sort_key):
-            r = self._data[nid]
+        for nid in sorted(data, key=_node_sort_key):
+            r = data[nid]
             cells = [
                 nid,
                 f"{r.total_time_milli / 1e3:.1f}",
@@ -76,7 +93,7 @@ class Dashboard:
             lines.append(
                 "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
             )
-        lines.extend(f"event: {e}" for e in self._events)
+        lines.extend(f"event: {e}" for e in events)
         lines.extend(self._telemetry_lines())
         return "\n".join(lines)
 

@@ -65,7 +65,7 @@ class _ExecutorTelemetry:
         self.steps = insts["steps"].labels(executor=name)
         self.in_flight = insts["in_flight"].labels(executor=name)
         self.pending = insts["pending"].labels(executor=name)
-        self._buf: list = []
+        self._buf: list = []  # guarded-by: _buf_lock
         self._buf_lock = threading.Lock()
         reg.add_collector(self.flush)
 
@@ -111,12 +111,12 @@ class TaskTracker:
     """Finished/started timestamp bookkeeping (ref task_tracker.h)."""
 
     def __init__(self) -> None:
-        self._finished: set[int] = set()
-        self._started: set[int] = set()
+        self._finished: set[int] = set()  # guarded-by: _lock
+        self._started: set[int] = set()  # guarded-by: _lock
         # in-flight is tracked incrementally: the set difference the
         # old in_flight() computed is O(all steps ever), and it ran
         # once per dispatched step — quadratic across a training run
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def start(self, ts: int) -> None:
@@ -153,7 +153,7 @@ class Executor:
         telemetry: Optional[bool] = None,
     ):
         self.name = name
-        self._time = 0
+        self._time = 0  # guarded-by: _cv — the logical clock
         # telemetry spine (doc/OBSERVABILITY.md): per-step phase
         # histograms + depth gauges, and one JSONL span event per
         # finished step correlating host time to the logical clock.
@@ -165,8 +165,13 @@ class Executor:
             _ExecutorTelemetry(name) if telemetry else None
         )
         # ts -> [t_submit, t_dispatch, run_s, materialize_s] (perf_counter)
+        # Deliberately NOT guarded-by _cv: the dispatch thread mutates a
+        # record's cells while waiter threads accumulate materialize
+        # time into others; cross-thread hand-off rides dict.pop's
+        # atomicity ("popped exactly once", _record_finished) so the
+        # per-step hot path never takes the cv twice.
         self._step_times: Dict[int, List[float]] = {}
-        self._pending: Dict[int, Tuple[Callable[[], Any], List[int]]] = {}
+        self._pending: Dict[int, Tuple[Callable[[], Any], List[int]]] = {}  # guarded-by: _cv
         # dependency-counted readiness (round 5): the original picker
         # re-sorted and re-scanned every pending step per dispatch —
         # O(n² log n) across an n-step burst, measured at 2.7k steps/s
@@ -174,18 +179,18 @@ class Executor:
         # Now: unmet-dep counts + a dep→dependents map maintained at
         # submit/finish, and a min-heap of ready timestamps — each
         # step is pushed and popped once.
-        self._unmet: Dict[int, int] = {}  # pending ts -> unmet dep count
-        self._dependents: Dict[int, List[int]] = {}  # dep ts -> waiters
-        self._ready: List[int] = []  # heap of dispatchable timestamps
-        self._running: Optional[int] = None  # picked, step() executing now
-        self._ran: set[int] = set()  # ran, not finished yet (pruned on finish)
-        self._futures: Dict[int, Any] = {}  # ts -> pytree (run, maybe async)
-        self._callbacks: Dict[int, Callable[[], None]] = {}
-        self._errors: Dict[int, BaseException] = {}
+        self._unmet: Dict[int, int] = {}  # guarded-by: _cv — pending ts -> unmet dep count
+        self._dependents: Dict[int, List[int]] = {}  # guarded-by: _cv — dep ts -> waiters
+        self._ready: List[int] = []  # guarded-by: _cv — heap of dispatchable timestamps
+        self._running: Optional[int] = None  # guarded-by: _cv — picked, step() executing now
+        self._ran: set[int] = set()  # guarded-by: _cv — ran, not finished yet (pruned on finish)
+        self._futures: Dict[int, Any] = {}  # guarded-by: _cv — ts -> pytree (run, maybe async)
+        self._callbacks: Dict[int, Callable[[], None]] = {}  # guarded-by: _cv
+        self._errors: Dict[int, BaseException] = {}  # guarded-by: _cv
         self.tracker = TaskTracker()
         self._cv = threading.Condition()
-        self._thread: Optional[threading.Thread] = None
-        self._stopped = False
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _cv
+        self._stopped = False  # guarded-by: _cv
         self.max_in_flight = max_in_flight  # 0 = unbounded (eventual consistency)
         # telemetry: max |started \ finished| ever observed at dispatch time
         # (τ-bounded-delay proof for the darlin scheduler)
@@ -275,7 +280,7 @@ class Executor:
 
     # -- the dispatch thread (ref executor.cc thread + PickActiveMsg) --
 
-    def _ensure_thread(self) -> None:
+    def _ensure_thread(self) -> None:  # holds-lock: _cv (submit calls this)
         if self._thread is None or not self._thread.is_alive():
             self._stopped = False
             self._thread = threading.Thread(
@@ -364,7 +369,7 @@ class Executor:
                     self._futures[ts] = result
                 self._cv.notify_all()
 
-    def _dep_done_locked(self, d: int) -> bool:
+    def _dep_done_locked(self, d: int) -> bool:  # holds-lock: _cv
         """A dependency is satisfied when finished — or never submitted
         (the reference waits only on timestamps it issued; an unknown ts is
         a no-op there too)."""
@@ -377,7 +382,7 @@ class Executor:
             and not self.tracker.was_started(d)
         )
 
-    def _pick_ready_locked(self) -> Optional[Tuple[int, Callable[[], Any]]]:
+    def _pick_ready_locked(self) -> Optional[Tuple[int, Callable[[], Any]]]:  # holds-lock: _cv
         """Lowest-timestamp READY step (PickActiveMsg: any ready message
         may overtake blocked ones). O(log n) via the ready heap. Lazy
         skips: entries whose step is gone (run or cancelled), and
@@ -432,9 +437,11 @@ class Executor:
                 raise
         self._note_materialize(ts, time.perf_counter() - t0)
 
-    def _record_finished(self, ts: int) -> None:
+    def _record_finished(self, ts: int, num_pending: int) -> None:
         """Record the finished step's phases into the registry and emit
-        the per-step span event (one line per step, popped exactly once)."""
+        the per-step span event (one line per step, popped exactly once).
+        ``num_pending`` is sampled by the caller inside its own _cv
+        critical section — this path must not re-take the cv per step."""
         tel = self._tel
         if tel is None:
             return
@@ -458,7 +465,7 @@ class Executor:
             mat_s,
             total,
             self.tracker.in_flight(),
-            len(self._pending),
+            num_pending,
         )
         if telemetry_spans.get_sink() is not None:
             telemetry_spans.emit(
@@ -480,7 +487,6 @@ class Executor:
         once, and promote dependents whose last unmet dep this was."""
         if self.tracker.was_started(ts):
             self.tracker.finish(ts)
-        self._record_finished(ts)
         with self._cv:
             self._ran.discard(ts)
             for t in self._dependents.pop(ts, ()):
@@ -494,7 +500,11 @@ class Executor:
                 else:
                     self._unmet[t] = left - 1
             cb = self._callbacks.pop(ts, None)
+            # sampled here so the telemetry record below needs no
+            # second cv acquire on the per-step path
+            num_pending = len(self._pending)
             self._cv.notify_all()
+        self._record_finished(ts, num_pending)
         if cb is not None:
             cb()
 

@@ -38,17 +38,17 @@ class HeartbeatInfo:
 
     def __init__(self, hostname: str = "localhost"):
         self.hostname = hostname
-        self._busy_ms = 0.0
-        self._busy_start: Optional[float] = None
+        self._busy_ms = 0.0  # guarded-by: _lock
+        self._busy_start: Optional[float] = None  # guarded-by: _lock
         self._start = time.time()
-        self._in_bytes = 0
-        self._out_bytes = 0
+        self._in_bytes = 0  # guarded-by: _lock
+        self._out_bytes = 0  # guarded-by: _lock
         # lifetime totals: ``get()`` drains the per-report deltas above
         # (the dashboard's in(MB)/out(MB) are per-interval), so tests and
         # telemetry snapshots need a counter that never resets
-        self._total_in_bytes = 0
-        self._total_out_bytes = 0
-        self._last = resource_usage.sample()
+        self._total_in_bytes = 0  # guarded-by: _lock
+        self._total_out_bytes = 0  # guarded-by: _lock
+        self._last = resource_usage.sample()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def start_timer(self) -> None:
@@ -82,18 +82,26 @@ class HeartbeatInfo:
             return self._total_out_bytes
 
     def get(self) -> HeartbeatReport:
-        cur = resource_usage.sample()
+        # The whole sample-and-diff runs under the lock (pslint
+        # guarded-access): ``_last`` was previously read and replaced
+        # OUTSIDE it, so two reporter threads could rate the same
+        # window twice — or write an OLDER sample over a newer one,
+        # making the next dt negative and the cpu rates garbage.
+        # Sampling inside the lock serializes reporters, so successive
+        # reports tile the timeline exactly once. sample() is two tiny
+        # /proc reads; heartbeat cadence is seconds — contention is nil.
         with self._lock:
+            cur = resource_usage.sample()
             busy = self._busy_ms
             self._busy_ms = 0.0
             in_b, self._in_bytes = self._in_bytes, 0
             out_b, self._out_bytes = self._out_bytes, 0
-        dt = max(1e-9, cur.timestamp - self._last.timestamp)
-        proc_cpu = (cur.cpu_seconds - self._last.cpu_seconds) / dt
+            last, self._last = self._last, cur
+        dt = max(1e-9, cur.timestamp - last.timestamp)
+        proc_cpu = (cur.cpu_seconds - last.cpu_seconds) / dt
         host_cpu = (
-            (cur.host_total_cpu_seconds - self._last.host_total_cpu_seconds) / dt
+            (cur.host_total_cpu_seconds - last.host_total_cpu_seconds) / dt
         )
-        self._last = cur
         return HeartbeatReport(
             hostname=self.hostname,
             seconds_since_epoch=cur.timestamp,
@@ -113,8 +121,8 @@ class HeartbeatCollector:
 
     def __init__(self, timeout: float = 10.0):
         self.timeout = timeout
-        self._reports: Dict[str, HeartbeatReport] = {}
-        self._last_seen: Dict[str, float] = {}
+        self._reports: Dict[str, HeartbeatReport] = {}  # guarded-by: _lock
+        self._last_seen: Dict[str, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def report(self, node_id: str, hb: HeartbeatReport) -> None:

@@ -18,7 +18,7 @@ P = TypeVar("P")
 
 class MonitorMaster(Generic[P]):
     def __init__(self, merger: Optional[Callable[[P, P], None]] = None):
-        self._progress: Dict[str, P] = {}
+        self._progress: Dict[str, P] = {}  # guarded-by: _lock
         self._merger = merger
         self._printer: Optional[Callable[[float, Dict[str, P]], None]] = None
         self._interval = 1.0

@@ -23,7 +23,7 @@ from .van import Van, init_distributed
 
 
 class Postoffice:
-    _instance: Optional["Postoffice"] = None
+    _instance: Optional["Postoffice"] = None  # guarded-by: _lock
     _lock = threading.Lock()
 
     def __init__(self) -> None:

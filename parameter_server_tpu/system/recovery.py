@@ -39,7 +39,7 @@ class RecoveryCoordinator:
             Node.SERVER: [],
             Node.SCHEDULER: [],
         }
-        self._recovered: set = set()
+        self._recovered: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -66,7 +66,7 @@ class ProducerConsumer(Generic[T]):
     def __init__(self, capacity: int = 16):
         self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._threads: list[threading.Thread] = []
-        self._live = 0
+        self._live = 0  # guarded-by: _live_lock — producers still running
         self._live_lock = threading.Lock()
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -91,7 +91,8 @@ class ProducerConsumer(Generic[T]):
         then unspecified — fine for SGD minibatches, which the reference
         shuffles anyway.
         """
-        self._live = num_threads
+        with self._live_lock:
+            self._live = num_threads
 
         def run():
             try:

@@ -12,8 +12,12 @@ The lint makes the choice explicit at every site instead of trusting
 review to notice a missing kwarg.
 
 Purely syntactic (ast + source lines): no jax import, no tracing.
-Run via ``make donation-lint`` or directly; exercised as a tier-1 test
-in tests/test_donation.py so drift fails CI before it ships.
+Runs as the ``donation`` pass of the pslint static-analysis suite
+(``make pslint``, doc/STATIC_ANALYSIS.md) — the logic lives here as
+the single source of truth and pslint wraps it. ``make donation-lint``
+aliases the single-pass pslint run; this file also stays directly
+runnable and is exercised as a tier-1 test in tests/test_donation.py
+so drift fails CI before it ships.
 """
 
 from __future__ import annotations

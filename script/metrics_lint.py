@@ -12,8 +12,12 @@ and fails on:
   ``_seconds`` or ``_bytes`` unit suffix (naming-convention drift);
 - a render_text() exposition that does not parse as Prometheus text.
 
-Run via ``make metrics-lint`` or directly; exercised as a tier-1 test in
-tests/test_telemetry.py so catalog drift fails CI before it ships.
+Runs as the ``metrics`` pass of the pslint static-analysis suite
+(``make pslint``, doc/STATIC_ANALYSIS.md) — the logic lives here as the
+single source of truth and pslint wraps it. ``make metrics-lint``
+aliases the single-pass pslint run; this file also stays directly
+runnable and is exercised as a tier-1 test in tests/test_telemetry.py
+so catalog drift fails CI before it ships.
 """
 
 from __future__ import annotations
@@ -27,11 +31,20 @@ EXPOSITION_LINE = re.compile(
 )
 
 
-def lint() -> list:
-    """Returns a list of problem strings (empty = clean)."""
+def lint(root: "str | None" = None) -> list:
+    """Returns a list of problem strings (empty = clean).
+
+    ``root`` selects which checkout's ``parameter_server_tpu`` to
+    validate (pslint passes its ``--root`` through); default is this
+    script's own repo. Caveat: Python's module cache wins — in a
+    process that already imported the package (pytest), the cached
+    import is what gets validated regardless of ``root``; the pslint
+    CLI runs fresh, where ``root`` is honored."""
     import os
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     from parameter_server_tpu.telemetry.instruments import install_all

@@ -1,0 +1,29 @@
+"""pslint: the repo's multi-pass static-analysis framework.
+
+One AST-based engine (file discovery, per-rule scoping, suppressions
+with mandatory reasons, findings report, exit codes) shared by every
+checked-in analysis pass:
+
+- ``locks``     — lock-discipline race detector: ``# guarded-by:``
+                  annotations on shared mutable attributes, flagged when
+                  read/written outside ``with self.<lock>:``, plus a
+                  cross-class lock-order graph with deadlock-cycle
+                  detection (doc/STATIC_ANALYSIS.md).
+- ``threads``   — thread-lifecycle pass: every ``threading.Thread``
+                  spawn site must have an owner that joins it.
+- ``jit-purity``— Python side effects inside jitted data-plane
+                  functions in ``ops/`` (telemetry, host numpy, clocks,
+                  nonlocal mutation) run at TRACE time only and then
+                  silently vanish from the compiled step.
+- ``donation``  — the donation lint (script/donation_lint.py) as an
+                  engine pass: every data-plane jit declares
+                  ``donate_argnums`` or a ``# no-donate:`` reason.
+- ``metrics``   — the telemetry-catalog lint (script/metrics_lint.py)
+                  as an engine pass: naming, duplicates, exposition.
+
+Pure ``ast`` + ``tokenize`` for the static passes — no jax import, fast
+enough for tier-1 (tests/test_pslint.py runs the whole suite against
+the repo). Run via ``make pslint`` or ``python script/pslint/cli.py``.
+"""
+
+from .engine import Engine, Finding, Rule, SourceFile, default_rules  # noqa: F401

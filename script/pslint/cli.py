@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""pslint CLI: run the multi-pass static-analysis suite.
+
+    python script/pslint/cli.py              # all passes, repo root
+    python script/pslint/cli.py --rules locks,threads
+    python script/pslint/cli.py --list       # show registered passes
+
+Findings print one per line as ``path:line rule message`` (clickable
+in editors); exit 0 = clean, 1 = unsuppressed findings, 2 = usage or
+internal error. Run via ``make pslint`` (aggregate) — ``make
+metrics-lint`` / ``make donation-lint`` alias single passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pslint.engine import Engine, default_rules  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="pslint", description=__doc__)
+    parser.add_argument(
+        "--rules",
+        help="comma-separated pass names to run (default: all)",
+    )
+    parser.add_argument(
+        "--root",
+        default=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        help="repository root (default: this checkout)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list registered passes and exit"
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        rules = default_rules(
+            args.rules.split(",") if args.rules else None
+        )
+    except ValueError as e:
+        print(f"pslint: {e}", file=sys.stderr)
+        return 2
+    if args.list:
+        for r in rules:
+            print(r.name)
+        return 0
+
+    try:
+        findings, suppressed = Engine(args.root, rules).run()
+    except Exception as e:  # engine bug, unreadable tree, ...
+        print(f"pslint: internal error: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.format())
+    names = ",".join(r.name for r in rules)
+    if findings:
+        print(
+            f"pslint: FAILED ({len(findings)} findings, "
+            f"{suppressed} suppressed) [{names}]",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"pslint: OK ({suppressed} suppressed) [{names}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""Donation pass (rule ``donation``): script/donation_lint.py refitted
+as an engine pass.
+
+The logic stays in ``script/donation_lint.py`` (single source of truth
+— tests/test_donation.py and the standalone ``make donation-lint``
+alias keep importing it directly); this pass loads it by file path and
+converts its ``rel:line: message`` problem strings into engine
+findings, so ``make pslint`` runs the whole suite in one report and
+pslint suppressions layer on top of the lint's own ``# no-donate:``
+mechanism.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+from typing import Dict, List, Sequence
+
+from .engine import Finding, Rule, SourceFile
+
+_PROBLEM_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):\s*(?P<msg>.*)$")
+
+
+def _load_sibling(name: str):
+    """Import a script/<name>.py module by path (script/ is not a
+    package; pslint lives one directory below it)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_pslint_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class DonationRule(Rule):
+    name = "donation"
+
+    def paths(self, root: str) -> Sequence[str]:
+        # parse the data-plane scope through the engine so pslint
+        # suppressions and suppression-hygiene checks apply to it
+        return tuple(_load_sibling("donation_lint").SCOPE)
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        lint = _load_sibling("donation_lint")
+        findings: List[Finding] = []
+        for problem in lint.lint(root):
+            m = _PROBLEM_RE.match(problem)
+            if m is not None:
+                findings.append(
+                    Finding(
+                        m.group("path").replace(os.sep, "/"),
+                        int(m.group("line")),
+                        self.name,
+                        m.group("msg"),
+                    )
+                )
+            else:  # e.g. "path: scoped module is missing"
+                path = problem.split(":", 1)[0]
+                msg = problem.split(":", 1)[-1].strip()
+                findings.append(Finding(path, 1, self.name, msg))
+        return findings

@@ -1,0 +1,234 @@
+"""pslint engine: shared machinery for every analysis pass.
+
+Responsibilities (and nothing else — rules own their logic):
+
+- **file discovery + parsing**: each scoped file is read, tokenized and
+  ast-parsed exactly ONCE per run, then shared across passes;
+- **suppressions**: ``# pslint: disable=<rule>[,<rule>] — <reason>``
+  on the flagged line (or a standalone comment on the line above)
+  silences that rule there. The reason is MANDATORY — a disable
+  without one is itself a finding (rule ``suppression``) that cannot
+  be suppressed;
+- **report + exit codes**: findings print one per line as
+  ``path:line rule message`` (editor-clickable), exit 0 clean / 1
+  findings / 2 internal error.
+
+The engine imports only the standard library — no jax, no repo
+modules — so the static passes stay import-safe and fast. Dynamic
+passes (metrics) do their own guarded imports inside ``check``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One problem at one location. ``rule`` is the suppression key."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+# `# pslint: disable=rule-a,rule-b — reason` (em/en dash, `--`, or `-`)
+_SUPPRESS_RE = re.compile(
+    r"#\s*pslint:\s*disable=\s*"
+    r"(?P<rules>[a-z][a-z0-9-]*(?:\s*,\s*[a-z][a-z0-9-]*)*)"
+    r"(?:\s*(?:—|–|--|-)\s*(?P<reason>.*))?$"
+)
+
+_SUPPRESSION_RULE = "suppression"
+
+
+class SourceFile:
+    """One scoped file, parsed once and shared by every pass."""
+
+    def __init__(self, root: str, rel: str):
+        self.root = root
+        self.rel = rel.replace(os.sep, "/")
+        self.path = os.path.join(root, rel)
+        with open(self.path, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.rel)
+        # line -> raw comment text (tokenize keeps comments ast drops)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass  # a truncated final line still lints on the ast
+        # line -> (set of suppressed rules, has_reason)
+        self.suppressions: Dict[int, Tuple[set, bool]] = {}
+        for line, comment in self.comments.items():
+            m = _SUPPRESS_RE.search(comment)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            reason = (m.group("reason") or "").strip()
+            self.suppressions[line] = (rules, bool(reason))
+
+    def comment_at_or_above(self, line: int) -> str:
+        """Trailing comment on ``line`` plus any comment line directly
+        above — the two places annotations may sit."""
+        parts = []
+        above = self.comments.get(line - 1)
+        if above is not None and self.lines[line - 2].lstrip().startswith("#"):
+            parts.append(above)
+        here = self.comments.get(line)
+        if here is not None:
+            parts.append(here)
+        return "\n".join(parts)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """A finding is silenced by a REASONED disable on its own line
+        or on a standalone comment line directly above it."""
+        for ln in (line, line - 1):
+            entry = self.suppressions.get(ln)
+            if entry is None:
+                continue
+            if ln == line - 1 and not self.lines[ln - 1].lstrip().startswith("#"):
+                continue  # trailing comment of the PREVIOUS statement
+            rules, has_reason = entry
+            if rule in rules and has_reason:
+                return True
+        return False
+
+
+class Rule:
+    """Base class of an analysis pass.
+
+    ``name`` selects the pass (``--rules``); ``paths(root)`` returns the
+    repo-relative files it wants parsed; ``check(files, root)`` returns
+    findings. ``files`` holds a SourceFile for every path that exists
+    (missing scoped files are reported by the engine).
+    """
+
+    name: str = "base"
+
+    def paths(self, root: str) -> Sequence[str]:
+        return ()
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        raise NotImplementedError
+
+
+def walk_package(root: str, package: str = "parameter_server_tpu") -> List[str]:
+    """Every .py file under ``package`` (repo-relative, sorted)."""
+    out: List[str] = []
+    base = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                out.append(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+class Engine:
+    def __init__(self, root: str, rules: Sequence[Rule]):
+        self.root = root
+        self.rules = list(rules)
+
+    def run(self) -> Tuple[List[Finding], int]:
+        """Returns (unsuppressed findings, suppressed count)."""
+        cache: Dict[str, SourceFile] = {}
+        findings: List[Finding] = []
+
+        def load(rel: str) -> Optional[SourceFile]:
+            if rel not in cache:
+                path = os.path.join(self.root, rel)
+                if not os.path.exists(path):
+                    findings.append(
+                        Finding(rel, 1, "scope", "scoped file is missing")
+                    )
+                    cache[rel] = None  # type: ignore[assignment]
+                    return None
+                try:
+                    cache[rel] = SourceFile(self.root, rel)
+                except SyntaxError as e:
+                    findings.append(
+                        Finding(rel, e.lineno or 1, "parse", f"failed to parse: {e.msg}")
+                    )
+                    cache[rel] = None  # type: ignore[assignment]
+            return cache[rel]
+
+        for rule in self.rules:
+            files = {}
+            for rel in rule.paths(self.root):
+                sf = load(rel)
+                if sf is not None:
+                    files[rel] = sf
+            findings.extend(rule.check(files, self.root))
+
+        # suppression hygiene over every file any pass touched: a
+        # disable without a reason is a finding in its own right
+        for sf in cache.values():
+            if sf is None:
+                continue
+            for line, (rules, has_reason) in sorted(sf.suppressions.items()):
+                if not has_reason:
+                    findings.append(
+                        Finding(
+                            sf.rel,
+                            line,
+                            _SUPPRESSION_RULE,
+                            "suppression without a reason: write "
+                            "'# pslint: disable=<rule> — <reason>'",
+                        )
+                    )
+
+        kept: List[Finding] = []
+        suppressed = 0
+        for f in findings:
+            sf = cache.get(f.path)
+            # the suppression rule itself is never suppressible —
+            # otherwise a reasonless disable could silence the finding
+            # that exists to demand its reason
+            if (
+                f.rule != _SUPPRESSION_RULE
+                and sf is not None
+                and sf.is_suppressed(f.rule, f.line)
+            ):
+                suppressed += 1
+                continue
+            kept.append(f)
+        kept.sort(key=lambda f: (f.path, f.line, f.rule))
+        return kept, suppressed
+
+
+def default_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
+    """The registered passes, optionally filtered by name."""
+    from . import donation, jitpure, locks, metrics, threads
+
+    rules: List[Rule] = [
+        locks.LockDisciplineRule(),
+        threads.ThreadLifecycleRule(),
+        jitpure.JitPurityRule(),
+        donation.DonationRule(),
+        metrics.MetricsRule(),
+    ]
+    if only is not None:
+        wanted = set(only)
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+        rules = [r for r in rules if r.name in wanted]
+    return rules

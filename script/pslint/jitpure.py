@@ -1,0 +1,178 @@
+"""jit-purity pass (rule ``jit-purity``).
+
+A jitted function's Python body runs ONCE, at trace time; everything
+that is not a traced jax op is baked or discarded. A telemetry call, a
+``print``, a wall-clock read or a host-numpy materialization inside a
+jitted data-plane function therefore *looks* like it works (it fires
+during the first call) and then silently stops — or worse, forces a
+device→host sync inside the hot path. This pass flags those constructs
+inside functions that are direct jit targets in ``ops/``:
+
+- ``print(...)`` — trace-time-only output;
+- ``time.time()`` / ``time.perf_counter()`` / ``time.monotonic()`` —
+  measures tracing, not execution (telemetry belongs OUTSIDE the jit,
+  as ops/kv_ops._dispatch_fused does);
+- telemetry instrument calls — ``.observe(...)`` / ``.inc(...)`` or any
+  call into a ``*_tel`` / ``telemetry`` name;
+- host numpy on traced values — ``np.asarray`` / ``np.array`` /
+  ``np.copy`` / ``np.frombuffer`` / ``np.ascontiguousarray`` /
+  ``np.save`` / ``np.random.*`` (``np.uint32(...)`` constants and
+  shape math like ``np.sqrt(q.shape[-1])`` are trace-time constants
+  and stay legal);
+- ``.item()`` / ``.tolist()`` — forced device→host sync;
+- ``nonlocal`` / ``global`` — closure mutation that happens once at
+  trace time and never again.
+
+A jit *target* is a function that is decorated with ``@jax.jit`` /
+``@jit`` / ``@(functools.)partial(jax.jit, ...)``, or referenced by
+name in a ``jit(f)`` / ``partial(jax.jit, ...)(f)`` call. Nested defs
+inside a target are traced with it and are scanned too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .engine import Finding, Rule, SourceFile
+
+SCOPE = (
+    "parameter_server_tpu/ops/kv_ops.py",
+    "parameter_server_tpu/ops/ftrl.py",
+    "parameter_server_tpu/ops/quantize.py",
+    "parameter_server_tpu/ops/flash_attention.py",
+)
+
+_NP_IMPURE = {
+    "asarray", "array", "copy", "frombuffer", "ascontiguousarray",
+    "save", "savez", "load",
+}
+_TIME_FNS = {"time", "perf_counter", "monotonic", "process_time"}
+_SYNC_METHODS = {"item", "tolist"}
+_TEL_METHODS = {"observe", "inc"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _is_jit_partial(node: ast.AST) -> bool:
+    """``(functools.)partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    is_partial = (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        or isinstance(fn, ast.Name) and fn.id == "partial"
+    )
+    return is_partial and bool(node.args) and _is_jit_ref(node.args[0])
+
+
+def _jit_target_names(tree: ast.Module) -> Set[str]:
+    """Names of module-level functions that are jitted by reference:
+    ``jit(f)``, ``partial(jax.jit, ...)(f)``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_ref(node.func) or _is_jit_partial(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _is_jitted_def(fn: ast.AST, by_name: Set[str]) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    if fn.name in by_name:
+        return True
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec) or _is_jit_partial(dec):
+            return True
+        if isinstance(dec, ast.Call) and _is_jit_ref(dec.func):
+            return True
+    return False
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+
+    def __init__(self, scope: Sequence[str] = SCOPE):
+        self.scope = tuple(scope)
+
+    def paths(self, root: str) -> Sequence[str]:
+        return self.scope
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files.values():
+            by_name = _jit_target_names(sf.tree)
+            for node in ast.walk(sf.tree):
+                if _is_jitted_def(node, by_name):
+                    findings.extend(self._check_body(node, sf))
+        return findings
+
+    def _check_body(self, fn, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, what: str):
+            findings.append(
+                Finding(
+                    sf.rel,
+                    node.lineno,
+                    "jit-purity",
+                    f"{what} inside jitted function '{fn.name}' runs at "
+                    "trace time only — move it outside the jit or "
+                    "disable with a reason",
+                )
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Nonlocal, ast.Global)):
+                flag(node, f"{type(node).__name__.lower()} mutation")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "print":
+                flag(node, "print()")
+            elif isinstance(f, ast.Attribute):
+                owner = f.value
+                owner_name = owner.id if isinstance(owner, ast.Name) else None
+                if owner_name == "time" and f.attr in _TIME_FNS:
+                    flag(node, f"time.{f.attr}() clock read")
+                elif owner_name == "np" and f.attr in _NP_IMPURE:
+                    flag(node, f"host numpy np.{f.attr}()")
+                elif (
+                    isinstance(owner, ast.Attribute)
+                    and owner.attr == "random"
+                    and isinstance(owner.value, ast.Name)
+                    and owner.value.id == "np"
+                ):
+                    flag(node, f"host numpy np.random.{f.attr}()")
+                elif f.attr in _SYNC_METHODS and not node.args:
+                    flag(node, f".{f.attr}() device→host sync")
+                elif f.attr in _TEL_METHODS and self._telemetry_owner(owner):
+                    flag(node, f"telemetry .{f.attr}() call")
+        return findings
+
+    @staticmethod
+    def _telemetry_owner(owner: ast.AST) -> bool:
+        """Owner expression smells like a telemetry instrument: a name
+        (or subscript of a name) matching ``*tel*`` / ``*metric*`` /
+        ``*instrument*``."""
+        base: Optional[str] = None
+        node = owner
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = node.id
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            base = node.func.id
+        if base is None:
+            return False
+        low = base.lower()
+        return "tel" in low or "metric" in low or "instrument" in low

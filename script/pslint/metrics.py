@@ -1,0 +1,36 @@
+"""Metrics pass (rule ``metrics``): script/metrics_lint.py refitted as
+an engine pass.
+
+Unlike the static passes this one is DYNAMIC — it instantiates the
+telemetry catalog (parameter_server_tpu.telemetry, no jax import)
+against a fresh registry and validates names, duplicates and the text
+exposition. The logic stays in ``script/metrics_lint.py`` (tests and
+the ``make metrics-lint`` alias keep using it directly); this pass
+loads it by file path and reports its problems as engine findings.
+
+Catalog problems have no single source line, so findings anchor at
+line 1 of the instrument catalog module.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .donation import _load_sibling
+from .engine import Finding, Rule, SourceFile
+
+_CATALOG = "parameter_server_tpu/telemetry/instruments.py"
+
+
+class MetricsRule(Rule):
+    name = "metrics"
+
+    def paths(self, root: str) -> Sequence[str]:
+        return ()
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        lint = _load_sibling("metrics_lint")
+        return [
+            Finding(_CATALOG, 1, self.name, problem)
+            for problem in lint.lint(root)
+        ]

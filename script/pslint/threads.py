@@ -1,0 +1,121 @@
+"""Thread-lifecycle pass (rule ``thread-join``, pass ``threads``).
+
+Every ``threading.Thread(...)`` spawn site must have an OWNER that
+joins it: the enclosing function, or (for spawns inside methods) some
+method of the enclosing class, must contain a ``.join(...)`` call. A
+spawned thread nobody joins outlives its work — interpreter teardown
+kills it mid-call (the 'terminate called / FATAL: exception not
+rethrown' crash utils/concurrent.iter_on_thread documents, and the
+leaked-thread pattern tests/test_ingest.py guards dynamically with
+before/after thread counts — this pass is the static version).
+
+``daemon=True`` is NOT an escape: daemon threads still die mid-call at
+teardown; it only changes whether the interpreter waits. Fire-and-
+forget threads that are genuinely unjoinable declare it:
+
+    # pslint: disable=thread-join — <who owns the lifetime and why>
+
+Purely syntactic: the pass proves a join SITE exists in the owning
+scope, not that every path reaches it — that's what the dynamic
+leak-guard tests are for. The two checks are complementary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence
+
+from .engine import Finding, Rule, SourceFile, walk_package
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "Thread":
+        return isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """A Thread.join-shaped call: ``t.join()``, ``t.join(5)``,
+    ``t.join(timeout=...)``. ``str.join`` / ``os.path.join`` take a
+    non-numeric positional argument, so they never match — a
+    ``", ".join(parts)`` in the owning class must not satisfy the
+    thread-lifecycle rule."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "join"):
+        return False
+    if isinstance(fn.value, ast.Constant):  # literal like ", ".join
+        return False
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if not call.args:
+        return not call.keywords
+    return len(call.args) == 1 and (
+        isinstance(call.args[0], ast.Constant)
+        and isinstance(call.args[0].value, (int, float))
+    )
+
+
+def _contains_join(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _is_thread_join(n):
+            return True
+    return False
+
+
+class ThreadLifecycleRule(Rule):
+    name = "threads"
+
+    def __init__(self, scope: Optional[Sequence[str]] = None):
+        self.scope = scope
+
+    def paths(self, root: str) -> Sequence[str]:
+        if self.scope is not None:
+            return self.scope
+        return walk_package(root)
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in files.values():
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        # parent chain: function defs and class defs enclosing each node
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def owners(node: ast.AST):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    yield cur
+                cur = parents.get(cur)
+
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            joined = False
+            for owner in owners(node):
+                if _contains_join(owner):
+                    joined = True
+                    break
+                if isinstance(owner, ast.ClassDef):
+                    break  # a class boundary is the widest owner scope
+            if not joined:
+                findings.append(
+                    Finding(
+                        sf.rel,
+                        node.lineno,
+                        "thread-join",
+                        "threading.Thread spawned with no owner that "
+                        "joins it (no .join() in the enclosing function "
+                        "or class); join it, or disable with a reason",
+                    )
+                )
+        return findings

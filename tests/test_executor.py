@@ -106,14 +106,24 @@ class TestOutOfOrderDispatch:
 
         ex = Executor()
         gate = th.Event()
+        independent_ran = th.Event()
         order = []
 
         t0 = ex.submit(lambda: (gate.wait(5), order.append("slow"))[1])
         t1 = ex.submit(lambda: order.append("dependent"), Task(wait_time=[t0]))
-        t2 = ex.submit(lambda: order.append("independent"))
-        # t0 is executing (blocked on the gate); t1 waits on t0; t2 has no
-        # deps — it must run before t1 even though it was submitted later
+        t2 = ex.submit(
+            lambda: (order.append("independent"), independent_ran.set())[0]
+        )
+        # t0 occupies the dispatch thread until the gate opens; t1 waits
+        # on t0; t2 has no deps. Once t0's step returns, the dispatcher
+        # must pick the ready t2 before it resolves t1's dependency.
+        # Synchronize on that EVENT rather than racing wait_all()
+        # against the dispatch thread: a wait_all() entered early can
+        # itself finish t0 (materialize + promote) and push t1 into the
+        # ready heap before t2 was ever picked — the load flake this
+        # test used to have (ROADMAP).
         gate.set()
+        assert independent_ran.wait(5), "independent step never dispatched"
         ex.wait_all()
         assert order.index("independent") < order.index("dependent")
         assert order[-1] == "dependent"

@@ -79,6 +79,68 @@ class TestHeartbeat:
         col.report("W1", HeartbeatInfo().get())  # W1 stays alive
         assert col.dead_nodes() == ["W0"]
 
+    def test_concurrent_get_windows_tile_exactly(self, monkeypatch):
+        """Regression (pslint guarded-access): ``get()`` used to read
+        and replace ``_last`` OUTSIDE the lock, so concurrent reporter
+        threads could rate the same sample window twice — or clobber a
+        newer sample with an older one, driving dt negative. With the
+        whole sample-and-diff under the lock, N concurrent gets consume
+        the synthetic sample stream in non-overlapping windows: the
+        cpu-rate multiset must be exactly {2i-1}."""
+        import sys
+        import threading
+
+        from parameter_server_tpu.system import heartbeat as hb_mod
+        from parameter_server_tpu.utils.resource_usage import Usage
+
+        state = {"n": 0}
+        state_lock = threading.Lock()
+
+        def fake_sample():
+            with state_lock:
+                state["n"] += 1
+                n = float(state["n"])
+            # timestamp advances by 1 per sample; cpu_seconds = n^2, so
+            # the true rate over the window (n-1, n) is exactly 2n - 1
+            return Usage(
+                timestamp=n,
+                rss_mb=1.0,
+                vm_mb=1.0,
+                cpu_seconds=n * n,
+                host_total_cpu_seconds=0.0,
+                load1=0.0,
+            )
+
+        monkeypatch.setattr(hb_mod.resource_usage, "sample", fake_sample)
+        info = HeartbeatInfo(hostname="h")  # consumes sample #1
+        rates = []
+        rates_lock = threading.Lock()
+        start = threading.Barrier(4)
+
+        def reporter():
+            start.wait()
+            for _ in range(50):
+                rep = info.get()
+                with rates_lock:
+                    rates.append(round(rep.process_cpu_usage))
+
+        threads = [threading.Thread(target=reporter) for _ in range(4)]
+        # the pre-fix window is a few bytecodes wide — preempt often
+        # enough that the racy interleaving actually happens
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old_interval)
+        # 200 gets consume samples #2..#201: rates 2n-1 for n in 2..201,
+        # each window exactly once — duplicates or misses mean the
+        # unlocked read-modify-write of _last came back
+        assert sorted(rates) == [2 * n - 1 for n in range(2, 202)]
+
 
 class TestDashboard:
     def test_table_render_and_order(self):
@@ -90,6 +152,53 @@ class TestDashboard:
         assert out[0].startswith("node")
         order = [line.split()[0] for line in out[1:]]
         assert order == ["H0", "W0", "S0", "S1"]
+
+    def test_report_never_sees_torn_event_window(self):
+        """Regression (pslint guarded-access): Dashboard had NO lock —
+        AuxRuntime.beat() feeds it from every node's reporter thread
+        while the aux poller renders report(). ``add_event`` appends
+        and THEN trims to the last ``keep`` entries; without the lock a
+        concurrent report() can observe the list between those two
+        steps and render more events than the window allows (and, on
+        free-threaded builds, corrupt the dict outright). With
+        add_event/report atomic under the new lock, the rendered event
+        count can never exceed the window."""
+        import sys
+        import threading
+
+        dash = Dashboard()
+        stop = threading.Event()
+
+        def writer(prefix):
+            i = 0
+            while not stop.is_set():
+                dash.add_event(f"{prefix}{i}")  # keep=8 window
+                i += 1
+
+        threads = [
+            threading.Thread(target=writer, args=(p,), daemon=True)
+            for p in ("W", "S")
+        ]
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)
+        max_seen = 0
+        try:
+            for t in threads:
+                t.start()
+            for _ in range(4000):
+                n_events = dash.report().count("event: ")
+                max_seen = max(max_seen, n_events)
+                if max_seen > 8:
+                    break
+        finally:
+            stop.set()
+            sys.setswitchinterval(old_interval)
+            for t in threads:
+                t.join(timeout=5)
+        assert max_seen <= 8, (
+            f"report() observed a torn event window ({max_seen} > 8): "
+            "add_event/report are not atomic"
+        )
 
 
 class TestRemoteNode:
