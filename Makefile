@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench clean
 
 all: native
 
@@ -59,6 +59,14 @@ donation-lint:
 # embedded in every bench.py record under "host_ingest")
 ingest-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks host_ingest
+
+# compact-wire encoded-vs-raw A/B (components bench): bytes/example
+# per encoding at the headline shape, multi-pass amortized bytes
+# through the upload key cache, exact-mode parity, encode cost (fast,
+# CPU-only; the same A/B is embedded in every bench.py record under
+# "wire" with per-encoding link-bound ceilings)
+wire-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks wire
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

@@ -463,6 +463,34 @@ def attach_host_ingest(rec_or_headline: dict, smoke: bool) -> None:
         )
 
 
+def attach_wire(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the compact-wire encoded-vs-raw A/B
+    (benchmarks/components.wire_ab) under ``wire`` in every bench
+    record: bytes/example per encoding, the multi-pass amortized bytes
+    through the upload key cache, exact-mode parity, and encode cost.
+    Host CPU only. When the record already carries a measured link rate
+    (``host_to_device_mb_s``), also derives the link-bound ceiling each
+    encoding implies — the e2e rate that bytes/example CAPS at that
+    link speed (ceiling = MB/s × 1e6 ÷ bytes/example), which is the
+    motivation for the whole wire: the recorded baseline sat at
+    34-69k examples/sec because 107.4 B/example met a 5-27 MB/s link."""
+    try:
+        from parameter_server_tpu.benchmarks.components import wire_ab
+
+        out = wire_ab(smoke)
+        mb_s = rec_or_headline.get("host_to_device_mb_s")
+        if mb_s:
+            per_enc = {}
+            for table in ("bytes_per_example", "amortized_bytes_per_example"):
+                for k, v in out[table].items():
+                    if v:
+                        per_enc[k] = round(mb_s * 1e6 / v, 1)
+            out["link_bound_examples_per_sec_at_measured_mb_s"] = per_enc
+        rec_or_headline["wire"] = out
+    except Exception as e:
+        rec_or_headline["wire_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
@@ -1403,6 +1431,8 @@ def run_real(args) -> int:
     attach_kv_dataplane(headline, worker.mesh, args.smoke)
     _beat("host_ingest")
     attach_host_ingest(headline, args.smoke)
+    _beat("wire")
+    attach_wire(headline, args.smoke)
     _beat("e2e", **headline)
 
     def host_prepped():
@@ -1793,6 +1823,8 @@ def run_synthetic(args) -> int:
     # ingest plane is the post-zero-copy bottleneck this record tracks
     _beat("host_ingest")
     attach_host_ingest(headline, args.smoke)
+    _beat("wire")
+    attach_wire(headline, args.smoke)
     _beat("e2e", **headline)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes

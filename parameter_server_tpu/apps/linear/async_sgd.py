@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...learner.sgd import ISGDCompNode, ISGDScheduler, SGDProgress
 from ...ops.kv_ops import localize, slot_sentinel, valid_slots
+from ...ops.wire_codec import decode_u24
 from ...parallel import mesh as meshlib
 from ...parallel.mesh import DATA_AXIS, SERVER_AXIS
 from ...system.message import Task
@@ -397,10 +398,10 @@ def pack_u24(idx: np.ndarray) -> np.ndarray:
     return flat.view(np.uint8).reshape(*idx.shape, 4)[..., :3].copy()
 
 
-def unpack_u24(b: jnp.ndarray) -> jnp.ndarray:
-    """uint8 [.., 3] → int32 [..] (jit-side inverse of pack_u24)."""
-    s = b.astype(jnp.int32)
-    return s[..., 0] | (s[..., 1] << 8) | (s[..., 2] << 16)
+# jit-side inverse of pack_u24 — the canonical implementation lives in
+# ops/wire_codec (decode_u24, with the rest of the wire decode ops);
+# re-exported under the historical name for the ELLPackedBatch step
+unpack_u24 = decode_u24
 
 
 def prep_batch_ell(
@@ -1173,6 +1174,19 @@ def _make_exact_mini_step(
                 "update='sparse' composes with the exact (unfiltered) "
                 "wire only; quantized/noisy filters need update='dense'"
             )
+        # pull_narrow only modifies a QUANTIZED pull (gather codes+mask
+        # instead of dequantized weights); with pull_quant rejected
+        # above it has nothing to modify, and the row-gather below
+        # ignores it entirely. Fail loudly on an explicit 'narrow'
+        # rather than silently dropping it, so a future
+        # narrow-without-quant mode cannot diverge here unnoticed
+        # (ADVICE round 5). `None` ("auto") stays fine.
+        if pull_narrow:
+            raise ValueError(
+                "update='sparse' does not implement pull_gather="
+                "'narrow' (narrow modifies the quantized pull, which "
+                "sparse mode rejects); use pull_gather='auto'/'wide'"
+            )
         from .updaters import apply_state_rows
 
         def mini_step_sparse(live, pulled, seed, y, mask, rows, ucols,
@@ -1341,6 +1355,126 @@ def make_train_step_scan(
     return _donation_variants(step_impl)
 
 
+def _encoded_shard_decoder(num_slots: int):
+    """Per-shard decode closure for the compact wire (ops/wire_codec via
+    learner.wire.decode_exact_shard): EncodedExactBatch leaves with a
+    leading local-shard dim of 1 → the raw per-shard exact-wire arrays.
+    The static encoding parameters ride on the batch object itself (the
+    batch and superbatch classes both carry them)."""
+    from ...learner.wire import decode_exact_shard
+
+    def decode(eb):
+        leaves = (
+            eb.y[0], eb.counts[0], eb.row_counts[0], eb.nnz[0],
+            eb.ucols_words[0], eb.uslots[0], eb.n_uniq[0],
+            None if eb.vals is None else eb.vals[0],
+            None if eb.vals_lo is None else eb.vals_lo[0],
+            None if eb.vals_hi is None else eb.vals_hi[0],
+        )
+        # named_scope: wire decode shows up as its own phase in the
+        # --profile trace (utils/profiling.summarize_trace), so the
+        # bytes-for-VPU-cycles trade stays measurable
+        with jax.named_scope("ps_wire_decode"):
+            return decode_exact_shard(eb, num_slots, _leaves=leaves)
+
+    return decode
+
+
+def make_train_step_encoded(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
+    update: str = "dense",
+):
+    """Fused SPMD step over the compact wire's EncodedExactBatch: only
+    the encoded buffers cross the host→device link; the jit decodes
+    them per shard (ops/wire_codec, trace-pure) and runs the SAME exact
+    mini-step as make_train_step — exact-mode parity is bit-for-bit
+    (tests/test_wire.py)."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_exact_mini_step(
+        updater, loss, shard, with_aux, update, push_quant, pull_quant,
+        push_noise, pull_noise, pull_narrow,
+    )
+    decode = _encoded_shard_decoder(num_slots)
+
+    def local_step(live, pulled, seed, eb):
+        y, mask, rows, ucols, vals, uslots, umask = decode(eb)
+        return mini_step(
+            live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask
+        )
+
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = _bits_state_spec(live_state)
+        bspec = jax.tree.map(lambda _: P(DATA_AXIS), batch)
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), bspec),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(live_state, pull_state, seed, batch)
+
+    return _donation_variants(step_impl)
+
+
+def make_train_step_encoded_scan(
+    updater, loss, mesh, num_slots: int, with_aux: bool = True,
+    push_quant: int = 0, pull_quant: int = 0, push_noise=None,
+    pull_noise=None, pull_narrow: "bool | None" = None,
+    update: str = "dense",
+):
+    """Scan-fused superstep over the compact wire: T encoded minibatches
+    per launch (the EncodedExactSuperBatch twin of make_train_step_scan
+    — decode AND ministep both live inside the one jitted program)."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_exact_mini_step(
+        updater, loss, shard, with_aux, update, push_quant, pull_quant,
+        push_noise, pull_noise, pull_narrow,
+    )
+    decode = _encoded_shard_decoder(num_slots)
+
+    def local_step(live, pulled, seed, eb):
+        del pulled  # staleness 0 inside the superstep (≤ any delay bound)
+        t_steps = eb.counts.shape[0]
+
+        def body(carry, xs):
+            state, i = carry
+            y, mask, rows, ucols, vals, uslots, umask = decode(xs)
+            new_state, metrics = mini_step(
+                state, state, seed + i, y, mask, rows, ucols, vals,
+                uslots, umask,
+            )
+            return (new_state, i + np.uint32(1)), metrics
+
+        (new_state, _), metrics = jax.lax.scan(
+            body, (live, np.uint32(0)), eb, length=t_steps
+        )
+        if not with_aux:
+            metrics = jax.tree.map(lambda m: m.sum(axis=0), metrics)
+        else:
+            metrics = {
+                k: (v.sum(axis=0) if v.ndim == 1 else v)
+                for k, v in metrics.items()
+            }
+        return new_state, metrics
+
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = _bits_state_spec(live_state)
+        bspec = jax.tree.map(lambda _: P(None, DATA_AXIS), batch)
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), bspec),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(live_state, pull_state, seed, batch)
+
+    return _donation_variants(step_impl)
+
+
 def make_train_step(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
@@ -1482,14 +1616,27 @@ class DeviceUploader:
                     tel["examples"].labels(pipeline="device_uploader").inc(
                         int(prepped.num_examples)
                     )
-                    tel["uploaded_bytes"].inc(
-                        sum(
-                            int(getattr(leaf, "nbytes", 0))
-                            for leaf in jax.tree.leaves(prepped)
-                        )
-                    )
+                # sample BEFORE the upload: when upload_fn is a caching
+                # uploader (learner/wire.UploadCache), leaves served
+                # from the device-resident cache never cross the link —
+                # uploaded_bytes must stay the REALIZED link traffic
+                # (doc/OBSERVABILITY.md), so hit bytes are subtracted
+                saved0 = int(getattr(upload_fn, "saved_bytes", 0))
                 staged = upload_fn(prepped)
                 if tel is not None:
+                    hit_bytes = (
+                        int(getattr(upload_fn, "saved_bytes", 0)) - saved0
+                    )
+                    tel["uploaded_bytes"].inc(
+                        max(
+                            0,
+                            sum(
+                                int(getattr(leaf, "nbytes", 0))
+                                for leaf in jax.tree.leaves(prepped)
+                            )
+                            - hit_bytes,
+                        )
+                    )
                     tel["stage_seconds"].labels(stage="upload").observe(
                         time.perf_counter() - t0
                     )
@@ -1542,6 +1689,17 @@ class AsyncSGDWorker(ISGDCompNode):
             raise ValueError(
                 f"unknown SGDConfig.wire {sgd.wire!r}; expected "
                 "'i32', 'u24', 'bits', or '' (legacy wire_u24 flag)"
+            )
+        from ...learner.wire import WIRE_ENCODE_MODES
+
+        if sgd.wire_encode not in WIRE_ENCODE_MODES:
+            raise ValueError(
+                f"unknown SGDConfig.wire_encode {sgd.wire_encode!r}; "
+                f"expected one of {WIRE_ENCODE_MODES}"
+            )
+        if sgd.wire_cache_mb < 0:
+            raise ValueError(
+                f"SGDConfig.wire_cache_mb must be >= 0, got {sgd.wire_cache_mb}"
             )
         # FIXING_FLOAT push/pull filters → n-byte quantized wire inside the
         # fused step (KEY_CACHING needs no device work here — streaming
@@ -1717,14 +1875,30 @@ class AsyncSGDWorker(ISGDCompNode):
         """Host-prepped shards → device arrays. Multi-process: assemble
         this host's shards into the global data-sharded batch (the data
         axis sits at dim 1 for scan superbatches, after the T axis)."""
+        from ...learner.wire import EncodedExactSuperBatch
         from ...parallel import distributed
 
         axis_dim = (
             1
-            if isinstance(prepped, (ELLBitsSuperBatch, PreppedSuperBatch))
+            if isinstance(
+                prepped,
+                (ELLBitsSuperBatch, PreppedSuperBatch, EncodedExactSuperBatch),
+            )
             else 0
         )
         return distributed.global_from_local(self.mesh, prepped, axis_dim=axis_dim)
+
+    def _maybe_encode(self, out):
+        """Compact-wire encode for exact-wire (PreppedBatch) preps —
+        STATELESS (pool-safe prep stage, the PR-3 ingest rule); falls
+        back to the raw wire when the batch lies outside a verified
+        encoding domain, so the wire is never wrong, only fat."""
+        if not self.sgd.wire_encode:
+            return out
+        from ...learner.wire import encode_exact
+
+        enc = encode_exact(out, self.num_slots, mode=self.sgd.wire_encode)
+        return out if enc is None else enc
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
@@ -1738,10 +1912,10 @@ class AsyncSGDWorker(ISGDCompNode):
             # the Pallas kernel.
             uniq = min(nnz_pad * num_shards, self.num_slots)
             uniq = -(-uniq // 1024) * 1024
-            out = prep_batch_shared(
+            out = self._maybe_encode(prep_batch_shared(
                 batch, self.directory, num_shards, rows_pad, nnz_pad,
                 uniq, self.num_slots,
-            )
+            ))
             return self.upload(out) if device_put else out
         out = None
         use_ell = self.sgd.ell_lanes > 0 and self.directory.hashed
@@ -1816,7 +1990,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.num_slots,
             )
         else:
-            out = prep_batch(
+            out = self._maybe_encode(prep_batch(
                 batch,
                 self.directory,
                 num_shards,
@@ -1824,11 +1998,39 @@ class AsyncSGDWorker(ISGDCompNode):
                 nnz_pad,
                 uniq_pad,
                 self.num_slots,
-            )
+            ))
         return self.upload(out) if device_put else out
 
     def _get_step(self, prepped, with_aux: bool):
-        if isinstance(prepped, PreppedSuperBatch):
+        from ...learner.wire import EncodedExactBatch, EncodedExactSuperBatch
+
+        if isinstance(prepped, EncodedExactSuperBatch):
+            key = (
+                "exact_enc_scan",
+                (prepped.steps, prepped.static_key(), self._update_mode),
+                with_aux,
+            )
+            builder = lambda: make_train_step_encoded_scan(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                with_aux=with_aux, push_quant=self._push_quant,
+                pull_quant=self._pull_quant, push_noise=self._push_noise,
+                pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
+                update=self._update_mode,
+            )
+        elif isinstance(prepped, EncodedExactBatch):
+            key = (
+                "exact_enc",
+                (prepped.static_key(), self._update_mode),
+                with_aux,
+            )
+            builder = lambda: make_train_step_encoded(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                with_aux=with_aux, push_quant=self._push_quant,
+                pull_quant=self._pull_quant, push_noise=self._push_noise,
+                pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
+                update=self._update_mode,
+            )
+        elif isinstance(prepped, PreppedSuperBatch):
             key = ("exact_scan", (prepped.steps, self._update_mode), with_aux)
             builder = lambda: make_train_step_scan(  # noqa: E731
                 self.updater, self.loss, self.mesh, self.num_slots,
@@ -1902,12 +2104,17 @@ class AsyncSGDWorker(ISGDCompNode):
             # host shards can't be auto-sharded across processes by jit;
             # assemble the global batch explicitly
             prepped = self.upload(prepped)
+        from ...learner.wire import EncodedExactSuperBatch
+
         tau = self.sgd.max_delay
         # a scan superbatch advances the weights n_steps times in one
         # submission (staleness 0 inside it — within any delay bound)
         n_steps = (
             prepped.steps
-            if isinstance(prepped, (ELLBitsSuperBatch, PreppedSuperBatch))
+            if isinstance(
+                prepped,
+                (ELLBitsSuperBatch, PreppedSuperBatch, EncodedExactSuperBatch),
+            )
             else 1
         )
         # snapshot *scheduling* happens at submit time (deterministic in
@@ -1965,19 +2172,36 @@ class AsyncSGDWorker(ISGDCompNode):
         device launch (see ELLBitsSuperBatch). Requires the bits wire —
         raises on ineligible batches (the training loop's submit_group is
         the tolerant variant)."""
+        from ...learner.wire import EncodedExactBatch, stack_encoded_batches
+
         prepped = [self.prep(b, device_put=False) for b in batches]
         if all(isinstance(p, ELLBitsBatch) for p in prepped):
             return self._submit_fused(prepped, with_aux)
-        if all(isinstance(p, PreppedBatch) for p in prepped):
-            # exact-wire superbatch (the sparse-update big-table path)
-            return self._submit_prepped(
-                self.upload(stack_prepped_batches(prepped)),
-                with_aux=with_aux,
-            )
+        # exact-wire (raw or compact-encoded) scan fusion is SPARSE-
+        # update only, same gate and rationale as _prep_group: the scan
+        # runs ministeps on the live state (staleness 0), which is
+        # sparse mode's contract but would silently drop dense mode's
+        # snapshot-pull / per-ministep filter semantics (ADVICE r5)
+        if self._update_mode == "sparse":
+            if all(isinstance(p, PreppedBatch) for p in prepped):
+                return self._submit_prepped(
+                    self.upload(stack_prepped_batches(prepped)),
+                    with_aux=with_aux,
+                )
+            if all(isinstance(p, EncodedExactBatch) for p in prepped) and (
+                len({p.static_key() for p in prepped}) == 1
+            ):
+                # compact-wire superbatch: decode rides inside the scan
+                return self._submit_prepped(
+                    self.upload(stack_encoded_batches(prepped)),
+                    with_aux=with_aux,
+                )
         raise ValueError(
             "superbatch needs the bits wire (hashed directory, binary "
-            "uniform-row batches) or the exact wire (sparse-update "
-            "mode); got a mixed/fallback encoding"
+            "uniform-row batches) or the exact wire in sparse-update "
+            "mode (dense-mode exact groups run per-minibatch: the scan "
+            "would bypass snapshot/filter semantics); got a "
+            "mixed/fallback encoding or a dense-mode exact group"
         )
 
     def _prep_group(self, batches: List[SparseBatch]):
@@ -1985,17 +2209,27 @@ class AsyncSGDWorker(ISGDCompNode):
         work ordering constraints — safe to run on a pipeline thread):
         one scan superbatch when every batch takes the bits wire, else
         per-minibatch parts. Returns ``[(host_prepped, n_ministeps)]``."""
+        from ...learner.wire import EncodedExactBatch, stack_encoded_batches
+
         prepped = [self.prep(b, device_put=False) for b in batches]
         if len(prepped) > 1 and all(
             isinstance(p, ELLBitsBatch) for p in prepped
         ):
             return [(stack_bits_batches(prepped), len(prepped))]
-        if len(prepped) > 1 and all(
-            isinstance(p, PreppedBatch) for p in prepped
-        ):
-            # the exact wire scan-fuses too (sparse-update mode preps
-            # PreppedBatches regardless of the configured wire)
-            return [(stack_prepped_batches(prepped), len(prepped))]
+        # exact-wire (raw or compact-encoded) scan fusion is gated on
+        # SPARSE update mode: make_train_step_scan runs every ministep
+        # against the LIVE state (`del pulled`, staleness 0), which is
+        # sparse mode's documented contract but would silently change
+        # dense-mode semantics (snapshot pulls every max_delay steps,
+        # push/pull filters per ministep) — dense exact-wire groups
+        # stay per-minibatch (ADVICE round 5).
+        if len(prepped) > 1 and self._update_mode == "sparse":
+            if all(isinstance(p, PreppedBatch) for p in prepped):
+                return [(stack_prepped_batches(prepped), len(prepped))]
+            if all(isinstance(p, EncodedExactBatch) for p in prepped) and (
+                len({p.static_key() for p in prepped}) == 1
+            ):
+                return [(stack_encoded_batches(prepped), len(prepped))]
         if len(prepped) > 1 and not self._warned_scan_fallback:
             import logging
 
@@ -2111,7 +2345,22 @@ class AsyncSGDWorker(ISGDCompNode):
                 for parts in pipe:
                     yield from parts
 
-            uploader = DeviceUploader(flattened(), self.upload, depth=2)
+            # upload key caching (learner/wire.UploadCache): stateful,
+            # so it lives on the uploader's serial thread (the PR-3
+            # stateless-or-feeder ingest rule), never in the prep pool.
+            # Multi-process keeps the plain path — global batch
+            # assembly owns placement there.
+            upload_fn = self.upload
+            if self.sgd.wire_cache_mb > 0:
+                from ...parallel import distributed
+
+                if not distributed.is_multiprocess():
+                    from ...learner.wire import UploadCache
+
+                    upload_fn = UploadCache(
+                        max_bytes=self.sgd.wire_cache_mb << 20
+                    )
+            uploader = DeviceUploader(flattened(), upload_fn, depth=2)
             try:
                 for staged_batch, n in uploader:
                     pending.append(

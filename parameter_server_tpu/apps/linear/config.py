@@ -84,6 +84,26 @@ class SGDConfig:
     # u24 otherwise — cheapest bytes AND cheapest host cycles via the
     # fused C++ hash→pack pass)
     wire: str = ""
+    # compact wire for the EXACT (host-dedup) batch path
+    # (learner/wire.py): "" = raw buffers (today's stream), "exact" =
+    # lossless encode — bit-packed ucols, delta/bit-packed sorted
+    # uslots, sign-bit labels, count-coded mask/rows, binary values
+    # elided; decode happens inside the jitted step (ops/wire_codec),
+    # so only encoded bytes cross the host→device link and the decoded
+    # stream is BIT-IDENTICAL to the raw wire (parity-tested).
+    # "int8"/"u16"/"bf16" additionally narrow the value stream of
+    # valued batches (stochastic fixed-point / bfloat16) — lossy,
+    # gated behind the logloss-parity bound in tests/test_wire.py.
+    wire_encode: str = ""
+    # upload key cache (learner/wire.UploadCache): >0 enables crc32c-
+    # signature key caching on the host→device leg with this many MB of
+    # retained host copies — a repeated batch array (multi-epoch
+    # passes, eval/replay loops) re-uses its device-resident buffer
+    # instead of re-crossing the link. Exact-verified (the signature
+    # routes, a byte compare decides), so it composes with wire_encode
+    # losslessly. Costs host RAM for the retained copies and HBM for
+    # the pinned device buffers; size it to the repeated working set.
+    wire_cache_mb: int = 0
     # ongoing server replication (ref FLAGS_num_replicas + Parameter::
     # SetReplica): >0 mirrors each server shard's segment onto its
     # neighbor shard every `replica_every` steps, so a dead server loses

@@ -611,6 +611,254 @@ def host_ingest_ab(
     }
 
 
+def _criteo_shape_batches(
+    rows: int, lanes: int, n_batches: int, valued: bool = False,
+    seed: int = 0,
+):
+    """Synthetic batches following the headline bench's data law
+    (bench.py _write_criteo_chunk): 13 small-vocab integer fields +
+    26 power-law (cube-of-uniform) categorical fields, field-salted
+    keys, ±1 labels — the distribution the recorded 107.4 B/example
+    baseline was measured on. ``valued`` attaches float values (the
+    quantized-wire arm; the binary CTR stream has no value bytes)."""
+    from ..utils.sparse import SparseBatch
+
+    rng = np.random.default_rng(seed)
+    n_int = min(13, lanes)
+    n_cat = lanes - n_int
+    out = []
+    for _ in range(n_batches):
+        ints = rng.integers(10, 100, size=(rows, n_int))
+        u = rng.random((rows, n_cat))
+        cats = (u * u * u * (1 << 24)).astype(np.int64)
+        # field-salted keys: distinct key spaces per field, like the
+        # criteo parser's (field, token) hash
+        keys = np.concatenate(
+            [
+                (j << 40) | ints[:, j : j + 1] for j in range(n_int)
+            ] + [
+                ((100 + j) << 40) | cats[:, j : j + 1] for j in range(n_cat)
+            ],
+            axis=1,
+        ).astype(np.int64)
+        y = rng.choice((-1.0, 1.0), rows).astype(np.float32)
+        vals = (
+            (rng.random(rows * lanes) + 0.5).astype(np.float32)
+            if valued else None
+        )
+        out.append(SparseBatch(
+            y=y,
+            indptr=np.arange(0, rows * lanes + 1, lanes),
+            indices=keys.ravel(),
+            values=vals,
+        ))
+    return out
+
+
+# signature-only wire cost of an upload-cache hit: crc32c (4B) +
+# shape/dtype routing metadata — what a repeated array actually costs
+# the link (filter/key_caching.py semantics)
+_SIG_BYTES = 16
+
+
+def wire_ab(smoke: bool = False) -> dict:
+    """Encoded-vs-raw compact-wire A/B (HOST side only, no device).
+
+    Measures what each wire format ships per example at the headline
+    bench shape, on data following the headline generator's law, plus
+    the encode cost and the exact-mode parity bit. Arms:
+
+    - ``raw_exact``  — the raw exact (host-dedup) PreppedBatch buffers
+    - ``exact``      — learner/wire.encode_exact, lossless default mode
+      (decode verified BIT-IDENTICAL here, every batch)
+    - ``bits``       — the ELL bits wire (today's e2e default; this is
+      the recorded 107.4 B/example raw baseline at 2^22 slots)
+    - ``raw_valued``/``int8_valued`` — the valued stream raw vs
+      fixed-point (the lossy mode, logloss-gated in tests)
+
+    Multi-pass amortization: CTR training makes ``num_data_pass``
+    passes over the shard, and pass ≥2 re-ships only crc32c signatures
+    through the upload key cache (learner/wire.UploadCache, exact-
+    verified) — ``amortized_bytes_per_example`` quotes the per-pass
+    average with the pass count disclosed; the single-pass numbers
+    stand alone above it. Encode throughput quotes the MEDIAN of
+    back-to-back paired reps (the PR-3 bench discipline: this host's
+    CPU capacity flaps on a seconds timescale)."""
+    import time as _time
+
+    from ..apps.linear.async_sgd import (
+        prep_batch_ell_bits,
+        prep_batch_shared,
+    )
+    from ..learner.wire import (
+        UploadCache,
+        decode_exact_host,
+        encode_exact,
+        tree_nbytes,
+    )
+    from ..parameter.parameter import KeyDirectory
+
+    rows = 4096 if smoke else 16384
+    lanes = 39
+    n_batches = 2 if smoke else 4
+    passes = 3
+    num_shards = 2
+    num_slots = 1 << 22
+    directory = KeyDirectory(num_slots, hashed=True)
+    rows_pad = rows // num_shards
+    nnz_pad = rows_pad * lanes
+    uniq_pad = -(-min(nnz_pad * num_shards, num_slots) // 1024) * 1024
+
+    batches = _criteo_shape_batches(rows, lanes, n_batches)
+    n_ex = rows * n_batches
+
+    def prep(b):
+        return prep_batch_shared(
+            b, directory, num_shards, rows_pad, nnz_pad, uniq_pad,
+            num_slots,
+        )
+
+    # -- bytes per example, per encoding (with exact-mode parity) --
+    raws = [prep(b) for b in batches]
+    encs = [encode_exact(p, num_slots) for p in raws]
+    assert all(e is not None for e in encs)
+    parity = True
+    for p, e in zip(raws, encs):
+        dec = decode_exact_host(e, num_slots)
+        import dataclasses as _dc
+
+        for f, arr in zip(_dc.fields(type(p)), dec):
+            want = np.asarray(getattr(p, f.name))
+            parity &= bool(
+                want.dtype == np.asarray(arr).dtype
+                and np.array_equal(want, np.asarray(arr))
+            )
+    bits = [
+        prep_batch_ell_bits(
+            b, directory, num_shards, rows_pad, lanes, num_slots
+        )
+        for b in batches
+    ]
+    assert all(x is not None for x in bits)
+    bpe = {
+        "raw_exact": sum(tree_nbytes(p) for p in raws) / n_ex,
+        "exact": sum(tree_nbytes(e) for e in encs) / n_ex,
+        "bits": sum(tree_nbytes(x) for x in bits) / n_ex,
+    }
+
+    # valued stream: raw f32 vs int8 fixed-point (the lossy mode)
+    vbatches = _criteo_shape_batches(rows, lanes, n_batches, valued=True,
+                                     seed=1)
+    vraws = [prep(b) for b in vbatches]
+    vencs = [encode_exact(p, num_slots, mode="int8") for p in vraws]
+    assert all(e is not None for e in vencs)
+    bpe["raw_valued"] = sum(tree_nbytes(p) for p in vraws) / n_ex
+    bpe["int8_valued"] = sum(tree_nbytes(e) for e in vencs) / n_ex
+
+    # -- multi-pass amortization through the upload key cache --
+    def amortize(parts):
+        shipped = 0
+        cache = UploadCache(upload_leaf=lambda leaf: leaf,
+                            max_bytes=1 << 30)
+        for _ in range(passes):
+            for part in parts:
+                b0, h0 = cache.saved_bytes, cache.hits
+                cache(part)
+                shipped += tree_nbytes(part) - (cache.saved_bytes - b0)
+                shipped += _SIG_BYTES * (cache.hits - h0)
+        return shipped / (n_ex * passes), cache
+
+    amort_exact, cache_e = amortize(encs)
+    amort_bits, cache_b = amortize(bits)
+    amortized = {
+        "exact_cached": round(amort_exact, 1),
+        "bits_cached": round(amort_bits, 1),
+    }
+
+    # -- encode cost: median of back-to-back (prep, prep+encode) pairs --
+    reps = 3 if smoke else 5
+    t_prep, t_enc = [], []
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        for b in batches:
+            prep(b)
+        t_prep.append(_time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        for b in batches:
+            encode_exact(prep(b), num_slots)
+        t_enc.append(_time.perf_counter() - t0)
+    ratios = sorted(e / p for e, p in zip(t_enc, t_prep))
+
+    raw_baseline = bpe["bits"]  # the recorded 107.4 B/ex configuration
+    out = {
+        "minibatch": rows,
+        "lanes": lanes,
+        "num_slots": num_slots,
+        "batches": n_batches,
+        "passes": passes,
+        "bytes_per_example": {k: round(v, 1) for k, v in bpe.items()},
+        "amortized_bytes_per_example": amortized,
+        "raw_baseline_bytes_per_example": round(raw_baseline, 1),
+        # the acceptance ratios, vs the recorded 107.4 B/ex baseline,
+        # amortized over the disclosed pass count. Named precisely:
+        # "lossless_default" is the e2e default BITS wire + the upload
+        # key cache (the cache is the cross-batch half of the exact/
+        # lossless contract — the bits stream itself is unchanged);
+        # "exact_encode" is the new encoded exact (PreppedBatch) wire
+        # under the same cache. Per-batch encode ratios are reported
+        # separately below against each wire's own raw form.
+        "reduction_vs_raw_baseline": {
+            "lossless_default_amortized": round(
+                raw_baseline / amort_bits, 2
+            ),
+            "exact_encode_amortized": round(
+                raw_baseline / amort_exact, 2
+            ),
+        },
+        "exact_reduction_vs_raw_exact": round(
+            bpe["raw_exact"] / bpe["exact"], 2
+        ),
+        "int8_reduction_vs_raw_valued": round(
+            bpe["raw_valued"] / bpe["int8_valued"], 2
+        ),
+        "exact_parity_bit_identical": bool(parity),
+        "cache": {
+            "hits": cache_e.hits + cache_b.hits,
+            "misses": cache_e.misses + cache_b.misses,
+            "saved_mb": round(
+                (cache_e.saved_bytes + cache_b.saved_bytes) / 1e6, 1
+            ),
+        },
+        "encode_over_prep_median_ratio": round(
+            ratios[len(ratios) // 2], 3
+        ),
+        "prep_examples_per_sec": round(n_ex * reps / sum(t_prep), 1),
+        "prep_encode_examples_per_sec": round(n_ex * reps / sum(t_enc), 1),
+    }
+    return out
+
+
+@benchmark("wire")
+def wire_perf(smoke: bool = False) -> None:
+    """Compact-wire encoded-vs-raw A/B (see wire_ab). CPU-only — bytes
+    and encode cost; the link-bound ceiling each bytes/example implies
+    is attached by bench.py from its measured link MB/s."""
+    out = wire_ab(smoke)
+    for k, v in out["bytes_per_example"].items():
+        report(f"wire_bytes_per_example_{k}", v, "bytes")
+    for k, v in out["amortized_bytes_per_example"].items():
+        report(
+            f"wire_amortized_bytes_per_example_{k}", v,
+            f"bytes ({out['passes']} passes)",
+        )
+    for k, v in out["reduction_vs_raw_baseline"].items():
+        report(f"wire_{k}_reduction_vs_raw_baseline", v, "x")
+    report(
+        "wire_encode_over_prep_median_ratio",
+        out["encode_over_prep_median_ratio"], "x",
+    )
+
+
 @benchmark("host_ingest")
 def host_ingest_perf(smoke: bool = False) -> None:
     """Serial vs pipelined host-ingest throughput (see host_ingest_ab).

@@ -36,18 +36,23 @@ def _use_pallas() -> bool:
 
 def xla_min_slots() -> int:
     """Dense-update formulation flip point, in slots — DISABLED by
-    default (2^62 ≈ never) because the honest A/B says the Pallas
-    kernel wins at every size. Measurement history, kept because the
-    wrong version is instructive: a single-pass non-donated A/B
-    (BENCH_ONCHIP 2026-08-02 16:12) showed XLA 17.8 ms vs Pallas
-    29.3 ms at 2^28 — but that form charges the Pallas arm defensive
-    whole-table copies for its input_output_aliases (the ftrl_update
-    docstring's own warning) and buries both arms under a ~14.5 ms
-    dispatch floor. The corrected 8-deep in-program chain
-    (ftrl_dense_*_chain_* captures, 16:54) has Pallas AHEAD at every
-    size: 2.82 vs 3.05 ms at 2^25 through 10.82 vs 12.81 ms at 2^28.
-    Env ``PS_FTRL_XLA_MIN_SLOTS`` remains as the sweep override; the
-    value is baked at trace time per shape (jit static caching)."""
+    default (2^62 ≈ never). The only committed capture (BENCH_ONCHIP
+    2026-08-02 16:12: ftrl_dense_xla_2e28 17.8 ms vs
+    ftrl_dense_pallas_2e28 29.3 ms) nominally favors XLA, but that
+    single-pass form is confounded twice over: it charges the Pallas
+    arm defensive whole-table copies for its input_output_aliases (the
+    ftrl_update docstring's own warning) and buries both arms under a
+    ~14.5 ms per-dispatch tunnel floor — so it cannot decide the flip,
+    and the default stays disabled on that methodology argument. A
+    corrected in-program chain A/B (8 chained updates per dispatch)
+    run the same day had Pallas ahead at every size, but its captures
+    were NOT retained in the repo, so they are deliberately not cited
+    as evidence here; the next ``make bench-all`` on a reachable
+    device appends ftrl_dense_*_chain_* captures to BENCH_ONCHIP.md
+    and is the committed measurement this default should be re-judged
+    against. Env ``PS_FTRL_XLA_MIN_SLOTS`` remains as the sweep
+    override; the value is baked at trace time per shape (jit static
+    caching)."""
     try:
         return int(os.environ.get("PS_FTRL_XLA_MIN_SLOTS", 1 << 62))
     except ValueError:

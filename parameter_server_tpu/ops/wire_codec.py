@@ -1,0 +1,114 @@
+"""Fused bitwise decode ops for the compact host→device wire.
+
+The host→device link is the training pipeline's scarce resource (the
+recorded link-bound ceiling sits at 34-69k examples/sec against a
+0.6-1.3M device-only rate), so the ingest prep stage ships *encoded*
+batch buffers (learner/wire.py) and the jitted train step reconstructs
+the original arrays with the ops in this module — decoded batches never
+cross the link. Every op here is trace-pure (pslint jit-purity pass):
+pure jnp on traced operands, shapes static, no host effects.
+
+Encodings decoded here (host encoders in learner/wire.py +
+utils/bitpack.py):
+
+- ``decode_u24``            3-byte little-endian slot ids → int32
+- ``decode_bitstream``      ceil(log2 S)-bit packed ids → int32
+- ``decode_sign_labels``    1-bit labels → ±1 float32 (0 past ``count``)
+- ``decode_mask``           live-row count → {0,1} float32 row mask
+- ``decode_row_ids``        per-row feature counts → COO row-id array
+- ``decode_sorted_deltas``  u16 gap stream → sorted unique slot array
+- ``decode_binary_vals``    nnz count → the all-ones value array
+- ``decode_fixed_point``    u8/u16 codes + per-shard (lo, hi) → float32
+- ``decode_bf16``           bfloat16 values → float32
+
+Each is the exact inverse of its host encoder over the encoder's
+declared domain (the encoder VERIFIES the domain per batch and falls
+back to the raw wire otherwise), so the default ``exact`` wire decodes
+bit-identical to the unencoded stream — parity-tested in
+tests/test_wire.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..filter.fixing_float import dequantize_jax
+from ..utils.bitpack import unpack_bits, unpack_sign_bits
+
+
+def decode_u24(b: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [.., 3] little-endian → int32 [..] (inverse of
+    async_sgd.pack_u24): three cheap VPU ops, no gather."""
+    s = b.astype(jnp.int32)
+    return s[..., 0] | (s[..., 1] << 8) | (s[..., 2] << 16)
+
+
+def decode_bitstream(words: jnp.ndarray, n: int, bits: int) -> jnp.ndarray:
+    """uint32 word stream → int32 [n]: the ceil(log2 S)-bit slot wire
+    (utils/bitpack.unpack_bits — tiled gather-free form when n divides
+    the value period, two-gather fallback otherwise)."""
+    return unpack_bits(words, n, bits)
+
+
+def decode_sign_labels(y_bits: jnp.ndarray, count, rows: int) -> jnp.ndarray:
+    """1-bit label stream → float32 [rows] of ±1, exactly 0.0 on padding
+    rows (the raw wire stores literal 0.0 there, and exact-mode decode
+    must reproduce it bit-for-bit)."""
+    y = unpack_sign_bits(y_bits, rows)
+    return jnp.where(jnp.arange(rows) < count, y, 0.0)
+
+
+def decode_mask(count, rows: int) -> jnp.ndarray:
+    """Live-row count → the float32 {1.0, 0.0} row mask (the raw wire's
+    ``mask`` is always ``1.0[:n]`` by construction — prep_batch*)."""
+    return (jnp.arange(rows) < count).astype(jnp.float32)
+
+
+def decode_row_ids(row_counts: jnp.ndarray, nnz, nnz_pad: int) -> jnp.ndarray:
+    """Per-row feature counts (uint8/uint16 [R]) → int32 [nnz_pad] COO
+    row-id array ``repeat(arange(R), counts)`` zero-padded past ``nnz``.
+
+    Scatter-free-of-gathers reconstruction: drop a +1 marker at each
+    row's start offset (rows with zero features stack their markers on
+    the next start — the cumsum then jumps by their count, skipping
+    them exactly like np.repeat does), inclusive-cumsum, and mask the
+    padding tail back to the raw wire's literal zeros.
+    """
+    starts = jnp.cumsum(row_counts.astype(jnp.int32))[:-1]  # rows 1..R-1
+    bumps = (
+        jnp.zeros((nnz_pad,), jnp.int32)
+        .at[starts]
+        .add(1, mode="drop")  # a trailing all-empty tail lands at nnz
+    )
+    ids = jnp.cumsum(bumps)
+    return jnp.where(jnp.arange(nnz_pad) < nnz, ids, 0)
+
+
+def decode_sorted_deltas(
+    deltas: jnp.ndarray, n_uniq, sentinel: int
+) -> jnp.ndarray:
+    """u16 gap stream → sorted int32 slot array, ``sentinel`` past
+    ``n_uniq`` (the exact wire's ``uslots`` layout: np.unique output is
+    strictly increasing, so gaps are ≥1 and — verified per batch by the
+    host encoder — fit u16; element 0 carries the absolute first slot).
+    The cumsum runs in int32, so reconstruction is exact."""
+    s = jnp.cumsum(deltas.astype(jnp.int32))
+    return jnp.where(jnp.arange(deltas.shape[0]) < n_uniq, s, sentinel)
+
+
+def decode_binary_vals(nnz, nnz_pad: int) -> jnp.ndarray:
+    """nnz count → the float32 value array of a binary batch: exactly
+    1.0 on live entries, exactly 0.0 on padding — what prep_batch*
+    writes for ``batch.binary`` data, elided from the wire entirely."""
+    return (jnp.arange(nnz_pad) < nnz).astype(jnp.float32)
+
+
+def decode_fixed_point(q: jnp.ndarray, lo, hi, num_bytes: int) -> jnp.ndarray:
+    """u8/u16 fixed-point codes + per-shard scalar (lo, hi) → float32
+    (the quantized value wire; filter/fixing_float dequantize_jax)."""
+    return dequantize_jax(q, lo, hi, num_bytes)
+
+
+def decode_bf16(v: jnp.ndarray) -> jnp.ndarray:
+    """bfloat16 value stream → float32 (widening is exact)."""
+    return v.astype(jnp.float32)

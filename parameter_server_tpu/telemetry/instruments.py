@@ -208,6 +208,43 @@ def ingest_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def wire_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Compact host→device wire (learner/wire.py): encoded bytes per
+    encoding, bytes the encodings and the upload key cache kept off the
+    link, encode cost, and cache traffic. The link-bound ceiling is
+    bytes/example × link MB/s — these counters are its numerator."""
+    return {
+        "bytes": reg.ensure_counter(
+            "ps_wire_bytes_total",
+            "host bytes actually shipped (or queued to ship) on the "
+            "host→device wire, by encoding mode",
+            labelnames=("encoding",),
+        ),
+        "saved_bytes": reg.ensure_counter(
+            "ps_wire_saved_bytes_total",
+            "bytes kept OFF the wire vs the raw batch buffers — "
+            "reason=encoding (compact formats) or cache_hit (a repeated "
+            "array re-used its device-resident buffer)",
+            labelnames=("reason",),
+        ),
+        "encode_seconds": reg.ensure_histogram(
+            "ps_wire_encode_seconds",
+            "per-batch wall time of the host-side wire encode (a "
+            "stateless prep-pool stage — off the trainer thread)",
+            buckets=PHASE_BUCKETS,
+        ),
+        "cache_hits": reg.ensure_counter(
+            "ps_wire_cache_hits_total",
+            "upload key-cache hits (crc32c signature routed, exact "
+            "compare verified)",
+        ),
+        "cache_misses": reg.ensure_counter(
+            "ps_wire_cache_misses_total",
+            "upload key-cache misses (array uploaded and retained)",
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -264,12 +301,34 @@ def cached_kvops_instruments():
     return _KVOPS_CACHE[1]
 
 
+# (registry, instruments) pair shared by every wire encode/cache call
+# site — the encode runs once per batch on every prep-pool worker, so
+# it must not re-ensure the family per call (same hot-path shape as
+# cached_kvops_instruments); None while telemetry is disabled
+_WIRE_CACHE = (None, None)
+
+
+def cached_wire_instruments():
+    """Process-default wire instruments, or None when telemetry is off.
+    The ONE cache for the wire hot paths (encode_exact, UploadCache)."""
+    from . import registry as telemetry_registry
+
+    if not telemetry_registry.enabled():
+        return None
+    reg = telemetry_registry.default_registry()
+    global _WIRE_CACHE
+    if _WIRE_CACHE[0] is not reg:
+        _WIRE_CACHE = (reg, wire_instruments(reg))
+    return _WIRE_CACHE[1]
+
+
 INSTRUMENT_FAMILIES = (
     executor_instruments,
     van_instruments,
     parameter_instruments,
     kvops_instruments,
     ingest_instruments,
+    wire_instruments,
     app_instruments,
     heartbeat_instruments,
 )

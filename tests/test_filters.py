@@ -130,6 +130,110 @@ class TestFrequency:
         np.testing.assert_array_equal(f.query_keys(keys, 0), keys)
 
 
+class TestFullWireChain:
+    """Full upload-wire chain round trips (learner/wire.wire_filter_specs):
+    key_caching + fixing_float + compressing together, decode in reverse,
+    with the stateful per-peer caches exercised across repeats."""
+
+    def _roundtrip(self, specs_fn, keys, vals, sender, receiver):
+        """Returns (key_crossed_wire, decoded message). The chain
+        mutates the Message in place (decode RESTORES msg.key), so
+        whether keys crossed must be sampled between encode and
+        decode."""
+        m = msg_with([v.copy() for v in vals],
+                     key=None if keys is None else keys.copy())
+        m.task.filters = specs_fn()
+        enc = sender.encode(m)
+        key_crossed = enc.key is not None
+        return key_crossed, receiver.decode(enc)
+
+    def test_reference_order_quantizes_then_compresses(self, rng):
+        # the WORKING order: fixing_float must run before the byte
+        # codec, else it sees uint8 frames and quantizes nothing
+        from parameter_server_tpu.learner.wire import wire_filter_specs
+
+        sender, receiver = FilterChain(), FilterChain()
+        keys = np.sort(rng.choice(1 << 30, 300, replace=False)).astype(np.int64)
+        vals = [rng.normal(size=300).astype(np.float32)]
+        crossed, dec = self._roundtrip(
+            lambda: wire_filter_specs(num_bytes=2), keys, vals,
+            sender, receiver,
+        )
+        assert crossed  # first send carries keys
+        np.testing.assert_array_equal(dec.key, keys)
+        step = (vals[0].max() - vals[0].min()) / 65535
+        assert np.abs(dec.values[0] - vals[0]).max() <= step + 1e-6
+        # repeat: the stateful per-peer key cache drops the keys from
+        # the wire; the receiver's cache restores them on decode
+        crossed2, dec2 = self._roundtrip(
+            lambda: wire_filter_specs(num_bytes=2), keys, vals,
+            sender, receiver,
+        )
+        assert not crossed2
+        np.testing.assert_array_equal(dec2.key, keys)
+
+    def test_swapped_order_still_roundtrips(self, rng):
+        # chain mechanics are order-agnostic (decode reverses encode):
+        # compressing → key_caching → fixing_float also round-trips —
+        # fixing_float just sees byte frames and passes them through
+        def specs():
+            return [
+                FilterSpec(type="compressing"),
+                FilterSpec(type="key_caching"),
+                FilterSpec(type="fixing_float", num_bytes=1),
+            ]
+
+        sender, receiver = FilterChain(), FilterChain()
+        keys = np.arange(64, dtype=np.int64)
+        vals = [np.zeros(512, np.float32)]
+        vals[0][::7] = 1.0
+        crossed, dec = self._roundtrip(specs, keys, vals, sender, receiver)
+        assert crossed
+        np.testing.assert_array_equal(dec.key, keys)
+        # lossless: the quantizer never touched the compressed bytes
+        np.testing.assert_array_equal(dec.values[0], vals[0])
+
+    def test_per_peer_caches_are_independent(self, rng):
+        # ref RemoteNode: one stateful chain PER PEER — a second
+        # receiver that never saw the keys must miss, not inherit the
+        # first receiver's cache
+        from parameter_server_tpu.learner.wire import wire_filter_specs
+
+        sender = FilterChain()
+        recv_a, recv_b = FilterChain(), FilterChain()
+        keys = np.arange(128, dtype=np.int64)
+        vals = [np.ones(128, np.float32)]
+        _, _ = self._roundtrip(
+            wire_filter_specs, keys, vals, sender, recv_a
+        )
+        crossed2, dec_a = self._roundtrip(
+            wire_filter_specs, keys, vals, sender, recv_a
+        )
+        assert not crossed2
+        np.testing.assert_array_equal(dec_a.key, keys)  # peer A: hit
+        # peer B never cached: replay the keyless wire form to it
+        m = msg_with([vals[0].copy()], key=keys.copy())
+        m.task.filters = wire_filter_specs()
+        wire_form = sender.encode(m)
+        assert wire_form.key is None  # sender cache still hot
+        with pytest.raises(KeyError):
+            recv_b.decode(wire_form)  # loud miss, not silent garbage
+
+    def test_mixed_dtype_values_pass_through(self, rng):
+        from parameter_server_tpu.learner.wire import wire_filter_specs
+
+        sender, receiver = FilterChain(), FilterChain()
+        ints = np.arange(100, dtype=np.int32)
+        floats = rng.normal(size=100).astype(np.float32)
+        _, dec = self._roundtrip(
+            lambda: wire_filter_specs(num_bytes=1), None,
+            [ints, floats], sender, receiver,
+        )
+        np.testing.assert_array_equal(dec.values[0], ints)  # untouched
+        step = (floats.max() - floats.min()) / 255
+        assert np.abs(dec.values[1] - floats).max() <= step + 1e-6
+
+
 class TestChainOrder:
     def test_stacked_filters_reverse_decode(self, rng):
         chain = FilterChain()
