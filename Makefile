@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench clean
 
 all: native
 
@@ -67,6 +67,15 @@ ingest-bench: native
 # "wire" with per-encoding link-bound ceilings)
 wire-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks wire
+
+# request-path serving SLO bench (components bench): open-loop Poisson
+# load against the serving frontend — p50/p99/p99.9 at >=2 offered-load
+# points, admission on/off A/B (bounded p99 under overload vs queue
+# collapse), coalescing merge factor, speculative-decode lane (fast,
+# CPU-runnable, self-calibrating rates; the same dict is embedded in
+# every bench.py record under "serve")
+serve-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks serve
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

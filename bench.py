@@ -491,6 +491,23 @@ def attach_wire(rec_or_headline: dict, smoke: bool) -> None:
         rec_or_headline["wire_error"] = f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def attach_serve(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the request-path serving bench
+    (benchmarks/components.serve_ab — the serving plane, doc/SERVING.md)
+    under ``serve`` in every bench record: open-loop p50/p99/p99.9 at
+    two offered-load points (below capacity + 3x overload), the
+    admission on/off p99 A/B (bounded tail vs queue collapse), the
+    coalescer's submits-per-request merge factor, and the speculative
+    LM decode lane. Rates self-calibrate to the host, so the record is
+    meaningful on CPU and on chip alike; never breaks a record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import serve_ab
+
+        rec_or_headline["serve"] = serve_ab(smoke)
+    except Exception as e:
+        rec_or_headline["serve_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
@@ -785,16 +802,25 @@ class UploadPipeline:
     the wire time dominated the loop and the breakdown fields read
     upload-bound (r4 verdict item 5: push e2e to the link ceiling).
 
-    Iterating yields ``(device_superbatch, num_examples, nbytes)``.
-    A trailing partial group (< T minibatches) is skipped — it would
-    compile a second scan shape inside the timed window — and reported
-    via ``skipped_examples`` after iteration ends. Exceptions on the
-    uploader thread propagate to the consuming iterator (the plumbing
-    is :func:`iter_on_thread`; this class only adds the staging
-    generator and the skipped-tail accounting)."""
+    Iterating yields ``(device_superbatch, num_examples, nbytes)`` —
+    ``nbytes`` is what actually CROSSED the link: with an upload key
+    cache attached (``cache=``, learner/wire.UploadCache — the encoded-
+    wire default since the wire flip), leaves the device already holds
+    ship ~signature bytes, and the yielded count subtracts the cache's
+    saved bytes so the e2e bytes/example and the link-ceiling
+    reconciliation stay honest. A trailing partial group (< T
+    minibatches) is skipped — it would compile a second scan shape
+    inside the timed window — and reported via ``skipped_examples``
+    after iteration ends. Exceptions on the uploader thread propagate
+    to the consuming iterator (the plumbing is :func:`iter_on_thread`;
+    this class only adds the staging generator and the accounting).
+    The cache is stateful and single-owner by contract — it lives on
+    THIS pipeline's one staging thread, satisfying the PR-3
+    stateless-or-feeder rule (UploadCache asserts it)."""
 
-    def __init__(self, parts_iter, T: int, queue_depth: int = 2):
+    def __init__(self, parts_iter, T: int, queue_depth: int = 2, cache=None):
         self.skipped_examples = 0
+        self._cache = cache
         self._it = iter_on_thread(
             self._stage(parts_iter, T), maxsize=queue_depth
         )
@@ -819,7 +845,12 @@ class UploadPipeline:
             # consumed item, and a beat would cancel a plain grace
             # mid-transfer
             with _transfer_op(nb):
-                staged = jax.device_put(sb)
+                if self._cache is not None:
+                    saved0 = self._cache.saved_bytes
+                    staged = self._cache(sb)
+                    nb = max(0, nb - (self._cache.saved_bytes - saved0))
+                else:
+                    staged = jax.device_put(sb)
             yield staged, int(sb.num_examples), nb
         self.skipped_examples = sum(int(p.num_examples) for p in parts)
 
@@ -1007,12 +1038,24 @@ def reconcile_link_ceiling(rec: dict, bytes_moved: int, done_ex: int,
 def stack_supersteps(parts, t: int):
     """Cycle ``parts`` to exactly ``t`` minibatches and stack them into
     one scan superbatch — every launch must reuse the ONE compiled
-    ('ell_bits_scan', (rows, t)) program; a mid-benchmark shape change
-    would put tens of seconds of XLA compile inside a timed window."""
+    scan program for its (wire, t) shape; a mid-benchmark shape change
+    would put tens of seconds of XLA compile inside a timed window.
+    Dispatches on the prepped wire type: ELL-bits batches (the legacy
+    headline wire) and compact-encoded exact batches (the default since
+    the wire flip — see run_synthetic's config note) stack into their
+    respective scan superbatches."""
     from parameter_server_tpu.apps.linear.async_sgd import stack_bits_batches
+    from parameter_server_tpu.learner.wire import (
+        EncodedExactBatch,
+        stack_encoded_batches,
+    )
 
     full = [parts[i % len(parts)] for i in range(t)]
-    return full[0] if t == 1 else stack_bits_batches(full)
+    if t == 1:
+        return full[0]
+    if isinstance(full[0], EncodedExactBatch):
+        return stack_encoded_batches(full)
+    return stack_bits_batches(full)
 
 
 def device_only_sweep(worker, prep_parts, base_t: int, minibatch: int,
@@ -1433,6 +1476,8 @@ def run_real(args) -> int:
     attach_host_ingest(headline, args.smoke)
     _beat("wire")
     attach_wire(headline, args.smoke)
+    _beat("serve")
+    attach_serve(headline, args.smoke)
     _beat("e2e", **headline)
 
     def host_prepped():
@@ -1534,6 +1579,25 @@ def main() -> int:
         default=8,
         help="minibatches scanned per device launch (ELLBitsSuperBatch); "
         "amortizes the tunnel round trip",
+    )
+    ap.add_argument(
+        "--wire-encode",
+        default="exact",
+        choices=("", "exact", "int8", "u16", "bf16"),
+        help="compact host→device wire for the headline e2e path "
+        "(learner/wire.py): DEFAULT 'exact' — sparse update + encoded "
+        "batches + the upload key cache, so the e2e stream stops "
+        "paying the raw 107.4 B/ex the BENCH_r05 breakdown showed "
+        "(ROADMAP item 1). '' restores the legacy bits-wire config; "
+        "quantized-pull runs (--pull-bytes) keep bits regardless "
+        "(sparse composes with unfiltered pulls only)",
+    )
+    ap.add_argument(
+        "--wire-cache-mb",
+        type=int,
+        default=64,
+        help="upload key-cache budget (MB of retained host copies) for "
+        "the encoded-wire e2e stream; 0 disables",
     )
     ap.add_argument(
         "--pull-bytes",
@@ -1709,15 +1773,30 @@ def run_synthetic(args) -> int:
     conf = Config()
     conf.penalty = PenaltyConfig(type="l1", lambda_=[1.0])
     conf.learning_rate = LearningRateConfig(type="decay", alpha=0.1, beta=1.0)
+    # THE WIRE FLIP (ROADMAP item 1): the headline e2e path rides the
+    # compact encoded wire by default — sparse update + wire_encode +
+    # the upload key cache — so the record's e2e bytes/example reflects
+    # the PR-5 codec instead of the raw 107.4 B/ex bits wire the
+    # breakdown kept quoting. Sparse mode is the exact-wire scan-fusion
+    # gate (ADVICE r5) and composes with UNFILTERED pulls only, so a
+    # quantized-pull run (--pull-bytes, the _qN metric) keeps the
+    # legacy bits-wire config — disclosed in the record either way.
+    encoded = bool(args.wire_encode) and not args.pull_bytes
     conf.async_sgd = SGDConfig(
         algo="ftrl",
         minibatch=args.minibatch,
         num_slots=args.num_slots,
-        max_delay=4,  # the reference criteo conf's bounded delay
+        # sparse ministeps run on the live state (staleness 0, within
+        # any delay bound); the bits path keeps the reference criteo
+        # conf's bounded delay
+        max_delay=0 if encoded else 4,
         ell_lanes=args.nnz_per_row,
-        # minimal wire: 22-bit slot stream + 1-bit labels, fused C++
-        # hash→pack — both bytes and host cycles are the bottleneck here
-        wire="bits",
+        # legacy minimal wire: 22-bit slot stream + 1-bit labels, fused
+        # C++ hash→pack (the --pull-bytes / --no-encoded-wire path)
+        wire="" if encoded else "bits",
+        update="sparse" if encoded else "auto",
+        wire_encode=args.wire_encode if encoded else "",
+        wire_cache_mb=args.wire_cache_mb if encoded else 0,
         pull_filter=(
             [{"type": "fixing_float", "num_bytes": args.pull_bytes}]
             if args.pull_bytes else []
@@ -1773,22 +1852,26 @@ def run_synthetic(args) -> int:
     flush(worker)
     # compile the delayed-step program too (see run_real's warmup note):
     # with T < max_delay the snapshot counter decides mid-stream which
-    # jitted variant runs, and the timed windows must never pay a compile
+    # jitted variant runs, and the timed windows must never pay a
+    # compile. The encoded-wire config needs no second warmup: max_delay
+    # is 0 there, so EVERY launch snapshots+donates — the one variant
+    # the warmup submits above already compiled.
     prep_parts = [
         worker.prep(raw[j % len(raw)], device_put=False) for j in range(T)
     ]
-    warm_host = stack_supersteps(prep_parts, T)
-    _grace_for_transfer(tree_host_nbytes(warm_host))
-    warm_sb = jax.device_put(warm_host)
-    del warm_host
-    step_fn = worker._get_step(warm_sb, False)
-    live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
-    pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
-    _grace_for_compile()  # delayed-path program compiles here
-    jax.block_until_ready(
-        step_fn(live_copy, pull_copy, warm_sb, np.uint32(0))[1]["num_ex"]
-    )
-    del live_copy, pull_copy, warm_sb
+    if not encoded:
+        warm_host = stack_supersteps(prep_parts, T)
+        _grace_for_transfer(tree_host_nbytes(warm_host))
+        warm_sb = jax.device_put(warm_host)
+        del warm_host
+        step_fn = worker._get_step(warm_sb, False)
+        live_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+        pull_copy = jax.tree.map(lambda x: x.copy(), worker.state)
+        _grace_for_compile()  # delayed-path program compiles here
+        jax.block_until_ready(
+            step_fn(live_copy, pull_copy, warm_sb, np.uint32(0))[1]["num_ex"]
+        )
+        del live_copy, pull_copy, warm_sb
 
     headline = headline_phase(
         worker, prep_parts,
@@ -1825,6 +1908,18 @@ def run_synthetic(args) -> int:
     attach_host_ingest(headline, args.smoke)
     _beat("wire")
     attach_wire(headline, args.smoke)
+    # serving-plane SLO bench rides along (open-loop p50/p99 + the
+    # admission/coalescing evidence, doc/SERVING.md)
+    _beat("serve")
+    attach_serve(headline, args.smoke)
+    # disclose which wire the e2e stream actually rode (the flip's
+    # whole point is that BENCH_r06 stops quoting the raw bits bytes)
+    headline["e2e_wire"] = {
+        "wire_encode": conf.async_sgd.wire_encode or conf.async_sgd.wire,
+        "update": conf.async_sgd.update,
+        "wire_cache_mb": conf.async_sgd.wire_cache_mb,
+        "max_delay": conf.async_sgd.max_delay,
+    }
     _beat("e2e", **headline)
 
     # The host→device tunnel's bandwidth drifts by several x over minutes
@@ -1842,6 +1937,16 @@ def run_synthetic(args) -> int:
         for i in range(n_launches * T):
             yield worker.prep(raw[i % len(raw)], device_put=False)
 
+    # upload key cache on the e2e stream (stateful → single-owner: it
+    # lives on the UploadPipeline's one staging thread). The synthetic
+    # stream CYCLES a fixed batch pool, so repeated key/column arrays
+    # re-use their device buffers — the cross-batch half of the wire
+    # win, with shipped bytes accounted net of cache hits
+    cache = None
+    if encoded and conf.async_sgd.wire_cache_mb > 0:
+        from parameter_server_tpu.learner.wire import UploadCache
+
+        cache = UploadCache(max_bytes=conf.async_sgd.wire_cache_mb << 20)
     rates = []
     done = 0
     wire_counter["bytes"] = 0  # count the TIMED phase only (not warmup)
@@ -1850,7 +1955,7 @@ def run_synthetic(args) -> int:
     win_done, win_t0 = 0, t0
     # uploader thread overlaps localize/pack + the tunnel wire with the
     # device steps the main thread is waiting on (see UploadPipeline)
-    for dev_sb, _n_ex, nb in UploadPipeline(host_parts(), T):
+    for dev_sb, _n_ex, nb in UploadPipeline(host_parts(), T, cache=cache):
         wire_counter["bytes"] += nb
         done += 1
         win_done += 1
@@ -1885,6 +1990,12 @@ def run_synthetic(args) -> int:
         "best": round(max(rates), 1) if rates else None,
     }
     rec.update(headline)
+    if cache is not None:
+        rec["e2e_upload_cache"] = {
+            "hits": cache.hits,
+            "misses": cache.misses,
+            "saved_mb": round(cache.saved_bytes / 1e6, 1),
+        }
     reconcile_link_ceiling(
         rec, wire_counter["bytes"], done * args.minibatch, dt
     )

@@ -1,0 +1,1 @@
+"""Serving app: the request-path CLI over the serving plane."""

@@ -238,6 +238,32 @@ class KVVector(Parameter):
             "push_pull", ch, len(slots), step, task, callback
         )
 
+    def snapshot(self, ch: int = 0, callback=None) -> int:
+        """Async donation-immune copy of the channel table; returns the
+        timestamp (result via ``executor.wait``/``pop_result``).
+
+        The copy runs as a SUBMITTED step, so it serializes with
+        in-flight donated pushes in timestamp order — unlike the
+        checkpoint path's drain-then-copy (``get_replica``), which is
+        only safe once the caller has stopped submitting. This is the
+        read-replica refresh primitive (serving/replica.py): training
+        keeps streaming donated pushes while the snapshot lands between
+        two of them, and the returned buffer is immune to every later
+        push."""
+        c = self.channel(ch)
+
+        def step():
+            return jnp.array(c.table, copy=True)
+
+        # plain submit, NOT instrumented_submit("pull", ...): a
+        # full-table copy counted as a num_slots-key pull would swamp
+        # ps_pull_keys_total and the pull latency histogram (the
+        # background refresher runs this every refresh_s), breaking the
+        # documented union_keys-vs-pull_keys dedup comparison. Refresh
+        # latency is observed at the call site instead
+        # (ps_serve_replica_refresh_seconds).
+        return self.submit(step, self.request(channel=ch), callback)
+
     def buffer(self, ch: int, ts: int) -> Optional[jax.Array]:
         """Staged pushes for a timestamp (ref KVVector::buffer)."""
         return self.channel(ch).buffers.get(ts)

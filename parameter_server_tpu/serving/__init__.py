@@ -1,0 +1,54 @@
+"""Serving plane: the request-path frontend over live parameter tables.
+
+The reference parameter server trains AND serves (OSDI'14 §5: "heavy
+traffic from millions of users"); PRs 1-5 built only the training half.
+This package is the read path: concurrent client sessions issuing
+sparse pulls / predictions against KVVector/KVMap tables and LM decode
+against the transformer stack, with the three production mechanisms a
+latency SLO needs —
+
+- **admission control** (:mod:`.admission`): token-bucket rate limiting
+  + queue-depth shedding with explicit 429-style rejection
+  (:class:`RejectedError`), so p99 stays bounded under overload instead
+  of collapsing into an unbounded queue.
+- **request coalescing** (:mod:`.coalescer`): concurrent pulls for
+  overlapping key ranges merge into ONE executor submit over the union
+  key set (dedup'd host-side, slot mapping served by the KeyDirectory
+  signature cache), inside a bounded coalesce window.
+- **read replicas** (:mod:`.replica`): snapshot-consistent read copies
+  refreshed OFF the push path (the donation-safe ``table(copy=True)``
+  contract from the zero-copy data plane), so serving reads never
+  contend with — and can never be invalidated by — training pushes.
+
+:mod:`.frontend` composes them into :class:`ServeFrontend`;
+:mod:`.loadgen` is the open-loop Poisson load generator + latency
+recorder behind ``make serve-bench`` and the ``serve`` section of every
+``bench.py`` record (p50/p99/p99.9 + goodput-vs-offered-load).
+"""
+
+from .admission import AdmissionController, RejectedError, TokenBucket
+from .coalescer import PullCoalescer
+from .frontend import (
+    DecodeRequest,
+    PredictRequest,
+    PullRequest,
+    ServeConfig,
+    ServeFrontend,
+)
+from .loadgen import LatencyStats, open_loop_bench
+from .replica import ReadReplica
+
+__all__ = [
+    "AdmissionController",
+    "DecodeRequest",
+    "LatencyStats",
+    "PredictRequest",
+    "PullCoalescer",
+    "PullRequest",
+    "ReadReplica",
+    "RejectedError",
+    "ServeConfig",
+    "ServeFrontend",
+    "TokenBucket",
+    "open_loop_bench",
+]

@@ -1,0 +1,504 @@
+"""ServeFrontend: the request-path composition.
+
+One object owns the serving door for a table (and optionally an LM):
+
+    client threads ──submit()──► admission gate ──► bounded work queue
+                                     │ RejectedError (429)   │
+                                     ▼                       ▼
+                               shed counters        worker pool (N)
+                                                     │        │
+                                       replica gather│        │decode worker
+                                     (hot hit, host) │        │(speculative)
+                                                     ▼        ▼
+                                            coalescer (misses/no-replica)
+                                                     │ one executor submit
+                                                     ▼ per window
+                                               live table pull
+
+Requests are typed (:class:`PullRequest` — raw rows;
+:class:`PredictRequest` — sparse logistic margins over pulled weights;
+:class:`DecodeRequest` — LM generation through a caller-supplied
+``decode_fn``, normally ``models.speculative.speculative_generate``).
+``submit`` is non-blocking: it either raises :class:`RejectedError`
+at the door or returns a :class:`Ticket` whose ``result()`` waits for
+a worker to complete the request. Latency is measured submit→complete
+— the number the open-loop bench quotes as p50/p99.
+
+Elasticity: :meth:`pause` gates the workers (admitted requests keep
+queueing; the admission depth gate sheds past the bound — never an
+error), :meth:`quiesce` waits out in-flight executions, and
+:meth:`rebind` points the frontend at the post-resize store. Together
+they make the ~52ms elastic stop-the-world invisible to clients except
+as a latency bump (tests/test_serving.py pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+from .admission import AdmissionController, RejectedError  # noqa: F401  (re-export: the door's exception belongs to the frontend API)
+from .coalescer import PullCoalescer
+from .replica import ReadReplica
+
+
+@dataclasses.dataclass
+class PullRequest:
+    """Raw rows for ``keys`` (global int64 key ids)."""
+
+    keys: np.ndarray
+    channel: int = 0
+
+
+@dataclasses.dataclass
+class PredictRequest:
+    """Sparse logistic scores: CSR rows over global keys; the response
+    is ``sigmoid(sum_j w[key_ij])`` per row (the binary-feature CTR
+    predict of the reference's linear apps)."""
+
+    indices: np.ndarray  # [nnz] global keys
+    indptr: np.ndarray  # [rows + 1]
+    channel: int = 0
+
+
+@dataclasses.dataclass
+class DecodeRequest:
+    """LM generation; executed by the frontend's ``decode_fn`` on the
+    dedicated decode worker (heavy requests must not head-of-line-block
+    the microsecond pull lane)."""
+
+    prompt: np.ndarray  # [B, P] int32
+    steps: int
+    prompt_lengths: Optional[np.ndarray] = None
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    # admission (0 disables a gate)
+    admission_rate: float = 0.0  # requests/s sustained
+    admission_burst: float = 32.0
+    max_queue_depth: int = 1024
+    # coalescing
+    coalesce_window_s: float = 0.002
+    coalesce_max_keys: int = 1 << 16
+    coalesce_max_requests: int = 256
+    # read replica: "off" (all pulls coalesce to the live table),
+    # "full" (whole-table snapshot), or "hot" with hot_keys set
+    replica: str = "full"
+    hot_keys: Optional[np.ndarray] = None
+    replica_refresh_s: Optional[float] = None  # None = manual refresh()
+    # worker pool (pull/predict lane) — decode gets its own worker
+    workers: int = 2
+
+
+class Ticket:
+    """One admitted request's completion handle."""
+
+    __slots__ = ("_done", "value", "error", "t_submit", "t_done", "kind")
+
+    def __init__(self, kind: str):
+        self._done = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_done = 0.0
+        self.kind = kind
+
+    def _complete(self, value=None, error=None) -> None:
+        self.value = value
+        self.error = error
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+    def latency_s(self) -> float:
+        return self.t_done - self.t_submit
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"{self.kind} request did not complete")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class ServeFrontend:
+    """Concurrent serving sessions against one store channel (+ LM).
+
+    ``store`` follows the KVVector protocol; ``decode_fn(req) -> array``
+    (if given) enables :class:`DecodeRequest`. ``start()`` spins the
+    worker pool; ``close()`` drains and joins every thread the frontend
+    started.
+    """
+
+    def __init__(
+        self,
+        store,
+        config: Optional[ServeConfig] = None,
+        channel: int = 0,
+        decode_fn: Optional[Callable[[DecodeRequest], np.ndarray]] = None,
+    ):
+        self.cfg = config or ServeConfig()
+        self.store = store
+        self.channel = int(channel)
+        self.decode_fn = decode_fn
+        self._cv = threading.Condition()
+        self._queue: deque = deque()  # guarded-by: _cv — pull/predict lane
+        self._decode_queue: deque = deque()  # guarded-by: _cv
+        # per-LANE in-flight counts (admitted, not completed): each
+        # lane carries its own max_queue_depth bound (submit()) — a
+        # decode backlog shedding microsecond pulls, or pull overload
+        # starving decodes, would reintroduce exactly the head-of-line
+        # coupling the dedicated decode worker removes
+        self._in_flight = 0  # guarded-by: _cv — pull/predict lane
+        self._in_flight_decode = 0  # guarded-by: _cv — decode lane
+        self._executing = 0  # guarded-by: _cv — popped, running right now
+        self._paused = False  # guarded-by: _cv — elastic stop-the-world
+        self._closed = False  # guarded-by: _cv
+        self._threads: list = []
+        self._refresher: Optional[threading.Thread] = None
+        self._stop_refresh = threading.Event()
+        self.completed = 0  # guarded-by: _cv
+        # rate gate only: the depth bounds are PER-LANE and owned by
+        # submit() (check+reserve in one critical section), not by the
+        # controller's shared depth_fn hook — one shared count would
+        # couple the lanes, and a depth_fn read outside the enqueue
+        # lock would let concurrent submits overshoot the bound
+        self.admission = AdmissionController(
+            rate=self.cfg.admission_rate,
+            burst=self.cfg.admission_burst,
+        )
+        # replica config is validated (and its first refresh runs)
+        # BEFORE the coalescer exists: PullCoalescer starts its flusher
+        # thread in its constructor, so raising after building it would
+        # leak a live thread with no close() to ever reach it
+        self.replica: Optional[ReadReplica] = None
+        if self.cfg.replica == "hot":
+            if self.cfg.hot_keys is None:
+                raise ValueError("replica='hot' needs ServeConfig.hot_keys")
+            self.replica = ReadReplica(
+                store, channel, hot_keys=self.cfg.hot_keys
+            )
+        elif self.cfg.replica == "full":
+            self.replica = ReadReplica(store, channel)
+        elif self.cfg.replica != "off":
+            raise ValueError(
+                f"ServeConfig.replica must be 'off'|'full'|'hot', "
+                f"got {self.cfg.replica!r}"
+            )
+        self.coalescer = PullCoalescer(
+            store,
+            channel=channel,
+            window_s=self.cfg.coalesce_window_s,
+            max_keys=self.cfg.coalesce_max_keys,
+            max_requests=self.cfg.coalesce_max_requests,
+        )
+        from ..telemetry.instruments import cached_serve_instruments
+
+        self._tel = cached_serve_instruments
+
+    # -- lifecycle --
+
+    def start(self) -> "ServeFrontend":
+        if self._threads:
+            return self
+        for i in range(max(1, self.cfg.workers)):
+            t = threading.Thread(
+                target=self._worker_loop, args=(self._queue,),
+                name=f"serve-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        if self.decode_fn is not None:
+            t = threading.Thread(
+                target=self._worker_loop, args=(self._decode_queue,),
+                name="serve-decode", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        if self.cfg.replica_refresh_s and self.replica is not None:
+            self._refresher = threading.Thread(
+                target=self._refresh_loop, name="serve-replica-refresh",
+                daemon=True,
+            )
+            self._refresher.start()
+        return self
+
+    def close(self) -> None:
+        """Drain queued work (closing un-pauses), then join every
+        thread the frontend started."""
+        with self._cv:
+            self._closed = True
+            self._paused = False  # workers must drain, not strand
+            self._cv.notify_all()
+        self._stop_refresh.set()
+        for t in self._threads:
+            t.join(timeout=60)
+        self._threads = []
+        if self._refresher is not None:
+            self._refresher.join(timeout=60)
+            self._refresher = None
+        self.coalescer.close()
+
+    # -- elasticity (system/elastic.py integration) --
+
+    def pause(self) -> None:
+        """Gate the workers: admitted requests queue (and shed past the
+        admission depth bound) instead of touching a store whose mesh
+        is being rebuilt. In-flight executions finish against the old
+        store — :meth:`quiesce` waits them out."""
+        with self._cv:
+            self._paused = True
+
+    def quiesce(self, timeout: float = 30.0) -> None:
+        """Block until no worker is mid-execution (call after
+        :meth:`pause`, before tearing down the old store)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._executing > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("serve workers did not quiesce")
+                self._cv.wait(left)
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def rebind(self, store, refresh_replica: bool = True) -> None:
+        """Point the frontend at the post-resize store (the elastic
+        coordinator rebuilds the worker and its tables; key→slot
+        hashing is stable across resizes, so requests queued across the
+        pause stay valid). Call between :meth:`pause`/:meth:`quiesce`
+        and :meth:`resume`."""
+        old = self.coalescer
+        self.store = store
+        self.coalescer = PullCoalescer(
+            store,
+            channel=self.channel,
+            window_s=self.cfg.coalesce_window_s,
+            max_keys=self.cfg.coalesce_max_keys,
+            max_requests=self.cfg.coalesce_max_requests,
+        )
+        old.close()
+        if self.replica is not None:
+            self.replica.store = store
+            if refresh_replica:
+                self.replica.refresh()
+
+    # -- the door --
+
+    def depth(self) -> int:
+        """The PULL/PREDICT lane's backlog: admitted, uncompleted
+        requests (queued + executing). The decode lane is bounded
+        separately (same-sized, in :meth:`submit`) — one shared count
+        would let a slow-decode pileup shed the microsecond pull
+        traffic at the door."""
+        with self._cv:
+            return self._in_flight
+
+    def _queue_retry_s(self, depth: int) -> float:
+        # the backlog drains at ~the admitted rate; tell the client to
+        # come back after its share of it (the admission controller's
+        # heuristic, applied per lane)
+        bucket = self.admission.bucket
+        return min(depth / bucket.rate, 5.0) if bucket is not None else 0.05
+
+    def submit(self, req) -> Ticket:
+        """Admit and enqueue one request; raises
+        :class:`~.admission.RejectedError` (the 429) at the door."""
+        if isinstance(req, DecodeRequest) and self.decode_fn is None:
+            raise ValueError("this frontend has no decode_fn")
+        if getattr(req, "channel", self.channel) != self.channel:
+            # one frontend serves ONE channel (its replica and
+            # coalescer are bound to it); silently answering another
+            # channel's request with this channel's rows would be a
+            # wrong-data bug, so reject loudly — stand up a frontend
+            # per served channel instead
+            raise ValueError(
+                f"this frontend serves channel {self.channel}, got a "
+                f"request for channel {req.channel}"
+            )
+        decode = isinstance(req, DecodeRequest)
+        with self._cv:
+            # closed-check BEFORE the admission gate: a submit racing
+            # close() must not burn tokens (or count as admitted) for a
+            # request that can never enqueue
+            if self._closed:
+                raise RuntimeError("ServeFrontend is closed")
+            # per-LANE depth gate, check AND reserve in this ONE
+            # critical section: each lane takes the same-sized bound
+            # against its own backlog (a shared count would let a
+            # decode pileup shed microsecond pulls — and vice versa),
+            # and checking in one section then reserving in another
+            # would let concurrent submits overshoot the bound by the
+            # submitter count. The reservation is released below on any
+            # rejection between here and enqueue.
+            lane = self._in_flight_decode if decode else self._in_flight
+            if 0 < self.cfg.max_queue_depth <= lane:
+                tel = self._tel()
+                if tel is not None:
+                    tel["shed"].labels(reason="queue").inc()
+                raise RejectedError("queue", self._queue_retry_s(lane))
+            if decode:
+                self._in_flight_decode += 1
+            else:
+                self._in_flight += 1
+        try:
+            self.admission.admit()  # rate gate (depth owned above)
+        except BaseException:
+            with self._cv:
+                if decode:
+                    self._in_flight_decode -= 1
+                else:
+                    self._in_flight -= 1
+            raise
+        kind = (
+            "pull" if isinstance(req, PullRequest)
+            else "predict" if isinstance(req, PredictRequest)
+            else "decode"
+        )
+        ticket = Ticket(kind)
+        tel = self._tel()
+        with self._cv:
+            if self._closed:  # closed during admit: nothing enqueued
+                if decode:
+                    self._in_flight_decode -= 1
+                else:
+                    self._in_flight -= 1
+                raise RuntimeError("ServeFrontend is closed")
+            if decode:
+                self._decode_queue.append((req, ticket))
+            else:
+                self._queue.append((req, ticket))
+            depth = self._in_flight + self._in_flight_decode
+            self._cv.notify_all()
+        # counted only once the request is really ENQUEUED, so
+        # requests_total reconciles with tickets issued
+        if tel is not None:
+            tel["requests"].labels(kind=kind).inc()
+            tel["queue_depth"].set(depth)
+        return ticket
+
+    # -- workers --
+
+    def _worker_loop(self, queue: deque) -> None:
+        decode_lane = queue is self._decode_queue
+        while True:
+            with self._cv:
+                while (not queue or self._paused) and not self._closed:
+                    self._cv.wait()
+                if not queue:  # closed and drained
+                    return
+                req, ticket = queue.popleft()
+                self._executing += 1
+            try:
+                value = self._execute(req)
+                err = None
+            except BaseException as e:
+                value, err = None, e
+            ticket._complete(value, err)
+            with self._cv:
+                self._executing -= 1
+                if decode_lane:
+                    self._in_flight_decode -= 1
+                else:
+                    self._in_flight -= 1
+                self.completed += 1
+                self._cv.notify_all()
+            tel = self._tel()
+            if tel is not None:
+                tel["latency"].labels(kind=ticket.kind).observe(
+                    ticket.latency_s()
+                )
+
+    def _pull_values(self, keys: np.ndarray) -> np.ndarray:
+        """The read path: replica first, coalesced live pull for misses
+        (requests for other channels never get here — submit rejects
+        them at the door)."""
+        if self.replica is not None:
+            vals, hit = self.replica.pull(keys)
+            if hit.all():
+                return vals
+            missed = np.asarray(keys)[~hit]
+            miss_vals = self.coalescer.pull(missed).result()
+            out = np.array(vals)
+            out[~hit] = miss_vals
+            return out
+        return self.coalescer.pull(keys).result()
+
+    def _execute(self, req):
+        if isinstance(req, PullRequest):
+            return self._pull_values(req.keys)
+        if isinstance(req, PredictRequest):
+            w = self._pull_values(req.indices)
+            seg = np.repeat(
+                np.arange(len(req.indptr) - 1), np.diff(req.indptr)
+            )
+            margins = np.zeros(len(req.indptr) - 1, np.float64)
+            np.add.at(margins, seg, w.sum(axis=1))
+            return 1.0 / (1.0 + np.exp(-margins))
+        if isinstance(req, DecodeRequest):
+            out = np.asarray(self.decode_fn(req))
+            tel = self._tel()
+            if tel is not None:
+                tel["decode_tokens"].inc(out.shape[0] * req.steps)
+            return out
+        raise TypeError(f"unknown request type {type(req).__name__}")
+
+    # -- replica refresher --
+
+    def _refresh_loop(self) -> None:
+        while not self._stop_refresh.wait(self.cfg.replica_refresh_s):
+            # the paused check and the _executing claim are ONE critical
+            # section: quiesce() waits on _executing, so an in-flight
+            # refresh holds the pause→resize sequence back exactly like
+            # a worker mid-request does — without this, pause() could
+            # pass quiesce() while refresh() is still touching a store
+            # the resize is about to tear down
+            with self._cv:
+                if self._paused:
+                    continue
+                self._executing += 1
+            try:
+                self.replica.refresh()
+            except Exception:
+                # one transient refresh failure must not silently kill
+                # the refresher for the rest of the process — the
+                # frontend would keep serving an ever-staler snapshot
+                # with no signal. Log and retry next tick; persistent
+                # failure shows up as a growing replica age_s.
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "read-replica refresh failed; retrying next tick"
+                )
+            finally:
+                with self._cv:
+                    self._executing -= 1
+                    self._cv.notify_all()
+
+    # -- introspection (the serve bench's record fields) --
+
+    def stats(self) -> dict:
+        with self._cv:
+            completed = self.completed
+            in_flight = self._in_flight + self._in_flight_decode
+        out = {
+            "completed": completed,
+            "in_flight": in_flight,
+            "coalescer": self.coalescer.stats(),
+        }
+        if self.replica is not None:
+            out["replica"] = {
+                "version": self.replica.version,
+                "age_s": round(self.replica.age_s(), 3),
+                "nbytes": self.replica.nbytes(),
+            }
+        return out

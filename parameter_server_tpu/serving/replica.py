@@ -1,0 +1,154 @@
+"""Read replicas: snapshot-consistent read copies refreshed off the push path.
+
+The zero-copy data plane (PR 2) made training pushes donate the live
+table in place — so a serving read against the live table must either
+go through the store's executor (contending with training submits) or
+risk jax's read-after-donate error. The read replica breaks the tie the
+way the reference's replica protocol does (``parameter/replica.py``,
+ref SetReplica/GetReplica): a PRIVATE copy of the table serves all
+reads; training pushes keep donating the live table without ever
+touching the replica's buffer.
+
+Race-freedom is by construction, not by quiescing: the refresh rides
+the store's own executor (``KVVector.snapshot`` — a submitted copy
+step, or a plain ``pull`` for the hot-key subset), so it serializes
+with in-flight donated pushes in timestamp order. Pull results never
+alias the table and the snapshot step copies before returning, so the
+replica's buffer is immune to every later donation — stronger than the
+checkpoint path's drain-then-copy, which assumes the caller quiesced.
+
+Consistency model: **snapshot** — every read between two ``refresh()``
+calls sees one table version (``version`` counts refreshes, ``age_s()``
+reports staleness). The refresh is the ONLY contention point with
+training; schedule it off the request path (the frontend's background
+refresher does).
+
+``hot_keys`` mode: instead of snapshotting the whole ``[P, k]`` table,
+the replica pulls just the hot rows into a compact ``[H, k]`` copy —
+the serving working set of a power-law key distribution is orders of
+magnitude smaller than the training table, so refresh stays O(hot)
+instead of O(table). Keys outside the hot set report a miss and the
+frontend falls through to the coalesced live-pull path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class ReadReplica:
+    """Snapshot read copy of one store channel, served from host memory.
+
+    ``store`` follows the KVVector protocol (``pull``/``wait_pull``/
+    ``request``; ``snapshot(ch)`` when available, else
+    ``table(ch, copy=True)`` after an executor drain). Reads
+    (:meth:`pull`) snapshot the (table, directory) pair under a small
+    lock and gather with numpy outside it — a concurrent refresh swaps
+    the pair atomically but never mutates a published array.
+    """
+
+    def __init__(
+        self,
+        store,
+        channel: int = 0,
+        hot_keys: Optional[np.ndarray] = None,
+    ):
+        self.store = store
+        self.channel = int(channel)
+        self.hot_keys = (
+            None
+            if hot_keys is None
+            else np.unique(np.asarray(hot_keys, dtype=np.int64))
+        )
+        self._lock = threading.Lock()
+        self._table: Optional[np.ndarray] = None  # guarded-by: _lock
+        self.version = 0  # guarded-by: _lock
+        self._refreshed_at = 0.0  # guarded-by: _lock
+        from ..telemetry.instruments import cached_serve_instruments
+
+        self._tel = cached_serve_instruments
+        self.refresh()
+
+    def _directory(self):
+        """The channel's KeyDirectory (KVVector keeps one per channel,
+        KVMap one per store)."""
+        if hasattr(self.store, "channel"):
+            return self.store.channel(self.channel).directory
+        return self.store.directory
+
+    # -- refresh (the ONLY path that touches the live store) --
+
+    def refresh(self) -> int:
+        """Take a fresh snapshot; returns the new version.
+
+        Hot-key replicas refresh via a plain ``pull`` (results never
+        alias the live table); full replicas via the store's submitted
+        ``snapshot`` copy step — both serialize through the executor
+        with training pushes, so there is no drain-and-hope window."""
+        t0 = time.perf_counter()
+        if self.hot_keys is not None:
+            ts = self.store.pull(
+                self.store.request(channel=self.channel), keys=self.hot_keys
+            )
+            host = np.asarray(self.store.wait_pull(ts))
+        elif hasattr(self.store, "snapshot"):
+            host = np.asarray(
+                self.store.executor.wait(self.store.snapshot(self.channel))
+            )
+        else:  # stores without a snapshot step: checkpoint-path contract
+            self.store.executor.wait_all(pop=False)
+            host = np.asarray(self.store.table(self.channel, copy=True))
+        with self._lock:
+            self._table = host
+            self.version += 1
+            self._refreshed_at = time.monotonic()
+            version = self.version
+        tel = self._tel()
+        if tel is not None:
+            tel["replica_refresh"].observe(time.perf_counter() - t0)
+        return version
+
+    def age_s(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._refreshed_at
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return 0 if self._table is None else self._table.nbytes
+
+    # -- the read path (no store executor, no live-table reads) --
+
+    def pull(self, keys: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+        """Rows for ``keys`` from the snapshot: ``(values [n, k],
+        hit_mask [n])``. Full-table replicas always hit (keys the
+        directory doesn't know read 0, the device range-mask contract);
+        hot-key replicas report misses so the caller can fall through
+        to a live pull."""
+        keys = np.asarray(keys, dtype=np.int64).ravel()
+        with self._lock:
+            table = self._table
+        tel = self._tel()
+        if self.hot_keys is None:
+            slots = self._directory().slots(keys)
+            miss = slots >= table.shape[0]
+            vals = table[np.minimum(slots, table.shape[0] - 1)]
+            if miss.any():
+                vals = np.where(miss[:, None], 0, vals)
+            if tel is not None:
+                tel["replica_hits"].inc(len(keys))
+            return vals, np.ones(len(keys), dtype=bool)
+        pos = np.searchsorted(self.hot_keys, keys)
+        posc = np.minimum(pos, len(self.hot_keys) - 1)
+        hit = (pos < len(self.hot_keys)) & (self.hot_keys[posc] == keys)
+        vals = np.zeros((len(keys), table.shape[1]), table.dtype)
+        if hit.any():
+            vals[hit] = table[posc[hit]]
+        if tel is not None:
+            n_hit = int(hit.sum())
+            tel["replica_hits"].inc(n_hit)
+            tel["replica_misses"].inc(len(keys) - n_hit)
+        return vals, hit

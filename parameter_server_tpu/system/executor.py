@@ -200,6 +200,15 @@ class Executor:
         with self._cv:
             return self._time
 
+    def pending_count(self) -> int:
+        """Submitted steps not yet picked by the dispatch thread — an
+        O(1) backlog read an admission controller can gate on per
+        request (serving/admission.py ``depth_fn``; the composed
+        frontend gates on its own in-flight count instead, but a bare
+        store serving direct pulls has only this signal)."""
+        with self._cv:
+            return len(self._pending)
+
     # -- submission (ref Customer::Submit) --
 
     def submit(

@@ -245,6 +245,76 @@ def wire_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def serve_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Serving plane (serving/ — the request-path frontend): request
+    volume + completion latency per kind, shed accounting by reason,
+    the coalescer's merge economics, and read-replica traffic. The SLO
+    view is ``ps_serve_latency_seconds`` p99 against
+    ``ps_serve_shed_total`` — bounded tails are BOUGHT with explicit
+    sheds (doc/SERVING.md, "Admission control")."""
+    return {
+        "requests": reg.ensure_counter(
+            "ps_serve_requests_total",
+            "requests admitted through the serving door, by kind "
+            "(pull/predict/decode)",
+            labelnames=("kind",),
+        ),
+        "shed": reg.ensure_counter(
+            "ps_serve_shed_total",
+            "requests rejected at admission (429-style), by reason: "
+            "rate (token bucket empty) or queue (backlog past the "
+            "depth bound)",
+            labelnames=("reason",),
+        ),
+        "latency": reg.ensure_histogram(
+            "ps_serve_latency_seconds",
+            "request latency submit to completion, by kind — the "
+            "serving SLO number (open-loop p50/p99 in bench records)",
+            labelnames=("kind",),
+            buckets=PHASE_BUCKETS,
+        ),
+        "queue_depth": reg.ensure_gauge(
+            "ps_serve_queue_depth",
+            "admitted, uncompleted requests (queued + executing), "
+            "sampled at each admission",
+        ),
+        "coalesce_submits": reg.ensure_counter(
+            "ps_serve_coalesce_submits_total",
+            "merged pull windows flushed as ONE executor submit",
+        ),
+        "coalesce_merged_requests": reg.ensure_counter(
+            "ps_serve_coalesce_merged_requests_total",
+            "client pull requests carried by coalesced submits "
+            "(merged/submits = the merge factor)",
+        ),
+        "coalesce_union_keys": reg.ensure_counter(
+            "ps_serve_coalesce_union_keys_total",
+            "deduped union keys actually pulled by coalesced submits "
+            "(compare ps_pull_keys_total for the key dedup win)",
+        ),
+        "replica_hits": reg.ensure_counter(
+            "ps_serve_replica_hits_total",
+            "keys served from the read replica (no live-table touch)",
+        ),
+        "replica_misses": reg.ensure_counter(
+            "ps_serve_replica_misses_total",
+            "keys outside the hot-key replica, fallen through to a "
+            "coalesced live pull",
+        ),
+        "replica_refresh": reg.ensure_histogram(
+            "ps_serve_replica_refresh_seconds",
+            "read-replica refresh wall time (the one serialization "
+            "point with training pushes — off the request path)",
+            buckets=PHASE_BUCKETS,
+        ),
+        "decode_tokens": reg.ensure_counter(
+            "ps_serve_decode_tokens_total",
+            "tokens generated by served decode requests "
+            "(rows x steps, host-side count)",
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -280,46 +350,44 @@ def heartbeat_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
-# (registry, instruments) pair shared by every kv_ops/KeyDirectory call
-# site — re-ensured when tests swap the default registry
-# (Postoffice.reset); None while telemetry is disabled
-_KVOPS_CACHE = (None, None)
+def _cached_family(family_fn):
+    """Process-default accessor for one instrument family: returns a
+    zero-arg callable yielding the family's instruments against the
+    CURRENT default registry, or None while telemetry is disabled.
+    The (registry, instruments) pair is cached per accessor and
+    re-ensured only when tests swap the default registry
+    (Postoffice.reset) — the call sites are hot paths (kv_ops pushes,
+    per-request admission/coalescer stages, per-batch wire encodes)
+    that must not re-ensure the family per call."""
+    cache = (None, None)
+
+    def accessor():
+        nonlocal cache
+        from . import registry as telemetry_registry
+
+        if not telemetry_registry.enabled():
+            return None
+        reg = telemetry_registry.default_registry()
+        if cache[0] is not reg:
+            cache = (reg, family_fn(reg))
+        return cache[1]
+
+    accessor.__name__ = f"cached_{family_fn.__name__}"
+    accessor.__qualname__ = accessor.__name__
+    accessor.__doc__ = (
+        f"Process-default {family_fn.__name__} (hot-path cache), or "
+        "None when telemetry is off."
+    )
+    return accessor
 
 
-def cached_kvops_instruments():
-    """Process-default kvops instruments, or None when telemetry is
-    off. The ONE cache for the data-plane hot paths (kv_ops pushes,
-    KVMap/KVLayer steps, KeyDirectory slot cache)."""
-    from . import registry as telemetry_registry
-
-    if not telemetry_registry.enabled():
-        return None
-    reg = telemetry_registry.default_registry()
-    global _KVOPS_CACHE
-    if _KVOPS_CACHE[0] is not reg:
-        _KVOPS_CACHE = (reg, kvops_instruments(reg))
-    return _KVOPS_CACHE[1]
-
-
-# (registry, instruments) pair shared by every wire encode/cache call
-# site — the encode runs once per batch on every prep-pool worker, so
-# it must not re-ensure the family per call (same hot-path shape as
-# cached_kvops_instruments); None while telemetry is disabled
-_WIRE_CACHE = (None, None)
-
-
-def cached_wire_instruments():
-    """Process-default wire instruments, or None when telemetry is off.
-    The ONE cache for the wire hot paths (encode_exact, UploadCache)."""
-    from . import registry as telemetry_registry
-
-    if not telemetry_registry.enabled():
-        return None
-    reg = telemetry_registry.default_registry()
-    global _WIRE_CACHE
-    if _WIRE_CACHE[0] is not reg:
-        _WIRE_CACHE = (reg, wire_instruments(reg))
-    return _WIRE_CACHE[1]
+# the one cache per hot-path family: data plane (kv_ops pushes,
+# KVMap/KVLayer steps, KeyDirectory slot cache), request path
+# (admission, coalescer, replica, frontend workers), and wire
+# (encode_exact, UploadCache)
+cached_kvops_instruments = _cached_family(kvops_instruments)
+cached_serve_instruments = _cached_family(serve_instruments)
+cached_wire_instruments = _cached_family(wire_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -329,6 +397,7 @@ INSTRUMENT_FAMILIES = (
     kvops_instruments,
     ingest_instruments,
     wire_instruments,
+    serve_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -306,7 +306,17 @@ def test_reused_timestamp_after_cancel_respects_fresh_deps():
     import time
 
     ex = Executor("reuse")
-    ex.submit(lambda: None, task=Task(time=7))  # ready, never dispatched?
+    # ts 7 must be cancelled BEFORE dispatch, or its reincarnation is
+    # (correctly) rejected as "already used" — which used to flake this
+    # test ~40% of runs: the dispatch thread raced the stop() and ran
+    # the instant lambda first. Pin the dispatch thread inside an
+    # earlier step for the whole cancel window instead.
+    hold = threading.Event()
+    running = threading.Event()
+    ex.submit(lambda: (running.set(), hold.wait(10)), task=Task(time=3))
+    running.wait(10)  # dispatch thread is now INSIDE step 3
+    ex.submit(lambda: None, task=Task(time=7))  # ready, never dispatched
+    threading.Timer(0.05, hold.set).start()  # unblocks stop()'s join
     ex.stop(cancel_pending=True)
     # reincarnate ts 7, now blocked on a slow dep 6
     gate = threading.Event()

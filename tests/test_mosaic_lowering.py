@@ -19,6 +19,10 @@ from parameter_server_tpu.ops.quantize import quantize
 
 
 def lower_tpu(fn, *args):
+    # jax 0.4.x only materializes jax.export on explicit submodule
+    # import (same shim as test_ops)
+    import jax.export  # noqa: F401
+
     jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
 
 
@@ -50,16 +54,47 @@ Z = jnp.zeros
         ((4, 1024, 64), jnp.float32, dict(causal=True, window=256)),
         ((2, 96, 40), jnp.float32, dict(causal=True)),  # sub-block, odd D
         ((1, 384, 128), jnp.float32, dict(causal=True)),  # S % block != 0
+        # sub-SUBLANE decode shapes (the BENCH_ONCHIP small-shape
+        # block-spec crash class): a speculative gamma+1 verify chunk
+        # and a single-row serving query — block specs must stay
+        # (8, 128)-tileable even when S < 8
+        ((4, 5, 64), jnp.float32, dict(causal=True)),  # spec verify chunk
+        ((4, 1, 64), jnp.float32, dict(causal=False)),  # 1-row query
+
         # the 512x512 default blocking with a wide head dim: the largest
         # VMEM tile shape the model paths can request
         ((2, 1024, 128), jnp.bfloat16, dict(causal=True)),
     ],
-    ids=["causal", "full", "bf16", "window", "small", "s384", "d128"],
+    ids=["causal", "full", "bf16", "window", "small", "s384",
+         "spec_chunk", "one_row", "d128"],
 )
 def test_flash_fwd_and_bwd_lower(shape, dtype, kw):
     q = Z(shape, dtype)
     lower_tpu(_fa(**kw), q, q, q)
     lower_tpu(_fa_grad(**kw), q, q, q)
+
+
+def test_flash_short_query_long_keys_lowers():
+    """The serving decode shape: a sub-sublane query block against a
+    long key axis (speculative verify reads the whole cache with a
+    gamma+1-row chunk). Fwd and bwd must lower with sq < 8 < sk."""
+    q = Z((4, 5, 64), jnp.float32)
+    k = Z((4, 1024, 64), jnp.float32)
+
+    def fn(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, q_offset=1019, use_pallas=True,
+            interpret=False, with_lse=True,
+        )
+
+    lower_tpu(fn, q, k, k)
+
+    def g(q, k, v):
+        return jax.grad(
+            lambda *a: fn(*a)[0].astype(jnp.float32).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    lower_tpu(g, q, k, k)
 
 
 def test_flash_traced_offsets_lower():
