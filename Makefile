@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench trace bench-diff clean
 
 all: native
 
@@ -76,6 +76,23 @@ wire-bench: native
 # every bench.py record under "serve")
 serve-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks serve
+
+# capture a short synthetic run's flow-correlated timeline and export
+# it as Chrome trace / Perfetto JSON (open at https://ui.perfetto.dev;
+# doc/OBSERVABILITY.md "Reading a timeline"). Override the output with
+# PS_TRACE_OUT=/path.json; the raw JSONL span stream lands next to it
+trace:
+	env JAX_PLATFORMS=cpu PS_TRACE_OUT=$${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} \
+		python -m parameter_server_tpu.benchmarks trace
+	@echo "timeline: $${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} (open at https://ui.perfetto.dev)"
+
+# bench regression sentinel: compare the newest valid BENCH_r*.json
+# against the prior trajectory (median-of-priors baseline, tolerance
+# band from the trajectory's own spread — ROADMAP bench discipline);
+# exit 1 on an out-of-band throughput regression (tier-1 tested
+# against fixture records in tests/data/bench_diff/)
+bench-diff:
+	python script/bench_diff.py
 
 clean:
 	$(MAKE) -C parameter_server_tpu/cpp clean

@@ -43,6 +43,7 @@ import traceback
 
 import numpy as np
 
+from parameter_server_tpu.telemetry import spans as telemetry_spans
 from parameter_server_tpu.utils.concurrent import iter_on_thread
 
 REF_8NODE_EXAMPLES_PER_SEC = 500_000.0
@@ -334,6 +335,83 @@ def _transfer_op(nbytes: int):
         yield
 
 
+def ensure_trace_sink() -> "str | None":
+    """Install a JSONL span sink for the run's timeline when none is
+    installed yet (telemetry/timeline.py); returns the trace path, or
+    None when an externally installed non-file sink owns the stream.
+
+    MUST run after Postoffice.reset() (reset closes the sink). The
+    timeline is the raw material of the record's ``attribution``
+    section — every stage span (prep/stack/upload on their threads,
+    executor step phases) lands here, flow-correlated per superbatch.
+    """
+    import tempfile
+
+    sink = telemetry_spans.get_sink()
+    if sink is not None:
+        return getattr(sink, "path", None)
+    path = os.path.join(
+        tempfile.gettempdir(), f"ps_bench_trace_{os.getpid()}.jsonl"
+    )
+    with contextlib.suppress(OSError):
+        os.remove(path)  # fresh capture: never mix runs
+    telemetry_spans.install_sink(telemetry_spans.JsonlSink(path))
+    return path
+
+
+def attach_attribution(
+    rec_or_headline: dict,
+    trace_path: "str | None",
+    e2e_window: "tuple[float, float] | None" = None,
+) -> None:
+    """Embed the critical-path attribution section derived from the
+    run's span timeline (telemetry/attribution.py) — the trace-derived
+    replacement for the hand-computed upload-bound arithmetic of the
+    BENCH_r05 era. Never breaks a record.
+
+    Top-level shares/binding come from the SERIALIZED breakdown-phase
+    spans (phase="breakdown": the same launches the legacy
+    ``breakdown_*`` fields price, so the two must agree — the
+    ``agrees_with_hand_breakdown`` cross-check says so explicitly);
+    ``e2e`` holds the pipelined phase's resource utilizations and
+    queue-wait over its wall window, where overlap and queueing are
+    visible. ``trace_jsonl`` points at the raw timeline; export it with
+    ``python -m parameter_server_tpu.benchmarks trace`` or
+    ``telemetry.timeline.export_chrome_trace`` and open in Perfetto.
+    """
+    if trace_path is None:
+        return
+    try:
+        from parameter_server_tpu.telemetry import attribution as attr_mod
+        from parameter_server_tpu.telemetry import timeline as timeline_mod
+
+        events = timeline_mod.load_events(trace_path)
+        section: dict = {"trace_jsonl": trace_path}
+        breakdown = [e for e in events if e.get("phase") == "breakdown"]
+        if breakdown:
+            summary = attr_mod.summarize(breakdown)
+            section.update(summary)
+        if e2e_window is not None:
+            section["e2e"] = attr_mod.summarize(events, window=e2e_window)
+        fracs = rec_or_headline.get("breakdown_fracs")
+        shares = section.get("shares")
+        if fracs and shares:
+            # the hand math's categories map 1:1 onto attribution's
+            pairs = (
+                ("host_prep", "host_prep"), ("upload", "upload"),
+                ("device", "device_compute"),
+            )
+            section["agrees_with_hand_breakdown"] = all(
+                abs(fracs.get(hand, 0.0) - shares.get(cat, 0.0)) <= 0.10
+                for hand, cat in pairs
+            )
+        rec_or_headline["attribution"] = section
+    except Exception as e:
+        rec_or_headline["attribution_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def telemetry_snapshot() -> "dict | None":
     """Best-effort host-side telemetry snapshot for the bench record.
 
@@ -456,7 +534,11 @@ def attach_host_ingest(rec_or_headline: dict, smoke: bool) -> None:
     try:
         from parameter_server_tpu.benchmarks.components import host_ingest_ab
 
-        rec_or_headline["host_ingest"] = host_ingest_ab(smoke)
+        # parked: the A/B's pipelined arm drives a real IngestPipeline
+        # whose per-batch span emits would tax only that arm of the
+        # paired ratio and flood the trace with off-window ingest flows
+        with telemetry_spans.parked_sink():
+            rec_or_headline["host_ingest"] = host_ingest_ab(smoke)
     except Exception as e:
         rec_or_headline["host_ingest_error"] = (
             f"{type(e).__name__}: {str(e)[:200]}"
@@ -477,7 +559,11 @@ def attach_wire(rec_or_headline: dict, smoke: bool) -> None:
     try:
         from parameter_server_tpu.benchmarks.components import wire_ab
 
-        out = wire_ab(smoke)
+        # parked: encode_exact emits a wire.encode span per call, which
+        # would tax the encode arm of the paired encode-over-prep ratio
+        # and land off-window noise in the trace
+        with telemetry_spans.parked_sink():
+            out = wire_ab(smoke)
         mb_s = rec_or_headline.get("host_to_device_mb_s")
         if mb_s:
             per_enc = {}
@@ -503,7 +589,12 @@ def attach_serve(rec_or_headline: dict, smoke: bool) -> None:
     try:
         from parameter_server_tpu.benchmarks.components import serve_ab
 
-        rec_or_headline["serve"] = serve_ab(smoke)
+        # parked: the SLO bench fires thousands of requests/s and three
+        # timeline events per request (submit/execute/reply + per-line
+        # fsync in the JSONL sink) would load the very tail latencies
+        # being measured — and flood the trace with off-window noise
+        with telemetry_spans.parked_sink():
+            rec_or_headline["serve"] = serve_ab(smoke)
     except Exception as e:
         rec_or_headline["serve_error"] = f"{type(e).__name__}: {str(e)[:200]}"
 
@@ -834,24 +925,36 @@ class UploadPipeline:
             parts.append(item)
             if len(parts) < T:
                 continue
-            sb = stack_supersteps(parts, T)
-            parts = []
-            nb = tree_host_nbytes(sb)
-            _beat()
-            # device_put returns promptly with transfer in flight; the
-            # bounded queue keeps at most a couple of superbatches
-            # staged ahead so host memory stays flat. _transfer_op
-            # (not _grace_for_transfer): the main thread beats per
-            # consumed item, and a beat would cancel a plain grace
-            # mid-transfer
-            with _transfer_op(nb):
-                if self._cache is not None:
-                    saved0 = self._cache.saved_bytes
-                    staged = self._cache(sb)
-                    nb = max(0, nb - (self._cache.saved_bytes - saved0))
-                else:
-                    staged = jax.device_put(sb)
-            yield staged, int(sb.num_examples), nb
+            # one timeline flow per superbatch: stack → upload here,
+            # then the consumer submits the trainer step under the same
+            # id (the 4th yielded element), so the executor.step span
+            # joins the flow and the critical path reads end to end
+            fid = telemetry_spans.maybe_new_flow()
+            with telemetry_spans.flow_scope(fid):
+                with telemetry_spans.span("bench.stack", phase="e2e"):
+                    sb = stack_supersteps(parts, T)
+                parts = []
+                nb = tree_host_nbytes(sb)
+                _beat()
+                # device_put returns promptly with transfer in flight;
+                # the bounded queue keeps at most a couple of
+                # superbatches staged ahead so host memory stays flat.
+                # _transfer_op (not _grace_for_transfer): the main
+                # thread beats per consumed item, and a beat would
+                # cancel a plain grace mid-transfer
+                with _transfer_op(nb):
+                    with telemetry_spans.span(
+                        "bench.upload", phase="e2e", nbytes=nb
+                    ):
+                        if self._cache is not None:
+                            saved0 = self._cache.saved_bytes
+                            staged = self._cache(sb)
+                            nb = max(
+                                0, nb - (self._cache.saved_bytes - saved0)
+                            )
+                        else:
+                            staged = jax.device_put(sb)
+            yield staged, int(sb.num_examples), nb, fid
         self.skipped_examples = sum(int(p.num_examples) for p in parts)
 
     def __iter__(self):
@@ -939,42 +1042,59 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
     (utils/profiling.device_trace) for op-level attribution."""
     import jax
 
+    from parameter_server_tpu.telemetry.timeline import device_annotation
     from parameter_server_tpu.utils.profiling import device_trace
 
     prep_s = up_s = dev_s = 0.0
     bytes_moved = 0
     for i in range(launches):
         _beat()
-        t0 = time.perf_counter()
-        sb = stack_supersteps(make_parts(i), T)
-        prep_s += time.perf_counter() - t0
-        nb = tree_host_nbytes(sb)
-        bytes_moved += nb
-        _grace_for_transfer(nb)
-        staged, sec_up = timed_upload(sb)
-        up_s += sec_up
-        if profile_dir and i == 0:
-            # fresh capture: the watcher reuses a fixed /tmp path, and
-            # summarize_trace must not mix this run with stale traces
-            # from a previous bench (or code version). Remove ONLY the
-            # profiler's own plugins/ subtree — the user may have
-            # pointed --profile at a directory holding other files
-            import shutil
+        # one timeline flow per serialized launch: the three stage
+        # spans below (phase="breakdown") are what the record's
+        # ``attribution`` section is computed from — the trace-derived
+        # twin of the hand accumulators in this loop, kept in lockstep
+        # by attach_attribution's agrees_with_hand_breakdown check
+        fid = telemetry_spans.maybe_new_flow()
+        with telemetry_spans.flow_scope(fid):
+            t0 = time.perf_counter()
+            with telemetry_spans.span("bench.prep", phase="breakdown"):
+                sb = stack_supersteps(make_parts(i), T)
+            prep_s += time.perf_counter() - t0
+            nb = tree_host_nbytes(sb)
+            bytes_moved += nb
+            _grace_for_transfer(nb)
+            with telemetry_spans.span(
+                "bench.upload", phase="breakdown", nbytes=nb
+            ):
+                staged, sec_up = timed_upload(sb)
+            up_s += sec_up
+            if profile_dir and i == 0:
+                # fresh capture: the watcher reuses a fixed /tmp path,
+                # and summarize_trace must not mix this run with stale
+                # traces from a previous bench (or code version).
+                # Remove ONLY the profiler's own plugins/ subtree — the
+                # user may have pointed --profile at a directory
+                # holding other files
+                import shutil
 
-            shutil.rmtree(
-                os.path.join(profile_dir, "plugins"), ignore_errors=True
+                shutil.rmtree(
+                    os.path.join(profile_dir, "plugins"), ignore_errors=True
+                )
+            ctx = (
+                device_trace(profile_dir) if (profile_dir and i == 0)
+                else contextlib.nullcontext()
             )
-        ctx = (
-            device_trace(profile_dir) if (profile_dir and i == 0)
-            else contextlib.nullcontext()
-        )
-        t0 = time.perf_counter()
-        with ctx:
-            worker.executor.wait(
-                worker._submit_prepped(staged, with_aux=False)
-            )
-            flush(worker)
-        dev_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            with ctx:
+                # the profiler's device tracks line up with the host
+                # timeline through this named annotation (no-op off-TPU)
+                with telemetry_spans.span("bench.device", phase="breakdown"):
+                    with device_annotation("bench.device"):
+                        worker.executor.wait(
+                            worker._submit_prepped(staged, with_aux=False)
+                        )
+                        flush(worker)
+            dev_s += time.perf_counter() - t0
     total = prep_s + up_s + dev_s
     out = {
         "breakdown_launches": launches,
@@ -1316,6 +1436,7 @@ def run_real(args) -> int:
 
     Postoffice.reset()
     po = Postoffice.instance().start()
+    trace_path = ensure_trace_sink()
 
     alpha, beta, l1 = 0.1, 1.0, 1.0
     conf = Config()
@@ -1484,19 +1605,22 @@ def run_real(args) -> int:
         for b in batches:  # rest of the file
             if b.n < args.minibatch:
                 break  # keep superstep shapes static
-            yield worker.prep(b, device_put=False)
+            with telemetry_spans.span("bench.prep", phase="e2e"):
+                part = worker.prep(b, device_put=False)
+            yield part
 
     def prepped_stream():
         # producer thread even on one core: parse is GIL-free C++, so
         # it overlaps the uploader's socket writes and the device steps
         return iter_on_thread(host_prepped(), maxsize=3 * T)
 
+    e2e_wall0 = time.time()
     t0 = time.perf_counter()
     done_ex = 0
     wire_bytes_moved = 0
     pending = []
     pipe = UploadPipeline(prepped_stream(), T)
-    for dev_sb, n_ex, nb in pipe:
+    for dev_sb, n_ex, nb, fid in pipe:
         done_ex += n_ex
         wire_bytes_moved += nb  # actual staged bytes, not a dtype model
         _beat()
@@ -1504,7 +1628,8 @@ def run_real(args) -> int:
         # flight: the wait below may pay the wire time, so grace it on
         # THIS thread (the beater) like the pre-pipeline code did
         _grace_for_transfer(nb)
-        pending.append(worker._submit_prepped(dev_sb, with_aux=False))
+        with telemetry_spans.flow_scope(fid):
+            pending.append(worker._submit_prepped(dev_sb, with_aux=False))
         if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
     # a trailing partial group would compile a second scan shape inside
@@ -1514,6 +1639,7 @@ def run_real(args) -> int:
         worker.executor.wait(ts)
     flush(worker)
     dt = time.perf_counter() - t0
+    e2e_wall1 = time.time()
     e2e_rate = done_ex / dt
 
     rec = {
@@ -1530,6 +1656,7 @@ def run_real(args) -> int:
     }
     rec.update(headline)
     reconcile_link_ceiling(rec, wire_bytes_moved, done_ex, dt)
+    attach_attribution(rec, trace_path, (e2e_wall0, e2e_wall1))
     _finish(rec)
     return 0
 
@@ -1768,6 +1895,7 @@ def run_synthetic(args) -> int:
 
     Postoffice.reset()
     po = Postoffice.instance().start()  # all local devices, 1 server axis
+    trace_path = ensure_trace_sink()
     n_workers = meshlib.num_workers(po.mesh)
 
     conf = Config()
@@ -1935,7 +2063,9 @@ def run_synthetic(args) -> int:
     window = max(5, n_launches // 5) if n_launches >= 5 else n_launches
     def host_parts():
         for i in range(n_launches * T):
-            yield worker.prep(raw[i % len(raw)], device_put=False)
+            with telemetry_spans.span("bench.prep", phase="e2e"):
+                part = worker.prep(raw[i % len(raw)], device_put=False)
+            yield part
 
     # upload key cache on the e2e stream (stateful → single-owner: it
     # lives on the UploadPipeline's one staging thread). The synthetic
@@ -1950,19 +2080,21 @@ def run_synthetic(args) -> int:
     rates = []
     done = 0
     wire_counter["bytes"] = 0  # count the TIMED phase only (not warmup)
+    e2e_wall0 = time.time()
     t0 = time.perf_counter()
     pending = []
     win_done, win_t0 = 0, t0
     # uploader thread overlaps localize/pack + the tunnel wire with the
     # device steps the main thread is waiting on (see UploadPipeline)
-    for dev_sb, _n_ex, nb in UploadPipeline(host_parts(), T, cache=cache):
+    for dev_sb, _n_ex, nb, fid in UploadPipeline(host_parts(), T, cache=cache):
         wire_counter["bytes"] += nb
         done += 1
         win_done += 1
         _beat()
         # the wait below may pay the staged transfer's wire time
         _grace_for_transfer(nb)
-        pending.append(worker._submit_prepped(dev_sb, with_aux=False))
+        with telemetry_spans.flow_scope(fid):
+            pending.append(worker._submit_prepped(dev_sb, with_aux=False))
         if len(pending) > 2:
             worker.executor.wait(pending.pop(0))
         if win_done >= window:
@@ -1976,6 +2108,7 @@ def run_synthetic(args) -> int:
         worker.executor.wait(ts)
     flush(worker)
     dt = time.perf_counter() - t0
+    e2e_wall1 = time.time()
     done *= T
 
     avg_rate = done * args.minibatch / dt
@@ -1999,6 +2132,7 @@ def run_synthetic(args) -> int:
     reconcile_link_ceiling(
         rec, wire_counter["bytes"], done * args.minibatch, dt
     )
+    attach_attribution(rec, trace_path, (e2e_wall0, e2e_wall1))
     _finish(rec)
     return 0
 

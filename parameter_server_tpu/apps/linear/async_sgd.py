@@ -1603,14 +1603,26 @@ class DeviceUploader:
     ends)."""
 
     def __init__(self, source, upload_fn, depth: int = 2):
+        import collections
+
         from ...learner.ingest import pipeline_instruments
+        from ...telemetry import spans as telemetry_spans
         from ...utils.concurrent import iter_on_thread
 
         tel = pipeline_instruments()
+        # timeline flow hand-off: the uploader thread records each
+        # staged batch's flow id (set by the ingest pipeline while this
+        # thread pulled the item) in FIFO order; the consumer pops one
+        # per item (iter_on_thread preserves order) so the trainer-step
+        # submit can run under the SAME flow — feeder → prep pool →
+        # uploader → trainer step all correlate. deque append/popleft
+        # are atomic (no lock needed; single producer, single consumer).
+        self._flows: "collections.deque" = collections.deque()
 
         def uploaded():
             for prepped, n in source:
                 t0 = time.perf_counter()
+                fid = telemetry_spans.current_flow()
                 if tel is not None:
                     tel["batches"].labels(pipeline="device_uploader").inc()
                     tel["examples"].labels(pipeline="device_uploader").inc(
@@ -1622,7 +1634,18 @@ class DeviceUploader:
                 # uploaded_bytes must stay the REALIZED link traffic
                 # (doc/OBSERVABILITY.md), so hit bytes are subtracted
                 saved0 = int(getattr(upload_fn, "saved_bytes", 0))
-                staged = upload_fn(prepped)
+                if telemetry_spans.get_sink() is not None:
+                    # span (not a hand-built emit): an upload_fn failure
+                    # still closes the event with an `error` attr, so
+                    # the traced flow shows WHERE it died instead of
+                    # silently ending at ingest.prep
+                    with telemetry_spans.flow_scope(fid):
+                        with telemetry_spans.span(
+                            "ingest.upload", pipeline="device_uploader"
+                        ):
+                            staged = upload_fn(prepped)
+                else:
+                    staged = upload_fn(prepped)
                 if tel is not None:
                     hit_bytes = (
                         int(getattr(upload_fn, "saved_bytes", 0)) - saved0
@@ -1640,6 +1663,7 @@ class DeviceUploader:
                     tel["stage_seconds"].labels(stage="upload").observe(
                         time.perf_counter() - t0
                     )
+                self._flows.append(fid)
                 yield staged, n
 
         # maxsize = depth - 1 staged in the queue + 1 held by the
@@ -1648,6 +1672,14 @@ class DeviceUploader:
         # iter_on_thread owns the cross-thread queue + join contract,
         # and _it is only touched from the consumer thread.
         self._it = iter_on_thread(uploaded(), maxsize=max(1, depth - 1))
+
+    def next_flow(self):
+        """The flow id of the next yielded batch (FIFO with the item
+        stream; None when tracing is off). Consumer thread only."""
+        try:
+            return self._flows.popleft()
+        except IndexError:
+            return None
 
     def __iter__(self):
         return self._it
@@ -2362,11 +2394,18 @@ class AsyncSGDWorker(ISGDCompNode):
                     )
             uploader = DeviceUploader(flattened(), upload_fn, depth=2)
             try:
+                from ...telemetry import spans as telemetry_spans
+
                 for staged_batch, n in uploader:
-                    pending.append(
-                        (self._submit_prepped(staged_batch, with_aux=True),
-                         n)
-                    )
+                    # submit under the batch's flow id (popped FIFO from
+                    # the uploader) so the executor.step span correlates
+                    # back through upload → prep → read in the timeline
+                    with telemetry_spans.flow_scope(uploader.next_flow()):
+                        pending.append(
+                            (self._submit_prepped(
+                                staged_batch, with_aux=True),
+                             n)
+                        )
                     while sum(n for _, n in pending) > bound:
                         self.collect(pending.pop(0)[0])
             finally:

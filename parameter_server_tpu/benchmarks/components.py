@@ -1204,3 +1204,78 @@ def serve_perf(smoke: bool = False) -> None:
         "serve_decode_tokens_per_sec",
         out["decode"]["tokens_per_sec"], "tokens/sec",
     )
+
+
+@benchmark("trace")
+def trace_perf(smoke: bool = False) -> None:
+    """Capture a short synthetic run's flow-correlated timeline and
+    export it as Chrome trace / Perfetto JSON (``make trace``).
+
+    Drives the real pipeline pieces — an IngestPipeline (feeder +
+    ordered prep pool) feeding executor steps submitted under each
+    batch's flow id — with a JSONL span sink installed, then writes the
+    merged timeline where ``PS_TRACE_OUT`` points (default
+    ``<tmp>/ps_timeline_trace.json``; the raw JSONL lands next to it)
+    and runs the critical-path analyzer over it. Open the export at
+    https://ui.perfetto.dev — doc/OBSERVABILITY.md "Reading a timeline"
+    walks what you see. Reported metrics double as liveness checks:
+    zero captured events or uncorrelated flows would fail the registry
+    smoke test."""
+    import os
+    import tempfile
+    import time as _time
+
+    from ..learner.ingest import IngestPipeline
+    from ..system.executor import Executor
+    from ..telemetry import attribution as attribution_mod
+    from ..telemetry import spans as telemetry_spans
+    from ..telemetry import timeline as timeline_mod
+
+    out_path = os.environ.get("PS_TRACE_OUT") or os.path.join(
+        tempfile.gettempdir(), "ps_timeline_trace.json"
+    )
+    jsonl_path = out_path + ".jsonl"
+    try:
+        os.remove(jsonl_path)  # fresh capture, never mix runs
+    except OSError:
+        pass
+    n_batches = 6 if smoke else 24
+    rng = np.random.default_rng(0)
+    work = rng.random(1 << (12 if smoke else 16))
+
+    def batches():
+        for i in range(n_batches):
+            yield i
+
+    def prep(i):
+        return float(np.sort(work).sum()) + i  # real CPU work
+
+    prev = telemetry_spans.install_sink(telemetry_spans.JsonlSink(jsonl_path))
+    t0 = _time.perf_counter()
+    try:
+        pipe = IngestPipeline(
+            batches(), prep_fn=prep, workers=2, name="trace"
+        ).start()
+        ex = Executor(name="trace_bench", telemetry=True)
+        for item in pipe:
+            # the pipeline keeps the batch's flow active on this thread
+            # until the next item, so the step correlates automatically
+            ex.submit(lambda item=item: float(work[:1024].sum()) + item)
+        ex.wait_all()
+        ex.stop()
+    finally:
+        mine = telemetry_spans.install_sink(prev)
+        if mine is not None and mine is not prev:
+            mine.close()
+    capture_s = _time.perf_counter() - t0
+
+    events = timeline_mod.load_events(jsonl_path)
+    trace = timeline_mod.to_chrome_trace(events)
+    import json as _json
+
+    with open(out_path, "w", encoding="utf-8") as f:
+        _json.dump(trace, f)
+    summary = attribution_mod.summarize(events)
+    report("trace_events_captured", len(events), "events")
+    report("trace_flows_correlated", summary["flows"].get("count", 0), "flows")
+    report("trace_capture_events_per_sec", len(events) / capture_s, "events/sec")

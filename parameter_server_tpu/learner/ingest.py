@@ -36,6 +36,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, Optional
 
+from ..telemetry import spans as telemetry_spans
 from ..utils.concurrent import OrderedStagePool, iter_on_thread
 
 
@@ -92,6 +93,12 @@ class IngestPipeline:
         self._thread_it = None
         self._it: Optional[Iterator] = None
         self._closed = False
+        # timeline tracing (telemetry/timeline.py): decided once at
+        # start() — when a span sink is installed, every batch gets a
+        # flow id on the feeder and rides it through filter → prep →
+        # the consumer (items travel internally as (flow, batch) pairs;
+        # the consumer-facing iterator unwraps). Off = zero overhead.
+        self._trace = False
 
     # -- stage bodies --------------------------------------------------
 
@@ -100,24 +107,62 @@ class IngestPipeline:
             self._tel["stage_seconds"].labels(stage=stage).observe(seconds)
 
     def _produced(self) -> Iterator:
-        """Feeder-side serial stages: read (source next) + filter."""
+        """Feeder-side serial stages: read (source next) + filter.
+        When tracing, each batch is born here with a flow id and every
+        stage span carries it — items flow on as (flow, batch)."""
         src = self._source
         while True:
+            t_wall = time.time()
             t0 = time.perf_counter()
             try:
                 batch = next(src)
             except StopIteration:
                 return
-            self._observe("read", time.perf_counter() - t0)
+            read_s = time.perf_counter() - t0
+            self._observe("read", read_s)
+            fid = None
+            if self._trace:
+                fid = telemetry_spans.new_flow()
+                telemetry_spans.emit(
+                    {
+                        "kind": "span",
+                        "name": "ingest.read",
+                        "pipeline": self._name,
+                        "t_wall": t_wall,
+                        "dur_s": read_s,
+                        "flow": fid,
+                    }
+                )
             if self._filter_fn is not None:
-                t0 = time.perf_counter()
-                batch = self._filter_fn(batch)
-                self._observe("filter", time.perf_counter() - t0)
-            yield batch
+                if self._trace:
+                    with telemetry_spans.flow_scope(fid):
+                        with telemetry_spans.span(
+                            "ingest.filter", pipeline=self._name
+                        ):
+                            t0 = time.perf_counter()
+                            batch = self._filter_fn(batch)
+                            self._observe(
+                                "filter", time.perf_counter() - t0
+                            )
+                else:
+                    t0 = time.perf_counter()
+                    batch = self._filter_fn(batch)
+                    self._observe("filter", time.perf_counter() - t0)
+            yield (fid, batch) if self._trace else batch
 
-    def _prep(self, batch):
+    def _prep(self, item):
+        if self._trace:
+            fid, batch = item
+            with telemetry_spans.flow_scope(fid):
+                with telemetry_spans.span(
+                    "ingest.prep", pipeline=self._name
+                ):
+                    t0 = time.perf_counter()
+                    out = self._prep_fn(batch)
+                    self._observe("prep", time.perf_counter() - t0)
+            return fid, out
         t0 = time.perf_counter()
-        out = self._prep_fn(batch)
+        out = self._prep_fn(item)
         self._observe("prep", time.perf_counter() - t0)
         return out
 
@@ -129,6 +174,7 @@ class IngestPipeline:
             raise RuntimeError(f"{self._name}: start() after close()")
         if self._it is not None:
             return self
+        self._trace = telemetry_spans.get_sink() is not None
         if self._prep_fn is not None and self._workers > 0:
             self._pool = OrderedStagePool(
                 self._prep,
@@ -166,6 +212,13 @@ class IngestPipeline:
         tel = self._tel
         try:
             for item in self._it:
+                # tracing wraps items as (flow, batch) internally; the
+                # consumer sees the bare batch, with the batch's flow
+                # active on its thread until it advances to the next
+                # item (so a downstream stage's spans correlate)
+                fid = None
+                if self._trace:
+                    fid, item = item
                 if tel is not None:
                     tel["queue_depth"].labels(queue=self._name).set(
                         self.qsize()
@@ -182,7 +235,8 @@ class IngestPipeline:
                         tel["examples"].labels(pipeline=self._name).inc(
                             int(n)
                         )
-                yield item
+                with telemetry_spans.flow_scope(fid):
+                    yield item
         finally:
             self.close()
 

@@ -285,7 +285,24 @@ def encode_exact(
     ``"exact"`` is lossless/bit-identical; ``"int8"``/``"u16"``/
     ``"bf16"`` additionally narrow the value stream (lossy — config-
     gated behind a logloss-parity bound; binary batches have no value
-    stream, so every mode is exact for them)."""
+    stream, so every mode is exact for them).
+
+    With a span sink installed, the encode emits one ``wire.encode``
+    timeline span carrying the active flow id — the ``encode`` category
+    of the critical-path attribution (telemetry/attribution.py)."""
+    from ..telemetry import spans as telemetry_spans
+
+    if telemetry_spans.get_sink() is None:
+        return _encode_exact_impl(prepped, num_slots, mode)
+    with telemetry_spans.span("wire.encode", mode=mode):
+        return _encode_exact_impl(prepped, num_slots, mode)
+
+
+def _encode_exact_impl(
+    prepped,
+    num_slots: int,
+    mode: str = "exact",
+) -> Optional[EncodedExactBatch]:
     from ..apps.linear.async_sgd import PreppedBatch
     from ..ops.kv_ops import slot_sentinel
 

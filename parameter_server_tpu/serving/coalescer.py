@@ -37,6 +37,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..telemetry import spans as telemetry_spans
+
 
 class _Window:
     """One coalesce generation: requests accumulated, then flushed as
@@ -46,7 +48,7 @@ class _Window:
 
     __slots__ = (
         "keys", "n_requests", "deadline", "done", "union", "values",
-        "error",
+        "error", "flows",
     )
 
     def __init__(self, deadline: float):
@@ -57,6 +59,10 @@ class _Window:
         self.union: Optional[np.ndarray] = None
         self.values: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # timeline fan-in: the flow ids of the requests this window
+        # merged (guarded like ``keys`` — appended under the owning
+        # coalescer's _cv, read by the flusher after the hand-off)
+        self.flows: List[int] = []
 
 
 class PullTicket:
@@ -142,6 +148,9 @@ class PullCoalescer:
                 self._open_keys = 0
             win.keys.append(keys)
             win.n_requests += 1
+            fid = telemetry_spans.current_flow()
+            if fid is not None:
+                win.flows.append(fid)
             self._open_keys += len(keys)
             self.requests_total += 1
             self.requested_keys_total += len(keys)
@@ -197,12 +206,31 @@ class PullCoalescer:
             self._flush(win)
 
     def _flush(self, win: _Window) -> None:
-        try:
+        # the flush gets its own flow id; the span's ``flows`` list
+        # names the merged requests, so the timeline draws fan-in
+        # arrows request → flush, and the executor step submitted
+        # below correlates to the flush (executor.submit captures the
+        # active flow)
+        fid = telemetry_spans.maybe_new_flow()
+
+        def pull_union():
             union = np.unique(np.concatenate(win.keys))
             ts = self.store.pull(
                 self.store.request(channel=self.channel), keys=union
             )
-            values = np.asarray(self.store.wait_pull(ts))
+            return union, np.asarray(self.store.wait_pull(ts))
+
+        try:
+            if fid is not None:
+                with telemetry_spans.flow_scope(fid):
+                    with telemetry_spans.span(
+                        "serve.coalesce.flush",
+                        merged=win.n_requests,
+                        flows=list(win.flows),
+                    ):
+                        union, values = pull_union()
+            else:  # tracing off: no span machinery on the flush path
+                union, values = pull_union()
             win.union = union
             win.values = values
             with self._cv:

@@ -45,6 +45,7 @@ import numpy as np
 from .admission import AdmissionController, RejectedError  # noqa: F401  (re-export: the door's exception belongs to the frontend API)
 from .coalescer import PullCoalescer
 from .replica import ReadReplica
+from ..telemetry import spans as telemetry_spans
 
 
 @dataclasses.dataclass
@@ -98,17 +99,23 @@ class ServeConfig:
 
 
 class Ticket:
-    """One admitted request's completion handle."""
+    """One admitted request's completion handle. ``flow`` is the
+    request's timeline flow id (telemetry/timeline.py) when a span sink
+    is installed — submit, execution, coalesced pull, executor step and
+    reply all correlate through it."""
 
-    __slots__ = ("_done", "value", "error", "t_submit", "t_done", "kind")
+    __slots__ = (
+        "_done", "value", "error", "t_submit", "t_done", "kind", "flow",
+    )
 
-    def __init__(self, kind: str):
+    def __init__(self, kind: str, flow: Optional[int] = None):
         self._done = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
         self.t_done = 0.0
         self.kind = kind
+        self.flow = flow
 
     def _complete(self, value=None, error=None) -> None:
         self.value = value
@@ -364,7 +371,21 @@ class ServeFrontend:
             else "predict" if isinstance(req, PredictRequest)
             else "decode"
         )
-        ticket = Ticket(kind)
+        fid = telemetry_spans.maybe_new_flow()
+        ticket = Ticket(kind, flow=fid)
+        if fid is not None:
+            # zero-duration submit marker: the gap to the execute span
+            # is the request's queue-wait in the timeline
+            telemetry_spans.emit(
+                {
+                    "kind": "span",
+                    "name": "serve.submit",
+                    "t_wall": time.time(),
+                    "dur_s": 0.0,
+                    "flow": fid,
+                    "req": kind,
+                }
+            )
         tel = self._tel()
         with self._cv:
             if self._closed:  # closed during admit: nothing enqueued
@@ -399,11 +420,38 @@ class ServeFrontend:
                 req, ticket = queue.popleft()
                 self._executing += 1
             try:
-                value = self._execute(req)
+                # span only when the request carries a flow (sink was
+                # installed at submit) — the µs pull lane pays nothing
+                # for tracing that is off
+                if ticket.flow is not None:
+                    span_name = (
+                        "serve.decode" if ticket.kind == "decode"
+                        else "serve.execute"
+                    )
+                    with telemetry_spans.flow_scope(ticket.flow):
+                        with telemetry_spans.span(span_name, req=ticket.kind):
+                            value = self._execute(req)
+                else:
+                    value = self._execute(req)
                 err = None
             except BaseException as e:
                 value, err = None, e
             ticket._complete(value, err)
+            if ticket.flow is not None:
+                # reply marker: completion handed back to the waiter —
+                # closes the request's flow in the timeline
+                telemetry_spans.emit(
+                    {
+                        "kind": "span",
+                        "name": "serve.reply",
+                        "t_wall": time.time(),
+                        "dur_s": 0.0,
+                        "flow": ticket.flow,
+                        "latency_s": ticket.latency_s(),
+                        "req": ticket.kind,
+                        **({"error": type(err).__name__} if err else {}),
+                    }
+                )
             with self._cv:
                 self._executing -= 1
                 if decode_lane:

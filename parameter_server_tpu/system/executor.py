@@ -254,8 +254,14 @@ class Executor:
             self._pending[ts] = (step, deps)
             if self._tel is not None:
                 # [t_submit, t_dispatch (0 = not picked yet),
-                #  run_s (-1 = run not completed yet), materialize_s]
-                self._step_times[ts] = [time.perf_counter(), 0.0, -1.0, 0.0]
+                #  run_s (-1 = run not completed yet), materialize_s,
+                #  flow id active on the SUBMITTING thread (timeline
+                #  flow correlation: the batch/request this step
+                #  serves) or None]
+                self._step_times[ts] = [
+                    time.perf_counter(), 0.0, -1.0, 0.0,
+                    telemetry_spans.current_flow(),
+                ]
             # readiness accounting: a dep not yet done registers this
             # step as its dependent; _finish(dep) decrements the count
             # and promotes the step to the ready heap at zero. A dep
@@ -465,7 +471,7 @@ class Executor:
         if times is None:
             return  # a concurrent finish won the pop; it emitted
         now = time.perf_counter()
-        t_submit, t_dispatch, run_s, mat_s = times
+        t_submit, t_dispatch, run_s, mat_s, flow = times
         queue_wait = max(0.0, t_dispatch - t_submit)
         total = max(0.0, now - t_submit)
         tel.record(
@@ -477,19 +483,20 @@ class Executor:
             num_pending,
         )
         if telemetry_spans.get_sink() is not None:
-            telemetry_spans.emit(
-                {
-                    "kind": "span",
-                    "name": "executor.step",
-                    "executor": tel.name,
-                    "ts": ts,
-                    "t_wall": time.time(),
-                    "queue_wait_s": queue_wait,
-                    "run_s": run_s,
-                    "materialize_s": mat_s,
-                    "total_s": total,
-                }
-            )
+            event = {
+                "kind": "span",
+                "name": "executor.step",
+                "executor": tel.name,
+                "ts": ts,
+                "t_wall": time.time(),
+                "queue_wait_s": queue_wait,
+                "run_s": run_s,
+                "materialize_s": mat_s,
+                "total_s": total,
+            }
+            if flow is not None:
+                event["flow"] = flow
+            telemetry_spans.emit(event)
 
     def _finish(self, ts: int) -> None:
         """Mark finished (results materialized), prune, fire callback

@@ -7,7 +7,14 @@ One low-overhead spine for every layer's observability (see
   process-default registry (hung off ``Postoffice``), JSON snapshots and
   Prometheus text exposition;
 - :mod:`spans` — ``span(name, ts=...)`` host intervals correlated to
-  executor logical timestamps, appended to a JSONL sink;
+  executor logical timestamps, appended to a JSONL sink; flow ids
+  (``new_flow``/``flow_scope``) correlate one batch/request across
+  threads;
+- :mod:`timeline` — merged cross-thread timeline reader + Chrome
+  trace-event / Perfetto export with flow arrows;
+- :mod:`attribution` — critical-path analyzer over a timeline: per-step
+  / per-request attribution to {host-prep, encode, upload, queue-wait,
+  device-compute, decode, reply} and the binding resource;
 - :mod:`instruments` — the canonical catalog of metric names each layer
   records (executor phases, van bytes, parameter push/pull, app volume,
   heartbeat traffic).
@@ -24,7 +31,19 @@ from .registry import (
     reset_default_registry,
     set_enabled,
 )
-from .spans import JsonlSink, close_sink, emit, get_sink, install_sink, span
+from .spans import (
+    JsonlSink,
+    close_sink,
+    current_flow,
+    emit,
+    flow_scope,
+    get_sink,
+    install_sink,
+    maybe_new_flow,
+    new_flow,
+    parked_sink,
+    span,
+)
 
 __all__ = [
     "Counter",
@@ -34,11 +53,16 @@ __all__ = [
     "JsonlSink",
     "MetricsRegistry",
     "close_sink",
+    "current_flow",
     "default_registry",
     "emit",
     "enabled",
+    "flow_scope",
     "get_sink",
     "install_sink",
+    "maybe_new_flow",
+    "new_flow",
+    "parked_sink",
     "reset_default_registry",
     "set_enabled",
     "span",

@@ -13,12 +13,26 @@ Sink contract: append-only JSONL, one event per line, thread-safe,
 best-effort (a tracing failure must never take down the step it was
 measuring). ``install_sink(None)`` (the default) makes ``emit`` a cheap
 None check — the hot path pays nothing when tracing is off.
+
+Flow correlation (the timeline layer, :mod:`telemetry.timeline`): a
+*flow id* names one unit of work — a batch, a superbatch launch, a
+served request — as it crosses threads (feeder → prep pool → uploader
+→ trainer step; serve submit → coalescer flush → executor). The stage
+that creates the unit allocates an id with :func:`new_flow`, each stage
+runs its work under ``with flow_scope(fid):``, and every span emitted
+inside the scope carries ``"flow": fid`` automatically, so a trace
+reader can stitch the per-thread tracks back into per-unit paths
+without the stages knowing about each other. The scope is a
+thread-local; crossing a thread boundary means carrying the id in the
+hand-off (a queue tuple, a ticket field) and re-entering the scope on
+the far side.
 """
 
 from __future__ import annotations
 
 import contextlib
 import io
+import itertools
 import json
 import threading
 import time
@@ -75,12 +89,92 @@ def close_sink() -> None:
 
 
 def emit(event: Dict[str, Any]) -> None:
-    """Best-effort emit to the installed sink (no-op when none)."""
+    """Best-effort emit to the installed sink (no-op when none). Every
+    event gains a ``thread`` field (the emitting thread's name) so the
+    timeline reader can lay events out on per-thread tracks without the
+    call sites threading identity through."""
     sink = _sink
     if sink is None:
         return
     with contextlib.suppress(Exception):
+        if "thread" not in event:
+            event["thread"] = threading.current_thread().name
         sink.emit(event)
+
+
+# -- flow correlation ------------------------------------------------------
+
+_flow_ids = itertools.count(1)  # count() is atomic under the GIL
+_flow_local = threading.local()
+
+
+def new_flow() -> int:
+    """Allocate a fresh process-unique flow id (one per unit of work)."""
+    return next(_flow_ids)
+
+
+def maybe_new_flow() -> Optional[int]:
+    """A fresh flow id when a sink is installed, else None — the
+    producer-side idiom (only pay for flow ids when tracing is on;
+    ``flow_scope(None)`` downstream is a no-op)."""
+    return new_flow() if _sink is not None else None
+
+
+@contextlib.contextmanager
+def parked_sink():
+    """Temporarily uninstall the span sink for a block — used around
+    embedded A/B benches whose instrumented arms would otherwise pay a
+    one-sided tracing tax and flood the run's trace with off-window
+    events. Restores the previous sink on exit."""
+    prev = install_sink(None)
+    try:
+        yield
+    finally:
+        install_sink(prev)
+
+
+def current_flow() -> Optional[int]:
+    """The flow id active on this thread, or None outside any scope."""
+    return getattr(_flow_local, "flow", None)
+
+
+@contextlib.contextmanager
+def flow_scope(flow: Optional[int]):
+    """Run a block with ``flow`` as this thread's active flow id; spans
+    emitted inside carry it automatically. ``flow_scope(None)`` is a
+    no-op passthrough (tracing off / no id carried), so hand-off code
+    can use it unconditionally. Scopes nest; the previous id is
+    restored on exit."""
+    if flow is None:
+        yield
+        return
+    prev = getattr(_flow_local, "flow", None)
+    _flow_local.flow = flow
+    try:
+        yield
+    finally:
+        _flow_local.flow = prev
+
+
+def abandoned(name: str, reason: str, flow: Optional[int] = None, **attrs) -> None:
+    """Emit an explicit ``abandoned`` terminator for work that died
+    before its span could close — the pool exception-forwarding path
+    (utils/concurrent.OrderedStagePool) calls this so a worker
+    exception leaves a tombstone in the timeline instead of an
+    open-ended track."""
+    event: Dict[str, Any] = {
+        "kind": "span",
+        "name": name,
+        "t_wall": time.time(),
+        "dur_s": 0.0,
+        "abandoned": True,
+        "reason": reason,
+    }
+    fid = flow if flow is not None else current_flow()
+    if fid is not None:
+        event["flow"] = fid
+    event.update(attrs)
+    emit(event)
 
 
 @contextlib.contextmanager
@@ -92,11 +186,25 @@ def span(name: str, ts: Optional[int] = None, histogram=None, **attrs):
     (a telemetry Histogram or labeled child) additionally records the
     duration, so the same interval feeds both the trace and the
     registry. Extra keyword attrs ride along verbatim.
+
+    The thread's active :func:`flow_scope` id is attached as ``flow``
+    (pass an explicit ``flow=`` attr to override). A block that exits
+    via an exception still emits its event — with ``error`` naming the
+    exception type — so the timeline never holds open-ended spans;
+    MUST be used as a ``with`` statement (the pslint ``spans`` pass
+    flags bare calls, whose block would otherwise never run).
     """
     t_wall = time.time()
     t0 = time.perf_counter()
+    error: Optional[str] = None
     try:
         yield
+    except BaseException as e:
+        # only an exception that actually unwound THIS block is an
+        # error of the span — sys.exc_info() in the finally would also
+        # see an outer exception being handled around a clean block
+        error = type(e).__name__
+        raise
     finally:
         dur = time.perf_counter() - t0
         if histogram is not None:
@@ -105,5 +213,10 @@ def span(name: str, ts: Optional[int] = None, histogram=None, **attrs):
         event = {"kind": "span", "name": name, "t_wall": t_wall, "dur_s": dur}
         if ts is not None:
             event["ts"] = ts
+        fid = current_flow()
+        if fid is not None:
+            event["flow"] = fid
+        if error is not None:
+            event["error"] = error
         event.update(attrs)
         emit(event)

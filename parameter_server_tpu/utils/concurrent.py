@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Callable, Generic, Iterator, Optional, TypeVar
 
+from ..telemetry import spans as telemetry_spans
+
 T = TypeVar("T")
 
 
@@ -243,6 +245,12 @@ class OrderedStagePool(Generic[T]):
                     return
             self._put(self._out_q, self._END)
         except BaseException as e:  # source exception -> ordered re-raise
+            # timeline terminator: the stream dies HERE — without this
+            # tombstone the trace just stops and a reader cannot tell a
+            # wedge from a crash (doc/OBSERVABILITY.md, abandoned spans)
+            telemetry_spans.abandoned(
+                f"{self._name}.source", reason=type(e).__name__
+            )
             slot = _Slot()
             slot.error = e
             slot.event.set()
@@ -262,6 +270,15 @@ class OrderedStagePool(Generic[T]):
             try:
                 slot.value = self._fn(item)
             except BaseException as e:
+                # exception-forwarding path: the item's span (opened by
+                # the stage fn) closed with an error attr when the
+                # exception unwound it; this explicit terminator marks
+                # the POOL abandoning the item, so the timeline shows
+                # where the ordered stream was poisoned even when the
+                # stage fn opened no span of its own
+                telemetry_spans.abandoned(
+                    f"{self._name}.worker", reason=type(e).__name__
+                )
                 slot.error = e
             slot.event.set()
 

@@ -213,7 +213,7 @@ class Engine:
 
 def default_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
     """The registered passes, optionally filtered by name."""
-    from . import donation, jitpure, locks, metrics, threads
+    from . import donation, jitpure, locks, metrics, spans, threads
 
     rules: List[Rule] = [
         locks.LockDisciplineRule(),
@@ -221,6 +221,7 @@ def default_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
         jitpure.JitPurityRule(),
         donation.DonationRule(),
         metrics.MetricsRule(),
+        spans.SpanDisciplineRule(),
     ]
     if only is not None:
         wanted = set(only)

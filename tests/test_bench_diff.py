@@ -1,0 +1,173 @@
+"""bench regression sentinel (script/bench_diff.py, `make bench-diff`).
+
+Fixture records in tests/data/bench_diff/ model the real trajectory's
+shapes: driver-wrapped rounds (``{"parsed": ...}``), an outage round,
+a pre-protocol artifact record (the retracted r01 5.25M dispatch-rate
+number), and judged records in the raw shape. The sentinel must flag a
+seeded 30% throughput regression, pass an in-band record, skip
+non-measurements — and pass the repo's real committed trajectory.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "bench_diff")
+
+_spec = importlib.util.spec_from_file_location(
+    "_bench_diff", os.path.join(REPO, "script", "bench_diff.py")
+)
+bench_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_diff)
+
+
+def fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+TRAJ = [
+    fx("traj_r0_artifact.json"),
+    fx("traj_r1.json"),
+    fx("traj_r2_outage.json"),
+    fx("traj_r3.json"),
+    fx("traj_r4.json"),
+]
+
+
+def run_cli(*argv):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "script", "bench_diff.py"), *argv],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    lines = [
+        json.loads(l) for l in proc.stdout.splitlines() if l.strip()
+    ]
+    return proc.returncode, lines
+
+
+class TestRecordLoading:
+    def test_unwraps_driver_shape_and_skips_failures(self):
+        rec = bench_diff.load_record(fx("traj_r1.json"))
+        assert rec["value"] == 1_280_000.0
+        assert bench_diff.is_valid(rec)
+        assert not bench_diff.is_valid(
+            bench_diff.load_record(fx("traj_r2_outage.json"))
+        )
+
+    def test_pre_protocol_artifact_is_not_a_baseline(self):
+        """The retracted round-1 5.25M dispatch-rate artifact (bench.py
+        round-2 MEASUREMENT NOTE) must never seed the baseline — the
+        schema gate is the flushed-protocol fields."""
+        rec = bench_diff.load_record(fx("traj_r0_artifact.json"))
+        assert rec["value"] > 5e6  # it LOOKS like a great baseline...
+        assert not bench_diff.is_valid(rec)  # ...and is rejected
+
+    def test_raw_record_shape_loads_too(self):
+        assert bench_diff.is_valid(bench_diff.load_record(fx("new_ok.json")))
+
+
+class TestDiffMath:
+    def _priors(self):
+        return [
+            bench_diff.load_record(fx(n))
+            for n in ("traj_r1.json", "traj_r3.json", "traj_r4.json")
+        ]
+
+    def test_seeded_30pct_regression_flagged(self):
+        new = bench_diff.load_record(fx("new_regressed.json"))
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert regressed
+        by_metric = {r["metric"]: r for r in rows}
+        assert by_metric["e2e_median_window"]["status"] == "REGRESSION"
+        assert by_metric["e2e_median_window"]["ratio"] == pytest.approx(
+            238_000.0 / 341_000.0, abs=0.01
+        )
+        # the device-only headline is in band — per-metric verdicts
+        assert by_metric["value"]["status"] == "ok"
+
+    def test_in_band_record_passes(self):
+        new = bench_diff.load_record(fx("new_ok.json"))
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        assert all(r["status"] == "ok" for r in rows)
+
+    def test_baseline_is_median_of_priors(self):
+        new = bench_diff.load_record(fx("new_ok.json"))
+        rows, _ = bench_diff.diff(new, self._priors())
+        by_metric = {r["metric"]: r for r in rows}
+        assert by_metric["value"]["baseline_median"] == 1_310_000.0
+
+    def test_band_widens_with_trajectory_noise_but_is_capped(self):
+        assert bench_diff.band_for([100.0, 100.0, 100.0], 0.2, 0.45) == 0.2
+        # a 25%-noisy trajectory earns a wider band (1.5 * max dev)...
+        assert bench_diff.band_for([100.0, 75.0, 104.0], 0.2, 0.45) == (
+            pytest.approx(0.375, abs=0.01)
+        )
+        # ...but can never alibi arbitrary regressions
+        assert bench_diff.band_for([100.0, 20.0], 0.2, 0.45) == 0.45
+
+    def test_improvement_never_flags(self):
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["value"] = 5_000_000.0
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        assert {r["metric"]: r for r in rows}["value"]["status"] == "improved"
+
+    def test_no_priors_means_no_baseline_pass(self):
+        new = bench_diff.load_record(fx("new_ok.json"))
+        rows, regressed = bench_diff.diff(new, [])
+        assert not regressed
+        assert all(r["status"] == "no-baseline" for r in rows)
+
+
+class TestCli:
+    def test_flags_seeded_regression_exit_1(self):
+        rc, lines = run_cli(
+            "--new", fx("new_regressed.json"), "--records", *TRAJ
+        )
+        assert rc == 1
+        assert lines[-1]["status"] == "REGRESSION"
+
+    def test_passes_in_band_record_exit_0(self):
+        rc, lines = run_cli("--new", fx("new_ok.json"), "--records", *TRAJ)
+        assert rc == 0
+        assert lines[-1]["status"] == "ok"
+        assert lines[-1]["priors"] == 3  # outage + artifact skipped
+
+    def test_default_mode_judges_newest_valid_against_earlier(self):
+        rc, lines = run_cli("--records", *TRAJ, fx("new_regressed.json"))
+        assert rc == 1  # newest valid record IS the regressed one
+
+    def test_passes_the_real_committed_trajectory(self):
+        """`make bench-diff` on this repo's BENCH_r*.json must be green
+        — the sentinel guards the trajectory without inventing a
+        regression out of the recorded history."""
+        rc, lines = run_cli()
+        assert rc == 0, lines
+        assert lines[-1]["status"] in ("ok", "no-valid-records")
+
+    def test_new_record_never_seeds_its_own_baseline(self):
+        """A committed-but-regressed record judged via --new must not
+        enter the priors it is compared against (it would pull the
+        median toward itself and widen the spread-derived band)."""
+        rc, lines = run_cli(
+            "--new", fx("new_regressed.json"),
+            "--records", *TRAJ, fx("new_regressed.json"),
+        )
+        assert rc == 1
+        assert lines[-1]["priors"] == 3  # itself excluded, outage+artifact skipped
+
+    def test_invalid_new_record_is_usage_error(self):
+        rc, _ = run_cli(
+            "--new", fx("traj_r2_outage.json"), "--records", *TRAJ
+        )
+        assert rc == 2

@@ -362,7 +362,9 @@ class TestUploadPipeline:
 
         pipe = bench.UploadPipeline(iter([P() for _ in range(7)]), T=3)
         got = list(pipe)
-        assert [(n, nb) for _sb, n, nb in got] == [(6, 7), (6, 7)]
+        assert [(n, nb) for _sb, n, nb, _fid in got] == [(6, 7), (6, 7)]
+        # no span sink installed -> no flow ids allocated
+        assert all(fid is None for _sb, _n, _nb, fid in got)
         # the 7th part is a trailing partial group: skipped + disclosed
         assert pipe.skipped_examples == 2
 

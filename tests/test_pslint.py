@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.join(REPO, "script"))
 from pslint.engine import Engine, SourceFile, default_rules  # noqa: E402
 from pslint.jitpure import JitPurityRule  # noqa: E402
 from pslint.locks import LockDisciplineRule  # noqa: E402
+from pslint.spans import SpanDisciplineRule  # noqa: E402
 from pslint.threads import ThreadLifecycleRule  # noqa: E402
 
 
@@ -544,6 +545,110 @@ class TestThreadLifecycle:
         assert findings == []
 
 
+class TestSpansPass:
+    def test_with_statement_span_passes(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            from parameter_server_tpu.telemetry import span, flow_scope
+
+            def timed(fid):
+                with flow_scope(fid), span("stage.prep", phase="e2e"):
+                    return 1
+            """,
+        )
+        findings, _ = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert findings == []
+
+    def test_bare_span_call_flagged(self, tmp_path):
+        """The PR-1 span-leak hazard: a bare span(...) builds a
+        generator that never runs — untimed block, and a stored ctx can
+        die with its owner and corrupt the timeline."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            from parameter_server_tpu.telemetry import span
+
+            def leaky():
+                span("stage.prep")
+                return 1
+            """,
+        )
+        findings, _ = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert [f.rule for f in findings] == ["span-with"]
+        assert findings[0].line == 5
+
+    def test_module_alias_span_flagged_and_with_passes(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            from parameter_server_tpu.telemetry import spans as telemetry_spans
+
+            def bad():
+                ctx = telemetry_spans.span("x")
+                with ctx:
+                    pass
+
+            def good():
+                with telemetry_spans.span("x"):
+                    pass
+            """,
+        )
+        findings, _ = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert [(f.rule, f.line) for f in findings] == [("span-with", 5)]
+
+    def test_enter_context_owns_the_span(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import contextlib
+            from parameter_server_tpu.telemetry import span
+
+            def stacked():
+                with contextlib.ExitStack() as stack:
+                    stack.enter_context(span("stage.prep"))
+            """,
+        )
+        findings, _ = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert findings == []
+
+    def test_regex_match_span_not_flagged(self, tmp_path):
+        """``re.Match.span()`` and other unrelated .span attributes must
+        never trip the rule."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import re
+
+            def bounds(m: "re.Match"):
+                return m.span(), m.span(1)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert findings == []
+
+    def test_suppressible_with_reason(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            from parameter_server_tpu.telemetry import span
+
+            def deferred():
+                # pslint: disable=span-with — handed to the reactor loop, which enters and closes it
+                return span("stage.prep")
+            """,
+        )
+        findings, suppressed = run_rule(tmp_path, SpanDisciplineRule(), rel)
+        assert findings == []
+        assert suppressed == 1
+
+
 class TestJitPurity:
     def test_pure_jit_passes(self, tmp_path):
         rel = write(
@@ -770,4 +875,5 @@ class TestRepoIsClean:
         assert proc.returncode == 0
         assert set(proc.stdout.split()) == {
             "locks", "threads", "jit-purity", "donation", "metrics",
+            "spans",
         }

@@ -271,10 +271,19 @@ def test_executor_telemetry_overhead_bounded():
 
     Steps carry realistic work (~100us of numpy) — the regime the bound
     protects; the per-step telemetry cost is a buffered record (one
-    small lock + append, flushed outside the hot path). Interleaved
-    paired chunks + median-of-ratios keep the comparison robust to this
-    box's scheduler noise; three attempts guard against a noisy burst
-    unlucky enough to span a whole attempt."""
+    small lock + append, flushed outside the hot path).
+
+    Measurement discipline (the ROADMAP bench invariant, same as
+    benchmarks/components.host_ingest_ab): this host's effective CPU
+    capacity flaps on a seconds timescale, so the quoted number is the
+    MEDIAN of BACK-TO-BACK PAIRED reps — each pair runs the on/off arms
+    adjacent in time (alternating order so drift cancels), and the
+    per-PAIR ratio divides out whatever capacity that moment had. The
+    old median(ons)/median(offs) compared medians of two *unpaired*
+    samples, which a capacity flap spanning half an attempt could skew
+    past the bound with both arms behaving — the flake this replaces.
+    Three attempts still guard against a burst swallowing a whole
+    attempt."""
     work = np.random.default_rng(0).random(262144)
 
     def one_chunk(ex, chunk=40):
@@ -289,17 +298,18 @@ def test_executor_telemetry_overhead_bounded():
         off = Executor(name=f"ovh_off_{tag}", telemetry=False)
         one_chunk(off, 10)
         one_chunk(on, 10)  # warm both paths
-        offs, ons = [], []
+        pair_ratios = []
         for i in range(16):
             if i % 2 == 0:  # alternate order so drift cancels
-                offs.append(one_chunk(off))
-                ons.append(one_chunk(on))
+                sec_off = one_chunk(off)
+                sec_on = one_chunk(on)
             else:
-                ons.append(one_chunk(on))
-                offs.append(one_chunk(off))
+                sec_on = one_chunk(on)
+                sec_off = one_chunk(off)
+            pair_ratios.append(sec_on / sec_off)
         off.stop()
         on.stop()
-        return statistics.median(ons) / statistics.median(offs)
+        return statistics.median(pair_ratios)
 
     ratios = []
     for i in range(3):
@@ -307,7 +317,8 @@ def test_executor_telemetry_overhead_bounded():
         if ratios[-1] <= 1.10:
             return
     pytest.fail(
-        f"telemetry overhead above 10% in all attempts: {ratios}"
+        f"telemetry overhead above 10% in all attempts "
+        f"(median of paired-rep ratios): {ratios}"
     )
 
 
