@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench trace bench-diff clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench trace bench-diff clean
 
 all: native
 
@@ -67,6 +67,17 @@ ingest-bench: native
 # "wire" with per-encoding link-bound ceilings)
 wire-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks wire
+
+# FTRL update-path benches (components): the sparse-touched XLA-rows
+# vs fused-Pallas-kernel A/B (embedded in every bench.py record under
+# "ftrl_sparse", with hbm_gb_s / frac-of-peak and the on-chip 10x
+# target), and the dense-formulation 8-update chain A/B whose
+# ftrl_dense_*_chain_* captures re-judge ops/ftrl.xla_min_slots.
+# CPU-runnable (fused arm falls back — shape truth, not a headline);
+# the on-chip watcher runs both via `make bench-all`.
+ftrl-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks ftrl_sparse_ab
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks ftrl_chain
 
 # request-path serving SLO bench (components bench): open-loop Poisson
 # load against the serving frontend — p50/p99/p99.9 at >=2 offered-load

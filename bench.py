@@ -577,6 +577,27 @@ def attach_wire(rec_or_headline: dict, smoke: bool) -> None:
         rec_or_headline["wire_error"] = f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def attach_ftrl(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the sparse-FTRL update A/B
+    (benchmarks/components.ftrl_sparse_ab — XLA rows path vs the fused
+    Pallas gather→update→scatter kernel, ops/ftrl_sparse.py) under
+    ``ftrl_sparse`` in every bench record: per-ministep ms for both
+    arms, median-of-paired-reps speedup, the disclosed bytes model with
+    ``hbm_gb_s``/``frac_of_peak``, and the on-chip 10x
+    ``ftrl_hbm_frac_of_peak`` target the next device capture is judged
+    against. On this CPU host the fused arm falls back to the rows path
+    (``fused_is_fallback``) — the record is shape truth, not a speedup
+    headline; never breaks a record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import ftrl_sparse_ab
+
+        rec_or_headline["ftrl_sparse"] = ftrl_sparse_ab(smoke)
+    except Exception as e:
+        rec_or_headline["ftrl_sparse_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def attach_serve(rec_or_headline: dict, smoke: bool) -> None:
     """Guarded embed of the request-path serving bench
     (benchmarks/components.serve_ab — the serving plane, doc/SERVING.md)
@@ -839,16 +860,9 @@ def build_device_error(
 
 
 # HBM peak bandwidth by device_kind (public spec sheets) for utilization
-# reporting; kinds not listed just omit the fraction
-HBM_PEAK_GB_S = {
-    "TPU v4": 1228.0,
-    "TPU v5 lite": 819.0,
-    "TPU v5e": 819.0,
-    "TPU v5": 2765.0,
-    "TPU v5p": 2765.0,
-    "TPU v6 lite": 1640.0,
-    "TPU v6e": 1640.0,
-}
+# reporting; kinds not listed just omit the fraction. ONE table, shared
+# with the component benches (ftrl_sparse_ab/ftrl_chain frac-of-peak).
+from parameter_server_tpu.benchmarks import HBM_PEAK_GB_S  # noqa: E402
 
 
 def tree_host_nbytes(prepped) -> int:
@@ -1597,6 +1611,8 @@ def run_real(args) -> int:
     attach_host_ingest(headline, args.smoke)
     _beat("wire")
     attach_wire(headline, args.smoke)
+    _beat("ftrl_sparse")
+    attach_ftrl(headline, args.smoke)
     _beat("serve")
     attach_serve(headline, args.smoke)
     _beat("e2e", **headline)
@@ -2036,6 +2052,11 @@ def run_synthetic(args) -> int:
     attach_host_ingest(headline, args.smoke)
     _beat("wire")
     attach_wire(headline, args.smoke)
+    # sparse-FTRL update A/B rides along (ROADMAP item 4): XLA rows
+    # path vs the fused Pallas kernel, with the on-chip frac-of-peak
+    # target stated in the record schema
+    _beat("ftrl_sparse")
+    attach_ftrl(headline, args.smoke)
     # serving-plane SLO bench rides along (open-loop p50/p99 + the
     # admission/coalescing evidence, doc/SERVING.md)
     _beat("serve")

@@ -1131,12 +1131,21 @@ def make_train_step_hashed(
 def sparse_update_min_slots() -> int:
     """``SGDConfig.update="auto"`` flip point, in PER-SERVER shard
     slots: below it the dense sweep wins (the whole-shard Pallas pass
-    is cheap — 2^28 trains at 446k ex/s); at and above it the
-    gather→apply→scatter row formulation wins (the sweep alone costs
-    ~130 ms at 2^30 while four 640k-row gathers/scatters cost ~80 ms,
-    BENCH_ONCHIP component medians) — and 2^31 REQUIRES it (the dense
-    gradient temp alone is 8.6 GB). Env ``PS_SPARSE_UPDATE_MIN_SLOTS``
-    overrides while on-chip captures refine the default."""
+    is cheap — 2^28 trains at 446k ex/s); at and above it the row
+    formulation wins — and 2^31 REQUIRES it (the dense gradient temp
+    alone is 8.6 GB). The current 2^30 default was derived from the
+    XLA rows path (~130 ms sweep at 2^30 vs ~80 ms for four 640k-row
+    gathers/scatters, BENCH_ONCHIP component medians). The fused
+    sparse kernel (ops/ftrl_sparse.py) moves the row side of that
+    comparison: once an on-chip ``ftrl_sparse`` A/B capture lands
+    (``make ftrl-bench`` / every bench record), re-derive as the
+    smallest shard where ``ftrl_sparse.fused_ms`` (at the training
+    uniq width) beats the dense sweep's per-ministep cost
+    (``step_phase_ftrl_update_ms`` at that shard) — the kernel only
+    LOWERS this threshold, it never raises it, so 2^30 stays a safe
+    default until the capture re-judges it (doc/PERFORMANCE.md, "FTRL
+    roofline"). Env ``PS_SPARSE_UPDATE_MIN_SLOTS`` overrides while
+    on-chip captures refine the default."""
     try:
         return int(os.environ.get("PS_SPARSE_UPDATE_MIN_SLOTS", 1 << 30))
     except ValueError:
@@ -2189,7 +2198,45 @@ class AsyncSGDWorker(ISGDCompNode):
             return metrics
 
         self._steps_since_snapshot += n_steps
+        self._note_ftrl_dispatch(prepped, n_steps)
         return self.submit(step, Task())
+
+    def _note_ftrl_dispatch(self, prepped, n_steps: int) -> None:
+        """Host-side FTRL update-path accounting (ps_ftrl_rows_total /
+        ps_ftrl_update_path_total): the path is STATIC per compiled
+        step (trace-time predicate), so the submit thread names it via
+        the same pure predicates the trace uses — an in-jit counter
+        would fire once at trace time and never again (pslint
+        jit-purity). No-op for non-FTRL/non-decay updaters and while
+        telemetry is off."""
+        from ...ops.ftrl import _use_pallas
+        from ...ops.ftrl_sparse import resolve_update_path
+        from ...telemetry.instruments import cached_ftrl_instruments
+        from .updaters import FTRLUpdater
+
+        tel = cached_ftrl_instruments()
+        if tel is None:
+            return
+        if not (
+            isinstance(self.updater, FTRLUpdater)
+            and self.updater.lr.type == LearningRate.DECAY
+        ):
+            return
+        shard = self.num_slots // meshlib.num_servers(self.mesh)
+        u = 0
+        if self._update_mode == "sparse":
+            u = int(
+                getattr(prepped, "uniq_pad", 0)
+                or getattr(prepped, "uslots", np.empty((0, 0))).shape[-1]
+            )
+        path = resolve_update_path(
+            self._update_mode, on_tpu=_use_pallas(), shard=shard, u=u,
+            bf16_n=self.updater.sqrt_n_dtype == jnp.bfloat16,
+            has_seed=True,  # _submit_prepped always threads a seed
+        )
+        rows = u if self._update_mode == "sparse" else shard
+        tel["path"].labels(path=path).inc(n_steps)
+        tel["rows"].inc(rows * n_steps)
 
     def _submit_fused(self, prepped: List[ELLBitsBatch], with_aux: bool) -> int:
         """The one fused-submit path both grouping APIs share."""

@@ -153,7 +153,8 @@ class SGDUpdater:
         return {"w": jnp.where(touched, w, state["w"]), "t": t}
 
 
-def apply_state_rows(updater, state, rel, ok, g_u, seed=None):
+def apply_state_rows(updater, state, rel, ok, g_u, seed=None, *,
+                     force_pallas=False, interpret=False):
     """Sparse-touched update: run ``updater.apply`` on just the gathered
     rows ``rel`` of a server shard and scatter the results back.
 
@@ -181,7 +182,49 @@ def apply_state_rows(updater, state, rel, ok, g_u, seed=None):
     can't perturb anything. Scalar state leaves (e.g. SGDUpdater's
     step count) take the updated value directly — there is nothing to
     scatter.
+
+    FTRL/decay takes the FUSED path when the shapes allow it
+    (ops/ftrl_sparse.py — one Pallas gather→update→scatter pass over
+    the touched 128-lane rows instead of four XLA dispatches, in-place
+    via input_output_aliases); ``use_sparse_kernel`` is the testable
+    path predicate and every fallback is bit-identical to the generic
+    gather/apply/scatter below. ``force_pallas``/``interpret`` pin the
+    kernel for parity tests and A/B sweeps (never onto a shape it
+    cannot tile).
     """
+    # the duplicate-free contract, asserted where it CAN be (concrete
+    # host arrays — direct calls and tests; traced production inputs
+    # are guaranteed by prep's slot-level np.unique): the update is
+    # nonlinear in the summed gradient, so a duplicated ok row would
+    # silently double-apply in BOTH formulations
+    if isinstance(rel, np.ndarray) and isinstance(ok, np.ndarray):
+        r = rel[np.asarray(ok, bool)]
+        assert len(np.unique(r)) == len(r), (
+            "apply_state_rows: rel must be duplicate-free among ok "
+            "entries (host prep dedups at slot level)"
+        )
+    from .learning_rate import LearningRate
+
+    if (
+        isinstance(updater, FTRLUpdater)
+        and updater.lr.type == LearningRate.DECAY
+        and state["z"].ndim == 1
+    ):
+        from ...ops import ftrl_sparse
+
+        if ftrl_sparse.use_sparse_kernel(
+            state["z"].shape[0], rel.shape[0],
+            updater.sqrt_n_dtype == jnp.bfloat16, seed is not None,
+            force_pallas,
+        ):
+            z_new, n_new = ftrl_sparse.ftrl_sparse_update(
+                state["z"], state["sqrt_n"], rel, ok, g_u,
+                alpha=updater.lr.alpha, beta=updater.lr.beta,
+                l1=updater.penalty.lambda1, l2=updater.penalty.lambda2,
+                seed=seed, force_pallas=force_pallas,
+                interpret=interpret,
+            )
+            return {"z": z_new, "sqrt_n": n_new}
     state_u = jax.tree.map(lambda a: a[rel] if a.ndim >= 1 else a, state)
     new_u = updater.apply(state_u, jnp.where(ok, g_u, 0.0), None, seed=seed)
     rel_u32 = rel.astype(jnp.uint32)

@@ -19,6 +19,20 @@ from typing import Callable, Dict
 
 REGISTRY: Dict[str, Callable[[bool], None]] = {}
 
+#: chip HBM peak bandwidth (GB/s) by jax device_kind — the roofline
+#: denominator for every frac-of-peak field (bench.py roofline_fields,
+#: components.ftrl_sparse_ab/ftrl_chain). Unknown kinds (CPU hosts)
+#: resolve to None and the frac field is reported as null, not faked.
+HBM_PEAK_GB_S = {
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5": 2765.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
 
 def benchmark(name: str):
     def deco(fn):

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import benchmark, report, timeit
+from . import HBM_PEAK_GB_S, benchmark, report, timeit
 
 
 def _mesh():
@@ -1279,3 +1279,249 @@ def trace_perf(smoke: bool = False) -> None:
     report("trace_events_captured", len(events), "events")
     report("trace_flows_correlated", summary["flows"].get("count", 0), "flows")
     report("trace_capture_events_per_sec", len(events) / capture_s, "events/sec")
+
+
+def _sparse_touch_pattern(p: int, u: int, seed: int = 0):
+    """A realistic deduped-touch draw for the sparse-update A/B: sorted
+    unique slot ids (prep's np.unique output shape) for ~7/8 of the
+    padded width, sentinel-style tail (clipped, ``ok`` False) for the
+    rest — the localize contract apply_state_rows sees."""
+    rng = np.random.default_rng(seed)
+    live = rng.choice(p, min(u - u // 8, p // 2), replace=False)
+    rel = np.full(u, p - 1, np.int32)
+    rel[: len(live)] = np.sort(live).astype(np.int32)
+    ok = np.zeros(u, bool)
+    ok[: len(live)] = True
+    g = rng.normal(size=u).astype(np.float32)
+    return rel, ok, g, len(live)
+
+
+def ftrl_sparse_ab(smoke: bool = False) -> dict:
+    """XLA-rows vs fused-kernel A/B for the sparse-touched FTRL update
+    (the ``update='sparse'`` big-table path, ops/ftrl_sparse.py).
+
+    Both arms run the DONATED form (the production configuration: the
+    fused step donates the table, so the kernel's in-place aliasing is
+    copy-free) over identical state and touch patterns:
+
+    - ``xla_rows`` — the gather→apply→scatter rows formulation
+      (``ftrl_sparse_rows_ref``, today's apply_state_rows path): four
+      separate XLA dispatches with intermediate row vectors.
+    - ``fused``    — the Pallas gather→update→scatter kernel: one pass,
+      scalar-prefetched row ids, double-buffered row DMAs, in-place
+      write-back. Off-TPU this arm falls back to the same rows path
+      (``fused_is_fallback: true`` — the A/B is then a record-shape
+      smoke, not a speedup claim; re-measure on chip).
+
+    Arms alternate back-to-back and the speedup quotes the MEDIAN of
+    paired ratios (this host's CPU capacity flaps seconds-scale — the
+    PR-3 bench discipline). ``hbm_gb_s``/``frac_of_peak`` use the
+    disclosed bytes model below; ``onchip_target`` states the roofline
+    goal the next device capture is judged against (ROADMAP item 4:
+    10x the 0.007-0.015 dense-sweep frac of BENCH_r05)."""
+    import time as _time
+
+    import jax
+
+    from ..ops.ftrl import _LANES, _use_pallas
+    from ..ops.ftrl_sparse import ftrl_sparse_rows_ref, ftrl_sparse_update
+
+    on_tpu = _use_pallas()
+    p = 1 << (18 if smoke else 22)
+    u = 1 << (11 if smoke else 16)
+    kw = dict(alpha=0.1, beta=1.0, l1=0.05, l2=0.0)
+    rel_h, ok_h, g_h, n_live = _sparse_touch_pattern(p, u)
+    rows_touched = len(np.unique(rel_h[ok_h] // _LANES))
+    rng = np.random.default_rng(1)
+    z0 = rng.normal(size=p).astype(np.float32)
+    n0 = np.abs(rng.normal(size=p)).astype(np.float32)
+    rel = jax.device_put(rel_h)
+    ok = jax.device_put(ok_h)
+    g = jax.device_put(g_h)
+
+    arms = {
+        "xla_rows": jax.jit(
+            lambda z, n: ftrl_sparse_rows_ref(z, n, rel, ok, g, **kw),
+            donate_argnums=(0, 1),
+        ),
+        "fused": jax.jit(
+            lambda z, n: ftrl_sparse_update(
+                z, n, rel, ok, g, **kw, force_pallas=on_tpu
+            ),
+            donate_argnums=(0, 1),
+        ),
+    }
+    boxes = {
+        name: [jax.device_put(z0.copy()), jax.device_put(n0.copy())]
+        for name in arms
+    }
+    for name, fn in arms.items():  # compile + warm, untimed
+        boxes[name] = list(fn(*boxes[name]))
+        jax.block_until_ready(boxes[name][0])
+
+    reps = 3 if smoke else 5
+    calls = 2 if smoke else 4
+    times = {name: [] for name in arms}
+    for _ in range(reps):
+        for name, fn in arms.items():
+            t0 = _time.perf_counter()
+            for _ in range(calls):
+                boxes[name] = list(fn(*boxes[name]))
+            jax.block_until_ready(boxes[name][0])
+            times[name].append((_time.perf_counter() - t0) / calls)
+    ratios = sorted(
+        x / f for x, f in zip(times["xla_rows"], times["fused"])
+    )
+    # medians for the headline ms too (not means): one capacity-flap
+    # rep would otherwise make the quoted ms pair contradict the
+    # paired-median speedup in the same record
+    sec = {k: sorted(v)[len(v) // 2] for k, v in times.items()}
+
+    # bytes model (disclosed, doc/PERFORMANCE.md "FTRL roofline"):
+    # every indexed access to the f32 tables moves a 512 B 128-lane row
+    # granule. fused: fetch + write-back of each DISTINCT touched row,
+    # z and √n, plus the in-program [U,128] gradient scatter (write +
+    # kernel read). xla_rows: 4 passes (gather z, gather √n, scatter
+    # z', scatter √n'), each touching U row granules (duplicates not
+    # deduped by XLA), plus the gathered/updated row vectors.
+    row_b = _LANES * 4
+    fused_bytes = rows_touched * row_b * 2 * 2 + u * row_b * 2
+    xla_bytes = 4 * u * row_b + 4 * u * 4
+    dev = jax.devices()[0]
+    peak = HBM_PEAK_GB_S.get(dev.device_kind)
+    fused_gb_s = fused_bytes / sec["fused"] / 1e9
+    out = {
+        "num_slots": p,
+        "uniq_pad": u,
+        "live_slots": n_live,
+        "rows_touched": rows_touched,
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "fused_is_fallback": not on_tpu,
+        "xla_rows_ms": round(sec["xla_rows"] * 1e3, 3),
+        "fused_ms": round(sec["fused"] * 1e3, 3),
+        "fused_speedup_median_paired": round(
+            ratios[len(ratios) // 2], 3
+        ),
+        "reps": reps,
+        "calls_per_rep": calls,
+        "bytes_model": {
+            "fused_bytes_per_ministep": int(fused_bytes),
+            "xla_rows_bytes_per_ministep": int(xla_bytes),
+            "note": "512 B row granule per indexed access; fused = "
+            "2 passes x distinct rows x {z,sqrt_n} + [U,128] grad "
+            "scatter; xla_rows = 4 single-array passes x U accesses",
+        },
+        "hbm_gb_s": round(fused_gb_s, 2),
+        "xla_rows_hbm_gb_s": round(xla_bytes / sec["xla_rows"] / 1e9, 2),
+        "hbm_peak_gb_s": peak,
+        "frac_of_peak": (
+            round(fused_gb_s / peak, 4) if peak else None
+        ),
+        # the record-schema statement of the on-chip goal: BENCH_r05
+        # measured the dense sweep at ftrl_hbm_frac_of_peak
+        # 0.007-0.015; the fused sparse kernel's acceptance bar on the
+        # next reachable-device capture is 10x that.
+        "onchip_target": {
+            "ftrl_hbm_frac_of_peak": ">= 0.07 (10x the 0.007-0.015 "
+            "BENCH_r05 dense-sweep capture)",
+            "measured_on": "next make bench-all with a reachable device",
+        },
+    }
+    return out
+
+
+@benchmark("ftrl_sparse_ab")
+def ftrl_sparse_perf(smoke: bool = False) -> None:
+    """Sparse-update A/B (see ftrl_sparse_ab). The same dict is
+    embedded in every bench.py record under ``ftrl_sparse``."""
+    out = ftrl_sparse_ab(smoke)
+    report("ftrl_sparse_xla_rows_ms", out["xla_rows_ms"], "ms")
+    report("ftrl_sparse_fused_ms", out["fused_ms"], "ms")
+    report(
+        "ftrl_sparse_fused_speedup",
+        out["fused_speedup_median_paired"], "x",
+    )
+    report("ftrl_sparse_fused_hbm_gb_s", out["hbm_gb_s"], "GB/s")
+    # `is not None`, NOT truthiness: a frac that rounds to 0.0 is a
+    # catastrophic roofline regression the capture must report
+    if out["frac_of_peak"] is not None:
+        report(
+            "ftrl_sparse_fused_frac_of_peak", out["frac_of_peak"],
+            "fraction",
+        )
+
+
+@benchmark("ftrl_chain")
+def ftrl_chain_perf(smoke: bool = False) -> None:
+    """Dense-formulation chain A/B: 8 chained FTRL updates per
+    dispatch, donated form — the corrected measurement the
+    ``ops/ftrl.xla_min_slots`` docstring has been awaiting. The
+    single-update on-chip captures (BENCH_ONCHIP 2026-08-02) were
+    confounded twice over: XLA inserted defensive whole-table copies
+    for the non-donated Pallas aliasing, and a ~14.5 ms per-dispatch
+    tunnel floor buried both arms. Chaining 8 updates inside ONE
+    donated dispatch amortizes the dispatch floor 8x and gives the
+    kernel its production aliasing, so the per-update delta is the
+    formulation difference. Emits ``ftrl_dense_{pallas,xla}_2e{K}_
+    chain_*`` — the metric names BENCH_ONCHIP.md's next ``make
+    bench-all`` capture appends, against which the 2^62 default is
+    re-judged (flip point = smallest size where the pallas per-update
+    median beats xla's; derivation in doc/PERFORMANCE.md)."""
+    import jax
+
+    from ..ops.ftrl import _use_pallas, ftrl_update, ftrl_update_ref
+
+    on_tpu = _use_pallas()
+    chain_len = 8
+    kw = dict(alpha=0.1, beta=1.0, l1=0.05, l2=0.0)
+    if smoke:
+        sizes = (1 << 14,)
+    elif on_tpu:
+        sizes = (1 << 24, 1 << 26, 1 << 28)
+    else:
+        sizes = (1 << 18, 1 << 20)
+
+    def make_chain(pallas: bool):
+        def chain(z, n, g):
+            for _ in range(chain_len):
+                if pallas:
+                    z, n = ftrl_update(z, n, g, None, **kw,
+                                       force_pallas=True)
+                else:
+                    z, n = ftrl_update_ref(z, n, g, None, **kw)
+            return z, n
+
+        return jax.jit(chain, donate_argnums=(0, 1))
+
+    for p in sizes:
+        tag = f"2e{p.bit_length() - 1}"
+        rng = np.random.default_rng(0)
+        z0 = rng.normal(size=p).astype(np.float32)
+        n0 = np.abs(rng.normal(size=p)).astype(np.float32)
+        g = jax.device_put(rng.normal(size=p).astype(np.float32))
+        arms = {"xla": make_chain(False)}
+        # off-TPU the forced-Pallas arm cannot run (no interpret in a
+        # timed bench); the xla arm still pins the record shape
+        if on_tpu:
+            arms["pallas"] = make_chain(True)
+        for name, fn in arms.items():
+            box = [jax.device_put(z0.copy()), jax.device_put(n0.copy())]
+            box = list(fn(*box, g))  # compile untimed
+            jax.block_until_ready(box[0])
+
+            def once(fn=fn, box=box):
+                box[:] = fn(*box, g)
+                jax.block_until_ready(box[0])
+
+            sec = timeit(once, 2 if smoke else 5, budget_s=30.0)
+            report(f"ftrl_dense_{name}_{tag}_chain_ms", sec * 1e3, "ms")
+            report(
+                f"ftrl_dense_{name}_{tag}_chain_per_update_ms",
+                sec / chain_len * 1e3, "ms",
+            )
+            # dense sweep traffic: z rw + sqrt_n rw = 16 B/slot/update
+            report(
+                f"ftrl_dense_{name}_{tag}_chain_gb_s",
+                16.0 * p * chain_len / sec / 1e9, "GB/s",
+            )

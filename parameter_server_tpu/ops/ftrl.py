@@ -43,16 +43,22 @@ def xla_min_slots() -> int:
     arm defensive whole-table copies for its input_output_aliases (the
     ftrl_update docstring's own warning) and buries both arms under a
     ~14.5 ms per-dispatch tunnel floor — so it cannot decide the flip,
-    and the default stays disabled on that methodology argument. A
-    corrected in-program chain A/B (8 chained updates per dispatch)
-    run the same day had Pallas ahead at every size, but its captures
-    were NOT retained in the repo, so they are deliberately not cited
-    as evidence here; the next ``make bench-all`` on a reachable
-    device appends ftrl_dense_*_chain_* captures to BENCH_ONCHIP.md
-    and is the committed measurement this default should be re-judged
-    against. Env ``PS_FTRL_XLA_MIN_SLOTS`` remains as the sweep
-    override; the value is baked at trace time per shape (jit static
-    caching)."""
+    and the default stays disabled on that methodology argument.
+
+    The corrected measurement is now COMMITTED as a registered bench:
+    ``benchmarks/components.ftrl_chain`` (``make ftrl-bench``; also in
+    every on-chip ``make bench-all``) chains 8 donated updates per
+    dispatch, which amortizes the dispatch floor 8x and gives the
+    kernel its production aliasing. Derivation once a device capture
+    lands in BENCH_ONCHIP.md: flip = the smallest sweep size whose
+    ``ftrl_dense_xla_2e{K}_chain_per_update_ms`` beats
+    ``ftrl_dense_pallas_2e{K}_chain_per_update_ms`` (sizes above the
+    crossover set this default; no crossover → stays 2^62). The
+    un-retained same-day chain run had Pallas ahead at every size,
+    predicting "no flip", but only a committed capture re-judges the
+    default (doc/PERFORMANCE.md, "FTRL roofline"). Env
+    ``PS_FTRL_XLA_MIN_SLOTS`` remains as the sweep override; the value
+    is baked at trace time per shape (jit static caching)."""
     try:
         return int(os.environ.get("PS_FTRL_XLA_MIN_SLOTS", 1 << 62))
     except ValueError:
@@ -76,24 +82,35 @@ def use_ref_path(p: int, bf16_n: bool, has_seed: bool,
     return p >= xla_min_slots()
 
 
+def dither_hash_u32(i: jnp.ndarray, seed) -> jnp.ndarray:
+    """THE dither stream: a counter-based integer hash of
+    (index, seed) — cheap, stateless, vectorized; rounding dither
+    needs uniformity, not cryptographic quality. ``i`` is a uint32
+    index array (position counters, or the sparse kernel's u-position
+    map); ``seed`` a uint32 scalar. Single copy shared by
+    :func:`stochastic_round_bf16`, :func:`_hash_dither_bits`, and the
+    sparse kernel's dither substitute (ops/ftrl_sparse.py), so the
+    interpret-mode parity contract — same (index, seed) in, same
+    dither out — cannot drift between the jnp path and a kernel."""
+    h = (i * np.uint32(2654435761)) ^ (
+        jnp.asarray(seed, jnp.uint32) * np.uint32(0x9E3779B9)
+    )
+    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
+    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
 def stochastic_round_bf16(x: jnp.ndarray, seed) -> jnp.ndarray:
     """Unbiased f32 -> bf16 narrowing (jnp path): add hash-derived
     uniform dither in [0, 2^16) to the f32 bits, then truncate the low
     mantissa bits. E[rounded] = x, so a bf16 accumulator performs an
-    unbiased walk instead of stalling by absorption. The dither is a
-    counter-based integer hash of (position, seed) — cheap, stateless,
-    vectorized; rounding dither needs uniformity, not cryptographic
-    quality. Values whose f32 form is already exactly bf16 (e.g.
-    untouched slots round-tripped through storage) are returned
-    unchanged for every dither draw."""
+    unbiased walk instead of stalling by absorption. The dither indexes
+    :func:`dither_hash_u32` by flat position. Values whose f32 form is
+    already exactly bf16 (e.g. untouched slots round-tripped through
+    storage) are returned unchanged for every dither draw."""
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     i = jax.lax.iota(jnp.uint32, max(1, x.size)).reshape(x.shape)
-    h = (i * np.uint32(2654435761)) ^ (
-        jnp.uint32(seed) * np.uint32(0x9E3779B9)
-    )
-    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
-    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
-    rnd = (h ^ (h >> 16)) & np.uint32(0xFFFF)
+    rnd = dither_hash_u32(i, jnp.uint32(seed)) & np.uint32(0xFFFF)
     out = (bits + rnd) & np.uint32(0xFFFF0000)
     return jax.lax.bitcast_convert_type(out, jnp.float32).astype(
         jnp.bfloat16
@@ -162,12 +179,7 @@ def _hash_dither_bits(seed_scalar, shape):
     for d in shape:
         n *= d
     i = jax.lax.iota(jnp.uint32, n).reshape(shape)
-    h = (i * np.uint32(2654435761)) ^ (
-        seed_scalar.astype(jnp.uint32) * np.uint32(0x9E3779B9)
-    )
-    h = (h ^ (h >> 15)) * np.uint32(0x85EBCA6B)
-    h = (h ^ (h >> 13)) * np.uint32(0xC2B2AE35)
-    return h ^ (h >> 16)
+    return dither_hash_u32(i, seed_scalar.astype(jnp.uint32))
 
 
 def _kernel_bf16(z_ref, n_ref, g_ref, t_ref, seed_ref, z_out, n_out, *,

@@ -315,6 +315,38 @@ def serve_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+#: update-path labels the FTRL dispatch records (ops/ftrl_sparse.py
+#: resolve_update_path): pallas_sparse (fused sparse kernel),
+#: xla_rows (gather→apply→scatter rows path), pallas_dense
+#: (whole-shard Pallas sweep), ref (jnp/XLA dense reference)
+FTRL_PATHS = ("pallas_sparse", "xla_rows", "pallas_dense", "ref")
+
+
+def ftrl_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """FTRL update-path accounting (ops/ftrl.py + ops/ftrl_sparse.py).
+
+    The path decision is STATIC per compiled step (a trace-time
+    predicate — ``use_ref_path`` / ``use_sparse_kernel``), so these
+    counters are incremented on the HOST at submit time (jit-purity:
+    an in-kernel counter would fire once at trace and never again);
+    they say which update formulation the training traffic actually
+    rode, next to the ``ftrl_sparse`` A/B in bench records."""
+    return {
+        "rows": reg.ensure_counter(
+            "ps_ftrl_rows_total",
+            "state rows moved per submitted FTRL ministep — the "
+            "deduped gather width (sparse formulations) or the "
+            "whole-shard sweep width (dense)",
+        ),
+        "path": reg.ensure_counter(
+            "ps_ftrl_update_path_total",
+            "FTRL ministeps dispatched, by resolved update path "
+            "(pallas_sparse / xla_rows / pallas_dense / ref)",
+            labelnames=("path",),
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -383,11 +415,13 @@ def _cached_family(family_fn):
 
 # the one cache per hot-path family: data plane (kv_ops pushes,
 # KVMap/KVLayer steps, KeyDirectory slot cache), request path
-# (admission, coalescer, replica, frontend workers), and wire
-# (encode_exact, UploadCache)
+# (admission, coalescer, replica, frontend workers), wire
+# (encode_exact, UploadCache), and the per-ministep FTRL path counter
+# (AsyncSGDWorker._submit_prepped)
 cached_kvops_instruments = _cached_family(kvops_instruments)
 cached_serve_instruments = _cached_family(serve_instruments)
 cached_wire_instruments = _cached_family(wire_instruments)
+cached_ftrl_instruments = _cached_family(ftrl_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -398,6 +432,7 @@ INSTRUMENT_FAMILIES = (
     ingest_instruments,
     wire_instruments,
     serve_instruments,
+    ftrl_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -31,6 +31,7 @@ import sys
 SCOPE = (
     "parameter_server_tpu/ops/kv_ops.py",
     "parameter_server_tpu/ops/ftrl.py",
+    "parameter_server_tpu/ops/ftrl_sparse.py",
     "parameter_server_tpu/parameter/parameter.py",
     "parameter_server_tpu/parameter/kv_vector.py",
     "parameter_server_tpu/parameter/kv_map.py",

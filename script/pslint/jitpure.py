@@ -39,6 +39,7 @@ from .engine import Finding, Rule, SourceFile
 SCOPE = (
     "parameter_server_tpu/ops/kv_ops.py",
     "parameter_server_tpu/ops/ftrl.py",
+    "parameter_server_tpu/ops/ftrl_sparse.py",
     "parameter_server_tpu/ops/quantize.py",
     "parameter_server_tpu/ops/flash_attention.py",
 )

@@ -15,6 +15,7 @@ import pytest
 
 from parameter_server_tpu.ops.flash_attention import flash_attention, flash_mha
 from parameter_server_tpu.ops.ftrl import ftrl_update
+from parameter_server_tpu.ops.ftrl_sparse import ftrl_sparse_update
 from parameter_server_tpu.ops.quantize import quantize
 
 
@@ -148,6 +149,56 @@ def test_ftrl_bf16_kernel_lowers():
     lower_tpu(
         fn, Z(p), Z(p, jnp.bfloat16), Z(p), Z(p, jnp.bool_),
         jnp.uint32(3),
+    )
+
+
+def test_ftrl_sparse_kernel_lowers():
+    """The fused sparse gather→update→scatter kernel: scalar-prefetched
+    row ids, manual double-buffered row DMAs from/to ANY-space refs,
+    aliased in-place outputs — all must survive real Mosaic rules."""
+    p, u = 1 << 14, 1024
+
+    def fn(z, n, rel, ok, g):
+        return ftrl_sparse_update(
+            z, n, rel, ok, g, alpha=0.1, beta=1.0, l1=1.0, l2=0.1,
+            force_pallas=True,
+        )
+
+    lower_tpu(fn, Z(p), Z(p), Z(u, jnp.int32), Z(u, jnp.bool_), Z(u))
+
+
+def test_ftrl_sparse_bf16_kernel_lowers():
+    """bf16-sqrt_n sparse variant: on-core PRNG stochastic narrow +
+    bf16 row DMAs (256 B) next to the f32 z rows."""
+    p, u = 1 << 14, 1024
+
+    def fn(z, n, rel, ok, g, seed):
+        return ftrl_sparse_update(
+            z, n, rel, ok, g, alpha=0.1, beta=1.0, l1=1.0, l2=0.1,
+            seed=seed, force_pallas=True,
+        )
+
+    lower_tpu(
+        fn, Z(p), Z(p, jnp.bfloat16), Z(u, jnp.int32), Z(u, jnp.bool_),
+        Z(u), jnp.uint32(3),
+    )
+
+
+def test_ftrl_sparse_donated_step_lowers():
+    """The production form: an enclosing donated jit around the aliased
+    kernel (what the fused train step compiles to)."""
+    p, u = 1 << 14, 1024
+
+    def fn(z, n, rel, ok, g):
+        return ftrl_sparse_update(
+            z, n, rel, ok, g, alpha=0.1, beta=1.0, l1=1.0, l2=0.1,
+            force_pallas=True,
+        )
+
+    import jax.export  # noqa: F401
+
+    jax.export.export(jax.jit(fn, donate_argnums=(0, 1)), platforms=["tpu"])(
+        Z(p), Z(p), Z(u, jnp.int32), Z(u, jnp.bool_), Z(u)
     )
 
 
