@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench trace bench-diff clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench trace bench-diff clean
 
 all: native
 
@@ -87,6 +87,16 @@ ftrl-bench: native
 # every bench.py record under "serve")
 serve-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks serve
+
+# chaos-plane recovery drill (components bench, doc/ROBUSTNESS.md):
+# kill a server shard via injected heartbeat silence under concurrent
+# train+serve load — detection/recovery/MTTR, requests
+# degraded/shed/failed, replayed-update count, and the post-recovery
+# trajectory bit-parity verdict vs an undisturbed run (fast,
+# CPU-runnable, deterministic under the drill seed; the same dict is
+# embedded in every bench.py record under "recovery")
+chaos-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks recovery_drill
 
 # capture a short synthetic run's flow-correlated timeline and export
 # it as Chrome trace / Perfetto JSON (open at https://ui.perfetto.dev;

@@ -620,6 +620,31 @@ def attach_serve(rec_or_headline: dict, smoke: bool) -> None:
         rec_or_headline["serve_error"] = f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def attach_recovery(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the kill-one-shard recovery drill
+    (benchmarks/components.recovery_drill — the chaos plane,
+    doc/ROBUSTNESS.md) under ``recovery`` in every bench record:
+    detection/recovery/MTTR wall times for an injected shard death
+    under concurrent train+serve load, replayed-update count, the
+    degraded/shed/failed serve accounting, the post-recovery
+    bit-parity verdict, and the disarmed-overhead paired check. This
+    section is DRILL METADATA, not a throughput metric —
+    script/bench_diff.py's sentinel explicitly excludes it from
+    banding (METADATA_SECTIONS); never breaks a record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import recovery_drill
+
+        # parked: the drill fires its own serve traffic and three span
+        # events per request would load the dead-window latencies —
+        # and flood the bench trace with off-window chaos flows
+        with telemetry_spans.parked_sink():
+            rec_or_headline["recovery"] = recovery_drill(smoke)
+    except Exception as e:
+        rec_or_headline["recovery_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
@@ -1615,6 +1640,8 @@ def run_real(args) -> int:
     attach_ftrl(headline, args.smoke)
     _beat("serve")
     attach_serve(headline, args.smoke)
+    _beat("recovery")
+    attach_recovery(headline, args.smoke)
     _beat("e2e", **headline)
 
     def host_prepped():
@@ -2061,6 +2088,10 @@ def run_synthetic(args) -> int:
     # admission/coalescing evidence, doc/SERVING.md)
     _beat("serve")
     attach_serve(headline, args.smoke)
+    # chaos-plane recovery drill rides along (kill-one-shard MTTR +
+    # bit-parity + degraded/shed accounting, doc/ROBUSTNESS.md)
+    _beat("recovery")
+    attach_recovery(headline, args.smoke)
     # disclose which wire the e2e stream actually rode (the flip's
     # whole point is that BENCH_r06 stops quoting the raw bits bytes)
     headline["e2e_wire"] = {

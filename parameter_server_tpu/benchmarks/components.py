@@ -1281,6 +1281,441 @@ def trace_perf(smoke: bool = False) -> None:
     report("trace_capture_events_per_sec", len(events) / capture_s, "events/sec")
 
 
+def _drill_batch(seed: int, i: int, key_space: int, n: int, k: int):
+    """Deterministic training batch ``i`` — regenerable by index, which
+    is what lets the recovery handler REPLAY acked-but-unbacked updates
+    instead of journaling arrays (doc/ROBUSTNESS.md "The drill")."""
+    rng = np.random.default_rng((seed << 20) + i)
+    keys = rng.integers(0, key_space, n).astype(np.int64)
+    vals = rng.normal(size=(n, k)).astype(np.float32)
+    return keys, vals
+
+
+def recovery_drill(smoke: bool = False) -> dict:
+    """Kill-one-shard recovery drill under concurrent train + serve load
+    (doc/ROBUSTNESS.md — ROADMAP item 2's acceptance drill, embedded in
+    every bench record under ``recovery``).
+
+    The script, all under live load (a paced training push stream and a
+    closed-loop serving client against the SAME store):
+
+    1. **healthy** — periodic consistent replica backups
+       (``ReplicaManager.start_periodic`` → snapshot steps THROUGH the
+       store executor, so donated pushes can't tear them) while the
+       trainer acks pushes and serving reads live.
+    2. **kill** — the backup stream stops, then ``S0`` dies the way real
+       shards die: its heartbeats stop arriving (injected
+       ``heartbeat.report`` silence), its table is wiped (the
+       replacement starts empty), and the serving store path starts
+       failing (``serve.pull`` / ``serve.refresh`` faults). Serving
+       DEGRADES to the stale read replica (503-distinct accounting)
+       instead of erroring; training keeps acking into the void —
+       exactly the updates the replay contract must not lose.
+    3. **detect + recover** — the RecoveryCoordinator's poll declares
+       S0 dead after the heartbeat timeout; the server-death handler
+       parks the trainer (bounded-delay semantics: survivors stop
+       pushing while the shard recovers), installs the last consistent
+       snapshot through the executor, REPLAYS every acked push past the
+       snapshot's barrier timestamp in original order, then re-arms the
+       store path and resumes.
+    4. **verify** — after the stream completes, the drilled table must
+       be BIT-identical to an undisturbed run of the same batch
+       sequence: zero lost *acknowledged* updates, to the bit.
+
+    Also measured: detection / recovery / MTTR wall times, serve
+    requests completed/degraded/shed/failed, and the disarmed-overhead
+    paired check (fault points present-but-disarmed vs stripped) that
+    keeps the "zero overhead when disarmed" claim honest.
+    """
+    import threading
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import mesh as meshlib
+    from ..parameter.kv_vector import KVVector
+    from ..parameter.replica import ReplicaManager
+    from ..serving import (
+        DegradedError,
+        PullRequest,
+        RejectedError,
+        ServeConfig,
+        ServeFrontend,
+    )
+    from ..system import faults
+    from ..system.heartbeat import HeartbeatCollector, HeartbeatReport
+    from ..system.recovery import RecoveryCoordinator
+
+    mesh = _mesh()
+    seed = 7
+    k = 4
+    num_slots = 1 << (10 if smoke else 12)
+    key_space = 1 << 16
+    n_per_batch = 64
+    # the stream must OUTLIVE detection in every mode: the drill's
+    # whole point is recovery under live load, so the trainer has to
+    # still be pushing when the handler parks it. Post-kill batches x
+    # (>=4ms pacing) must exceed hb_timeout + poll + margin — with
+    # 100+ post-kill batches at >=4ms the park is guaranteed even in
+    # smoke (the record's trainer_parked field pins it in CI).
+    n_batches = 120 if smoke else 240
+    kill_at = n_batches // 6
+    hb_timeout = 0.3
+
+    def batch(i: int):
+        return _drill_batch(seed, i, key_space, n_per_batch, k)
+
+    def push_and_ack(kv, i: int) -> int:
+        keys, vals = batch(i)
+        ts = kv.push(kv.request(channel=0), keys=keys, values=vals)
+        kv.executor.wait(ts, timeout=60)
+        return ts
+
+    # -- the undisturbed reference trajectory (also warms every jit:
+    # push scatter-add, gather, snapshot copy — so compile stalls can't
+    # eat the drill's heartbeat margin) --
+    kv_ref = KVVector(
+        mesh=mesh, k=k, num_slots=num_slots, hashed=True, name="drill_ref"
+    )
+    for i in range(n_batches):
+        push_and_ack(kv_ref, i)
+    t_ref = np.array(kv_ref.table(0, copy=True))
+    kv_ref.executor.stop()
+
+    # -- the drilled store + chaos-plane wiring --
+    faults.reset()
+    kv = KVVector(
+        mesh=mesh, k=k, num_slots=num_slots, hashed=True, name="drill_live"
+    )
+    rm = ReplicaManager()
+    rm.backup_consistent(kv)  # a snapshot exists before any fault can
+    rm.start_periodic(kv, interval_s=0.04)
+
+    collector = HeartbeatCollector(timeout=hb_timeout)
+    rc = RecoveryCoordinator(collector, handler_retry=None)  # replay is
+    # not idempotent: a partial replay retried would double-apply, so
+    # the drill's handler runs exactly once and fails loudly instead
+
+    fe = ServeFrontend(
+        kv,
+        ServeConfig(
+            replica="fallback",  # live-first reads; replica = degraded path
+            replica_refresh_s=0.15,
+            live_pull_deadline_s=2.0,
+            degraded_max_staleness_s=60.0,
+            workers=2,
+            max_queue_depth=256,
+        ),
+    ).start()
+    rng = np.random.default_rng(seed + 1)
+    u = rng.random((128, 16))
+    pool = (u * u * u * key_space).astype(np.int64)  # hot-headed draws
+    fe.submit(PullRequest(keys=pool[0])).result(30)  # warm the pull lane
+
+    counts = {"ok": 0, "shed": 0, "failed": 0}  # serve-thread-only writes
+    stop_serve = threading.Event()
+
+    def serve_loop() -> None:
+        i = 0
+        while not stop_serve.is_set():
+            try:
+                fe.submit(PullRequest(keys=pool[i % len(pool)])).result(10)
+                counts["ok"] += 1
+            except RejectedError:
+                counts["shed"] += 1
+            except Exception:  # DegradedError and organic failures both
+                counts["failed"] += 1  # count here; degraded SUCCESSES
+                # are counted by the frontend (degraded_served)
+            i += 1
+            _time.sleep(0.002)
+
+    acked: list = []  # (push ts, batch index); guarded-by: ack_lock
+    ack_lock = threading.Lock()
+    pause_req = threading.Event()
+    parked = threading.Event()
+    train_err: list = []
+
+    def trainer() -> None:
+        try:
+            for i in range(n_batches):
+                if pause_req.is_set():
+                    parked.set()
+                    while pause_req.is_set():
+                        _time.sleep(0.002)
+                    parked.clear()
+                ts = push_and_ack(kv, i)
+                with ack_lock:
+                    acked.append((ts, i))
+                _time.sleep(0.004)  # paced: a continuous live stream,
+                # not a burst that outruns the detection window
+        except BaseException as e:  # surfaced after join
+            train_err.append(e)
+
+    stop_beat = threading.Event()
+
+    def beater() -> None:
+        while not stop_beat.wait(0.04):
+            collector.report("S0", HeartbeatReport(hostname="S0"))
+            collector.report("W0", HeartbeatReport(hostname="W0"))
+
+    t_kill = [0.0]
+    t_detect = [0.0]
+    t_recovered = [0.0]
+    replayed = [0]
+    barrier_used = [-1]
+    trainer_parked = [False]
+
+    trainer_t = threading.Thread(target=trainer, name="drill-trainer")
+
+    def on_server_dead(nid: str) -> None:
+        if t_kill[0] == 0.0:
+            # a loaded host can stall the beater past the heartbeat
+            # timeout BEFORE the drill killed anything — that is a
+            # false positive, and consuming the exactly-once handler
+            # on it would mask the real kill. Revive and keep watching.
+            rc.revive(nid)
+            return
+        t_detect[0] = _time.perf_counter()
+        # bounded-delay semantics: survivors stop pushing while the
+        # shard recovers (park the trainer between batches)
+        pause_req.set()
+        while not parked.is_set() and trainer_t.is_alive():
+            _time.sleep(0.002)
+        # the under-live-load property CI pins: the trainer was ALIVE
+        # and parked (not already finished) when recovery began
+        trainer_parked[0] = parked.is_set()
+        rec_ok = rm.recover(kv, through_executor=True)
+        assert rec_ok, "no replica snapshot to recover from"
+        barrier = rm.barrier(kv.name).get(0, -1)
+        barrier_used[0] = barrier
+        with ack_lock:
+            replay = [(ts, i) for ts, i in acked if ts > barrier]
+        for _, i in replay:  # original order — FP addition must re-run
+            push_and_ack(kv, i)  # in the exact sequence it first ran
+        replayed[0] = len(replay)
+        # the replacement shard is up: store path + heartbeats return
+        faults.disarm("serve.pull")
+        faults.disarm("serve.refresh")
+        faults.disarm("heartbeat.report")
+        t_recovered[0] = _time.perf_counter()
+        pause_req.clear()
+
+    rc.on_server_dead(on_server_dead)
+    collector.report("S0", HeartbeatReport(hostname="S0"))
+    collector.report("W0", HeartbeatReport(hostname="W0"))
+
+    serve_t = threading.Thread(target=serve_loop, name="drill-serve")
+    beat_t = threading.Thread(target=beater, name="drill-beater")
+    degraded_probes = 0
+    try:
+        beat_t.start()
+        rc.start(interval=0.03)
+        trainer_t.start()
+        serve_t.start()
+
+        # phase 1 (healthy): run until the kill point has been ACKED
+        while True:
+            with ack_lock:
+                n_acked = len(acked)
+            if n_acked >= kill_at or train_err:
+                break
+            _time.sleep(0.005)
+        if train_err:
+            raise train_err[0]
+
+        # phase 2 (kill): the dead shard's backup stream stops FIRST —
+        # a crashed node cannot keep snapshotting — then make sure at
+        # least one acked update postdates the final barrier (the
+        # replay set must be provably non-empty)
+        rm.stop_periodic()
+        barrier_before = rm.barrier(kv.name).get(0, -1)
+        replay_deadline = _time.perf_counter() + 30
+        while True:
+            with ack_lock:
+                if any(ts > barrier_before for ts, _ in acked):
+                    break
+            assert trainer_t.is_alive() and (
+                _time.perf_counter() < replay_deadline
+            ), "no acked update ever postdated the final backup barrier"
+            _time.sleep(0.002)
+        faults.arm("heartbeat.report", kind="silence", match="S0")
+        faults.arm("serve.pull", kind="raise")
+        faults.arm("serve.refresh", kind="raise")
+        t_kill[0] = _time.perf_counter()
+        # wipe the shard through the executor (the replacement starts
+        # empty; the submitted step serializes with in-flight pushes)
+        zeros = jax.device_put(
+            jnp.zeros((kv.num_slots, kv.k), kv.dtype),
+            meshlib.table_sharding(kv.mesh),
+        )
+        kv.executor.wait(
+            kv.submit(lambda: kv.set_table(0, zeros), kv.request(channel=0)),
+            timeout=60,
+        )
+        # deterministic degraded evidence: requests in the dead window
+        # must be ANSWERED (stale) — the 503-vs-429 story, measured
+        for j in range(3):
+            try:
+                fe.submit(PullRequest(keys=pool[j])).result(10)
+                degraded_probes += 1
+            except Exception:
+                pass
+
+        # phase 3: detection + recovery run on the coordinator thread;
+        # phase 4: the trainer finishes the stream
+        deadline = _time.perf_counter() + 90
+        while t_recovered[0] == 0.0 and _time.perf_counter() < deadline:
+            _time.sleep(0.005)
+        assert t_recovered[0] > 0.0, "recovery never completed"
+        trainer_t.join(timeout=120)
+        assert not trainer_t.is_alive(), "trainer wedged"
+        if train_err:
+            raise train_err[0]
+    finally:
+        faults.reset()
+        rm.stop_periodic()
+        stop_serve.set()
+        stop_beat.set()
+        rc.stop()
+        for t in (serve_t, beat_t, trainer_t):
+            if t.ident is not None:
+                t.join(timeout=60)
+        fe.close()
+
+    kv.executor.wait_all(pop=False, timeout=60)
+    t_drill = np.array(kv.table(0, copy=True))
+    fe_stats = fe.stats()
+    kv.executor.stop()
+    bit_identical = (
+        t_ref.dtype == t_drill.dtype
+        and t_ref.shape == t_drill.shape
+        and t_ref.tobytes() == t_drill.tobytes()
+    )
+
+    # -- disarmed-overhead paired check: the SAME push stream with the
+    # fault points live-but-disarmed vs check() stubbed out (the
+    # no-call-sites counterfactual), back-to-back per rep, median of
+    # paired ratios (ROADMAP bench discipline) --
+    kv2 = KVVector(
+        mesh=mesh, k=k, num_slots=1 << 10, hashed=True, name="drill_ovh"
+    )
+    okeys, ovals = batch(0)
+
+    def ovh_stream(m: int = 24) -> None:
+        for _ in range(m):
+            kv2.executor.wait(
+                kv2.push(kv2.request(channel=0), keys=okeys, values=ovals)
+            )
+
+    ovh_stream()  # warm
+    real_check = faults.check
+    ratios = []
+    reps = 3 if smoke else 5
+    for _ in range(reps):
+        # both orders inside one rep (disarmed, stripped, stripped,
+        # disarmed) so a monotone capacity drift on this flapping host
+        # cancels out of the paired ratio instead of biasing it
+        t0 = _time.perf_counter()
+        ovh_stream()
+        disarmed_s = _time.perf_counter() - t0
+        faults.check = lambda point, detail=None: None  # stripped arm
+        try:
+            t0 = _time.perf_counter()
+            ovh_stream()
+            ovh_stream()
+            stripped_s = (_time.perf_counter() - t0) / 2
+        finally:
+            faults.check = real_check
+        t0 = _time.perf_counter()
+        ovh_stream()
+        disarmed_s = (disarmed_s + (_time.perf_counter() - t0)) / 2
+        ratios.append(disarmed_s / max(stripped_s, 1e-9))
+    kv2.executor.stop()
+    # the stream ratio is hostage to this host's seconds-scale capacity
+    # flap (ROADMAP bench discipline), so ALSO time the disarmed check
+    # itself — a tight-loop ns/call that a flap cannot fake. This is
+    # the per-step cost every fault point adds when nothing is armed.
+    n_calls = 200_000
+    t0 = _time.perf_counter()
+    for _ in range(n_calls):
+        faults.check("executor.step")
+    check_ns = (_time.perf_counter() - t0) / n_calls * 1e9
+
+    return {
+        "config": {
+            "n_batches": n_batches,
+            "kill_at_batch": kill_at,
+            "keys_per_batch": n_per_batch,
+            "k": k,
+            "num_slots": num_slots,
+            "backup_interval_s": 0.04,
+            "heartbeat_timeout_s": hb_timeout,
+        },
+        "detection_ms": round((t_detect[0] - t_kill[0]) * 1e3, 1),
+        "recovery_ms": round((t_recovered[0] - t_detect[0]) * 1e3, 1),
+        "mttr_ms": round((t_recovered[0] - t_kill[0]) * 1e3, 1),
+        "replayed_updates": replayed[0],
+        "acked_updates": n_batches,
+        "barrier_ts": barrier_used[0],
+        "backup_version_used": (rm.meta(kv.name) or {}).get("version"),
+        "trainer_parked": trainer_parked[0],
+        "trajectory_bit_identical": bool(bit_identical),
+        "serve": {
+            "requests": counts["ok"] + counts["shed"] + counts["failed"],
+            "completed_ok": counts["ok"],
+            "degraded_served": fe_stats["degraded_served"],
+            "degraded_probes_in_dead_window": degraded_probes,
+            "shed": counts["shed"],
+            "failed": counts["failed"],
+        },
+        "disarmed_overhead": {
+            "reps": reps,
+            "ratio_median": round(float(np.median(ratios)), 3),
+            "check_ns_per_call": round(check_ns, 1),
+        },
+    }
+
+
+@benchmark("recovery_drill")
+def recovery_drill_perf(smoke: bool = False) -> None:
+    """The chaos-plane headline (``make chaos-bench``): injected shard
+    death under live train+serve load must be detected, degraded
+    around, and recovered with zero lost acknowledged updates — the
+    post-drill table bit-identical to an undisturbed run. Reported
+    times are this host's; the same drill shape runs on chip."""
+    out = recovery_drill(smoke)
+    assert out["trajectory_bit_identical"], (
+        "post-recovery trajectory diverged from the undisturbed run — "
+        "acknowledged updates were lost"
+    )
+    assert out["replayed_updates"] > 0, (
+        "drill proved nothing: no acked update postdated the barrier"
+    )
+    assert out["trainer_parked"], (
+        "drill proved nothing: the trainer finished before detection, "
+        "so recovery never ran against live load — size n_batches/"
+        "pacing so the stream outlives the heartbeat timeout"
+    )
+    report("recovery_detection_ms", out["detection_ms"], "ms")
+    report("recovery_recovery_ms", out["recovery_ms"], "ms")
+    report("recovery_mttr_ms", out["mttr_ms"], "ms")
+    report("recovery_replayed_updates", out["replayed_updates"], "updates")
+    report(
+        "recovery_serve_degraded",
+        out["serve"]["degraded_served"], "requests",
+    )
+    report(
+        "recovery_disarmed_overhead_ratio",
+        out["disarmed_overhead"]["ratio_median"], "x",
+    )
+    report(
+        "recovery_disarmed_check_ns",
+        out["disarmed_overhead"]["check_ns_per_call"], "ns/call",
+    )
+    report("recovery_bit_identical", 1.0, "bool")
+
+
 def _sparse_touch_pattern(p: int, u: int, seed: int = 0):
     """A realistic deduped-touch draw for the sparse-update A/B: sorted
     unique slot ids (prep's np.unique output shape) for ~7/8 of the

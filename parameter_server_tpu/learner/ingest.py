@@ -36,6 +36,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterator, Optional
 
+from ..system import faults
 from ..telemetry import spans as telemetry_spans
 from ..utils.concurrent import OrderedStagePool, iter_on_thread
 
@@ -151,6 +152,11 @@ class IngestPipeline:
             yield (fid, batch) if self._trace else batch
 
     def _prep(self, item):
+        # fault point (doc/ROBUSTNESS.md): an armed raise dies mid-batch
+        # on a POOL WORKER thread — exercising the pool's contract that
+        # worker exceptions forward to the consumer at the position they
+        # occurred and close() still joins every thread
+        faults.inject("ingest.prep", detail=self._name)
         if self._trace:
             fid, batch = item
             with telemetry_spans.flow_scope(fid):

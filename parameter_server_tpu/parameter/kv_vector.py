@@ -298,6 +298,24 @@ class KVVector(Parameter):
         self.executor.wait_all(pop=False)
         return {ch: np.asarray(c.table) for ch, c in self._channels.items()}
 
+    def get_replica_consistent(self) -> "tuple[dict, dict]":
+        """Tear-free host snapshot THROUGH the executor: one submitted
+        ``snapshot`` copy step per channel, so the copy serializes with
+        in-flight donated pushes in timestamp order — no drain, no
+        quiesce, safe under a live training stream (unlike
+        ``get_replica``'s drain-then-copy, which assumes the caller
+        stopped submitting). Returns ``(snapshot, barrier)``: barrier
+        maps channel → the snapshot step's executor timestamp; every
+        push submitted before it (lower ts) is IN the snapshot, every
+        later one is not — the replay contract the recovery drill
+        exercises (ReplicaManager.backup_consistent)."""
+        barrier = {ch: self.snapshot(ch) for ch in list(self._channels)}
+        snap = {
+            ch: np.asarray(self.executor.wait(ts))
+            for ch, ts in barrier.items()
+        }
+        return snap, barrier
+
     def set_replica(self, snapshot: dict) -> None:
         for ch, arr in snapshot.items():
             c = self.channel(ch)

@@ -107,6 +107,16 @@ class Parameter(Customer):
     def set_replica(self, snapshot: dict) -> None:
         pass
 
+    def get_replica_consistent(self) -> "tuple[dict, dict]":
+        """``(snapshot, barrier)`` where the snapshot is safe to take
+        under concurrent submissions and ``barrier`` maps channel →
+        the executor timestamp the snapshot was taken at (every step
+        with a lower timestamp is inside it). Stores with a submitted
+        snapshot step override this (KVVector); the base fallback is
+        the drain-then-copy ``get_replica`` with no barrier info —
+        correct only for quiesced callers, exactly like ``backup()``."""
+        return self.get_replica(), {}
+
     def recover(self, snapshot: dict) -> None:
         self.set_replica(snapshot)
 

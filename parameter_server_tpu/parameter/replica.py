@@ -12,12 +12,18 @@ NumPy fallback writer for environments without orbax.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+from ..system import faults
+
+_LOG = logging.getLogger(__name__)
 
 
 def _to_host(tree: Any) -> Any:
@@ -149,6 +155,13 @@ class CheckpointManager:
                 *flat,
                 __treedef__=np.frombuffer(repr(treedef).encode(), dtype=np.uint8),
             )
+        # fault point (doc/ROBUSTNESS.md): die mid-write — INSIDE the
+        # crash window the tmp-then-rename protocol exists for (tmp dir
+        # fully written, final rename never happens). The torn step_*.tmp
+        # must never surface from latest_step(), the next wait() must
+        # re-raise for async saves, and a subsequent save must heal by
+        # rewriting the tmp (tests/test_faults.py pins all three).
+        faults.inject("checkpoint.write", detail=path)
         if os.path.exists(path):
             import shutil
 
@@ -227,7 +240,21 @@ class CheckpointManager:
             data = np.load(os.path.join(path, "arrays.npz"))
             arrays = [data[k] for k in data.files if k != "__treedef__"]
             assert like is not None, "npz fallback restore needs a template"
-            out = jax.tree.unflatten(jax.tree.structure(like), arrays)
+            treedef = jax.tree.structure(like)
+            if treedef.num_leaves != len(arrays):
+                # the orbax path raises a field-named mismatch via
+                # _rebuild_like; the npz path must be as loud — a bare
+                # unflatten error (or worse, a silent mispairing when
+                # counts happen to agree structurally) would point at
+                # jax internals instead of the config drift that
+                # caused it
+                raise ValueError(
+                    f"checkpoint at {path} holds {len(arrays)} arrays "
+                    f"where the template expects {treedef.num_leaves} "
+                    "leaves — saved with a different model/optimizer "
+                    "config?"
+                )
+            out = jax.tree.unflatten(treedef, arrays)
         if like is not None:
             # reshard onto the template's placements (server-count changes OK)
             out = jax.tree.map(
@@ -254,20 +281,149 @@ class CheckpointManager:
 class ReplicaManager:
     """In-memory replica protocol parity (ref kReplicaGroup / kOwnerGroup):
     each Parameter's shard snapshot is mirrored so a replacement node can
-    Recover() it — here snapshots are host copies keyed by customer name."""
+    Recover() it — here snapshots are host copies keyed by customer name.
+
+    Two backup paths:
+
+    - :meth:`backup` — the manual drain-then-copy (``get_replica``),
+      only safe once the caller has quiesced its own submissions;
+    - :meth:`backup_consistent` — snapshots THROUGH the store executor
+      (``get_replica_consistent``: one submitted copy step per channel),
+      so a LIVE training stream of donated pushes cannot tear it, and
+      the returned **barrier** timestamps say exactly which pushes are
+      inside the snapshot (every step with a lower executor timestamp)
+      — the replay contract the recovery drill's zero-lost-acked-updates
+      check rests on (doc/ROBUSTNESS.md "The backup barrier").
+
+    :meth:`start_periodic` runs ``backup_consistent`` on a background
+    thread so a crash loses at most one interval of updates instead of
+    everything since the last hand-invoked snapshot. Thread safety:
+    every map below is guarded (the periodic thread races ``recover()``
+    called from the recovery coordinator's poll thread); snapshot I/O
+    runs OUTSIDE the lock so a slow store never blocks a concurrent
+    recover of a different parameter.
+    """
 
     def __init__(self) -> None:
-        self._replicas: Dict[str, dict] = {}
+        self._replicas: Dict[str, dict] = {}  # guarded-by: _lock
+        #: per-name snapshot metadata: {"barrier": {ch: ts}, "version",
+        #: "at" (wall clock), "consistent" (which path took it)}
+        self._meta: Dict[str, dict] = {}  # guarded-by: _lock
+        self._periodic: Dict[str, Tuple[threading.Thread, threading.Event]] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def _store(self, name: str, snap: dict, barrier: Dict[int, int],
+               consistent: bool) -> None:
+        with self._lock:
+            self._replicas[name] = snap
+            prev = self._meta.get(name)
+            self._meta[name] = {
+                "barrier": dict(barrier),
+                "version": (prev["version"] + 1) if prev else 1,
+                "at": time.time(),
+                "consistent": consistent,
+            }
 
     def backup(self, parameter) -> None:
-        self._replicas[parameter.name] = parameter.get_replica()
+        """Manual snapshot via ``get_replica`` (drains the executor,
+        then copies — the caller must not be submitting concurrently)."""
+        self._store(parameter.name, parameter.get_replica(), {}, False)
 
-    def recover(self, parameter) -> bool:
-        snap = self._replicas.get(parameter.name)
+    def backup_consistent(self, parameter) -> dict:
+        """Tear-free snapshot through the store executor; returns the
+        stored metadata (incl. the per-channel barrier timestamps).
+        Safe under a concurrent donated-push stream."""
+        snap, barrier = parameter.get_replica_consistent()
+        self._store(parameter.name, snap, barrier, True)
+        return self.meta(parameter.name)
+
+    def recover(self, parameter, through_executor: bool = False,
+                timeout: Optional[float] = 60.0) -> bool:
+        """Install the last snapshot. ``through_executor`` submits the
+        install as a store step so it serializes with in-flight pushes
+        in timestamp order (the live-crash path: survivors may still be
+        pushing); default installs directly (the quiesced path the
+        existing callers assume). The executor wait is BOUNDED
+        (``timeout``, None = wait forever): this path runs on the
+        recovery coordinator's thread, and a store executor wedged by
+        the very failure being recovered must surface a diagnostic
+        DeadlineExceeded to the handler machinery — not hang the
+        coordinator so no other dead node ever recovers."""
+        with self._lock:
+            snap = self._replicas.get(parameter.name)
         if snap is None:
             return False
-        parameter.recover(snap)
+        if through_executor and hasattr(parameter, "submit"):
+            ts = parameter.submit(
+                lambda: parameter.recover(snap),
+                parameter.request(),
+            )
+            parameter.executor.wait(ts, timeout=timeout)
+        else:
+            parameter.recover(snap)
         return True
 
+    def barrier(self, name: str) -> Dict[int, int]:
+        """Per-channel executor timestamps of the last snapshot: a push
+        step with a LOWER timestamp is in the snapshot, a higher one is
+        not (and must be replayed after a recover)."""
+        with self._lock:
+            meta = self._meta.get(name)
+            return dict(meta["barrier"]) if meta else {}
+
+    def meta(self, name: str) -> Optional[dict]:
+        with self._lock:
+            m = self._meta.get(name)
+            return dict(m) if m else None
+
     def drop(self, name: str) -> None:
-        self._replicas.pop(name, None)
+        with self._lock:
+            self._replicas.pop(name, None)
+            self._meta.pop(name, None)
+
+    # -- the periodic backup loop --
+
+    def start_periodic(self, parameter, interval_s: float = 30.0) -> None:
+        """Back up ``parameter`` every ``interval_s`` on a background
+        thread (consistent path). One loop per parameter name;
+        :meth:`stop_periodic` stops and joins. A failing backup logs
+        and retries next tick — the previous good snapshot stays
+        installed (never half-replaced: the swap is one guarded dict
+        store)."""
+        name = parameter.name
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.backup_consistent(parameter)
+                except Exception:
+                    _LOG.exception(
+                        "periodic replica backup of %r failed; keeping "
+                        "the previous snapshot and retrying next tick",
+                        name,
+                    )
+
+        t = threading.Thread(
+            target=loop, name=f"replica-backup:{name}", daemon=True
+        )
+        with self._lock:
+            if name in self._periodic:
+                raise RuntimeError(
+                    f"periodic backup of {name!r} already running"
+                )
+            self._periodic[name] = (t, stop)
+        t.start()
+
+    def stop_periodic(self, name: Optional[str] = None) -> None:
+        """Stop (and join) one parameter's backup loop, or all of them."""
+        with self._lock:
+            if name is None:
+                entries = list(self._periodic.items())
+                self._periodic.clear()
+            else:
+                e = self._periodic.pop(name, None)
+                entries = [(name, e)] if e else []
+        for _, (t, stop) in entries:
+            stop.set()
+            t.join(timeout=30)

@@ -19,6 +19,12 @@ latency SLO needs —
   refreshed OFF the push path (the donation-safe ``table(copy=True)``
   contract from the zero-copy data plane), so serving reads never
   contend with — and can never be invalidated by — training pushes.
+- **degraded-mode serving** (chaos plane, doc/ROBUSTNESS.md): a live
+  pull that fails or misses ``live_pull_deadline_s`` falls back to the
+  read replica inside a staleness bound; past it, requests fail with
+  the 503-style :class:`DegradedError` — DISTINCT from the admission
+  429, so overload shedding and failure degradation are separately
+  observable (``ps_serve_degraded_total`` vs ``ps_serve_shed_total``).
 
 :mod:`.frontend` composes them into :class:`ServeFrontend`;
 :mod:`.loadgen` is the open-loop Poisson load generator + latency
@@ -30,6 +36,7 @@ from .admission import AdmissionController, RejectedError, TokenBucket
 from .coalescer import PullCoalescer
 from .frontend import (
     DecodeRequest,
+    DegradedError,
     PredictRequest,
     PullRequest,
     ServeConfig,
@@ -41,6 +48,7 @@ from .replica import ReadReplica
 __all__ = [
     "AdmissionController",
     "DecodeRequest",
+    "DegradedError",
     "LatencyStats",
     "PredictRequest",
     "PullCoalescer",

@@ -38,6 +38,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..telemetry import spans as telemetry_spans
+from ..utils.retry import DeadlineExceeded
 
 
 class _Window:
@@ -80,7 +81,13 @@ class PullTicket:
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._win.done.wait(timeout):
-            raise TimeoutError("coalesced pull did not complete in time")
+            # explicit deadline semantics (utils/retry.py) — still a
+            # TimeoutError; the frontend's degraded path catches this
+            # as "live store past deadline"
+            raise DeadlineExceeded(
+                f"coalesced pull did not complete within {timeout}s",
+                op="serve:coalesced-pull", deadline_s=timeout,
+            )
         if self._win.error is not None:
             raise RuntimeError(
                 "coalesced pull failed"

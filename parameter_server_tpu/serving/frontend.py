@@ -45,7 +45,30 @@ import numpy as np
 from .admission import AdmissionController, RejectedError  # noqa: F401  (re-export: the door's exception belongs to the frontend API)
 from .coalescer import PullCoalescer
 from .replica import ReadReplica
+from ..system import faults
 from ..telemetry import spans as telemetry_spans
+from ..utils.retry import DeadlineExceeded
+
+
+class DegradedError(Exception):
+    """503-style failure degradation — DISTINCT from the admission 429
+    (:class:`~.admission.RejectedError`). A shed says "you sent too
+    much, back off and retry"; degraded says "the live store is dead or
+    past its deadline AND the stale-read fallback could not answer"
+    (no replica, staleness past the bound, or keys outside its
+    coverage). Separately observable on purpose: overload shedding and
+    failure degradation need different operator responses
+    (doc/ROBUSTNESS.md "Degraded vs shed").
+
+    ``reason`` is ``"no-replica"`` | ``"stale"`` | ``"replica-miss"``.
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(
+            f"live store unavailable and degraded path cannot serve "
+            f"({reason})" + (f": {detail}" if detail else "")
+        )
+        self.reason = reason
 
 
 @dataclasses.dataclass
@@ -90,12 +113,27 @@ class ServeConfig:
     coalesce_max_keys: int = 1 << 16
     coalesce_max_requests: int = 256
     # read replica: "off" (all pulls coalesce to the live table),
-    # "full" (whole-table snapshot), or "hot" with hot_keys set
+    # "full" (whole-table snapshot), "hot" with hot_keys set, or
+    # "fallback" — a full snapshot that is NOT consulted on the happy
+    # path (reads stay live/fresh through the coalescer) and serves
+    # only as the degraded path when the live store fails or misses
+    # its deadline (doc/ROBUSTNESS.md "Degraded-mode serving")
     replica: str = "full"
     hot_keys: Optional[np.ndarray] = None
     replica_refresh_s: Optional[float] = None  # None = manual refresh()
     # worker pool (pull/predict lane) — decode gets its own worker
     workers: int = 2
+    # degraded-mode serving: a live (coalesced) pull that raises — or
+    # exceeds live_pull_deadline_s (0 = no deadline) — falls back to
+    # the read replica IF its snapshot is younger than
+    # degraded_max_staleness_s; otherwise the request fails with the
+    # 503-style DegradedError (vs the admission 429). The staleness
+    # bound is deliberately FINITE by default: an unbounded default
+    # would let a forgotten config serve arbitrarily old parameters
+    # forever with only a counter to notice — a store outage must
+    # become loud within a bounded window, not silently stale
+    live_pull_deadline_s: float = 0.0
+    degraded_max_staleness_s: float = 60.0
 
 
 class Ticket:
@@ -128,7 +166,14 @@ class Ticket:
 
     def result(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
-            raise TimeoutError(f"{self.kind} request did not complete")
+            # explicit deadline semantics (utils/retry.py): still a
+            # TimeoutError for legacy callers, but diagnosable
+            raise DeadlineExceeded(
+                f"{self.kind} request did not complete within "
+                f"{timeout}s (submitted "
+                f"{time.perf_counter() - self.t_submit:.3f}s ago)",
+                op=f"serve:{self.kind}", deadline_s=timeout,
+            )
         if self.error is not None:
             raise self.error
         return self.value
@@ -191,13 +236,14 @@ class ServeFrontend:
             self.replica = ReadReplica(
                 store, channel, hot_keys=self.cfg.hot_keys
             )
-        elif self.cfg.replica == "full":
+        elif self.cfg.replica in ("full", "fallback"):
             self.replica = ReadReplica(store, channel)
         elif self.cfg.replica != "off":
             raise ValueError(
-                f"ServeConfig.replica must be 'off'|'full'|'hot', "
-                f"got {self.cfg.replica!r}"
+                f"ServeConfig.replica must be 'off'|'full'|'hot'|"
+                f"'fallback', got {self.cfg.replica!r}"
             )
+        self.degraded_served = 0  # guarded-by: _cv — stale-replica answers
         self.coalescer = PullCoalescer(
             store,
             channel=channel,
@@ -466,20 +512,85 @@ class ServeFrontend:
                     ticket.latency_s()
                 )
 
+    def _live_pull(self, keys: np.ndarray) -> np.ndarray:
+        """One coalesced pull against the live store, bounded by
+        ``live_pull_deadline_s``. The ``serve.pull`` fault point
+        (doc/ROBUSTNESS.md) sits here — the exact place a dead shard
+        manifests to serving — so drills can kill the store path
+        without touching the admission door or the replica."""
+        # inject() covers both documented kinds: "raise" raises after
+        # any delay_s, "stall" sleeps delay_s and falls through
+        faults.inject("serve.pull", detail=getattr(self.store, "name", ""))
+        deadline = self.cfg.live_pull_deadline_s or None
+        return self.coalescer.pull(keys).result(deadline)
+
+    def _degraded_fallback(
+        self, keys: np.ndarray, cause: BaseException
+    ) -> np.ndarray:
+        """The live store failed (or deadlined): serve from the read
+        replica when its snapshot is inside the staleness bound and
+        covers every key; otherwise raise the 503-style DegradedError.
+        Never catches RejectedError — overload sheds are the door's
+        verdict, not a store failure to degrade around."""
+        tel = self._tel()
+        r = self.replica
+        reason = None
+        if r is None:
+            reason, detail = "no-replica", f"live pull failed: {cause}"
+        else:
+            age = r.age_s()
+            if age > self.cfg.degraded_max_staleness_s:
+                reason, detail = "stale", (
+                    f"replica {age:.1f}s old > "
+                    f"{self.cfg.degraded_max_staleness_s}s bound"
+                )
+        if reason is None:
+            vals, hit = r.pull(keys)
+            if hit.all():
+                with self._cv:
+                    self.degraded_served += 1
+                if tel is not None:
+                    tel["degraded"].labels(outcome="served").inc()
+                return vals
+            reason, detail = "replica-miss", (
+                f"{int((~hit).sum())}/{len(hit)} keys outside the "
+                "replica's coverage"
+            )
+        if tel is not None:
+            tel["degraded"].labels(outcome="error").inc()
+        raise DegradedError(reason, detail) from cause
+
     def _pull_values(self, keys: np.ndarray) -> np.ndarray:
-        """The read path: replica first, coalesced live pull for misses
-        (requests for other channels never get here — submit rejects
-        them at the door)."""
-        if self.replica is not None:
+        """The read path (requests for other channels never get here —
+        submit rejects them at the door). Modes:
+
+        - replica full/hot: replica first, coalesced live pull for
+          misses; a FAILED live pull degrades (hot misses degrade to
+          DegradedError — the hot replica cannot cover them);
+        - replica fallback: live-first (fresh reads), replica only as
+          the degraded path;
+        - replica off: live only; failures are DegradedError(no-replica).
+        """
+        if self.replica is not None and self.cfg.replica != "fallback":
             vals, hit = self.replica.pull(keys)
             if hit.all():
                 return vals
             missed = np.asarray(keys)[~hit]
-            miss_vals = self.coalescer.pull(missed).result()
+            try:
+                miss_vals = self._live_pull(missed)
+            except RejectedError:
+                raise
+            except Exception as e:
+                return self._degraded_fallback(keys, e)
             out = np.array(vals)
             out[~hit] = miss_vals
             return out
-        return self.coalescer.pull(keys).result()
+        try:
+            return self._live_pull(keys)
+        except RejectedError:
+            raise
+        except Exception as e:
+            return self._degraded_fallback(keys, e)
 
     def _execute(self, req):
         if isinstance(req, PullRequest):
@@ -538,9 +649,11 @@ class ServeFrontend:
         with self._cv:
             completed = self.completed
             in_flight = self._in_flight + self._in_flight_decode
+            degraded = self.degraded_served
         out = {
             "completed": completed,
             "in_flight": in_flight,
+            "degraded_served": degraded,
             "coalescer": self.coalescer.stats(),
         }
         if self.replica is not None:

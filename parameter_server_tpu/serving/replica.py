@@ -39,6 +39,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..system import faults
+
 
 class ReadReplica:
     """Snapshot read copy of one store channel, served from host memory.
@@ -89,6 +91,12 @@ class ReadReplica:
         alias the live table); full replicas via the store's submitted
         ``snapshot`` copy step — both serialize through the executor
         with training pushes, so there is no drain-and-hope window."""
+        # fault point (doc/ROBUSTNESS.md): a dead shard's replica
+        # refresh FAILS — it must not snapshot a corrupt table. The
+        # frontend's background refresher logs-and-retries, keeping the
+        # last good snapshot (whose age the degraded staleness bound
+        # then judges).
+        faults.inject("serve.refresh", detail=getattr(self.store, "name", ""))
         t0 = time.perf_counter()
         if self.hot_keys is not None:
             ts = self.store.pull(

@@ -30,6 +30,8 @@ import jax
 
 from ..telemetry import registry as telemetry_registry
 from ..telemetry import spans as telemetry_spans
+from ..utils.retry import Deadline, DeadlineExceeded
+from . import faults
 from .message import INVALID_TIME, Message, Task
 
 
@@ -369,6 +371,13 @@ class Executor:
                 if times is not None:
                     times[1] = t_run0  # dispatch pickup: queue wait ends
             try:
+                # fault point (doc/ROBUSTNESS.md): kind="raise" makes
+                # this step fail exactly like a raising step body (the
+                # error propagates to the waiter); a ``delay_s`` stalls
+                # the dispatch thread first (kind="stall" stalls
+                # without raising). Inside the try so an injected raise
+                # rides the organic error path bit-for-bit.
+                faults.inject("executor.step", detail=f"{self.name}:{ts}")
                 result = step()
                 err = None
             except BaseException as e:  # propagate to the waiter
@@ -526,7 +535,8 @@ class Executor:
 
     # -- waiting (ref Customer::Wait) --
 
-    def wait(self, ts: int, pop: bool = True) -> Any:
+    def wait(self, ts: int, pop: bool = True,
+             timeout: Optional[float] = None) -> Any:
         """Block until step ``ts`` has run and materialized (Customer::Wait).
 
         By default evicts the step's future so device buffers are released —
@@ -534,7 +544,15 @@ class Executor:
         ``pop=False`` blocks without consuming (used by the throttle).
         Returns the step's value (None if ts is unknown or already popped).
         Re-raises the step's exception, if it raised.
+
+        ``timeout`` bounds the wait (seconds): on expiry a diagnostic
+        :class:`~..utils.retry.DeadlineExceeded` (a TimeoutError) names
+        the wedged timestamp, its state, and — the case that used to
+        hang callers forever — its unsatisfied ``wait_time``
+        dependencies. Completion-only; a timed-out step keeps running
+        and a later wait() can still claim its result.
         """
+        deadline = Deadline(timeout)
         with self._cv:
             known = (
                 ts in self._pending
@@ -550,7 +568,13 @@ class Executor:
                 or ts in self._errors
                 or self.tracker.is_finished(ts)
             ):
-                self._cv.wait()
+                left = deadline.remaining()
+                if left is None:
+                    self._cv.wait()
+                elif left <= 0:
+                    raise self._wait_timeout_locked(ts, timeout)
+                else:
+                    self._cv.wait(left)
             err = self._errors.pop(ts, None) if pop else self._errors.get(ts)
             fut = self._futures.pop(ts, None) if pop else self._futures.get(ts)
         if err is not None:
@@ -568,9 +592,42 @@ class Executor:
         self._finish(ts)
         return fut
 
-    def wait_all(self, pop: bool = True) -> None:
+    def _wait_timeout_locked(self, ts: int, timeout: float) -> DeadlineExceeded:  # holds-lock: _cv
+        """Build the diagnostic deadline error for a wedged wait: which
+        state the step is stuck in, and — when it is pending — which
+        ``wait_time`` dependencies never finished (a lost dependency is
+        the classic way a caller hangs forever)."""
+        entry = self._pending.get(ts)
+        if entry is not None:
+            unmet = [d for d in entry[1] if not self._dep_done_locked(d)]
+            state = (
+                f"pending with unsatisfied wait_time deps {unmet}"
+                if unmet
+                else "pending (ready but not yet dispatched)"
+            )
+        elif ts == self._running:
+            state = "executing on the dispatch thread right now"
+        elif ts in self._ran:
+            state = "ran; result not yet materialized/finished"
+        else:
+            state = (
+                "started externally (tracker), never finished — a "
+                "Customer.reply that never arrived?"
+            )
+        return DeadlineExceeded(
+            f"executor {self.name!r}: step {ts} unfinished after "
+            f"{timeout}s — {state}",
+            op=f"executor:{self.name} wait({ts})", deadline_s=timeout,
+        )
+
+    def wait_all(self, pop: bool = True,
+                 timeout: Optional[float] = None) -> None:
         """Drain every unfinished step, including the one executing right
-        now. ``pop=False`` preserves results for later collection."""
+        now. ``pop=False`` preserves results for later collection.
+        ``timeout`` bounds the WHOLE drain (one budget across steps,
+        utils/retry.Deadline); expiry raises the per-step diagnostic
+        DeadlineExceeded of whichever step was wedged."""
+        deadline = Deadline(timeout)
         while True:
             with self._cv:
                 todo = set(self._pending) | self._ran
@@ -579,7 +636,8 @@ class Executor:
             if not todo:
                 return
             for ts in sorted(todo):
-                self.wait(ts, pop=pop)
+                left = deadline.remaining()
+                self.wait(ts, pop=pop, timeout=left)
 
     def result(self, ts: int) -> Any:
         """The (possibly still-async) value of step ts (None once waited,

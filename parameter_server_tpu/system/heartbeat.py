@@ -15,6 +15,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..utils import resource_usage
+from . import faults
 
 
 @dataclasses.dataclass
@@ -126,6 +127,13 @@ class HeartbeatCollector:
         self._lock = threading.Lock()
 
     def report(self, node_id: str, hb: HeartbeatReport) -> None:
+        # fault point (doc/ROBUSTNESS.md): an armed "silence" (matched
+        # on the node id) drops the report BEFORE it refreshes
+        # last-seen — to the collector the node simply stops reporting,
+        # which is exactly what a crashed shard looks like. The node
+        # itself keeps running; the recovery drill kills shards this way.
+        if faults.check("heartbeat.report", detail=node_id) is not None:
+            return
         with self._lock:
             self._reports[node_id] = hb
             self._last_seen[node_id] = time.time()

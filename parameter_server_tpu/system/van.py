@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as meshlib
 from ..telemetry import registry as telemetry_registry
+from . import faults
 from .message import Message
 
 
@@ -100,7 +101,31 @@ class Van:
         sent = len(blob)
         self.wire_sent_bytes += sent
         self._account(msg.sender, out_bytes=sent)
+        # fault point (doc/ROBUSTNESS.md) — the wire between serialize
+        # and deliver, where real networks fail. Placed AFTER the send
+        # accounting so a dropped frame costs sender bytes but never
+        # receiver bytes (the side-correct counting contract above):
+        #   drop      → FaultError; the RPC layer sees a lost frame
+        #   delay     → the frame arrives late (delay_s)
+        #   duplicate → at-least-once delivery: from_wire runs twice,
+        #               probing receiver idempotence under redelivery
+        fault = faults.check(
+            "van.transfer", detail=f"{msg.sender}->{msg.recver}"
+        )
+        duplicate = False
+        if fault is not None:
+            if fault.delay_s:
+                import time as _time
+
+                _time.sleep(fault.delay_s)
+            if fault.kind == "drop":
+                raise fault.make_error(
+                    f"frame {msg.sender}->{msg.recver} dropped"
+                )
+            duplicate = fault.kind == "duplicate"
         recv_before = recver.wire_recv_bytes
+        if duplicate:
+            recver.from_wire(blob)
         out = recver.from_wire(blob)
         recv = recver.wire_recv_bytes - recv_before
         self.wire_recv_bytes += recv

@@ -312,6 +312,16 @@ def serve_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "tokens generated by served decode requests "
             "(rows x steps, host-side count)",
         ),
+        "degraded": reg.ensure_counter(
+            "ps_serve_degraded_total",
+            "requests that hit the degraded path after the live store "
+            "failed or missed its deadline (503-style, DISTINCT from "
+            "the admission 429s in ps_serve_shed_total): "
+            "outcome=served (answered from the stale read replica "
+            "inside the staleness bound) or outcome=error (DegradedError "
+            "— no replica, too stale, or keys it cannot cover)",
+            labelnames=("outcome",),
+        ),
     }
 
 
@@ -343,6 +353,33 @@ def ftrl_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "FTRL ministeps dispatched, by resolved update path "
             "(pallas_sparse / xla_rows / pallas_dense / ref)",
             labelnames=("path",),
+        ),
+    }
+
+
+def recovery_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Failure detection → recovery orchestration (system/recovery.py +
+    the chaos plane, doc/ROBUSTNESS.md). ``RecoveryCoordinator.check``
+    used to only log; these make detection volume, handler health and
+    recovery latency visible to every snapshot — the drill's MTTR has
+    a live counterpart."""
+    return {
+        "deaths": reg.ensure_counter(
+            "ps_recovery_deaths_total",
+            "nodes declared dead by the recovery coordinator (first "
+            "detection only; revive + re-death counts again), by role",
+            labelnames=("role",),
+        ),
+        "handler_failures": reg.ensure_counter(
+            "ps_recovery_handler_failures_total",
+            "recovery handler invocations that still failed after "
+            "exhausting their retry policy (utils/retry.py backoff)",
+        ),
+        "seconds": reg.ensure_histogram(
+            "ps_recovery_seconds",
+            "wall time of one dead node's full recovery handling "
+            "(every registered handler, retries included)",
+            buckets=PHASE_BUCKETS,
         ),
     }
 
@@ -433,6 +470,7 @@ INSTRUMENT_FAMILIES = (
     wire_instruments,
     serve_instruments,
     ftrl_instruments,
+    recovery_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -51,6 +51,29 @@ WATCHED = (
     ("e2e_stream", "e2e examples/sec (--real stream)"),
 )
 
+#: record sections that are drill/A-B METADATA, not throughput metrics
+#: the sentinel may band: ``recovery`` carries MTTR/degraded counts
+#: whose host-dependent wall times would false-flag every round, and
+#: the embedded A/B sections quote their own paired medians with their
+#: own disclosure. A WATCHED key must never point into one of these —
+#: enforced at import so a future metric addition cannot silently band
+#: drill metadata.
+METADATA_SECTIONS = frozenset(
+    {
+        "recovery",
+        "serve",
+        "wire",
+        "host_ingest",
+        "kv_dataplane",
+        "ftrl_sparse",
+        "attribution",
+        "telemetry",
+    }
+)
+assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (
+    "WATCHED must not band metadata sections"
+)
+
 
 def load_record(path: str) -> Optional[dict]:
     """The bench record inside ``path`` (unwrapping the round driver's
@@ -125,6 +148,8 @@ def diff(
     rows: List[dict] = []
     regressed = False
     for key, desc in WATCHED:
+        if key in METADATA_SECTIONS:  # second line of defense behind
+            continue  # the import-time assert: never band drill metadata
         new_v = new.get(key)
         if not isinstance(new_v, (int, float)) or new_v <= 0:
             continue

@@ -63,8 +63,10 @@ SCOPE = (
     "parameter_server_tpu/system/dashboard.py",
     "parameter_server_tpu/system/recovery.py",
     "parameter_server_tpu/system/monitor.py",
+    "parameter_server_tpu/system/faults.py",
     "parameter_server_tpu/utils/concurrent.py",
     "parameter_server_tpu/parameter/parameter.py",
+    "parameter_server_tpu/parameter/replica.py",
     "parameter_server_tpu/learner/ingest.py",
     "parameter_server_tpu/learner/workload_pool.py",
     "parameter_server_tpu/apps/linear/async_sgd.py",

@@ -128,6 +128,28 @@ class TestDiffMath:
         assert not regressed
         assert all(r["status"] == "no-baseline" for r in rows)
 
+    def test_recovery_section_is_metadata_never_banded(self):
+        """The chaos-plane `recovery` section carries drill wall times
+        (MTTR, detection) and degraded/shed counts — host-dependent
+        metadata, not throughput the sentinel may band. A catastrophic-
+        looking recovery section must not flag, and WATCHED is
+        statically barred from pointing into any metadata section."""
+        assert "recovery" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["recovery"] = {  # 100x-worse drill numbers, all ignored
+            "mttr_ms": 1e9, "detection_ms": 1e9,
+            "serve": {"degraded_served": 1e9, "failed": 1e9},
+        }
+        priors = self._priors()
+        rows, regressed = bench_diff.diff(new, priors)
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported  # the scalar metrics are still judged
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):
