@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench trace bench-diff clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench trace bench-diff metrics-serve clean
 
 all: native
 
@@ -106,6 +106,17 @@ trace:
 	env JAX_PLATFORMS=cpu PS_TRACE_OUT=$${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} \
 		python -m parameter_server_tpu.benchmarks trace
 	@echo "timeline: $${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} (open at https://ui.perfetto.dev)"
+
+# cluster metrics plane demo (doc/OBSERVABILITY.md "Cluster metrics
+# plane"): a tiny live system on the CPU mesh with the full plane up —
+# scrape http://127.0.0.1:$(METRICS_PORT)/metrics (also /healthz,
+# /debug/snapshot) while it trains; default SLO alert rules from
+# configs/alerts/default.json evaluate live. Ctrl-C stops it cleanly.
+# The same endpoint rides any real run via `python bench.py
+# --expose-port 9100` or `apps/serve ... --expose-port 9100`.
+METRICS_PORT ?= 9100
+metrics-serve:
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.telemetry.exposition --port $(METRICS_PORT)
 
 # bench regression sentinel: compare the newest valid BENCH_r*.json
 # against the prior trajectory (median-of-priors baseline, tolerance

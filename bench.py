@@ -645,9 +645,73 @@ def attach_recovery(rec_or_headline: dict, smoke: bool) -> None:
         )
 
 
+_EXPOSITION = None  # live ExpositionServer while --expose-port is up
+
+
+def _maybe_expose(po, args) -> None:
+    """--expose-port: stand the cluster metrics plane up over this run
+    (telemetry/exposition.py) — /metrics serves the node-labeled
+    aggregate, /healthz the heartbeat+recovery verdict, and the default
+    SLO alert rules evaluate live against the run's registry. Port 0
+    binds ephemeral; the chosen port is printed to stderr so a scraper
+    (or a human with curl) can attach mid-run."""
+    global _EXPOSITION
+    if getattr(args, "expose_port", None) is None:
+        return
+    from parameter_server_tpu.telemetry.exposition import expose_cluster
+
+    _EXPOSITION = expose_cluster(
+        po, port=args.expose_port, metrics_interval=1.0
+    )
+    print(f"bench: metrics exposed at {_EXPOSITION.url}/metrics "
+          f"(/healthz, /debug/snapshot)", file=sys.stderr)
+
+
+def _expose_summary(rec: dict) -> None:
+    """One self-scrape before teardown: the record carries proof the
+    endpoint served node-labeled series while the run was live."""
+    if _EXPOSITION is None:
+        return
+    try:
+        import urllib.request
+
+        txt = urllib.request.urlopen(
+            f"{_EXPOSITION.url}/metrics", timeout=10
+        ).read().decode()
+        nodes = sorted({
+            line.split('node="', 1)[1].split('"', 1)[0]
+            for line in txt.splitlines()
+            if line.startswith("ps_cluster_node_up{")
+        })
+        ok, health = _EXPOSITION.aux.health()
+        firing = health.get("alerts_firing", [])
+        rec["expose"] = {
+            "url": _EXPOSITION.url,
+            "nodes": nodes,
+            "series_lines": sum(
+                1 for l in txt.splitlines() if l and not l.startswith("#")
+            ),
+            "healthz_ok": ok,
+            "alerts_firing": firing,
+        }
+    except Exception as e:
+        rec["expose"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def _close_exposition() -> None:
+    global _EXPOSITION
+    if _EXPOSITION is not None:
+        from parameter_server_tpu.telemetry.exposition import close_cluster
+
+        close_cluster(_EXPOSITION)
+        _EXPOSITION = None
+
+
 def _finish(rec: dict) -> None:
     """Print the final record through the watchdog's lock (single-record
     guarantee); plain print when no watchdog is armed (library use)."""
+    _expose_summary(rec)
+    _close_exposition()
     if "telemetry" not in rec:
         snap = telemetry_snapshot()
         if snap is not None:
@@ -1476,6 +1540,7 @@ def run_real(args) -> int:
     Postoffice.reset()
     po = Postoffice.instance().start()
     trace_path = ensure_trace_sink()
+    _maybe_expose(po, args)
 
     alpha, beta, l1 = 0.1, 1.0, 1.0
     conf = Config()
@@ -1793,6 +1858,19 @@ def main() -> int:
         "is removed first so the summary reflects this run only",
     )
     ap.add_argument(
+        "--expose-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the cluster metrics plane while the bench runs "
+        "(telemetry/exposition.py): /metrics = node-labeled Prometheus "
+        "aggregate, /healthz = heartbeat+recovery verdict (503 on a "
+        "dead/stale shard), /debug/snapshot = registry+alerts+timeline "
+        "JSON; default SLO alert rules from configs/alerts/default.json "
+        "evaluate live. 0 binds an ephemeral port (printed to stderr); "
+        "the record gains an 'expose' section with the scrape summary",
+    )
+    ap.add_argument(
         "--stall-timeout",
         type=float,
         default=300.0,
@@ -1939,6 +2017,7 @@ def run_synthetic(args) -> int:
     Postoffice.reset()
     po = Postoffice.instance().start()  # all local devices, 1 server axis
     trace_path = ensure_trace_sink()
+    _maybe_expose(po, args)
     n_workers = meshlib.num_workers(po.mesh)
 
     conf = Config()

@@ -93,6 +93,14 @@ def main(argv=None) -> int:
                     help="add the speculative-decode LM lane")
     ap.add_argument("--gamma", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--expose-port", type=int, default=None, metavar="PORT",
+                    help="serve the cluster metrics plane while the CLI "
+                    "runs (telemetry/exposition.py): /metrics (node-"
+                    "labeled aggregate incl. the live ps_serve_* "
+                    "family), /healthz, /debug/snapshot; the default "
+                    "SLO alert rules evaluate against this process — "
+                    "overload points past the serve p99 rule show "
+                    "ps_alert_state flip live. 0 = ephemeral")
     args = ap.parse_args(argv)
 
     from ...parameter.kv_vector import KVVector
@@ -107,6 +115,15 @@ def main(argv=None) -> int:
 
     Postoffice.reset()
     po = Postoffice.instance().start()
+    exposition = None
+    if args.expose_port is not None:
+        from ...telemetry.exposition import expose_cluster
+
+        exposition = expose_cluster(
+            po, port=args.expose_port, metrics_interval=1.0
+        )
+        print(f"serve: metrics exposed at {exposition.url}/metrics "
+              f"(/healthz, /debug/snapshot)", file=sys.stderr)
     kv = KVVector(
         mesh=po.mesh, k=1, num_slots=args.num_slots, hashed=True,
         name="serve_w",
@@ -240,6 +257,14 @@ def main(argv=None) -> int:
     emit({"metric": "serve_frontend_stats", "value": 1, "unit": "ok",
           **fe.stats()})
     fe.close()
+    if exposition is not None:
+        ok, health = exposition.aux.health()
+        emit({"metric": "serve_exposition", "value": 1, "unit": "ok",
+              "url": exposition.url, "healthz_ok": ok,
+              "alerts_firing": health.get("alerts_firing", [])})
+        from ...telemetry.exposition import close_cluster
+
+        close_cluster(exposition)
     return 0
 
 

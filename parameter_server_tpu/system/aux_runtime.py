@@ -1,39 +1,58 @@
-"""Production wiring of heartbeat → dashboard → recovery.
+"""Production wiring of heartbeat → metrics plane → dashboard → recovery.
 
 The reference starts these as part of every run: nodes send
 HeartbeatReports on a timer (``src/system/postoffice.cc`` heartbeat
 thread), the scheduler renders the dashboard (``dashboard.cc``) and its
 manager reacts to dead nodes (``manager.cc`` dead-node flow). Round 1
 built the pieces but never started them from a production loop; this
-module is the glue the apps actually call.
+module is the glue the apps actually call — and, since the cluster
+metrics plane (PR 10), the place per-node METRIC reports are produced:
+each registered node owns a private registry of ps_node_* instruments
+refreshed from its HeartbeatReport, shipped over the Van's real
+transfer path (serialization, filter chains, byte accounting, the
+``van.transfer`` fault point) to the scheduler-side
+:class:`~parameter_server_tpu.telemetry.aggregate.ClusterAggregator`,
+which merges everything under a ``node`` label for the exposition
+endpoint (telemetry/exposition.py). The direct-call path is kept for
+single-process tests (``wire=False``).
 
 Usage (see apps/linear/main.py and tests/test_aux_integration.py):
 
     aux = Postoffice.instance().start_aux(heartbeat_timeout=10.0)
     aux.coordinator.on_worker_dead(pool.restore)
-    aux.start(check_interval=1.0, dashboard_interval=30.0)
+    aux.start(check_interval=1.0, dashboard_interval=30.0,
+              metrics_interval=1.0)
     ...   # hot loops call po.beat(node_id) / aux.beat(node_id)
     aux.stop()
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
+from ..telemetry import registry as telemetry_registry
+from ..telemetry.aggregate import ClusterAggregator
 from .dashboard import Dashboard
-from .heartbeat import HeartbeatCollector, HeartbeatInfo
+from .heartbeat import HeartbeatCollector, HeartbeatInfo, HeartbeatReport
+from .message import Command, Message, Task
 from .recovery import RecoveryCoordinator
+
+_LOG = logging.getLogger(__name__)
 
 
 class AuxRuntime:
-    """Heartbeat sampling + liveness + dashboard, one per process."""
+    """Heartbeat sampling + liveness + metrics plane + dashboard."""
 
     def __init__(
         self,
         heartbeat_timeout: float = 10.0,
         print_fn: Callable[[str], None] = print,
+        node_id: Optional[str] = None,
+        stale_after_s: Optional[float] = None,
     ):
         self.collector = HeartbeatCollector(timeout=heartbeat_timeout)
         # "default": the dashboard's telemetry section renders whatever
@@ -42,16 +61,40 @@ class AuxRuntime:
         self.dashboard = Dashboard(registry="default")
         self.coordinator = RecoveryCoordinator(self.collector)
         self.print_fn = print_fn
+        #: this PROCESS's identity on the cluster metrics plane — the
+        #: node the default registry's export is reported under. One
+        #: process per node in the multi-process future; "H0" (the
+        #: scheduler) in today's single-process runs.
+        self.node_id = node_id or os.environ.get("PS_NODE_ID", "H0")
+        #: scheduler-side merge of every node's metric reports
+        self.cluster = ClusterAggregator(
+            stale_after_s=(
+                heartbeat_timeout if stale_after_s is None else stale_after_s
+            )
+        )
+        #: optional AlertManager (telemetry/alerts.py) — set_alerts()
+        self.alerts = None
         self._tel = None
-        from ..telemetry import registry as telemetry_registry
-
         if telemetry_registry.enabled():
             from ..telemetry.instruments import heartbeat_instruments
 
             self._tel = heartbeat_instruments(
                 telemetry_registry.default_registry()
             )
+        #: scrape-time refresh floor: a /metrics GET younger than this
+        #: since the last sweep serves the merged view as-is instead of
+        #: re-sweeping — a tight scrape loop must not multiply message-
+        #: plane traffic or tick per-node report counters (and the
+        #: heartbeat.report fault point's call counter) at scrape rate
+        self.scrape_refresh_min_s = 0.2
+        self._last_sweep = 0.0  # monotonic; single float, atomic in CPython
         self._infos: Dict[str, HeartbeatInfo] = {}  # guarded-by: _lock
+        # per-node PRIVATE registries for the metrics plane:
+        # node id -> (registry, instruments, last-lifetime-totals)
+        self._node_regs: Dict[str, Tuple] = {}  # guarded-by: _lock
+        # per-(node -> scheduler) RemoteNode endpoint pairs for the
+        # metric-report wire (stateful filter chains stay per peer)
+        self._wire_eps: Dict[str, Tuple] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -60,7 +103,10 @@ class AuxRuntime:
 
     def register(self, node_id: str, hostname: str = "") -> HeartbeatInfo:
         """Create (or return) the node's metrics sampler and report an
-        initial heartbeat so liveness tracking starts immediately."""
+        initial heartbeat AND metric report, so liveness tracking and
+        cluster staleness marking both start immediately — a node that
+        goes silent right after joining shows up STALE in the merged
+        view, not absent from it."""
         with self._lock:
             info = self._infos.get(node_id)
             if info is None:
@@ -68,7 +114,10 @@ class AuxRuntime:
 
                 info = HeartbeatInfo(hostname=hostname or socket.gethostname())
                 self._infos[node_id] = info
-        self.beat(node_id)
+        # direct-path seed (wire=False): registration is local
+        # bootstrap, not remote traffic — it must not tick the Van's
+        # byte accounting the way timer-driven reports deliberately do
+        self.report_node(node_id, wire=False)
         return info
 
     def beat(self, node_id: str) -> None:
@@ -78,8 +127,18 @@ class AuxRuntime:
             info = self._infos.get(node_id)
         if info is None:
             return
-        report = info.get()
+        self._deliver(node_id, info.get())
+
+    def _deliver(self, node_id: str, report: HeartbeatReport) -> bool:
+        """Feed one sampled report into collector + dashboard +
+        registry mirrors; returns False when the collector's armed
+        ``heartbeat.report`` silence swallowed it (the node is 'dead'
+        to the scheduler — nothing downstream may report on its
+        behalf)."""
+        before = self.collector.last_seen(node_id)
         self.collector.report(node_id, report)
+        if self.collector.last_seen(node_id) == before:
+            return False
         self.dashboard.add_report(node_id, report)
         if self._tel is not None:
             self._tel["reports"].labels(node=node_id).inc()
@@ -88,6 +147,7 @@ class AuxRuntime:
         # a node beating again after being declared dead is back — allow
         # future re-detection (ref manager re-adding a returned node)
         self.coordinator.revive(node_id)
+        return True
 
     def info(self, node_id: str) -> Optional[HeartbeatInfo]:
         with self._lock:
@@ -95,30 +155,247 @@ class AuxRuntime:
 
     def forget(self, node_id: str) -> None:
         """Drop a decommissioned node everywhere (elastic shrink): its
-        sampler, its liveness record, and its dead-handled flag — so it
-        neither false-alarms a 'death' nor blocks re-detection if the
-        same slot id joins again later."""
+        sampler, its liveness record, its metrics-plane state, and its
+        dead-handled flag — so it neither false-alarms a 'death' nor
+        blocks re-detection if the same slot id joins again later."""
         with self._lock:
             self._infos.pop(node_id, None)
+            self._node_regs.pop(node_id, None)
+            self._wire_eps.pop(node_id, None)
         self.collector.forget(node_id)
+        self.cluster.forget(node_id)
         self.coordinator.revive(node_id)
+
+    # -- the cluster metrics plane (PR 10) --
+
+    def report_node(self, node_id: str, wire: Optional[bool] = None) -> bool:
+        """One node's metric report: heartbeat-deliver, refresh its
+        private ps_node_* registry from the sampled report, and ship
+        the registry export to the aggregator — over the Van message
+        plane when the system is started (``wire=None`` auto-detects;
+        ``False`` forces the direct call for single-process tests).
+        Returns False when the report was silenced or lost."""
+        with self._lock:
+            info = self._infos.get(node_id)
+        if info is None:
+            return False
+        report = info.get()
+        if not self._deliver(node_id, report):
+            return False  # silenced: a crashed node reports NOTHING
+        export = self._node_export(node_id, info, report)
+        return self._ship(node_id, export, report, wire)
+
+    def report_all(self, wire: Optional[bool] = None) -> int:
+        """One metrics-plane sweep: every registered node reports, plus
+        the process default registry under this process's
+        :attr:`node_id` (when that id is not itself a registered
+        sampler). Returns how many reports landed."""
+        self._last_sweep = time.monotonic()
+        with self._lock:
+            node_ids = list(self._infos)
+        landed = sum(1 for nid in node_ids if self.report_node(nid, wire))
+        if self.node_id not in node_ids:
+            from .faults import check as faults_check
+
+            if faults_check("heartbeat.report", detail=self.node_id) is None:
+                if self._ship(
+                    self.node_id,
+                    telemetry_registry.default_registry().export_state(),
+                    None,
+                    wire,
+                ):
+                    landed += 1
+        return landed
+
+    def _node_export(
+        self, node_id: str, info: HeartbeatInfo, report: HeartbeatReport
+    ) -> dict:
+        """Refresh the node's private registry from its sampler and
+        return the export. Counters advance by LIFETIME-total deltas so
+        they stay monotone no matter how report windows interleave with
+        hot-loop beats (which drain the per-report deltas)."""
+        from ..telemetry.instruments import node_instruments
+        from ..telemetry.registry import MetricsRegistry
+
+        with self._lock:
+            entry = self._node_regs.get(node_id)
+            if entry is None:
+                reg = MetricsRegistry()
+                entry = self._node_regs[node_id] = (
+                    reg, node_instruments(reg), {"t": None},
+                )
+            reg, tel, state = entry
+            now = time.monotonic()
+            for key, total in (
+                ("busy", info.total_busy_ms / 1e3),
+                ("net_in", float(info.total_in_bytes)),
+                ("net_out", float(info.total_out_bytes)),
+            ):
+                prev = state.get(key, 0.0)
+                if total > prev:
+                    tel[key].inc(total - prev)
+                state[key] = max(prev, total)
+            tel["heartbeats"].inc()
+            tel["rss_mb"].set(report.process_rss_mb)
+            tel["cpu"].set(report.process_cpu_usage)
+            tel["host_cpu"].set(report.host_cpu_usage)
+            tel["uptime"].set(info.uptime_s)
+            if state["t"] is not None:
+                tel["report_interval"].observe(now - state["t"])
+            state["t"] = now
+        export = reg.export_state()
+        if node_id == self.node_id:
+            # this process's node also carries the process-wide
+            # registry (the spine every layer records into)
+            merged = dict(telemetry_registry.default_registry().export_state())
+            merged.update(export)
+            export = merged
+        return export
+
+    def _wire_pair(self, node_id: str):
+        from .remote_node import RemoteNode
+
+        with self._lock:
+            pair = self._wire_eps.get(node_id)
+            if pair is None:
+                pair = self._wire_eps[node_id] = (
+                    RemoteNode(self.node_id),  # node's endpoint → scheduler
+                    RemoteNode(node_id),       # scheduler's endpoint ← node
+                )
+            return pair
+
+    def _ship(
+        self,
+        node_id: str,
+        export: dict,
+        report: Optional[HeartbeatReport],
+        wire: Optional[bool],
+    ) -> bool:
+        """Move one report to the aggregator — through ``van.transfer``
+        (real serialization + byte accounting + the ``van.transfer``
+        fault point) when the system is started, directly otherwise."""
+        payload = {"node": node_id, "metrics": export}
+        if report is not None:
+            payload["heartbeat"] = report
+        van = None
+        if wire is not False:
+            from .postoffice import Postoffice
+
+            po = Postoffice._instance  # never create the singleton here
+            van = po.van if po is not None else None
+        if van is not None:
+            msg = Message(
+                task=Task(cmd=Command.HEARTBEAT, payload=payload),
+                sender=node_id,
+                recver=self.node_id,
+            )
+            tx, rx = self._wire_pair(node_id)
+            try:
+                payload = van.transfer(tx, rx, msg).task.payload
+            except Exception as e:  # injected drop / torn frame: the
+                # report is LOST — staleness tracking is how it shows
+                _LOG.debug("metric report from %s lost: %s", node_id, e)
+                return False
+        self.handle_metrics_message(payload)
+        return True
+
+    def handle_metrics_message(self, payload: dict) -> None:
+        """Receiver side of a metric report (scheduler): merge the
+        node's export; a piggybacked HeartbeatReport from a REMOTE
+        process also lands in the collector/dashboard (in-process
+        reports already delivered through :meth:`_deliver`)."""
+        node = payload["node"]
+        self.cluster.update(node, payload["metrics"])
+        hb = payload.get("heartbeat")
+        if hb is not None and self.info(node) is None:
+            self.collector.report(node, hb)
+            self.dashboard.add_report(node, hb)
+
+    def metrics_text(self, refresh: bool = True) -> str:
+        """The /metrics scrape body: refresh local nodes' reports (each
+        passing the heartbeat fault gate — a silenced node goes stale,
+        it does not freeze) and render the node-labeled merged view.
+        Refreshes are floored at :attr:`scrape_refresh_min_s` so a
+        tight scrape loop reads the merged view instead of re-driving
+        the message plane per GET."""
+        if (
+            refresh
+            and time.monotonic() - self._last_sweep
+            >= self.scrape_refresh_min_s
+        ):
+            self.report_all()
+        return self.cluster.render_text()
+
+    def health(self, now: Optional[float] = None) -> Tuple[bool, dict]:
+        """The /healthz verdict: non-OK while any tracked shard is dead
+        (heartbeat timeout) or its metric reports are stale. Firing
+        alerts are DISCLOSED but do not flip health — an SLO breach is
+        the workload's problem, not the process's."""
+        dead = sorted(self.collector.dead_nodes(now))
+        stale = self.cluster.stale_nodes()
+        firing = sorted(self.alerts.firing()) if self.alerts is not None else []
+        detail = {
+            "ok": not dead and not stale,
+            "node_id": self.node_id,
+            "dead_nodes": dead,
+            "stale_nodes": stale,
+            "node_report_age_s": {
+                n: round(a, 3) for n, a in sorted(self.cluster.node_ages().items())
+            },
+            "heartbeat_timeout_s": self.collector.timeout,
+            "stale_after_s": self.cluster.stale_after_s,
+            "recovery_running": self.running,
+            "alerts_firing": firing,
+        }
+        return detail["ok"], detail
+
+    def set_alerts(self, manager) -> None:
+        """Attach an AlertManager: the aux loop evaluates it each pass,
+        its transitions land in the dashboard event log, and its firing
+        rules show in /healthz + the dashboard's alerts section."""
+        self.alerts = manager
+        manager.add_listener(
+            lambda ev: self.dashboard.add_event(str(ev))
+        )
+        self.dashboard.set_alerts(manager)
 
     # -- scheduler-side background services --
 
     def start(
-        self, check_interval: float = 1.0, dashboard_interval: float = 0.0
+        self,
+        check_interval: float = 1.0,
+        dashboard_interval: float = 0.0,
+        metrics_interval: float = 0.0,
     ) -> None:
         """Start the liveness/recovery poller; ``dashboard_interval > 0``
         also prints the dashboard table on that period (ref dashboard.cc
-        scheduler thread)."""
+        scheduler thread), and ``metrics_interval > 0`` runs the
+        metrics-plane report sweep (ref postoffice.cc heartbeat thread:
+        per-node reports over messages on a timer)."""
         if self._thread is not None:
             return
         self._stop.clear()
         last_dash = [time.monotonic()]
+        last_metrics = [0.0]
 
         def loop() -> None:
             while not self._stop.wait(check_interval):
                 self.coordinator.check()
+                now = time.monotonic()
+                if (
+                    metrics_interval > 0
+                    and now - last_metrics[0] >= metrics_interval
+                ):
+                    last_metrics[0] = now
+                    try:
+                        self.report_all()
+                    except Exception:
+                        _LOG.exception("metrics-plane sweep failed")
+                if self.alerts is not None:
+                    try:
+                        self.alerts.evaluate()
+                    except Exception:
+                        _LOG.exception("alert evaluation failed")
                 if (
                     dashboard_interval > 0
                     and time.monotonic() - last_dash[0] >= dashboard_interval

@@ -49,6 +49,14 @@ class Dashboard:
         # at RENDER time so a Postoffice.reset between construction and
         # report never shows a stale spine. AuxRuntime passes "default".
         self._registry = registry
+        # optional AlertManager (telemetry/alerts.py): report() renders
+        # its non-inactive rules under an "alerts:" heading, and its
+        # transitions already land in the event log via add_event —
+        # the scheduler-side console view of an SLO breach
+        self._alerts = None
+
+    def set_alerts(self, manager) -> None:
+        self._alerts = manager
 
     def add_report(self, node_id: str, report: HeartbeatReport) -> None:
         with self._lock:
@@ -94,8 +102,20 @@ class Dashboard:
                 "  ".join(c.ljust(w) for c, (_, w) in zip(cells, _COLUMNS))
             )
         lines.extend(f"event: {e}" for e in events)
+        lines.extend(self._alert_lines())
         lines.extend(self._telemetry_lines())
         return "\n".join(lines)
+
+    def _alert_lines(self) -> list:
+        if self._alerts is None:
+            return []
+        active = [
+            f"  {name} {st.state_name}"
+            + (f" value={st.value:.6g}" if st.value is not None else "")
+            for name, st in sorted(self._alerts.states().items())
+            if st.state_name != "inactive"
+        ]
+        return ["alerts:"] + active if active else []
 
     def _telemetry_lines(self) -> list:
         """Registry snapshot rendered for humans: one line per series,

@@ -49,6 +49,7 @@ class HeartbeatInfo:
         # telemetry snapshots need a counter that never resets
         self._total_in_bytes = 0  # guarded-by: _lock
         self._total_out_bytes = 0  # guarded-by: _lock
+        self._total_busy_ms = 0.0  # guarded-by: _lock
         self._last = resource_usage.sample()  # guarded-by: _lock
         self._lock = threading.Lock()
 
@@ -59,7 +60,9 @@ class HeartbeatInfo:
     def stop_timer(self) -> None:
         with self._lock:
             if self._busy_start is not None:
-                self._busy_ms += (time.perf_counter() - self._busy_start) * 1e3
+                delta = (time.perf_counter() - self._busy_start) * 1e3
+                self._busy_ms += delta
+                self._total_busy_ms += delta
                 self._busy_start = None
 
     def increase_in_bytes(self, delta: int) -> None:
@@ -81,6 +84,18 @@ class HeartbeatInfo:
     def total_out_bytes(self) -> int:
         with self._lock:
             return self._total_out_bytes
+
+    @property
+    def total_busy_ms(self) -> float:
+        """Lifetime busy-timer milliseconds — ``get()`` drains the
+        per-report delta, so the cluster metrics plane's monotone
+        ps_node_busy_seconds_total counter needs this."""
+        with self._lock:
+            return self._total_busy_ms
+
+    @property
+    def uptime_s(self) -> float:
+        return time.time() - self._start
 
     def get(self) -> HeartbeatReport:
         # The whole sample-and-diff runs under the lock (pslint
@@ -147,6 +162,18 @@ class HeartbeatCollector:
                 for nid, seen in self._last_seen.items()
                 if now - seen > self.timeout
             ]
+
+    def last_seen(self, node_id: str) -> Optional[float]:
+        """Wall time of the node's newest *landed* report, or None.
+
+        The metrics plane uses the before/after delta of this to learn
+        whether a report it just submitted actually landed — an armed
+        ``heartbeat.report`` silence drops reports inside
+        :meth:`report`, and the caller must not then feed the cluster
+        aggregator on the silenced node's behalf (a crashed node stops
+        reporting *everything*)."""
+        with self._lock:
+            return self._last_seen.get(node_id)
 
     def forget(self, node_id: str) -> None:
         """Drop a decommissioned node from liveness tracking (elastic

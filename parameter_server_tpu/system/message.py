@@ -45,6 +45,10 @@ _SAFE_PACKAGE = {
     ("parameter_server_tpu.system.message", "FilterSpec"),
     ("parameter_server_tpu.system.message", "Command"),
     ("parameter_server_tpu.utils.range", "Range"),
+    # heartbeat/metrics reports ride the message plane (aux_runtime
+    # metric reports, monitor progress): a plain dataclass of floats
+    # and one hostname string, no side effects on construction
+    ("parameter_server_tpu.system.heartbeat", "HeartbeatReport"),
 }
 
 

@@ -1,17 +1,33 @@
 """Progress monitoring (ref ``src/system/monitor.h``).
 
-MonitorMaster collects typed progress reports from slavers and merges them
-per node; MonitorSlaver pushes reports. The reference moves these over
-messages on a timer; here slavers call the master directly (same process —
-the scheduler is host-side), preserving the merge semantics and the
-periodic display hook.
+MonitorMaster collects typed progress reports from slavers and merges
+them per node; MonitorSlaver pushes reports. The reference moves these
+over messages on a timer (``monitor.h`` MonitorSlaver sends a
+``Command::UPDATE`` task every second; the master merges on receipt);
+this port keeps the direct-call path for single-process tests AND
+offers the message-plane path: a slaver constructed ``over_van`` wraps
+each report in a :class:`~parameter_server_tpu.system.message.Message`
+(``Command.EVALUATE_PROGRESS``) and ships it through the Van's real
+transfer path — filter chains, serialization, byte accounting and the
+``van.transfer`` fault point included — and ``start_periodic`` is the
+reference's reporting timer.
+
+Progress payloads on the message path must be plain data (dicts /
+lists / numbers / numpy arrays): the wire header rides the restricted
+unpickler (``message._restricted_loads``), which rejects arbitrary
+classes by design.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, Generic, Optional, TypeVar
+
+from .message import Command, Message, Task
+
+_LOG = logging.getLogger(__name__)
 
 P = TypeVar("P")
 
@@ -24,6 +40,10 @@ class MonitorMaster(Generic[P]):
         self._interval = 1.0
         self._lock = threading.Lock()
         self._start = time.time()
+        # guarded-by: _lock — maybe_print used to read-then-write this
+        # OUTSIDE the lock: two reporter threads racing the check could
+        # both pass it and print the same window twice (pslint
+        # guarded-access; regression test in tests/test_system_aux.py)
         self._last_print = 0.0
 
     def set_data_merger(self, fn: Callable[[P, P], None]) -> None:
@@ -42,15 +62,26 @@ class MonitorMaster(Generic[P]):
                 self._merger(progress, cur)
         self.maybe_print()
 
+    def handle_message(self, msg: Message) -> None:
+        """Receiver side of the message-plane path: unwrap one slaver
+        report (``task.payload = {"node": id, "progress": P}``) and
+        merge it like a direct call."""
+        payload = msg.task.payload or {}
+        self.report(payload["node"], payload["progress"])
+
     def maybe_print(self, force: bool = False) -> None:
         if self._printer is None:
             return
         now = time.time()
-        if force or now - self._last_print >= self._interval:
+        # check-and-claim the print window atomically: the snapshot is
+        # taken in the same critical section, the (potentially slow)
+        # printer runs outside it
+        with self._lock:
+            if not force and now - self._last_print < self._interval:
+                return
             self._last_print = now
-            with self._lock:
-                snapshot = dict(self._progress)
-            self._printer(now - self._start, snapshot)
+            snapshot = dict(self._progress)
+        self._printer(now - self._start, snapshot)
 
     def progress(self) -> Dict[str, P]:
         with self._lock:
@@ -58,10 +89,88 @@ class MonitorMaster(Generic[P]):
 
 
 class MonitorSlaver(Generic[P]):
-    def __init__(self, master: Optional[MonitorMaster[P]], node_id: str):
+    """Node-side reporter.
+
+    ``wire`` is the transport: None (default) calls the master
+    directly — the single-process test path; a callable ships the
+    wrapped Message (see :meth:`over_van`). ``start_periodic`` reports
+    ``progress_fn()`` on a timer like the reference's monitor thread.
+    """
+
+    def __init__(
+        self,
+        master: Optional[MonitorMaster[P]],
+        node_id: str,
+        wire: Optional[Callable[[Message], None]] = None,
+    ):
         self.master = master
         self.node_id = node_id
+        self.wire = wire
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def over_van(
+        cls,
+        master: MonitorMaster[P],
+        node_id: str,
+        van,
+        master_id: str = "H0",
+    ) -> "MonitorSlaver[P]":
+        """A slaver whose reports ride ``van.transfer`` between a fresh
+        RemoteNode endpoint pair (node → scheduler), landing in
+        ``master.handle_message`` — the reference's report-over-message
+        flow inside one process."""
+        from .remote_node import RemoteNode
+
+        tx, rx = RemoteNode(master_id), RemoteNode(node_id)
+
+        def wire(msg: Message) -> None:
+            master.handle_message(van.transfer(tx, rx, msg))
+
+        return cls(master, node_id, wire=wire)
 
     def report(self, progress: P) -> None:
-        if self.master is not None:
+        if self.wire is not None:
+            self.wire(Message(
+                task=Task(
+                    cmd=Command.EVALUATE_PROGRESS,
+                    payload={"node": self.node_id, "progress": progress},
+                ),
+                sender=self.node_id,
+                recver="H0",
+            ))
+        elif self.master is not None:
             self.master.report(self.node_id, progress)
+
+    # -- the reporting timer (ref monitor.h: slaver reports every sec) --
+
+    def start_periodic(
+        self, progress_fn: Callable[[], P], interval: float = 1.0
+    ) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    self.report(progress_fn())
+                except Exception:  # noqa: BLE001 — a dropped frame (the
+                    # van.transfer fault point) or transient wire error
+                    # loses ONE report; the timer must survive to send
+                    # the next, else the master's view silently freezes
+                    _LOG.exception(
+                        "monitor report from %s failed", self.node_id
+                    )
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name=f"monitor-{self.node_id}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None

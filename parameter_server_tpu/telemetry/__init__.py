@@ -17,8 +17,20 @@ One low-overhead spine for every layer's observability (see
   device-compute, decode, reply} and the binding resource;
 - :mod:`instruments` — the canonical catalog of metric names each layer
   records (executor phases, van bytes, parameter push/pull, app volume,
-  heartbeat traffic).
+  heartbeat traffic);
+- :mod:`aggregate` — cluster aggregation: per-node registry exports
+  merged under a ``node`` label (counters sum, gauges stay per-node,
+  histograms merge bucket-wise) with per-node staleness marking;
+- :mod:`exposition` — the HTTP scrape point (/metrics, /healthz,
+  /debug/snapshot) over the cluster aggregate;
+- :mod:`alerts` — declarative threshold/burn-rate SLO rules evaluated
+  in-process on a sliding window, pending→firing→resolved state
+  exported as ``ps_alert_state``.
 """
+
+from .aggregate import CLUSTER_NODE, ClusterAggregator
+from .alerts import AlertManager, AlertRule, default_rules, load_rules
+from .exposition import ExpositionServer, close_cluster, expose_cluster
 
 from .registry import (
     Counter,
@@ -46,12 +58,21 @@ from .spans import (
 )
 
 __all__ = [
+    "AlertManager",
+    "AlertRule",
+    "CLUSTER_NODE",
+    "ClusterAggregator",
     "Counter",
     "DuplicateMetricError",
+    "ExpositionServer",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "close_cluster",
+    "default_rules",
+    "expose_cluster",
+    "load_rules",
     "close_sink",
     "current_flow",
     "default_registry",

@@ -384,6 +384,122 @@ def recovery_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def node_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Per-node resource metrics for the cluster metrics plane
+    (system/aux_runtime.py): each registered node owns a PRIVATE
+    registry holding this family, refreshed from its HeartbeatReport at
+    every metric report and shipped over the message plane for
+    node-labeled aggregation (telemetry/aggregate.py — the ``node``
+    label is added by the aggregator, which is why the family itself is
+    unlabeled). Counters track the sampler's LIFETIME totals so
+    cross-node sums stay monotone."""
+    return {
+        "heartbeats": reg.ensure_counter(
+            "ps_node_heartbeats_total",
+            "metric reports this node shipped onto the cluster plane",
+        ),
+        "busy": reg.ensure_counter(
+            "ps_node_busy_seconds_total",
+            "lifetime busy-timer seconds (HeartbeatInfo start/stop_timer)",
+        ),
+        "net_in": reg.ensure_counter(
+            "ps_node_net_in_bytes_total",
+            "lifetime bytes received by this node (Van transfer accounting)",
+        ),
+        "net_out": reg.ensure_counter(
+            "ps_node_net_out_bytes_total",
+            "lifetime bytes sent by this node (Van transfer accounting)",
+        ),
+        "rss_mb": reg.ensure_gauge(
+            "ps_node_rss_mb",
+            "resident set size at the node's last report (MB)",
+        ),
+        "cpu": reg.ensure_gauge(
+            "ps_node_cpu_usage",
+            "process cpu usage over the node's last report window "
+            "(1.0 = one core)",
+        ),
+        "host_cpu": reg.ensure_gauge(
+            "ps_node_host_cpu_usage",
+            "whole-host cpu usage over the node's last report window",
+        ),
+        "uptime": reg.ensure_gauge(
+            "ps_node_uptime_seconds",
+            "seconds since the node's sampler started",
+        ),
+        "report_interval": reg.ensure_histogram(
+            "ps_node_report_interval_seconds",
+            "observed gap between this node's consecutive metric "
+            "reports (bucket-merged across nodes in the cluster view)",
+            buckets=PHASE_BUCKETS,
+        ),
+    }
+
+
+def cluster_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """The aggregator's own health series (telemetry/aggregate.py):
+    per-node liveness of the METRICS PLANE itself — rendered at the top
+    of every /metrics scrape so a frozen node is marked
+    (``ps_cluster_node_up 0`` + its report age) instead of its last
+    values silently reading as current."""
+    return {
+        "nodes": reg.ensure_gauge(
+            "ps_cluster_nodes",
+            "nodes the aggregator has ever heard from (and not forgotten)",
+        ),
+        "node_up": reg.ensure_gauge(
+            "ps_cluster_node_up",
+            "1 while the node's last metric report is younger than the "
+            "staleness window, else 0 (stale/dead)",
+            labelnames=("node",),
+        ),
+        "report_age": reg.ensure_gauge(
+            "ps_cluster_report_age_seconds",
+            "age of the node's newest metric report at scrape time",
+            labelnames=("node",),
+        ),
+        "reports": reg.ensure_counter(
+            "ps_cluster_reports_total",
+            "metric reports merged per node",
+            labelnames=("node",),
+        ),
+        "conflicts": reg.ensure_counter(
+            "ps_cluster_merge_conflicts_total",
+            "distinct (node, metric) pairs rejected from the merge "
+            "because the node re-declared the metric with a different "
+            "kind or bucket layout (mis-merging would be worse than "
+            "dropping; deduped — one persistently-bad export counts "
+            "once, not once per scrape)",
+        ),
+    }
+
+
+#: alert states exported by ps_alert_state (telemetry/alerts.py):
+#: 0 inactive, 1 pending (condition holding, for_s not yet elapsed),
+#: 2 firing, 3 resolved (recently cleared, held resolve_hold_s)
+ALERT_STATES = ("inactive", "pending", "firing", "resolved")
+
+
+def alert_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """SLO alerting (telemetry/alerts.py): each rule's live state and
+    its transition history as counters — scrapers page on
+    ``ps_alert_state == 2`` and the dashboard event log carries the
+    same transitions for humans."""
+    return {
+        "state": reg.ensure_gauge(
+            "ps_alert_state",
+            "alert rule state: 0 inactive / 1 pending / 2 firing / "
+            "3 resolved (recently cleared)",
+            labelnames=("rule",),
+        ),
+        "transitions": reg.ensure_counter(
+            "ps_alert_transitions_total",
+            "alert state transitions, by rule and destination state",
+            labelnames=("rule", "to"),
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -471,6 +587,9 @@ INSTRUMENT_FAMILIES = (
     serve_instruments,
     ftrl_instruments,
     recovery_instruments,
+    node_instruments,
+    cluster_instruments,
+    alert_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -74,6 +74,37 @@ def _fmt(v: float) -> str:
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
+def _help_line(name: str, help: str) -> str:
+    """One ``# HELP`` line, escaped per the exposition spec (backslash
+    and newline only — HELP text is not quoted, so no quote escaping)."""
+    return (
+        f"# HELP {name} "
+        + help.replace("\\", "\\\\").replace("\n", "\\n")
+    )
+
+
+def _histogram_lines(
+    name: str, label_fmt, bounds, bucket_counts, count: int, total: float
+) -> List[str]:
+    """The Prometheus histogram text series (cumulative ``_bucket``
+    lines, ``+Inf``, ``_sum``, ``_count``) — the ONE renderer shared by
+    the live registry and the cluster aggregator
+    (telemetry/aggregate.py), so the text format cannot drift between
+    the two /metrics producers. ``label_fmt(extra)`` renders the series'
+    label block with ``extra`` (the ``le`` pair) appended."""
+    lines: List[str] = []
+    cum = 0
+    for bound, c in zip(bounds, bucket_counts):
+        cum += c
+        le = 'le="%s"' % _fmt(bound)
+        lines.append(f"{name}_bucket{label_fmt(le)} {cum}")
+    inf = 'le="+Inf"'
+    lines.append(f"{name}_bucket{label_fmt(inf)} {count}")
+    lines.append(f"{name}_sum{label_fmt('')} {_fmt(total)}")
+    lines.append(f"{name}_count{label_fmt('')} {count}")
+    return lines
+
+
 class Instrument:
     """Base: name/help/labelnames + the per-instrument lock."""
 
@@ -97,6 +128,22 @@ class Instrument:
 
     def _snapshot_values(self):
         raise NotImplementedError
+
+    def _export_series(self) -> List[dict]:
+        """Raw, JSON-able series state (telemetry/aggregate.py): unlike
+        ``_snapshot_values`` this keeps histogram BUCKET COUNTS rather
+        than derived percentiles, so exports from different nodes can be
+        merged bucket-wise without losing information."""
+        raise NotImplementedError
+
+    def _export_decl(self) -> dict:
+        out = {
+            "type": self.kind,
+            "help": self.help,
+            "labelnames": list(self.labelnames),
+            "series": self._export_series(),
+        }
+        return out
 
     def _label_str(self, key: Tuple[str, ...]) -> str:
         if not self.labelnames:
@@ -175,6 +222,14 @@ class Counter(Instrument):
     def _snapshot_values(self):
         with self._lock:
             return {self._label_str(k): v for k, v in sorted(self._values.items())}
+
+    def _export_series(self) -> List[dict]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [
+            {"labels": dict(zip(self.labelnames, k)), "value": v}
+            for k, v in items
+        ]
 
 
 class Gauge(Counter):
@@ -311,25 +366,16 @@ class Histogram(Instrument):
     def _series_lines(self) -> List[str]:
         lines: List[str] = []
         with self._lock:
-            items = sorted(self._series.items())
-            for key, s in items:
-                cum = 0
-                for bound, c in zip(self.buckets, s.bucket_counts):
-                    cum += c
-                    le = 'le="%s"' % _fmt(bound)
-                    lines.append(
-                        f"{self.name}_bucket{self._prom_labels(key, le)} {cum}"
-                    )
-                inf = 'le="+Inf"'
-                lines.append(
-                    f"{self.name}_bucket{self._prom_labels(key, inf)} {s.count}"
-                )
-                lines.append(
-                    f"{self.name}_sum{self._prom_labels(key)} {_fmt(s.sum)}"
-                )
-                lines.append(
-                    f"{self.name}_count{self._prom_labels(key)} {s.count}"
-                )
+            items = [
+                (key, list(s.bucket_counts), s.count, s.sum)
+                for key, s in sorted(self._series.items())
+            ]
+        for key, counts, count, total in items:
+            lines.extend(_histogram_lines(
+                self.name,
+                lambda extra, key=key: self._prom_labels(key, extra),
+                self.buckets, counts, count, total,
+            ))
         return lines
 
     def _snapshot_values(self):
@@ -349,6 +395,25 @@ class Histogram(Instrument):
                     "p90": self._percentile_locked(s, 0.9),
                     "p99": self._percentile_locked(s, 0.99),
                 }
+        return out
+
+    def _export_series(self) -> List[dict]:
+        out = []
+        with self._lock:
+            for key, s in sorted(self._series.items()):
+                out.append({
+                    "labels": dict(zip(self.labelnames, key)),
+                    "buckets": list(s.bucket_counts),
+                    "count": s.count,
+                    "sum": s.sum,
+                    "min": None if s.count == 0 else s.min,
+                    "max": None if s.count == 0 else s.max,
+                })
+        return out
+
+    def _export_decl(self) -> dict:
+        out = super()._export_decl()
+        out["buckets"] = list(self.buckets)
         return out
 
 
@@ -486,14 +551,26 @@ class MetricsRegistry:
             }
         return out
 
+    def export_state(self) -> Dict[str, dict]:
+        """Raw serializable state of every instrument — the unit a node
+        ships over the message plane for cluster aggregation
+        (telemetry/aggregate.py). Plain dicts/lists/floats only, so the
+        export survives the restricted wire unpickler and ``json.dumps``
+        alike. Histograms keep raw bucket counts (mergeable); the
+        derived-percentile view stays in :meth:`snapshot`."""
+        self.collect()
+        return {
+            inst.name: inst._export_decl()
+            for inst in self._sorted_instruments()
+        }
+
     def render_text(self) -> str:
         """Prometheus text exposition (one snapshot, trailing newline)."""
         self.collect()
         lines: List[str] = []
         for inst in self._sorted_instruments():
             if inst.help:
-                help_txt = inst.help.replace("\\", "\\\\").replace("\n", "\\n")
-                lines.append(f"# HELP {inst.name} {help_txt}")
+                lines.append(_help_line(inst.name, inst.help))
             lines.append(f"# TYPE {inst.name} {inst.kind}")
             lines.extend(inst._series_lines())
         return "\n".join(lines) + "\n" if lines else ""

@@ -68,6 +68,10 @@ METADATA_SECTIONS = frozenset(
         "ftrl_sparse",
         "attribution",
         "telemetry",
+        # the --expose-port self-scrape summary (node list, series-line
+        # count, alerts firing at teardown) — run metadata, not a
+        # throughput the sentinel may band
+        "expose",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

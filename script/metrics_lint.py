@@ -10,7 +10,13 @@ and fails on:
 - non-snake_case metric or label names (the registry raises);
 - counters missing the ``_total`` suffix / histograms missing a
   ``_seconds`` or ``_bytes`` unit suffix (naming-convention drift);
-- a render_text() exposition that does not parse as Prometheus text.
+- a render_text() exposition that does not parse as Prometheus text;
+- **orphan registrations**: any ``ps_*`` instrument registered by name
+  anywhere in the package (or bench.py) outside the canonical catalog.
+  The exposition endpoint serves whatever the registry holds, so a
+  call-site-invented name would ship undocumented, un-linted series —
+  every ``ps_*`` name must exist in ``instruments.py`` (satellite of
+  the cluster-metrics-plane PR; static AST scan, no imports).
 
 Runs as the ``metrics`` pass of the pslint static-analysis suite
 (``make pslint``, doc/STATIC_ANALYSIS.md) — the logic lives here as the
@@ -22,6 +28,8 @@ so catalog drift fails CI before it ships.
 
 from __future__ import annotations
 
+import ast
+import os
 import re
 import sys
 
@@ -29,6 +37,58 @@ EXPOSITION_LINE = re.compile(
     r"^[a-z_][a-z0-9_]*(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})? [^ ]+$"
 )
+
+#: registry methods whose first positional arg is a metric name
+_REGISTER_METHODS = frozenset({
+    "counter", "gauge", "histogram",
+    "ensure_counter", "ensure_gauge", "ensure_histogram",
+})
+
+#: the one module allowed to declare ps_* names (the canonical catalog)
+_CATALOG_REL = os.path.join("telemetry", "instruments.py")
+
+
+def orphan_problems(root: str, catalog_names: "set[str]") -> list:
+    """Static AST sweep: every ``reg.counter("ps_...")``-shaped call in
+    the package (+ bench.py) must name a metric the canonical catalog
+    declares. Catches runtime-registered orphans that would be served
+    by the exposition endpoint but documented and linted nowhere."""
+    problems = []
+    pkg = os.path.join(root, "parameter_server_tpu")
+    paths = [os.path.join(root, "bench.py")]
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        paths.extend(
+            os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+        )
+    for path in sorted(paths):
+        rel = os.path.relpath(path, root)
+        if rel.endswith(_CATALOG_REL) or not os.path.exists(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            problems.append(f"{rel}: unparseable for orphan scan: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTER_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            name = node.args[0].value
+            if name.startswith("ps_") and name not in catalog_names:
+                problems.append(
+                    f"{rel}:{node.lineno} registers ps_* metric "
+                    f"{name!r} outside the instruments.py catalog "
+                    "(orphan: served but undocumented/unlinted)"
+                )
+    return problems
 
 
 def lint(root: "str | None" = None) -> list:
@@ -39,9 +99,8 @@ def lint(root: "str | None" = None) -> list:
     script's own repo. Caveat: Python's module cache wins — in a
     process that already imported the package (pytest), the cached
     import is what gets validated regardless of ``root``; the pslint
-    CLI runs fresh, where ``root`` is honored."""
-    import os
-
+    CLI runs fresh, where ``root`` is honored. The orphan scan is
+    static (AST over ``root``) and honors ``root`` either way."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, root)
@@ -88,6 +147,8 @@ def lint(root: "str | None" = None) -> list:
             continue
         if not EXPOSITION_LINE.match(line):
             problems.append(f"unparseable exposition line: {line!r}")
+
+    problems.extend(orphan_problems(root, set(instruments)))
     return problems
 
 

@@ -64,6 +64,9 @@ SCOPE = (
     "parameter_server_tpu/system/recovery.py",
     "parameter_server_tpu/system/monitor.py",
     "parameter_server_tpu/system/faults.py",
+    "parameter_server_tpu/telemetry/aggregate.py",
+    "parameter_server_tpu/telemetry/alerts.py",
+    "parameter_server_tpu/telemetry/exposition.py",
     "parameter_server_tpu/utils/concurrent.py",
     "parameter_server_tpu/parameter/parameter.py",
     "parameter_server_tpu/parameter/replica.py",
