@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench trace bench-diff metrics-serve clean
+.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench roofline trace bench-diff metrics-serve clean
 
 all: native
 
@@ -97,6 +97,17 @@ serve-bench: native
 # embedded in every bench.py record under "recovery")
 chaos-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks recovery_drill
+
+# device truth plane probe (components bench, doc/OBSERVABILITY.md
+# "Device truth plane"): an HBM-bound FTRL chain + a FLOPs-bound flash
+# fwd through instrumented wrappers with per-dispatch roofline
+# sampling — achieved GB/s / GFLOP/s per kernel against the XLA cost
+# analysis, frac-of-peak where the peak tables know the chip, and the
+# zero-steady-state-recompile sanity (fast, CPU-runnable; the full
+# per-jit inventory is embedded in every bench.py record under
+# "device")
+roofline:
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks roofline
 
 # capture a short synthetic run's flow-correlated timeline and export
 # it as Chrome trace / Perfetto JSON (open at https://ui.perfetto.dev;

@@ -386,6 +386,16 @@ def attach_attribution(
         from parameter_server_tpu.telemetry import timeline as timeline_mod
 
         events = timeline_mod.load_events(trace_path)
+        # a --profile run's device track rides the same JSONL (emitted
+        # by phase_breakdown): stitch it to the submitting executor.step
+        # spans so the breakdown summary below grows the per-kernel
+        # device_compute_breakdown and flows cross the host/chip line
+        dev_events = [e for e in events if attr_mod.is_device_event(e)]
+        if dev_events:
+            events = timeline_mod.merge_device_track(
+                [e for e in events if not attr_mod.is_device_event(e)],
+                dev_events,
+            )
         section: dict = {"trace_jsonl": trace_path}
         breakdown = [e for e in events if e.get("phase") == "breakdown"]
         if breakdown:
@@ -641,6 +651,49 @@ def attach_recovery(rec_or_headline: dict, smoke: bool) -> None:
             rec_or_headline["recovery"] = recovery_drill(smoke)
     except Exception as e:
         rec_or_headline["recovery_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
+def attach_device(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the device truth plane
+    (parameter_server_tpu/telemetry/device.py) under ``device`` in
+    every bench record: per-jit cost-analysis FLOPs/bytes and buffer
+    sizes from the compiled-function inventory (the kv_ops entry
+    points + every step builder wrap into it), recompile counts with
+    the post-warmup total (the warmup mark is set right before the
+    timed e2e phase, so a healthy record reads zero), the runtime
+    donation-fallback count (zero on the data plane — a nonzero means
+    XLA silently turned an in-place table update into a copy), HBM /
+    live-buffer high-water, and the roofline cross-checks: the
+    ``ftrl_sparse`` hand bytes model vs the XLA-derived bytes (ratio
+    disclosed in the A/B section itself) and the flash fwd hand-FLOPs
+    vs cost-analysis probe. Capture-hardware facts, not trajectory
+    points — script/bench_diff.py excludes this section from banding
+    (METADATA_SECTIONS); never breaks a record."""
+    try:
+        from parameter_server_tpu.telemetry import device as device_mod
+
+        section = device_mod.snapshot()
+        rooflines: dict = {}
+        fs = rec_or_headline.get("ftrl_sparse")
+        if isinstance(fs, dict) and isinstance(
+            fs.get("bytes_model_cross_check"), dict
+        ):
+            rooflines["ftrl_sparse"] = dict(fs["bytes_model_cross_check"])
+        try:
+            from parameter_server_tpu.benchmarks.components import (
+                flash_cost_crosscheck,
+            )
+
+            rooflines["flash"] = flash_cost_crosscheck(smoke)
+        except Exception as e:
+            rooflines["flash_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        if rooflines:
+            section["rooflines"] = rooflines
+        rec_or_headline["device"] = section
+    except Exception as e:
+        rec_or_headline["device_error"] = (
             f"{type(e).__name__}: {str(e)[:200]}"
         )
 
@@ -1187,6 +1240,11 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
                 device_trace(profile_dir) if (profile_dir and i == 0)
                 else contextlib.nullcontext()
             )
+            if i == 0:
+                # wall anchor for the merged device track: the profiler
+                # clock has no wall reference, so the capture's ops are
+                # shifted to start at this launch's host wall time
+                dev_wall0 = time.time()
             t0 = time.perf_counter()
             with ctx:
                 # the profiler's device tracks line up with the host
@@ -1217,7 +1275,10 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
         out["breakdown_upload_mb_s"] = round(bytes_moved / up_s / 1e6, 1)
     if profile_dir:
         out["profile_dir"] = profile_dir
-        from parameter_server_tpu.utils.profiling import summarize_trace
+        from parameter_server_tpu.utils.profiling import (
+            device_track_events,
+            summarize_trace,
+        )
 
         summary = summarize_trace(profile_dir)
         if summary:
@@ -1227,6 +1288,17 @@ def phase_breakdown(worker, make_parts, T: int, launches: int = 3,
             out["profile_device_ms"] = summary["device_ms"]
             out["profile_phases_ms"] = summary["phases"]
             out["profile_top_ops"] = summary["top_ops"][:6]
+        # the capture's device ops land in the run's span timeline as a
+        # device:<pid> track (anchored at the profiled launch's wall
+        # time), so the Chrome export renders them under the host
+        # tracks and attach_attribution grows its device_compute
+        # sub-breakdown + flow arrows from the submitting step spans
+        dev_events = device_track_events(profile_dir, host_anchor=dev_wall0)
+        for ev in dev_events:
+            ev["phase"] = "breakdown"
+            telemetry_spans.emit(dict(ev))
+        if dev_events:
+            out["profile_device_track_events"] = len(dev_events)
     return out
 
 
@@ -1540,6 +1612,12 @@ def run_real(args) -> int:
     Postoffice.reset()
     po = Postoffice.instance().start()
     trace_path = ensure_trace_sink()
+    # HBM/live-buffer gauges refresh on every snapshot/scrape from here
+    # on (telemetry/device.py collector; feeds the record's device.hbm
+    # section and the ps_device_hbm_* families on /metrics)
+    from parameter_server_tpu.telemetry.device import install_hbm_monitor
+
+    install_hbm_monitor()
     _maybe_expose(po, args)
 
     alpha, beta, l1 = 0.1, 1.0, 1.0
@@ -1722,6 +1800,12 @@ def run_real(args) -> int:
         # it overlaps the uploader's socket writes and the device steps
         return iter_on_thread(host_prepped(), maxsize=3 * T)
 
+    # warmup mark for the device inventory: every program the timed
+    # stream below will run has compiled by now (warmup + headline +
+    # the A/B attaches) — recompiles_post_warmup must read zero
+    from parameter_server_tpu.telemetry import device as _device_mod
+
+    _device_mod.mark_warmup()
     e2e_wall0 = time.time()
     t0 = time.perf_counter()
     done_ex = 0
@@ -1764,6 +1848,9 @@ def run_real(args) -> int:
     }
     rec.update(headline)
     reconcile_link_ceiling(rec, wire_bytes_moved, done_ex, dt)
+    # device truth plane AFTER the timed stream: the post-warmup
+    # recompile count covers the phase that must not re-specialize
+    attach_device(rec, args.smoke)
     attach_attribution(rec, trace_path, (e2e_wall0, e2e_wall1))
     _finish(rec)
     return 0
@@ -2017,6 +2104,12 @@ def run_synthetic(args) -> int:
     Postoffice.reset()
     po = Postoffice.instance().start()  # all local devices, 1 server axis
     trace_path = ensure_trace_sink()
+    # HBM/live-buffer gauges refresh on every snapshot/scrape from here
+    # on (telemetry/device.py collector; feeds the record's device.hbm
+    # section and the ps_device_hbm_* families on /metrics)
+    from parameter_server_tpu.telemetry.device import install_hbm_monitor
+
+    install_hbm_monitor()
     _maybe_expose(po, args)
     n_workers = meshlib.num_workers(po.mesh)
 
@@ -2211,6 +2304,11 @@ def run_synthetic(args) -> int:
     rates = []
     done = 0
     wire_counter["bytes"] = 0  # count the TIMED phase only (not warmup)
+    # warmup mark for the device inventory (see run_real): the timed
+    # windows below must trigger zero new compiles
+    from parameter_server_tpu.telemetry import device as _device_mod
+
+    _device_mod.mark_warmup()
     e2e_wall0 = time.time()
     t0 = time.perf_counter()
     pending = []
@@ -2263,6 +2361,9 @@ def run_synthetic(args) -> int:
     reconcile_link_ceiling(
         rec, wire_counter["bytes"], done * args.minibatch, dt
     )
+    # device truth plane AFTER the timed windows (post-warmup
+    # recompiles cover the phase that must not re-specialize)
+    attach_device(rec, args.smoke)
     attach_attribution(rec, trace_path, (e2e_wall0, e2e_wall1))
     _finish(rec)
     return 0

@@ -774,7 +774,7 @@ def _progress_metrics(loss, y, xw, mask, with_aux: bool):
     return metrics
 
 
-def _donation_variants(step_impl):
+def _donation_variants(step_impl, name: str = "train_step"):
     """Wrap a traced ``(live, pull, batch, seed) -> (new_state, metrics)``
     step with input-buffer donation where it is legal.
 
@@ -791,18 +791,34 @@ def _donation_variants(step_impl):
     - ``pull is live`` otherwise: a single-argument non-donated program —
       the snapshot buffer must survive for future delayed steps.
     - distinct buffers (delayed step): donate live, pull is safe.
+
+    Each jitted variant is wrapped into the device inventory
+    (telemetry/device.py) under ``<name>.<variant>``: per-step-builder
+    cost/memory analysis lands in the bench record's ``device``
+    section, new-aval recompiles are counted (zero post-warmup on a
+    healthy run), and the donated variants' input→output aliasing is
+    runtime-verified (a fallback means the step silently paid a
+    whole-table copy).
     """
-    step_delay = functools.partial(jax.jit, donate_argnums=(0,))(step_impl)
+    from ...telemetry import device as device_tel
+
+    step_delay = device_tel.instrument(
+        f"{name}.delay",
+        functools.partial(jax.jit, donate_argnums=(0,))(step_impl),
+        donate_argnums=(0,),
+    )
 
     def snap_impl(live_state, batch, seed):
         return step_impl(live_state, live_state, batch, seed)
 
     # no-donate: the snapshot buffer must survive for future delayed
     # steps (max_delay > 0); the donate_ok path below covers delay 0
-    step_snap = jax.jit(snap_impl)
-    step_snap_donate = functools.partial(
-        jax.jit, donate_argnums=(0,)
-    )(snap_impl)
+    step_snap = device_tel.instrument(f"{name}.snap", jax.jit(snap_impl))
+    step_snap_donate = device_tel.instrument(
+        f"{name}.snap_donate",
+        functools.partial(jax.jit, donate_argnums=(0,))(snap_impl),
+        donate_argnums=(0,),
+    )
 
     def step(live_state, pull_state, batch, seed=np.uint32(0),
              donate_ok: bool = False):
@@ -889,7 +905,7 @@ def make_train_step_ell(
             check_vma=False,
         )(live_state, pull_state, seed, batch.y, batch.mask, slots, vals)
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_ell")
 
 
 def _make_bits_mini_step(
@@ -991,7 +1007,7 @@ def make_train_step_ell_bits(
         )(live_state, pull_state, seed, batch.y_bits, batch.counts,
           batch.slots_words)
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_ell_bits")
 
 
 def make_train_step_ell_bits_scan(
@@ -1060,7 +1076,7 @@ def make_train_step_ell_bits_scan(
         )(live_state, pull_state, seed, batch.y_bits, batch.counts,
           batch.slots_words)
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_ell_bits_scan")
 
 
 def make_train_step_hashed(
@@ -1125,7 +1141,7 @@ def make_train_step_hashed(
             batch.vals,
         )
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_hashed")
 
 
 def sparse_update_min_slots() -> int:
@@ -1361,7 +1377,7 @@ def make_train_step_scan(
             batch.umask,
         )
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_exact_scan")
 
 
 def _encoded_shard_decoder(num_slots: int):
@@ -1425,7 +1441,7 @@ def make_train_step_encoded(
             check_vma=False,
         )(live_state, pull_state, seed, batch)
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_encoded")
 
 
 def make_train_step_encoded_scan(
@@ -1481,7 +1497,7 @@ def make_train_step_encoded_scan(
             check_vma=False,
         )(live_state, pull_state, seed, batch)
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_encoded_scan")
 
 
 def make_train_step(
@@ -1539,7 +1555,7 @@ def make_train_step(
             batch.umask,
         )
 
-    return _donation_variants(step_impl)
+    return _donation_variants(step_impl, name="step_exact")
 
 
 _SUPPORTED_FILTERS = (

@@ -33,6 +33,21 @@ HBM_PEAK_GB_S = {
     "TPU v6e": 1640.0,
 }
 
+#: chip bf16 matmul peak (TFLOP/s) by jax device_kind — the MFU
+#: denominator for every flops roofline frac (telemetry/device.py
+#: roofline gauges, the bench record's ``device`` section). Same
+#: honesty rule as the HBM table: unknown kinds (CPU hosts) resolve to
+#: None and the frac is reported as null, never faked.
+FLOPS_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 394.0,
+    "TPU v5e": 394.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
 
 def benchmark(name: str):
     def deco(fn):

@@ -1863,6 +1863,109 @@ def ftrl_sparse_ab(smoke: bool = False) -> dict:
             "measured_on": "next make bench-all with a reachable device",
         },
     }
+    # XLA-derived bytes cross-check (device truth plane, telemetry/
+    # device.py): the hand 512B-granule model above is the TPU DMA
+    # story; cost_analysis() is the compiler's own count. The ratio is
+    # DISCLOSED, not gated — XLA counts element bytes (no row-granule
+    # rounding), so disagreement off-TPU is expected and its size says
+    # how much of the hand model is granule overhead vs real traffic.
+    from ..telemetry.device import aot_analyze
+
+    analyses = {
+        name: aot_analyze(fn, *boxes[name]) for name, fn in arms.items()
+    }
+    fused_an = analyses.get("fused") or {}
+    rows_an = analyses.get("xla_rows") or {}
+    if fused_an.get("bytes_accessed"):
+        xla_fused_b = fused_an["bytes_accessed"]
+        xla_gb_s = xla_fused_b / sec["fused"] / 1e9
+        out["bytes_model_cross_check"] = {
+            "hand_fused_bytes": int(fused_bytes),
+            "xla_fused_bytes_accessed": int(xla_fused_b),
+            "hand_over_xla_ratio": round(fused_bytes / xla_fused_b, 3),
+            "hand_xla_rows_bytes": int(xla_bytes),
+            "xla_rows_bytes_accessed": (
+                int(rows_an["bytes_accessed"])
+                if rows_an.get("bytes_accessed") else None
+            ),
+            "xla_fused_hbm_gb_s": round(xla_gb_s, 2),
+            "frac_of_peak_xla": (
+                round(xla_gb_s / peak, 4) if peak else None
+            ),
+            "fused_flops": (
+                int(fused_an["flops"]) if fused_an.get("flops") else None
+            ),
+            "donation_aliased": (
+                fused_an.get("alias_bytes", 0) > 0
+                and not fused_an.get("donation_warned", False)
+            ),
+            "note": "hand = 512B row-granule DMA model; XLA cost "
+            "analysis counts element bytes — the ratio is disclosure, "
+            "not a gate (re-judge on a device capture)",
+        }
+    return out
+
+
+def flash_cost_crosscheck(smoke: bool = False) -> dict:
+    """Flash-attention fwd: hand FLOPs model vs XLA cost analysis.
+
+    The MFU tables (doc/PERFORMANCE.md "Byte-LM training MFU") divide
+    by the hand ``4·bh·s²·d`` convention; this probe asks the compiler
+    what it actually counted at the same shape and disclosed the ratio
+    — the flash half of the bench record's roofline cross-check. Runs
+    the XLA formulation on every backend (a Pallas custom call is
+    opaque to cost analysis); one timed flush gives the achieved
+    TFLOP/s both models imply, with frac-of-peak only where the peak
+    table knows the chip."""
+    import time as _time
+
+    import jax
+
+    from ..ops.flash_attention import flash_attention
+    from ..telemetry.device import aot_analyze
+    from . import FLOPS_PEAK_TFLOPS
+
+    bh, d = 4, 64
+    s = 256 if smoke else 1024
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(rng.normal(size=(bh, s, d)).astype(np.float32))
+        for _ in range(3)
+    )
+    fn = jax.jit(
+        lambda qq, kk, vv: flash_attention(
+            qq, kk, vv, causal=True, use_pallas=False
+        )
+    )
+    hand_flops = 4.0 * bh * s * s * d
+    an = aot_analyze(fn, q, k, v) or {}
+    jax.block_until_ready(fn(q, k, v))  # compile + warm untimed
+    reps = 3
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(q, k, v))
+    sec = (_time.perf_counter() - t0) / reps
+    dev = jax.devices()[0]
+    peak = FLOPS_PEAK_TFLOPS.get(dev.device_kind)
+    out = {
+        "shape_bh_s_d": [bh, s, d],
+        "device_kind": dev.device_kind,
+        "hand_flops": int(hand_flops),
+        "hand_tflops": round(hand_flops / sec / 1e12, 5),
+        "xla_path": True,  # cost analysis needs the non-Pallas program
+    }
+    if an.get("flops"):
+        out["xla_flops"] = int(an["flops"])
+        out["hand_over_xla_ratio"] = round(hand_flops / an["flops"], 3)
+        out["xla_tflops"] = round(an["flops"] / sec / 1e12, 5)
+    if an.get("bytes_accessed"):
+        out["xla_bytes_accessed"] = int(an["bytes_accessed"])
+    if peak:
+        out["mfu_hand"] = round(hand_flops / sec / 1e12 / peak, 6)
+        if an.get("flops"):
+            out["mfu_xla"] = round(an["flops"] / sec / 1e12 / peak, 6)
+    else:
+        out["mfu_hand"] = None  # CPU host: no faked peak (HBM table rule)
     return out
 
 
@@ -1960,3 +2063,93 @@ def ftrl_chain_perf(smoke: bool = False) -> None:
                 f"ftrl_dense_{name}_{tag}_chain_gb_s",
                 16.0 * p * chain_len / sec / 1e9, "GB/s",
             )
+
+
+@benchmark("roofline")
+def roofline_probe(smoke: bool = False) -> None:
+    """``make roofline``: drive the device truth plane end to end on
+    the live backend (telemetry/device.py).
+
+    Two representative kernels — a dense FTRL chain (HBM-bound) and a
+    flash-attention fwd (FLOPs-bound) — run through instrumented
+    wrappers with per-call roofline sampling, so each dispatch lands
+    its measured wall time against its own XLA cost analysis. Reports
+    achieved GB/s / TFLOP/s per kernel, frac-of-peak where the peak
+    tables know the chip (CPU hosts report the achieved rates only —
+    the frac is never faked), and the inventory's compile/recompile
+    sanity (a steady-shape probe must recompile zero times after its
+    first call). The same families are node-labeled on /metrics
+    (``ps_device_kernel_*``, ``ps_device_roofline_frac``)."""
+    import jax
+
+    from ..ops.flash_attention import flash_attention
+    from ..ops.ftrl import ftrl_update_ref
+    from ..telemetry import device as device_tel
+
+    inv = device_tel.DeviceInventory()
+    inv.set_sampling(1)  # every dispatch timed: this is a measurement run
+    rng = np.random.default_rng(0)
+
+    # HBM-bound probe: 8 chained dense FTRL updates in one program
+    p = 1 << (14 if smoke else 20)
+    kw = dict(alpha=0.1, beta=1.0, l1=0.05, l2=0.0)
+
+    def chain(z, n, g):
+        for _ in range(8):
+            z, n = ftrl_update_ref(z, n, g, None, **kw)
+        return z, n
+
+    ftrl_fn = inv.instrument(
+        "roofline_ftrl_chain",
+        jax.jit(chain, donate_argnums=(0, 1)),
+        donate_argnums=(0, 1),
+    )
+    box = [
+        jax.device_put(rng.normal(size=p).astype(np.float32)),
+        jax.device_put(np.abs(rng.normal(size=p)).astype(np.float32)),
+    ]
+    g = jax.device_put(rng.normal(size=p).astype(np.float32))
+    for _ in range(3 if smoke else 5):
+        box = list(ftrl_fn(*box, g))
+    jax.block_until_ready(box[0])
+
+    # FLOPs-bound probe: flash fwd, XLA formulation (cost-analyzable)
+    bh, s, d = 4, 256 if smoke else 1024, 64
+    q, k, v = (
+        jax.device_put(rng.normal(size=(bh, s, d)).astype(np.float32))
+        for _ in range(3)
+    )
+    flash_fn = inv.instrument(
+        "roofline_flash_fwd",
+        jax.jit(
+            lambda qq, kk, vv: flash_attention(
+                qq, kk, vv, causal=True, use_pallas=False
+            )
+        ),
+    )
+    for _ in range(3 if smoke else 5):
+        jax.block_until_ready(flash_fn(q, k, v))
+
+    snap = inv.snapshot()
+    recompiles = sum(
+        rec["recompiles"] for rec in snap["functions"].values()
+    )
+    report("roofline_functions", len(snap["functions"]), "fns")
+    report("roofline_steady_recompiles_plus_one", recompiles + 1, "compiles")
+    for name, rec in sorted(snap["functions"].items()):
+        tl = rec.get("roofline") or {}
+        # every guard below is `is not None`, not truthiness — an
+        # achieved rate or frac that rounds to 0.0 is a catastrophic
+        # regression the capture must report, not omit (PR 8 rule)
+        if tl.get("achieved_gb_s") is not None:
+            report(f"{name}_gb_s", tl["achieved_gb_s"], "GB/s")
+        if tl.get("achieved_tflops") is not None:
+            # GFLOP/s (and pct below): report()'s 2-decimal rounding
+            # would flatten a CPU-host TFLOP/s figure to 0.0
+            report(f"{name}_gflops", tl["achieved_tflops"] * 1e3,
+                   "GFLOP/s")
+        if tl.get("frac_of_hbm_peak") is not None:
+            report(f"{name}_hbm_peak_pct",
+                   tl["frac_of_hbm_peak"] * 100.0, "pct")
+        if tl.get("mfu") is not None:
+            report(f"{name}_mfu_pct", tl["mfu"] * 100.0, "pct")

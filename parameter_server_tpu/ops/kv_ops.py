@@ -47,6 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map
 
 from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
+from ..telemetry import device as _device
 from ..telemetry.instruments import cached_kvops_instruments as _tel
 
 
@@ -112,9 +113,18 @@ def _pull_impl(table, idx, *, mesh: Mesh, batch_sharded: bool = True):
     )(table, idx)
 
 
-# no-donate: pull reads the table; the store keeps serving it afterwards
-pull = functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))(
-    _pull_impl
+# no-donate: pull reads the table; the store keeps serving it afterwards.
+# Every public entry point below is wrapped into the device inventory
+# (telemetry/device.py): each lower().compile() lands its cost/memory
+# analysis in the ``device`` bench section, recompiles are counted per
+# name, and the donated paths' aliasing is runtime-verified.
+pull = _device.instrument(
+    "kv_pull",
+    # no-donate: pull reads the table; the store keeps serving it
+    functools.partial(jax.jit, static_argnames=("mesh", "batch_sharded"))(
+        _pull_impl
+    ),
+    static_argnames=("mesh", "batch_sharded"),
 )
 pull.__doc__ = """Gather rows ``table[idx]`` from a server-sharded table.
 
@@ -173,7 +183,13 @@ _PUSH_STATICS = ("mesh", "batch_sharded", "average", "combine_data")
 # no-donate: the copying path — for callers whose input table must
 # survive the push (checkpoint staging, A/B benches); owners use
 # push_donated
-push = functools.partial(jax.jit, static_argnames=_PUSH_STATICS)(_push_impl)
+push = _device.instrument(
+    "kv_push",
+    # no-donate: the copying path — for callers whose input table must
+    # survive the push (checkpoint staging, A/B benches)
+    functools.partial(jax.jit, static_argnames=_PUSH_STATICS)(_push_impl),
+    static_argnames=_PUSH_STATICS,
+)
 push.__doc__ = """Scatter-add ``vals`` at ``idx`` into the server-sharded table.
 
 table: [P, k] sharded P(SERVER, None); idx: [n] int32; vals: [n, k].
@@ -186,9 +202,14 @@ This entry point COPIES: XLA materializes a fresh table output. Callers
 that own their table should use :func:`push_donated` (in-place).
 """
 
-_push_donated_jit = functools.partial(
-    jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
-)(_push_impl)
+_push_donated_jit = _device.instrument(
+    "kv_push_donated",
+    functools.partial(
+        jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
+    )(_push_impl),
+    static_argnames=_PUSH_STATICS,
+    donate_argnums=(0,),
+)
 
 
 def push_donated(table, idx, vals, **kw):
@@ -236,12 +257,22 @@ def _push_pull_impl(
 
 
 # no-donate: the copying fused path (A/B benches, shared-table callers)
-_push_pull_jit = functools.partial(
-    jax.jit, static_argnames=_PUSH_STATICS
-)(_push_pull_impl)
-_push_pull_donated_jit = functools.partial(
-    jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
-)(_push_pull_impl)
+_push_pull_jit = _device.instrument(
+    "kv_push_pull",
+    # no-donate: the copying fused path (A/B benches, shared tables)
+    functools.partial(
+        jax.jit, static_argnames=_PUSH_STATICS
+    )(_push_pull_impl),
+    static_argnames=_PUSH_STATICS,
+)
+_push_pull_donated_jit = _device.instrument(
+    "kv_push_pull_donated",
+    functools.partial(
+        jax.jit, static_argnames=_PUSH_STATICS, donate_argnums=(0,)
+    )(_push_pull_impl),
+    static_argnames=_PUSH_STATICS,
+    donate_argnums=(0,),
+)
 
 
 def _dispatch_fused(jit_fn, table, idx, vals, pull_idx, kw):

@@ -25,11 +25,17 @@ One low-overhead spine for every layer's observability (see
   /debug/snapshot) over the cluster aggregate;
 - :mod:`alerts` — declarative threshold/burn-rate SLO rules evaluated
   in-process on a sliding window, pending→firing→resolved state
-  exported as ``ps_alert_state``.
+  exported as ``ps_alert_state``;
+- :mod:`device` — the device truth plane: a compiled-function
+  inventory over the jit entry points (per-name cost/memory analysis,
+  recompile detection, runtime donation-aliasing verification), live
+  roofline gauges, and HBM/live-buffer accounting
+  (``doc/OBSERVABILITY.md`` "Device truth plane").
 """
 
 from .aggregate import CLUSTER_NODE, ClusterAggregator
 from .alerts import AlertManager, AlertRule, default_rules, load_rules
+from .device import DeviceInventory, HbmMonitor, aot_analyze, instrument
 from .exposition import ExpositionServer, close_cluster, expose_cluster
 
 from .registry import (
@@ -63,15 +69,19 @@ __all__ = [
     "CLUSTER_NODE",
     "ClusterAggregator",
     "Counter",
+    "DeviceInventory",
     "DuplicateMetricError",
     "ExpositionServer",
     "Gauge",
+    "HbmMonitor",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
+    "aot_analyze",
     "close_cluster",
     "default_rules",
     "expose_cluster",
+    "instrument",
     "load_rules",
     "close_sink",
     "current_flow",

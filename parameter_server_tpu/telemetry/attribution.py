@@ -60,6 +60,16 @@ CATEGORIES = (
 #: binding resource is named among these; queue_wait/reply are symptoms)
 RESOURCE_CATEGORIES = ("host_prep", "encode", "upload", "device_compute", "decode")
 
+#: device-track events (utils/profiling.device_track_events — a merged
+#: jax.profiler capture) are named ``device.<op>`` on ``device:<pid>``
+#: threads. They are deliberately OUTSIDE the category map: their wall
+#: time is already billed to device_compute through the executor
+#: run/materialize phases, so categorizing them would double-count.
+#: Instead :func:`device_breakdown` turns them into the per-kernel
+#: sub-breakdown of device_compute that :func:`summarize` attaches as
+#: ``device_compute_breakdown`` whenever a device track is present.
+DEVICE_TRACK_PREFIX = "device."
+
 #: span-name prefix → category. Longest prefix wins; names outside the
 #: map contribute to the timeline but not to attribution.
 NAME_CATEGORIES: Dict[str, str] = {
@@ -86,12 +96,22 @@ NAME_CATEGORIES: Dict[str, str] = {
 
 
 def categorize(name: str) -> Optional[str]:
+    if name.startswith(DEVICE_TRACK_PREFIX):
+        return None  # device track: handled by device_breakdown
     best: Optional[str] = None
     best_len = -1
     for prefix, cat in NAME_CATEGORIES.items():
         if name.startswith(prefix) and len(prefix) > best_len:
             best, best_len = cat, len(prefix)
     return best
+
+
+def is_device_event(ev: Dict[str, Any]) -> bool:
+    """True for merged device-track spans (``device.<op>`` names on a
+    ``device:<pid>`` thread)."""
+    return str(ev.get("name", "")).startswith(DEVICE_TRACK_PREFIX) or str(
+        ev.get("thread", "")
+    ).startswith("device:")
 
 
 def categorize_event(ev: Dict[str, Any]) -> Optional[str]:
@@ -158,6 +178,12 @@ def _clip(start: float, dur: float, window: Optional[Tuple[float, float]]) -> fl
     return max(0.0, min(start + dur, hi) - max(start, lo))
 
 
+def _start_end_dur(ev: Dict[str, Any]) -> Tuple[float, float]:
+    """(start, duration) of one span event — the _clip calling shape."""
+    s, e = _start_end(ev)
+    return s, e - s
+
+
 def _merge_intervals(
     intervals: List[Tuple[float, float]],
 ) -> List[Tuple[float, float]]:
@@ -215,6 +241,105 @@ def busy_by_category(
                     sec -= _clip(ov_lo, ov_hi - ov_lo, window)
         busy[cat] += max(0.0, sec)
     return busy
+
+
+def _span_self_times(spans: List[Dict[str, Any]]):
+    """Yield ``(event, self_s)`` per span of ONE track: duration minus
+    time covered by child spans nested inside it (same stack pass as
+    utils/profiling._self_times, over span dicts) — a ``while``/
+    ``fusion`` wrapper is credited only the time its body ops leave."""
+    evs = sorted(
+        spans,
+        key=lambda e: (
+            float(e.get("t_wall", 0.0)), -float(e.get("dur_s", 0.0) or 0.0)
+        ),
+    )
+    stack: List[list] = []  # [event, end_t, child_s]
+    for ev in evs:
+        t0 = float(ev.get("t_wall", 0.0))
+        dur = float(ev.get("dur_s", 0.0) or 0.0)
+        while stack and t0 >= stack[-1][1]:
+            top, _, child = stack.pop()
+            yield top, float(top.get("dur_s", 0.0) or 0.0) - child
+        if stack:
+            stack[-1][2] += dur
+        stack.append([ev, t0 + dur, 0.0])
+    while stack:
+        top, _, child = stack.pop()
+        yield top, float(top.get("dur_s", 0.0) or 0.0) - child
+
+
+def device_breakdown(
+    events: Sequence[Dict[str, Any]],
+    window: Optional[Tuple[float, float]] = None,
+    top: int = 8,
+) -> Optional[Dict[str, Any]]:
+    """Per-kernel sub-breakdown of device_compute from a merged device
+    track, or None when the trace carries no device events.
+
+    Busy time is per-kernel SELF time (nesting carved out, per device
+    thread); ``gap_s`` is the device wall window minus the union of op
+    intervals — a kernel-dominated capture shows ``busy_frac`` near
+    1.0, a dispatch-bound one shows the gaps the ROADMAP's "where do
+    the other 96% go" question is about. ``shares`` normalize over
+    total device busy time (the device_compute analog of the resource
+    view's ``shares``)."""
+    dev = [
+        ev for ev in events
+        if is_device_event(ev) and not ev.get("abandoned")
+    ]
+    if not dev:
+        return None
+    if window is None:
+        window = events_window(dev)
+    wall = max(0.0, window[1] - window[0])
+    by_thread: Dict[Any, List[Dict[str, Any]]] = {}
+    for ev in dev:
+        by_thread.setdefault(ev.get("thread"), []).append(ev)
+    per_kernel: Dict[str, List[float]] = {}
+    intervals: List[Tuple[float, float]] = []
+    busy_total = 0.0
+    for track in by_thread.values():
+        for ev in track:
+            s = float(ev.get("t_wall", 0.0))
+            d = float(ev.get("dur_s", 0.0) or 0.0)
+            lo, hi = max(s, window[0]), min(s + d, window[1])
+            if hi > lo:
+                intervals.append((lo, hi))
+        for ev, self_s in _span_self_times(track):
+            sec = min(self_s, _clip(
+                float(ev.get("t_wall", 0.0)), float(ev.get("dur_s", 0.0) or 0.0),
+                window,
+            ))
+            if sec <= 0.0:
+                continue
+            name = str(ev.get("name", "?"))
+            if name.startswith(DEVICE_TRACK_PREFIX):
+                name = name[len(DEVICE_TRACK_PREFIX):]
+            rec = per_kernel.setdefault(name, [0.0, 0])
+            rec[0] += sec
+            rec[1] += 1
+            busy_total += sec
+    covered = sum(hi - lo for lo, hi in _merge_intervals(intervals))
+    out: Dict[str, Any] = {
+        "device_busy_s": round(busy_total, 6),
+        "wall_s": round(wall, 6),
+        "gap_s": round(max(0.0, wall - covered), 6),
+        "busy_frac": round(covered / wall, 4) if wall > 0 else None,
+        "tracks": len(by_thread),
+    }
+    if busy_total > 0:
+        ranked = sorted(per_kernel.items(), key=lambda kv: -kv[1][0])
+        out["kernels"] = [
+            {
+                "name": k,
+                "ms": round(v[0] * 1e3, 4),
+                "calls": v[1],
+                "share": round(v[0] / busy_total, 4),
+            }
+            for k, v in ranked[:top]
+        ]
+    return out
 
 
 def flow_critical_path(seq: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
@@ -337,6 +462,20 @@ def summarize(
         "abandoned_spans": abandoned,
         "flows": attribute_flows(events, window),
     }
+    # the per-kernel view of where device_compute itself goes — present
+    # only when a profiler capture's device track was merged into this
+    # timeline; records without one are unchanged. Gap accounting runs
+    # over the device TRACK's own extent (a capture covers one launch,
+    # not the whole bench window — clipping to `window` would charge
+    # every non-captured second as device gap).
+    dev_events = [
+        ev for ev in events
+        if is_device_event(ev)
+        and (window is None or _clip(*_start_end_dur(ev), window) > 0.0)
+    ]
+    dev = device_breakdown(dev_events) if dev_events else None
+    if dev is not None:
+        out["device_compute_breakdown"] = dev
     if stage_total > 0.0:
         out["shares"] = {
             cat: round(sec / stage_total, 4)

@@ -357,6 +357,101 @@ def ftrl_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def device_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Device truth plane (telemetry/device.py): per-jit compile and
+    recompile counts from the compiled-function inventory, the runtime
+    donation-aliasing verifier, live roofline gauges (achieved GB/s /
+    TFLOP/s and frac-of-peak against the benchmarks peak tables), and
+    HBM/live-buffer accounting sampled by a registry collector. The
+    ``fn`` label is the inventory name the wrap point declared
+    (kv_push, step_encoded_scan.snap_donate, ...); ``resource`` is
+    hbm or flops. A recompile RATE above noise is a storm (shape churn
+    re-tracing every step — the configs/alerts/default.json rule); a
+    donation fallback means XLA silently turned an in-place update
+    into a whole-table copy (doc/PERFORMANCE.md "Donation rules")."""
+    return {
+        "compiles": reg.ensure_counter(
+            "ps_device_compiles_total",
+            "XLA compiles owned by the device inventory, per named "
+            "function (first compile + every re-specialization)",
+            labelnames=("fn",),
+        ),
+        "recompiles": reg.ensure_counter(
+            "ps_device_recompiles_total",
+            "compiles BEYOND a function's first — new avals or statics "
+            "re-specialized an already-compiled entry point (zero on a "
+            "healthy steady-state run after warmup)",
+            labelnames=("fn",),
+        ),
+        "donation_fallbacks": reg.ensure_counter(
+            "ps_device_donation_fallbacks_total",
+            "compiles where a declared donation did not fully alias "
+            "input to output (memory_analysis alias bytes below the "
+            "donated argument bytes, or XLA's donated-buffers-unusable "
+            "warning) — the update silently paid a copy",
+            labelnames=("fn",),
+        ),
+        "dispatch_fallbacks": reg.ensure_counter(
+            "ps_device_dispatch_fallbacks_total",
+            "instrumented calls routed to the plain jit path (signature "
+            "unreadable, or the compiled executable rejected the args) "
+            "— correctness preserved, chip accounting skipped",
+            labelnames=("fn",),
+        ),
+        "kernel_gb_s": reg.ensure_gauge(
+            "ps_device_kernel_gb_s",
+            "achieved HBM GB/s of the last sampled dispatch "
+            "(cost-analysis bytes / measured wall time)",
+            labelnames=("fn",),
+        ),
+        "kernel_tflops": reg.ensure_gauge(
+            "ps_device_kernel_tflops",
+            "achieved TFLOP/s of the last sampled dispatch "
+            "(cost-analysis FLOPs / measured wall time)",
+            labelnames=("fn",),
+        ),
+        "roofline_frac": reg.ensure_gauge(
+            "ps_device_roofline_frac",
+            "achieved fraction of this chip's peak for one resource "
+            "(hbm: of HBM_PEAK_GB_S; flops: MFU vs FLOPS_PEAK_TFLOPS); "
+            "absent on device kinds the peak tables do not know",
+            labelnames=("fn", "resource"),
+        ),
+        "hbm_bytes_in_use": reg.ensure_gauge(
+            "ps_device_hbm_bytes_in_use",
+            "allocator bytes in use on the device at last collection "
+            "(memory_stats; TPU backends)",
+            labelnames=("device",),
+        ),
+        "hbm_high_water": reg.ensure_gauge(
+            "ps_device_hbm_high_water_bytes",
+            "allocator peak bytes in use since process start "
+            "(memory_stats peak_bytes_in_use)",
+            labelnames=("device",),
+        ),
+        "hbm_limit": reg.ensure_gauge(
+            "ps_device_hbm_bytes_limit",
+            "allocator byte limit for the device (memory_stats)",
+            labelnames=("device",),
+        ),
+        "hbm_frac_used": reg.ensure_gauge(
+            "ps_device_hbm_frac_used",
+            "bytes_in_use / bytes_limit at last collection — the "
+            "gauge the HBM high-water alert rule watches",
+            labelnames=("device",),
+        ),
+        "live_buffers": reg.ensure_gauge(
+            "ps_device_live_buffer_bytes",
+            "total nbytes of live jax arrays at last collection "
+            "(jax.live_arrays — works on every backend, CPU included)",
+        ),
+        "live_high_water": reg.ensure_gauge(
+            "ps_device_live_buffer_high_water_bytes",
+            "process-lifetime high-water mark of the live-buffer total",
+        ),
+    }
+
+
 def recovery_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Failure detection → recovery orchestration (system/recovery.py +
     the chaos plane, doc/ROBUSTNESS.md). ``RecoveryCoordinator.check``
@@ -575,6 +670,7 @@ cached_kvops_instruments = _cached_family(kvops_instruments)
 cached_serve_instruments = _cached_family(serve_instruments)
 cached_wire_instruments = _cached_family(wire_instruments)
 cached_ftrl_instruments = _cached_family(ftrl_instruments)
+cached_device_instruments = _cached_family(device_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -586,6 +682,7 @@ INSTRUMENT_FAMILIES = (
     wire_instruments,
     serve_instruments,
     ftrl_instruments,
+    device_instruments,
     recovery_instruments,
     node_instruments,
     cluster_instruments,

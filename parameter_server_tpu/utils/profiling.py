@@ -132,6 +132,100 @@ def _iter_trace_events(log_dir: str):
         yield pnames, tnames, events
 
 
+def _device_op_keys(pnames: dict, tnames: dict):
+    """(device_pids, keep(pid, tid)) — the device-op track filter shared
+    by :func:`summarize_trace` and :func:`device_track_events`: pids
+    whose process name looks like a device, and within them only
+    op-level tids (prefer threads named "XLA Ops"; a device pid without
+    one keeps its tids minus Module/Step aggregates, which cover the
+    sum of their ops and would double everything)."""
+    device_pids = {
+        pid
+        for pid, nm in pnames.items()
+        if any(k in nm for k in ("XLA Ops", "TPU", "/device:", "Device"))
+        and "host" not in nm.lower()
+    }
+    op_tids = {
+        key
+        for key, nm in tnames.items()
+        if key[0] in device_pids and "XLA Ops" in nm
+    }
+    named_pids = {p for p, _ in op_tids}
+    excluded = {
+        key
+        for key, nm in tnames.items()
+        if key[0] in device_pids
+        and any(k in nm for k in ("Module", "Step", "module"))
+    }
+
+    def keep(pid, tid) -> bool:
+        if pid not in device_pids:
+            return False
+        key = (pid, tid)
+        if pid in named_pids:
+            return key in op_tids
+        return key not in excluded
+
+    return device_pids, keep
+
+
+def device_track_events(
+    log_dir: str,
+    host_anchor: "float | None" = None,
+    max_events: int = 4000,
+) -> "list[dict]":
+    """The newest capture's device-op complete events as span-sink-shaped
+    dicts — the device track of a merged timeline.
+
+    Each op becomes ``{"kind": "span", "name": "device.<op>", "thread":
+    "device:<pid>", "t_wall": ..., "dur_s": ...}``, consumable by the
+    same readers as host spans (telemetry/timeline.py export,
+    telemetry/attribution.device_breakdown). Durations are exact trace
+    truth; ABSOLUTE placement is best-effort — the profiler clock has
+    no wall reference, so the track is shifted as a block to start at
+    ``host_anchor`` (the host wall time of the profiled launch,
+    bench.py phase_breakdown); nothing is clipped at the far end. Ops
+    beyond ``max_events`` are dropped longest-kept (sorted by
+    duration) and the truncation is visible as ``len() ==
+    max_events``; never raises (result-path code)."""
+    try:
+        collected: "list[tuple[int, float, float, str]]" = []
+        for pnames, tnames, events in _iter_trace_events(log_dir):
+            _, keep = _device_op_keys(pnames, tnames)
+            for ev in events:
+                if not isinstance(ev, dict) or ev.get("ph") != "X":
+                    continue
+                if not keep(ev.get("pid"), ev.get("tid")):
+                    continue
+                dur = ev.get("dur")
+                if not dur:
+                    continue
+                name = str(ev.get("name") or "?")[:80]
+                collected.append(
+                    (ev.get("pid"), float(ev.get("ts", 0.0)), float(dur), name)
+                )
+        if not collected:
+            return []
+        if len(collected) > max_events:
+            collected = sorted(collected, key=lambda c: -c[2])[:max_events]
+        t0_us = min(c[1] for c in collected)
+        base = host_anchor if host_anchor is not None else 0.0
+        out = [
+            {
+                "kind": "span",
+                "name": f"device.{name}",
+                "thread": f"device:{pid}",
+                "t_wall": base + (ts - t0_us) / 1e6,
+                "dur_s": dur / 1e6,
+            }
+            for pid, ts, dur, name in collected
+        ]
+        out.sort(key=lambda e: e["t_wall"])
+        return out
+    except Exception:  # pragma: no cover - defensive: result-path code
+        return []
+
+
 def _self_times(track_events: "list[dict]"):
     """Yield ``(event, self_us)`` for complete events of ONE trace
     track, where self_us is the event's duration minus the duration of
@@ -184,50 +278,23 @@ def summarize_trace(
         seen = False
         all_device_pids: set = set()
         for pnames, tnames, events in _iter_trace_events(log_dir):
-            device_pids = {
-                pid
-                for pid, nm in pnames.items()
-                if any(
-                    k in nm
-                    for k in ("XLA Ops", "TPU", "/device:", "Device")
-                )
-                and "host" not in nm.lower()
-            }
+            # op-level device tracks only (the shared filter: prefer
+            # "XLA Ops"-named threads, exclude Module/Step aggregates)
+            device_pids, keep = _device_op_keys(pnames, tnames)
             if not device_pids:
                 continue  # no device track in this file
             all_device_pids.update(device_pids)
-            # op-level tids only: prefer threads explicitly named
-            # "XLA Ops"; when a device pid has no such thread name,
-            # take its tids that are NOT module/step aggregates
-            op_tids = {
-                key
-                for key, nm in tnames.items()
-                if key[0] in device_pids and "XLA Ops" in nm
-            }
-            named_pids = {p for p, _ in op_tids}
-            excluded = {
-                key
-                for key, nm in tnames.items()
-                if key[0] in device_pids
-                and any(k in nm for k in ("Module", "Step", "module"))
-            }
             tracks: dict = {}
             for ev in events:
                 if not isinstance(ev, dict) or ev.get("ph") != "X":
                     continue
                 pid = ev.get("pid")
-                if pid not in device_pids:
-                    continue
-                key = (pid, ev.get("tid"))
-                if pid in named_pids:
-                    if key not in op_tids:
-                        continue
-                elif key in excluded:
+                if not keep(pid, ev.get("tid")):
                     continue
                 dur = ev.get("dur")
                 if not dur:
                     continue
-                tracks.setdefault(key, []).append(ev)
+                tracks.setdefault((pid, ev.get("tid")), []).append(ev)
             for track_events in tracks.values():
                 for ev, self_us in _self_times(track_events):
                     if self_us <= 0:

@@ -72,6 +72,12 @@ METADATA_SECTIONS = frozenset(
         # count, alerts firing at teardown) — run metadata, not a
         # throughput the sentinel may band
         "expose",
+        # the device truth plane (per-jit cost analysis, recompile /
+        # donation-fallback counts, HBM high-water, roofline
+        # cross-checks) — capture-HARDWARE facts: fracs of peak move
+        # with the chip the record was taken on, not with the code,
+        # so banding them would false-flag every capture-host change
+        "device",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

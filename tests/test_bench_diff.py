@@ -150,6 +150,30 @@ class TestDiffMath:
         assert reported  # the scalar metrics are still judged
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_device_section_is_metadata_never_banded(self):
+        """The device truth plane's `device` section carries roofline
+        fracs and HBM high-water — capture-HARDWARE facts (they move
+        with the chip, not the code) plus per-jit cost analyses. A
+        catastrophic-looking device section must not flag; the
+        import-time assert bars WATCHED from ever pointing into it
+        (the PR 9 metadata-gate pattern)."""
+        assert "device" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["device"] = {  # chip-truth horrors, all ignored
+            "recompiles_post_warmup": 1e9,
+            "donation_fallbacks_total": 1e9,
+            "functions": {"kv_push": {"compiles": 1e9}},
+            "hbm": {"live_buffer_high_water_bytes": 1e18},
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):
