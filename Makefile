@@ -1,11 +1,24 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench serve-bench ftrl-bench chaos-bench roofline trace bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench roofline trace bench-diff metrics-serve clean
 
 all: native
 
 native:
 	$(MAKE) -C parameter_server_tpu/cpp
+
+# native-vs-Python parity, REQUIRING the library: the tier-1 suite
+# skips the C-parity tests gracefully when libpsnative.so is absent
+# (a CPU-only checkout must still pass), but THIS target builds the
+# lib and fails LOUDLY if it is missing or the fused-prep / codec
+# outputs diverge from the Python paths — run it wherever native is
+# expected to exist (the bench container, the on-chip watcher host)
+native-test: native
+	env JAX_PLATFORMS=cpu PS_REQUIRE_NATIVE=1 python -m pytest \
+		tests/test_wire.py -k "stream or native or staging" \
+		-q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu PS_REQUIRE_NATIVE=1 python -m pytest \
+		tests/test_codec.py -q -p no:cacheprovider
 
 test: native
 	python -m pytest tests/ -x -q
@@ -67,6 +80,13 @@ ingest-bench: native
 # "wire" with per-encoding link-bound ceilings)
 wire-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks wire
+
+# native-vs-Python fused stream-prep A/B (components bench): the one
+# C ABI call (hash→per-lane unique→remap→bit-pack) against the NumPy
+# passes it replaces — byte-identical output asserted, median paired
+# speedup disclosed (also embedded in wire_ab under "fused_prep")
+stream-prep-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks stream_prep
 
 # FTRL update-path benches (components): the sparse-touched XLA-rows
 # vs fused-Pallas-kernel A/B (embedded in every bench.py record under

@@ -1067,6 +1067,14 @@ class UploadPipeline:
 
     def __init__(self, parts_iter, T: int, queue_depth: int = 2, cache=None):
         self.skipped_examples = 0
+        # staging-leg codec accounting (wire_compress): frames decoded
+        # on THIS pipeline's one staging thread, right before the
+        # stack+device_put — raw vs framed bytes disclosed so the
+        # record can quote the staging leg net of compression while
+        # ``nbytes`` (what reconcile_link_ceiling divides) stays the
+        # REALIZED tunnel traffic
+        self.staged_raw_bytes = 0
+        self.staged_compressed_bytes = 0
         self._cache = cache
         self._it = iter_on_thread(
             self._stage(parts_iter, T), maxsize=queue_depth
@@ -1076,8 +1084,18 @@ class UploadPipeline:
         # runs on iter_on_thread's daemon thread
         import jax
 
+        from parameter_server_tpu.learner.wire import CompressedBatch
+
         parts = []
         for item in parts_iter:
+            if isinstance(item, CompressedBatch):
+                self.staged_raw_bytes += item.raw_nbytes
+                self.staged_compressed_bytes += item.wire_nbytes
+                from parameter_server_tpu.learner.wire import (
+                    decompress_batch,
+                )
+
+                item = decompress_batch(item)
             parts.append(item)
             if len(parts) < T:
                 continue
@@ -1341,8 +1359,10 @@ def stack_supersteps(parts, t: int):
     respective scan superbatches."""
     from parameter_server_tpu.apps.linear.async_sgd import stack_bits_batches
     from parameter_server_tpu.learner.wire import (
+        EncodedEllStreamBatch,
         EncodedExactBatch,
         stack_encoded_batches,
+        stack_stream_batches,
     )
 
     full = [parts[i % len(parts)] for i in range(t)]
@@ -1350,6 +1370,8 @@ def stack_supersteps(parts, t: int):
         return full[0]
     if isinstance(full[0], EncodedExactBatch):
         return stack_encoded_batches(full)
+    if isinstance(full[0], EncodedEllStreamBatch):
+        return stack_stream_batches(full)
     return stack_bits_batches(full)
 
 
@@ -1624,13 +1646,22 @@ def run_real(args) -> int:
     conf = Config()
     conf.penalty = PenaltyConfig(type="l1", lambda_=[l1])
     conf.learning_rate = LearningRateConfig(type="decay", alpha=alpha, beta=beta)
+    # THE STREAM-ONCE WIRE FLIP (ROADMAP item 1, --real half): the
+    # production-shaped path streams each example ONCE, so the upload
+    # key cache never hits and the exact encoding loses to raw bits —
+    # the lane-dictionary stream wire is the cache-free encoding built
+    # for exactly this regime (~96 B/example vs the recorded 126.9 at
+    # 2^26 slots, bit-identical decode on device). Falls back to the
+    # bits wire per batch when a batch leaves the pinned lane statics
+    # (fallbacks disclosed under e2e_wire).
     conf.async_sgd = SGDConfig(
         algo="ftrl",
         minibatch=args.minibatch,
         num_slots=num_slots,
         max_delay=0,  # parity first; the timed phase relaxes to 4
         ell_lanes=39,
-        wire="bits",
+        wire=args.real_wire,
+        wire_compress=args.wire_compress,
         pull_filter=(
             [{"type": "fixing_float", "num_bytes": args.pull_bytes}]
             if args.pull_bytes else []
@@ -1726,7 +1757,66 @@ def run_real(args) -> int:
     # discarded result mutates nothing, and copies keep donation away
     # from the live table).
     _beat("warmup")
+    from parameter_server_tpu.apps.linear.async_sgd import (
+        prep_batch_ell_bits,
+    )
+    from parameter_server_tpu.learner.wire import EncodedEllStreamBatch
+
     prep_parts = [worker.prep(b, device_put=False) for b in kept]
+    # e2e_wire: the --real twin of the synthetic record's section (the
+    # stream-once path's wire choice must be visible in the record) —
+    # which wire the stream actually rides, the per-encoding
+    # bytes/example A/B on THIS run's first real batch, and the pinned
+    # lane statics. bench_diff treats it as metadata, never a band.
+    stream_mode = isinstance(prep_parts[0], EncodedEllStreamBatch)
+    warmup_fallbacks = 0
+    if stream_mode:
+        # a kept batch past the pinned lane statics fell back to the
+        # bits wire — a mixed list cannot stack into the one compiled
+        # scan shape (same guard the timed stream applies), so drop
+        # fallback parts from the warm pool and disclose
+        n0 = len(prep_parts)
+        prep_parts = [
+            p for p in prep_parts
+            if isinstance(p, EncodedEllStreamBatch)
+        ]
+        warmup_fallbacks = n0 - len(prep_parts)
+    rows_pad, _, _ = worker._padding(kept[0])
+    bits_part = prep_batch_ell_bits(
+        kept[0], worker.directory, worker._num_shards(), rows_pad, 39,
+        worker.num_slots,
+    )
+    e2e_wire = {
+        "wire": conf.async_sgd.wire,
+        "wire_actual": "stream" if stream_mode else "bits",
+        "wire_compress": conf.async_sgd.wire_compress or None,
+        "max_delay": 4,  # the timed phase's delay bound (set below)
+        "bytes_per_example": {
+            "bits": round(
+                tree_host_nbytes(bits_part) / args.minibatch, 1
+            ),
+            **(
+                {
+                    "stream": round(
+                        tree_host_nbytes(prep_parts[0]) / args.minibatch,
+                        1,
+                    )
+                }
+                if stream_mode
+                else {}
+            ),
+        },
+    }
+    if stream_mode:
+        e2e_wire["warmup_fallback_parts"] = warmup_fallbacks
+        st = worker._stream_statics
+        e2e_wire["stream_statics"] = {
+            "dict_lanes": len(st.dict_lanes),
+            "raw_lanes": st.lanes - len(st.dict_lanes),
+            "code_bits": st.code_bits,
+            "raw_bits": st.raw_bits,
+            "dict_pad": st.dict_pad,
+        }
     warm = stack_supersteps(prep_parts, T)
     _grace_for_transfer(tree_host_nbytes(warm))
     warm = jax.device_put(warm)
@@ -1756,6 +1846,7 @@ def run_real(args) -> int:
             **({"parity_tol_relaxed_for_quantized_pull": tol_scale}
                if args.pull_bytes else {}),
             "parse_only_examples_per_sec": parse_only_ex_s,
+            "e2e_wire": e2e_wire,
         },
     )
     # serialized stage pricing (localize+pack / upload / device) — the
@@ -1787,12 +1878,34 @@ def run_real(args) -> int:
     attach_recovery(headline, args.smoke)
     _beat("e2e", **headline)
 
+    wire_fallback = {"parts": 0, "rows": 0}
+
     def host_prepped():
         for b in batches:  # rest of the file
             if b.n < args.minibatch:
                 break  # keep superstep shapes static
             with telemetry_spans.span("bench.prep", phase="e2e"):
                 part = worker.prep(b, device_put=False)
+            if stream_mode and not isinstance(part, EncodedEllStreamBatch):
+                # a batch left the pinned lane statics and fell back to
+                # the bits wire — a mixed group cannot stack into the
+                # one compiled scan shape, so the batch is dropped from
+                # the timed stream and DISCLOSED (never silently mixed;
+                # rows dropped are excluded from the rate's numerator)
+                wire_fallback["parts"] += 1
+                wire_fallback["rows"] += int(b.n)
+                continue
+            if args.wire_compress:
+                # staging-leg codec on the producer (prep) thread; the
+                # UploadPipeline's staging thread decodes before the
+                # stack+device_put (the stateless-or-feeder split)
+                from parameter_server_tpu.learner.wire import (
+                    compress_batch,
+                )
+
+                part = compress_batch(
+                    part, encoding="stream" if stream_mode else "bits"
+                )
             yield part
 
     def prepped_stream():
@@ -1846,6 +1959,20 @@ def run_real(args) -> int:
         "file_rows": int(file_rows),
         "skipped_tail_rows": int(skipped_tail),
     }
+    e2e_wire["fallback_parts"] = wire_fallback["parts"]
+    e2e_wire["fallback_rows_dropped"] = wire_fallback["rows"]
+    if pipe.staged_raw_bytes:
+        # staging leg net of compression (the ps_wire accounting twin);
+        # the tunnel bytes in reconcile_link_ceiling stay REALIZED
+        e2e_wire["staging_leg"] = {
+            "raw_mb": round(pipe.staged_raw_bytes / 1e6, 1),
+            "compressed_mb": round(pipe.staged_compressed_bytes / 1e6, 1),
+            "ratio": round(
+                pipe.staged_raw_bytes
+                / max(1, pipe.staged_compressed_bytes),
+                3,
+            ),
+        }
     rec.update(headline)
     reconcile_link_ceiling(rec, wire_bytes_moved, done_ex, dt)
     # device truth plane AFTER the timed stream: the post-warmup
@@ -1893,6 +2020,29 @@ def main() -> int:
         "timed pipeline + logloss parity vs the numpy oracle (table 2^26)",
     )
     ap.add_argument("--real-mb", type=int, default=2048, help="file size to stream")
+    ap.add_argument(
+        "--real-wire",
+        default="stream",
+        choices=("stream", "bits"),
+        help="--real path's ELL wire: DEFAULT 'stream' — the stream-once "
+        "lane-dictionary encoding (cache-free: small-vocabulary lanes "
+        "ship uslot tables + packed ucols, ~96 B/ex vs bits' 126.9 at "
+        "2^26; ROADMAP item 1's --real half); 'bits' restores the "
+        "legacy raw bit stream. Per-batch fallbacks to bits are "
+        "disclosed under e2e_wire",
+    )
+    ap.add_argument(
+        "--wire-compress",
+        default="",
+        choices=("", "lz"),
+        help="staging-leg byte codec for the --real stream: prep "
+        "compresses each encoded batch's leaves (native LZ, "
+        "incompressible rides raw), the uploader thread decodes before "
+        "device_put. Shrinks the modeled feeder→trainer staging leg "
+        "(disclosed under e2e_wire.staging_leg), NOT the PJRT tunnel "
+        "bytes — default off on the tunnel since the decode costs "
+        "serial uploader-thread time for zero tunnel-byte gain",
+    )
     ap.add_argument("--parse-threads", type=int, default=4)
     ap.add_argument("--parity-steps", type=int, default=24)
     ap.add_argument(

@@ -555,6 +555,117 @@ def prep_batch_ell_bits(
     )
 
 
+def prep_batch_ell_stream(
+    batch: SparseBatch,
+    directory,
+    num_shards: int,
+    rows_pad: int,
+    lanes: int,
+    num_slots: int,
+    statics,
+):
+    """Stream-once lane-dictionary wire prep: the fused
+    hash→unique→remap→bit-pack pass (one native C ABI call per shard,
+    learner/wire.encode_stream_shard; NumPy fallback bit-identical).
+    Small-vocabulary lanes ship per-lane uslot tables + packed ucols,
+    high-vocabulary lanes keep the raw bit stream — the cache-free
+    encoding for single-epoch data, where the UploadCache never hits.
+
+    Applies to the same domain as the bits wire (hashed directory,
+    binary features, uniform rows, ±1 labels) AND only while every
+    shard fits the pinned ``statics`` — returns None otherwise so the
+    caller falls back to the raw bits wire (never wrong bytes, only
+    fat ones). STATELESS given ``statics`` (pool-able prep stage)."""
+    from ...learner.wire import (
+        EncodedEllStreamBatch,
+        encode_stream_shard,
+        tree_nbytes,
+        wire_instruments,
+    )
+
+    tel = wire_instruments()
+
+    def fallback(reason: str):
+        if tel is not None:
+            tel["fallbacks"].labels(reason=reason).inc()
+        return None
+
+    if statics is None or not (batch.binary and directory.hashed):
+        return fallback("domain")
+    if statics.lanes != lanes:
+        return fallback("domain")
+    counts_all = np.diff(batch.indptr)
+    if not (counts_all == lanes).all():
+        return fallback("ragged")
+    if not (np.abs(batch.y) == 1).all():
+        return fallback("labels")
+    t0 = time.perf_counter()
+    per = -(-batch.n // num_shards)
+    n_dict = len(statics.dict_lanes)
+    y_nbytes = (rows_pad + 7) // 8
+    y_bits = np.zeros((num_shards, y_nbytes), np.uint8)
+    counts = np.zeros((num_shards,), np.int32)
+    raw_ws, code_ws, table_ws = [], [], []
+    lane_starts = np.zeros((num_shards, n_dict), np.int32)
+    n_uniq = np.zeros((num_shards,), np.int32)
+    for d in range(num_shards):
+        lo_r, hi_r = min(d * per, batch.n), min((d + 1) * per, batch.n)
+        nsub = hi_r - lo_r
+        if nsub > rows_pad:
+            raise ValueError(f"batch exceeds padding: {nsub}>{rows_pad}")
+        seg = slice(batch.indptr[lo_r], batch.indptr[hi_r])
+        got = encode_stream_shard(
+            batch.indices[seg], nsub, rows_pad,
+            # hash modulus = the directory's CONFIGURED slot count (the
+            # same map as every other path, stable across elastic
+            # resizes); raw_bits sizing uses the padded table
+            directory.num_slots,
+            statics,
+        )
+        if got is None:
+            # a shard overflowed the pinned statics (vocabulary drift
+            # past the padded code space / table capacity)
+            return fallback("statics_overflow")
+        raw_w, code_w, table_w, starts, total = got
+        raw_ws.append(raw_w)
+        code_ws.append(code_w)
+        table_ws.append(table_w)
+        lane_starts[d] = starts
+        n_uniq[d] = total
+        yb = np.packbits(batch.y[lo_r:hi_r] > 0, bitorder="little")
+        y_bits[d, : yb.size] = yb
+        counts[d] = nsub
+    out = EncodedEllStreamBatch(
+        y_bits=y_bits,
+        counts=counts,
+        raw_words=np.stack(raw_ws),
+        code_words=np.stack(code_ws),
+        table_words=np.stack(table_ws),
+        lane_starts=lane_starts,
+        n_uniq=n_uniq,
+        rows=rows_pad,
+        lanes=lanes,
+        dict_lanes=statics.dict_lanes,
+        code_bits=statics.code_bits,
+        dict_pad=statics.dict_pad,
+        raw_bits=statics.raw_bits,
+    )
+    if tel is not None:
+        enc_b = tree_nbytes(out)
+        # the raw alternative these bytes displace: the bits wire at
+        # the same shape (what prep_batch_ell_bits would have shipped)
+        bits_b = num_shards * (
+            packed_nwords(rows_pad * lanes, statics.raw_bits) * 4
+            + y_nbytes + 4
+        )
+        tel["encode_seconds"].observe(time.perf_counter() - t0)
+        tel["bytes"].labels(encoding="stream").inc(enc_b)
+        tel["saved_bytes"].labels(reason="encoding").inc(
+            max(0, bits_b - enc_b)
+        )
+    return out
+
+
 def _lane_positions(counts: np.ndarray, lanes: int) -> np.ndarray:
     """Per-entry lane index within its row; -1 when beyond the lane budget."""
     total = int(counts.sum())
@@ -908,26 +1019,25 @@ def make_train_step_ell(
     return _donation_variants(step_impl, name="step_ell")
 
 
-def _make_bits_mini_step(
-    updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant,
+def _make_uniform_ell_mini_step(
+    updater, loss, shard, decode_fn, with_aux, push_quant,
     pull_quant, push_noise=None, pull_noise=None, pull_narrow=None,
 ):
-    """Shared single-minibatch body for the bits-wire step builders:
-    (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
-    bits = slot_bits(num_slots)
+    """Shared single-minibatch body for the uniform-row binary ELL wire
+    step builders (bits + stream): ``decode_fn(*wire_operands)`` →
+    ``(y, mask, slots[R, K])`` inside the jit, then the one pull →
+    lane-sum → push → update body both wires share."""
     push_touched = make_push_touched(push_quant, noise=push_noise)
     pull_derive, pull_lookup = make_pull_lookup(
         updater, pull_quant, noise=pull_noise, narrow=pull_narrow
     )
 
-    def mini_step(live, pulled, seed, y_bits, count, words):
+    def mini_step(live, pulled, seed, *wire_operands):
         # named_scope phases: HLO op metadata carries these, so a
         # --profile trace buckets step time into wire-decode / pull /
         # compute / push / update (utils/profiling.summarize_trace)
         with jax.named_scope("ps_decode"):
-            y = unpack_sign_bits(y_bits, rows)
-            mask = (jnp.arange(rows) < count).astype(jnp.float32)
-            slots = unpack_bits(words, rows * lanes, bits).reshape(rows, lanes)
+            y, mask, slots = decode_fn(*wire_operands)
             # slot-localization arithmetic belongs to decode: it turns
             # wire slots into shard-relative gather indices
             flat = slots.reshape(-1)
@@ -959,6 +1069,56 @@ def _make_bits_mini_step(
         return new_state, metrics
 
     return mini_step
+
+
+def _make_bits_mini_step(
+    updater, loss, num_slots, shard, rows, lanes, with_aux, push_quant,
+    pull_quant, push_noise=None, pull_noise=None, pull_narrow=None,
+):
+    """Single-minibatch body for the bits-wire step builders:
+    (live, pulled, seed, per-device y_bits/count/words) -> (state, metrics)."""
+    bits = slot_bits(num_slots)
+
+    def decode_fn(y_bits, count, words):
+        y = unpack_sign_bits(y_bits, rows)
+        mask = (jnp.arange(rows) < count).astype(jnp.float32)
+        slots = unpack_bits(words, rows * lanes, bits).reshape(rows, lanes)
+        return y, mask, slots
+
+    return _make_uniform_ell_mini_step(
+        updater, loss, shard, decode_fn, with_aux, push_quant,
+        pull_quant, push_noise, pull_noise, pull_narrow,
+    )
+
+
+def _make_stream_mini_step(
+    updater, loss, shard, static_key, with_aux, push_quant,
+    pull_quant, push_noise=None, pull_noise=None, pull_narrow=None,
+):
+    """Single-minibatch body for the stream-wire (lane-dictionary) step
+    builders: (live, pulled, seed, per-device y_bits/count/raw_words/
+    code_words/table_words/lane_starts) -> (state, metrics). The lane
+    split, code width and table capacity are static (they pin the
+    decode program — one jit per ``static_key``)."""
+    from ...ops.wire_codec import decode_stream_slots
+
+    rows, lanes, dict_lanes, code_bits, dict_pad, raw_bits = static_key
+
+    def decode_fn(y_bits, count, raw_words, code_words, table_words,
+                  lane_starts):
+        y = unpack_sign_bits(y_bits, rows)
+        mask = (jnp.arange(rows) < count).astype(jnp.float32)
+        slots = decode_stream_slots(
+            raw_words, code_words, table_words, lane_starts,
+            rows=rows, lanes=lanes, dict_lanes=dict_lanes,
+            code_bits=code_bits, dict_pad=dict_pad, raw_bits=raw_bits,
+        )
+        return y, mask, slots
+
+    return _make_uniform_ell_mini_step(
+        updater, loss, shard, decode_fn, with_aux, push_quant,
+        pull_quant, push_noise, pull_noise, pull_narrow,
+    )
 
 
 def _bits_state_spec(state):
@@ -1077,6 +1237,116 @@ def make_train_step_ell_bits_scan(
           batch.slots_words)
 
     return _donation_variants(step_impl, name="step_ell_bits_scan")
+
+
+_STREAM_FIELDS = (
+    "y_bits", "counts", "raw_words", "code_words", "table_words",
+    "lane_starts",
+)
+
+
+def make_train_step_ell_stream(
+    updater,
+    loss,
+    mesh,
+    num_slots: int,
+    static_key: tuple,
+    with_aux: bool = True,
+    push_quant: int = 0,
+    pull_quant: int = 0,
+    push_noise=None,
+    pull_noise=None,
+    pull_narrow: "bool | None" = None,
+):
+    """Fused SPMD step over the stream-once lane-dictionary wire
+    (EncodedEllStreamBatch): dictionary lanes decode as
+    ``uslots[lane_start + ucol]`` gathers, raw lanes unpack from the
+    bit stream — all inside the jitted step, so only the encoded bytes
+    cross the host→device link."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_stream_mini_step(
+        updater, loss, shard, static_key, with_aux,
+        push_quant, pull_quant, push_noise, pull_noise, pull_narrow,
+    )
+
+    def local_step(live, pulled, seed, *wire):
+        return mini_step(live, pulled, seed, *(w[0] for w in wire))
+
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = _bits_state_spec(live_state)
+        batch_specs = tuple(P(DATA_AXIS) for _ in _STREAM_FIELDS)
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), *batch_specs),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(live_state, pull_state, seed,
+          *(getattr(batch, f) for f in _STREAM_FIELDS))
+
+    return _donation_variants(step_impl, name="step_ell_stream")
+
+
+def make_train_step_ell_stream_scan(
+    updater,
+    loss,
+    mesh,
+    num_slots: int,
+    static_key: tuple,
+    with_aux: bool = True,
+    push_quant: int = 0,
+    pull_quant: int = 0,
+    push_noise=None,
+    pull_noise=None,
+    pull_narrow: "bool | None" = None,
+):
+    """Scan-fused superstep over T stream-wire minibatches per launch
+    (the make_train_step_ell_bits_scan twin — see its semantics note:
+    weights advance every ministep, one dispatch per T steps)."""
+    n_server = meshlib.num_servers(mesh)
+    shard = num_slots // n_server
+    mini_step = _make_stream_mini_step(
+        updater, loss, shard, static_key, with_aux,
+        push_quant, pull_quant, push_noise, pull_noise, pull_narrow,
+    )
+
+    def local_step(live, pulled, seed, *wire):
+        del pulled  # staleness 0 inside the superstep (≤ any delay bound)
+        t_steps = wire[0].shape[0]
+
+        def body(carry, xs):
+            state, i = carry
+            new_state, metrics = mini_step(
+                state, state, seed + i, *(w[0] for w in xs)
+            )
+            return (new_state, i + np.uint32(1)), metrics
+
+        (new_state, _), metrics = jax.lax.scan(
+            body, (live, np.uint32(0)), wire, length=t_steps,
+        )
+        if not with_aux:
+            metrics = jax.tree.map(lambda m: m.sum(axis=0), metrics)
+        else:
+            metrics = {
+                k: (v.sum(axis=0) if v.ndim == 1 else v)
+                for k, v in metrics.items()
+            }
+        return new_state, metrics
+
+    def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
+        specs = _bits_state_spec(live_state)
+        batch_specs = tuple(P(None, DATA_AXIS) for _ in _STREAM_FIELDS)
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, specs, P(), *batch_specs),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(live_state, pull_state, seed,
+          *(getattr(batch, f) for f in _STREAM_FIELDS))
+
+    return _donation_variants(step_impl, name="step_ell_stream_scan")
 
 
 def make_train_step_hashed(
@@ -1606,6 +1876,27 @@ def _fixing_float_bytes(filters, where: str) -> int:
     return nb
 
 
+def _wire_encoding_name(prepped) -> str:
+    """Telemetry label for the wire a prepped batch rides
+    (``ps_wire_bytes_total{encoding="<name>+lz"}`` on the staging leg)."""
+    from ...learner.wire import (
+        EncodedEllStreamBatch,
+        EncodedEllStreamSuperBatch,
+        EncodedExactBatch,
+        EncodedExactSuperBatch,
+    )
+
+    if isinstance(
+        prepped, (EncodedEllStreamBatch, EncodedEllStreamSuperBatch)
+    ):
+        return "stream"
+    if isinstance(prepped, (EncodedExactBatch, EncodedExactSuperBatch)):
+        return "exact"
+    if isinstance(prepped, (ELLBitsBatch, ELLBitsSuperBatch)):
+        return "bits"
+    return "raw"
+
+
 class DeviceUploader:
     """Double-buffered host→device stage of the ingest pipeline.
 
@@ -1645,8 +1936,16 @@ class DeviceUploader:
         self._flows: "collections.deque" = collections.deque()
 
         def uploaded():
+            from ...learner.wire import maybe_decompress
+
             for prepped, n in source:
                 t0 = time.perf_counter()
+                # staging-leg frames (wire_compress) decode HERE, on
+                # the single uploader thread, immediately before the
+                # device_put — the feeder half of the stateless-or-
+                # feeder rule; everything below sees plain arrays and
+                # uploaded_bytes stays the REALIZED link traffic
+                prepped = maybe_decompress(prepped)
                 fid = telemetry_spans.current_flow()
                 if tel is not None:
                     tel["batches"].labels(pipeline="device_uploader").inc()
@@ -1742,10 +2041,16 @@ class AsyncSGDWorker(ISGDCompNode):
 
         from ...parameter.parameter import KeyDirectory, pad_slots
 
-        if sgd.wire not in ("", "i32", "u24", "bits"):
+        if sgd.wire not in ("", "i32", "u24", "bits", "stream"):
             raise ValueError(
                 f"unknown SGDConfig.wire {sgd.wire!r}; expected "
-                "'i32', 'u24', 'bits', or '' (legacy wire_u24 flag)"
+                "'i32', 'u24', 'bits', 'stream', or '' (legacy "
+                "wire_u24 flag)"
+            )
+        if sgd.wire_compress not in ("", "lz"):
+            raise ValueError(
+                f"unknown SGDConfig.wire_compress {sgd.wire_compress!r}; "
+                "expected '' or 'lz'"
             )
         from ...learner.wire import WIRE_ENCODE_MODES
 
@@ -1780,6 +2085,14 @@ class AsyncSGDWorker(ISGDCompNode):
         self._seed_counter = 0
         self._warned_ell_overflow = False
         self._warned_scan_fallback = False
+        self._warned_stream_multiproc = False
+        # stream-wire statics: derived ONCE from the first batch on the
+        # feeder/trainer thread and pinned (the `_padding` pattern), so
+        # every pool worker encodes against the same decode program.
+        # None after derivation = no lane-dictionary split wins on this
+        # data → the run stays on the plain bits wire.
+        self._stream_statics = None
+        self._stream_statics_set = False
         self.num_slots = pad_slots(sgd.num_slots, meshlib.num_servers(mesh))
         self._update_mode = self._resolve_update_mode(sgd)
         # the hash modulus is the CONFIGURED slot count, not the padded
@@ -1931,15 +2244,28 @@ class AsyncSGDWorker(ISGDCompNode):
     def upload(self, prepped):
         """Host-prepped shards → device arrays. Multi-process: assemble
         this host's shards into the global data-sharded batch (the data
-        axis sits at dim 1 for scan superbatches, after the T axis)."""
-        from ...learner.wire import EncodedExactSuperBatch
+        axis sits at dim 1 for scan superbatches, after the T axis).
+        Staging-leg frames (wire_compress) decode here, immediately
+        before device placement — the uploader half of the
+        stateless-or-feeder rule."""
+        from ...learner.wire import (
+            EncodedEllStreamSuperBatch,
+            EncodedExactSuperBatch,
+            maybe_decompress,
+        )
         from ...parallel import distributed
 
+        prepped = maybe_decompress(prepped)
         axis_dim = (
             1
             if isinstance(
                 prepped,
-                (ELLBitsSuperBatch, PreppedSuperBatch, EncodedExactSuperBatch),
+                (
+                    ELLBitsSuperBatch,
+                    PreppedSuperBatch,
+                    EncodedExactSuperBatch,
+                    EncodedEllStreamSuperBatch,
+                ),
             )
             else 0
         )
@@ -1956,6 +2282,30 @@ class AsyncSGDWorker(ISGDCompNode):
 
         enc = encode_exact(out, self.num_slots, mode=self.sgd.wire_encode)
         return out if enc is None else enc
+
+    def _get_stream_statics(self, batch: SparseBatch):
+        """Pinned stream-wire statics, derived from the FIRST eligible
+        batch (like ``_padding``: pinned on the feeder/trainer thread
+        before parallel preps could race to different lane splits).
+        None = the lane-dictionary wire never wins on this data — the
+        run stays on the bits wire."""
+        if not self._stream_statics_set:
+            from ...learner.wire import derive_stream_statics
+
+            counts = np.diff(batch.indptr)
+            if (
+                batch.binary
+                and batch.n
+                and (counts == self.sgd.ell_lanes).all()
+            ):
+                self._stream_statics = derive_stream_statics(
+                    batch.indices,
+                    self.sgd.ell_lanes,
+                    self.directory.num_slots,
+                    self.num_slots,
+                )
+                self._stream_statics_set = True
+        return self._stream_statics
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
@@ -2004,7 +2354,37 @@ class AsyncSGDWorker(ISGDCompNode):
                 use_ell = False
         if use_ell:
             wire = self.sgd.wire or ("u24" if self.sgd.wire_u24 else "i32")
-            if wire == "bits":
+            if wire == "stream":
+                from ...parallel import distributed
+
+                if distributed.is_multiprocess():
+                    # statics are DATA-derived (which lanes take the
+                    # dictionary) — per-host derivation could compile
+                    # different programs and desync the collectives, so
+                    # multi-process runs keep the uniform bits wire
+                    if not self._warned_stream_multiproc:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "wire='stream' is single-process (its lane "
+                            "split is derived from data); multi-process "
+                            "runs use the bits wire"
+                        )
+                        self._warned_stream_multiproc = True
+                    wire = "bits"
+                else:
+                    out = prep_batch_ell_stream(
+                        batch,
+                        self.directory,
+                        num_shards,
+                        rows_pad,
+                        self.sgd.ell_lanes,
+                        self.num_slots,
+                        self._get_stream_statics(batch),
+                    )
+                    if out is None:
+                        wire = "bits"  # raw fallback: never wrong bytes
+            if out is None and wire == "bits":
                 out = prep_batch_ell_bits(
                     batch,
                     self.directory,
@@ -2059,9 +2439,33 @@ class AsyncSGDWorker(ISGDCompNode):
         return self.upload(out) if device_put else out
 
     def _get_step(self, prepped, with_aux: bool):
-        from ...learner.wire import EncodedExactBatch, EncodedExactSuperBatch
+        from ...learner.wire import (
+            EncodedEllStreamBatch,
+            EncodedEllStreamSuperBatch,
+            EncodedExactBatch,
+            EncodedExactSuperBatch,
+        )
 
-        if isinstance(prepped, EncodedExactSuperBatch):
+        if isinstance(prepped, EncodedEllStreamSuperBatch):
+            key = ("ell_stream_scan", (prepped.steps, prepped.static_key()),
+                   with_aux)
+            builder = lambda: make_train_step_ell_stream_scan(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                static_key=prepped.static_key(), with_aux=with_aux,
+                push_quant=self._push_quant, pull_quant=self._pull_quant,
+                push_noise=self._push_noise, pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
+            )
+        elif isinstance(prepped, EncodedEllStreamBatch):
+            key = ("ell_stream", prepped.static_key(), with_aux)
+            builder = lambda: make_train_step_ell_stream(  # noqa: E731
+                self.updater, self.loss, self.mesh, self.num_slots,
+                static_key=prepped.static_key(), with_aux=with_aux,
+                push_quant=self._push_quant, pull_quant=self._pull_quant,
+                push_noise=self._push_noise, pull_noise=self._pull_noise,
+                pull_narrow=self._pull_narrow,
+            )
+        elif isinstance(prepped, EncodedExactSuperBatch):
             key = (
                 "exact_enc_scan",
                 (prepped.steps, prepped.static_key(), self._update_mode),
@@ -2161,7 +2565,10 @@ class AsyncSGDWorker(ISGDCompNode):
             # host shards can't be auto-sharded across processes by jit;
             # assemble the global batch explicitly
             prepped = self.upload(prepped)
-        from ...learner.wire import EncodedExactSuperBatch
+        from ...learner.wire import (
+            EncodedEllStreamSuperBatch,
+            EncodedExactSuperBatch,
+        )
 
         tau = self.sgd.max_delay
         # a scan superbatch advances the weights n_steps times in one
@@ -2170,7 +2577,12 @@ class AsyncSGDWorker(ISGDCompNode):
             prepped.steps
             if isinstance(
                 prepped,
-                (ELLBitsSuperBatch, PreppedSuperBatch, EncodedExactSuperBatch),
+                (
+                    ELLBitsSuperBatch,
+                    PreppedSuperBatch,
+                    EncodedExactSuperBatch,
+                    EncodedEllStreamSuperBatch,
+                ),
             )
             else 1
         )
@@ -2267,11 +2679,23 @@ class AsyncSGDWorker(ISGDCompNode):
         device launch (see ELLBitsSuperBatch). Requires the bits wire —
         raises on ineligible batches (the training loop's submit_group is
         the tolerant variant)."""
-        from ...learner.wire import EncodedExactBatch, stack_encoded_batches
+        from ...learner.wire import (
+            EncodedEllStreamBatch,
+            EncodedExactBatch,
+            stack_encoded_batches,
+            stack_stream_batches,
+        )
 
         prepped = [self.prep(b, device_put=False) for b in batches]
         if all(isinstance(p, ELLBitsBatch) for p in prepped):
             return self._submit_fused(prepped, with_aux)
+        if all(isinstance(p, EncodedEllStreamBatch) for p in prepped) and (
+            len({p.static_key() for p in prepped}) == 1
+        ):
+            return self._submit_prepped(
+                self.upload(stack_stream_batches(prepped)),
+                with_aux=with_aux,
+            )
         # exact-wire (raw or compact-encoded) scan fusion is SPARSE-
         # update only, same gate and rationale as _prep_group: the scan
         # runs ministeps on the live state (staleness 0), which is
@@ -2303,14 +2727,30 @@ class AsyncSGDWorker(ISGDCompNode):
         """Host side of tolerant grouping (prep + stack, no device
         work ordering constraints — safe to run on a pipeline thread):
         one scan superbatch when every batch takes the bits wire, else
-        per-minibatch parts. Returns ``[(host_prepped, n_ministeps)]``."""
-        from ...learner.wire import EncodedExactBatch, stack_encoded_batches
+        per-minibatch parts. Returns ``[(host_prepped, n_ministeps)]``.
+        With ``wire_compress`` set, every emitted part's leaves are
+        framed through the staging-leg codec here — ON the pool
+        (stateless), decoded on the uploader thread by ``upload``."""
+        from ...learner.wire import (
+            EncodedEllStreamBatch,
+            EncodedExactBatch,
+            stack_encoded_batches,
+            stack_stream_batches,
+        )
 
         prepped = [self.prep(b, device_put=False) for b in batches]
         if len(prepped) > 1 and all(
+            isinstance(p, EncodedEllStreamBatch) for p in prepped
+        ) and len({p.static_key() for p in prepped}) == 1:
+            return self._maybe_compress(
+                [(stack_stream_batches(prepped), len(prepped))]
+            )
+        if len(prepped) > 1 and all(
             isinstance(p, ELLBitsBatch) for p in prepped
         ):
-            return [(stack_bits_batches(prepped), len(prepped))]
+            return self._maybe_compress(
+                [(stack_bits_batches(prepped), len(prepped))]
+            )
         # exact-wire (raw or compact-encoded) scan fusion is gated on
         # SPARSE update mode: make_train_step_scan runs every ministep
         # against the LIVE state (`del pulled`, staleness 0), which is
@@ -2320,11 +2760,15 @@ class AsyncSGDWorker(ISGDCompNode):
         # stay per-minibatch (ADVICE round 5).
         if len(prepped) > 1 and self._update_mode == "sparse":
             if all(isinstance(p, PreppedBatch) for p in prepped):
-                return [(stack_prepped_batches(prepped), len(prepped))]
+                return self._maybe_compress(
+                    [(stack_prepped_batches(prepped), len(prepped))]
+                )
             if all(isinstance(p, EncodedExactBatch) for p in prepped) and (
                 len({p.static_key() for p in prepped}) == 1
             ):
-                return [(stack_encoded_batches(prepped), len(prepped))]
+                return self._maybe_compress(
+                    [(stack_encoded_batches(prepped), len(prepped))]
+                )
         if len(prepped) > 1 and not self._warned_scan_fallback:
             import logging
 
@@ -2335,7 +2779,20 @@ class AsyncSGDWorker(ISGDCompNode):
                 self.sgd.steps_per_launch,
             )
             self._warned_scan_fallback = True
-        return [(p, 1) for p in prepped]
+        return self._maybe_compress([(p, 1) for p in prepped])
+
+    def _maybe_compress(self, parts):
+        """Staging-leg codec for emitted prep parts (``wire_compress``):
+        stateless frame encode on the pool; ``upload`` decodes on the
+        uploader thread right before device placement. Off = identity."""
+        if not self.sgd.wire_compress:
+            return parts
+        from ...learner.wire import compress_batch
+
+        return [
+            (compress_batch(p, encoding=_wire_encoding_name(p)), n)
+            for p, n in parts
+        ]
 
     def submit_group(self, batches: List[SparseBatch], with_aux: bool = True):
         """Tolerant grouping for the training loop: scan-fuse when every
@@ -2416,6 +2873,13 @@ class AsyncSGDWorker(ISGDCompNode):
                     # could race to different pads
                     if self._pads is None:
                         self._padding(batch)
+                    # same for the stream wire's lane-split statics:
+                    # pinned here on the feeder, before the pool forks
+                    if (
+                        self.sgd.wire == "stream"
+                        and not self._stream_statics_set
+                    ):
+                        self._get_stream_statics(batch)
                     group.append(batch)
                     if len(group) >= T:
                         yield group

@@ -79,11 +79,27 @@ class SGDConfig:
     # only pays off on links where raw bytes (not host cycles) dominate.
     wire_u24: bool = False
     # wire format for ELL batches: "" (legacy: honor wire_u24), "i32",
-    # "u24", or "bits" (ceil(log2 num_slots)-bit slot stream + 1-bit
+    # "u24", "bits" (ceil(log2 num_slots)-bit slot stream + 1-bit
     # labels; needs the hashed/binary/uniform-row hot path, falls back to
     # u24 otherwise — cheapest bytes AND cheapest host cycles via the
-    # fused C++ hash→pack pass)
+    # fused C++ hash→pack pass), or "stream" (the stream-once
+    # lane-dictionary wire, learner/wire.py: small-vocabulary lanes —
+    # criteo's integer count fields — ship per-lane sorted unique-slot
+    # tables + bit-packed table indices, high-vocabulary lanes keep the
+    # raw bit stream; the cache-free encoding for single-epoch data,
+    # ~96 B/example vs the bits wire's 126.9 at the criteo-law 2^26
+    # shape, bit-identical decode on device, falls back to "bits" when
+    # no lane split wins or a batch leaves the pinned lane statics)
     wire: str = ""
+    # staging-leg byte codec (learner/wire.compress_batch): "" = off,
+    # "lz" = prep-pool workers frame each emitted batch's leaves
+    # through the native LZ codec (utils/codec.py; incompressible
+    # leaves ride raw) and the uploader thread decodes them right
+    # before device_put. Shrinks the host↔host STAGING leg (the
+    # disaggregated feeder→trainer hand-off), NOT the PJRT
+    # host→device link itself — see doc/PERFORMANCE.md "Wire format"
+    # for which legs compression does and does not shrink.
+    wire_compress: str = ""
     # compact wire for the EXACT (host-dedup) batch path
     # (learner/wire.py): "" = raw buffers (today's stream), "exact" =
     # lossless encode — bit-packed ucols, delta/bit-packed sorted

@@ -688,15 +688,20 @@ def wire_ab(smoke: bool = False) -> dict:
 
     from ..apps.linear.async_sgd import (
         prep_batch_ell_bits,
+        prep_batch_ell_stream,
         prep_batch_shared,
     )
     from ..learner.wire import (
         UploadCache,
+        compress_batch,
         decode_exact_host,
+        decode_stream_shard,
+        derive_stream_statics,
         encode_exact,
         tree_nbytes,
     )
     from ..parameter.parameter import KeyDirectory
+    from ..utils.murmur import hash_slots
 
     rows = 4096 if smoke else 16384
     lanes = 39
@@ -740,11 +745,61 @@ def wire_ab(smoke: bool = False) -> dict:
         for b in batches
     ]
     assert all(x is not None for x in bits)
+
+    # -- the stream-once lane-dictionary wire (cache-free arm): statics
+    # pinned from the first batch exactly like the worker does, decode
+    # verified bit-identical against the hashed slot matrix --
+    st = derive_stream_statics(
+        batches[0].indices, lanes, num_slots, num_slots
+    )
+    streams = [
+        prep_batch_ell_stream(
+            b, directory, num_shards, rows_pad, lanes, num_slots, st
+        )
+        for b in batches
+    ]
+    stream_parity = st is not None and all(s is not None for s in streams)
+    if stream_parity:
+        for b, s in zip(batches, streams):
+            per = -(-b.n // num_shards)
+            for d in range(num_shards):
+                lo, hi = min(d * per, b.n), min((d + 1) * per, b.n)
+                seg = slice(b.indptr[lo], b.indptr[hi])
+                want = hash_slots(
+                    np.ascontiguousarray(b.indices[seg], np.uint64),
+                    num_slots,
+                ).reshape(hi - lo, lanes)
+                y, mask, slots = decode_stream_shard(s, d)
+                stream_parity &= bool(
+                    np.array_equal(np.asarray(slots)[: hi - lo], want)
+                    and np.array_equal(
+                        np.asarray(y)[: hi - lo], b.y[lo:hi]
+                    )
+                )
     bpe = {
         "raw_exact": sum(tree_nbytes(p) for p in raws) / n_ex,
         "exact": sum(tree_nbytes(e) for e in encs) / n_ex,
         "bits": sum(tree_nbytes(x) for x in bits) / n_ex,
+        **(
+            {"stream": sum(tree_nbytes(s) for s in streams) / n_ex}
+            if stream_parity
+            else {}
+        ),
     }
+
+    # staging-leg codec per encoding (net of compression, utils/codec —
+    # incompressible streams ride raw so the worst case is ~free):
+    # quoted separately from bpe because it shrinks the host↔host
+    # staging leg, NOT the PJRT host→device tunnel bytes
+    lz_bpe = {}
+    for name, parts in (
+        ("exact", encs),
+        ("bits", bits),
+        *((("stream", streams),) if stream_parity else ()),
+    ):
+        lz_bpe[name] = round(
+            sum(compress_batch(p).wire_nbytes for p in parts) / n_ex, 1
+        )
 
     # valued stream: raw f32 vs int8 fixed-point (the lossy mode)
     vbatches = _criteo_shape_batches(rows, lanes, n_batches, valued=True,
@@ -814,7 +869,21 @@ def wire_ab(smoke: bool = False) -> dict:
             "exact_encode_amortized": round(
                 raw_baseline / amort_exact, 2
             ),
+            # the CACHE-FREE column (stream-once data gets no cache
+            # repeats — the production --real regime): single-pass
+            # bytes, no UploadCache anywhere in the arm
+            **(
+                {
+                    "stream_cache_free": round(
+                        raw_baseline / bpe["stream"], 2
+                    )
+                }
+                if stream_parity
+                else {}
+            ),
         },
+        "lz_staging_bytes_per_example": lz_bpe,
+        "stream_parity_bit_identical": bool(stream_parity),
         "exact_reduction_vs_raw_exact": round(
             bpe["raw_exact"] / bpe["exact"], 2
         ),
@@ -835,7 +904,110 @@ def wire_ab(smoke: bool = False) -> dict:
         "prep_examples_per_sec": round(n_ex * reps / sum(t_prep), 1),
         "prep_encode_examples_per_sec": round(n_ex * reps / sum(t_enc), 1),
     }
+    out["fused_prep"] = stream_prep_ab(smoke)
     return out
+
+
+def stream_prep_ab(smoke: bool = False) -> dict:
+    """Native-vs-Python fused stream-prep A/B (HOST side only).
+
+    The stream wire's prep is the named multi-ms host stage fused into
+    one C ABI call (``ps_stream_encode``: hash → per-lane unique →
+    remap → bit-pack); the Python arm is the NumPy path it replaces
+    (hash pass, per-lane ``np.unique``/``searchsorted`` passes, then
+    the bit-packer). Both arms produce BYTE-IDENTICAL wire buffers
+    (asserted here, every rep) — the native lib is a speedup, never a
+    format. Quotes the MEDIAN of back-to-back paired reps with both
+    arms disclosed (the bench discipline: this host's CPU capacity
+    flaps seconds-scale). Without ``libpsnative`` the native arm is
+    absent and the dict says so (``native_available``)."""
+    import time as _time
+
+    from ..cpp import native
+    from ..learner import wire as wire_mod
+    from ..learner.wire import derive_stream_statics, encode_stream_shard
+    from ..utils.murmur import hash_slots
+
+    rows = 4096 if smoke else 16384
+    lanes = 39
+    num_slots = 1 << 22
+    b = _criteo_shape_batches(rows, lanes, 1, seed=3)[0]
+    keys = np.ascontiguousarray(b.indices, np.uint64)
+    st = derive_stream_statics(keys, lanes, num_slots, num_slots)
+    assert st is not None, "criteo-law data must take the lane dictionary"
+    lib = native()
+    native_ok = (
+        lib is not None and getattr(lib, "ps_stream_encode", None) is not None
+    )
+    out = {
+        "minibatch": rows,
+        "lanes": lanes,
+        "num_slots": num_slots,
+        "native_available": bool(native_ok),
+        "dict_lanes": len(st.dict_lanes),
+    }
+
+    def run_py():
+        return wire_mod._encode_stream_shard_py(
+            hash_slots(keys, num_slots), rows, rows, st
+        )
+
+    def run_native():
+        return encode_stream_shard(keys, rows, rows, num_slots, st)
+
+    # parity first: byte-identical output, every field, before any
+    # timing is quoted (the fallback contract)
+    ref = run_py()
+    assert ref is not None
+    if native_ok:
+        nat = run_native()
+        for a, c in zip(nat, ref):
+            assert np.array_equal(np.asarray(a), np.asarray(c)), (
+                "native fused prep diverged from the Python path"
+            )
+
+    reps = 3 if smoke else 5
+    t_py, t_nat = [], []
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        run_py()
+        t_py.append(_time.perf_counter() - t0)
+        if native_ok:
+            t0 = _time.perf_counter()
+            run_native()
+            t_nat.append(_time.perf_counter() - t0)
+    py_ms = sorted(t_py)[len(t_py) // 2] * 1e3
+    out["python_ms_median"] = round(py_ms, 3)
+    out["python_examples_per_sec"] = round(rows / (py_ms / 1e3), 1)
+    out["reps"] = reps
+    if native_ok:
+        nat_ms = sorted(t_nat)[len(t_nat) // 2] * 1e3
+        out["native_ms_median"] = round(nat_ms, 3)
+        out["native_examples_per_sec"] = round(rows / (nat_ms / 1e3), 1)
+        out["speedup_median_paired"] = round(py_ms / nat_ms, 2)
+        out["parity_byte_identical"] = True
+    return out
+
+
+@benchmark("stream_prep")
+def stream_prep_perf(smoke: bool = False) -> None:
+    """Native-vs-Python fused stream-prep A/B (see stream_prep_ab):
+    one C ABI call (hash→unique→remap→bit-pack) against the NumPy
+    passes it replaces, byte-identical output asserted."""
+    out = stream_prep_ab(smoke)
+    report(
+        "stream_prep_python_examples_per_sec",
+        out["python_examples_per_sec"], "examples/sec",
+    )
+    if out["native_available"]:
+        report(
+            "stream_prep_native_examples_per_sec",
+            out["native_examples_per_sec"], "examples/sec",
+        )
+        report(
+            "stream_prep_speedup_median_paired",
+            out["speedup_median_paired"], "x",
+        )
 
 
 @benchmark("wire")

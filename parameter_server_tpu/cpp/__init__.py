@@ -44,6 +44,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint32, u8p,
     ]
     lib.ps_hash_slots_packbits.restype = None
+    lib.ps_stream_encode.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32,      # keys, nsub, lanes
+        ctypes.c_uint64, ctypes.c_uint64,          # seed, num_slots
+        u8p, ctypes.c_uint32, ctypes.c_uint32,     # dict_mask, raw/code bits
+        ctypes.c_int32,                            # dict_pad
+        i32p, u8p, u8p, u8p,                       # lane_starts + 3 streams
+    ]
+    lib.ps_stream_encode.restype = ctypes.c_int64
     lib.ps_lz_max_compressed.argtypes = [ctypes.c_uint64]
     lib.ps_lz_max_compressed.restype = ctypes.c_uint64
     lib.ps_lz_compress.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint64]

@@ -9,6 +9,14 @@ incompressible payloads ride raw). Arrays are restored to their original
 dtype/shape on decode. The device-path analog is dtype narrowing (bf16
 pulls / int8 pushes) which the learners apply directly — compression of
 ICI traffic is a precision choice, not a byte codec.
+
+The UPLOAD path's realization of this filter is
+``learner/wire.compress_batch``/``decompress_batch`` (the
+``wire_compress`` staging leg): same codec, same incompressible-rides-
+raw rule, same chain position (quantize/encode first, byte codec
+last), applied per batch-tree leaf between the prep pool and the
+uploader thread — see doc/PERFORMANCE.md "What LZ does and does not
+shrink" for which legs it actually compresses.
 """
 
 from __future__ import annotations

@@ -23,7 +23,12 @@ concurrency:
   it fans out over ``workers`` pool threads, and the pool re-emits
   results IN SOURCE ORDER, so the consumer sees a batch stream
   bit-identical to the serial path (tier-1 parity test in
-  tests/test_ingest.py).
+  tests/test_ingest.py). The stream wire's fused native prep
+  (``learner/wire.encode_stream_shard``, one C ABI call per shard) and
+  the staging-leg frame encode (``wire_compress``) both run INSIDE
+  this stage — stateless, so the pool parallelism applies to them for
+  free; the matching frame DECODE belongs to the single uploader
+  thread (DeviceUploader), never here.
 
 Exceptions from any stage forward to the consumer at the position they
 occurred; ``close()`` joins every thread (early consumer exit leaks

@@ -535,6 +535,354 @@ def decode_exact_host(enc: EncodedExactBatch, num_slots: int) -> tuple:
     return tuple(np.stack(x) for x in zip(*parts))
 
 
+# ---------------------------------------------------------------------------
+# Stream-once lane-dictionary wire (the cache-free encoding).
+#
+# The UploadCache amortizes repeated traffic, but the production CTR
+# shape — stream a multi-GB criteo file ONCE — repeats nothing, so the
+# bits wire's ceil(log2 S) bits/feature stood as the recorded
+# 126.9 B/example upload bound. The exploitable structure that survives
+# the hash is per-FIELD: a lane whose per-batch vocabulary is small
+# (criteo's 13 integer count fields hash to ~90 distinct slots per 16k
+# batch) ships a per-lane sorted unique-slot table (``uslots``) plus
+# bit-packed per-row table indices (``ucols``) at ~7 bits instead of
+# 26, while high-vocabulary lanes (hashed categorical tokens, ~98%
+# unique per batch — incompressible past the hash; delta-coding the
+# global unique-slot set was measured and LOSES at ≥60% unique) keep
+# the raw bit stream. Measured on the criteo-law shape: 96.4 B/example
+# at 2^26 slots vs the 126.9 raw-bits baseline, no cache anywhere.
+#
+# Statics (which lanes take the dictionary, the shared code width, the
+# table capacity) are derived once from the worker's first batch
+# (`derive_stream_statics`, the `_padding` pattern) and pinned: encode
+# itself stays STATELESS (pool-able — the PR-3 ingest rule) and
+# VERIFIES each batch fits the pinned statics, returning None so the
+# caller ships the raw bits wire when it doesn't — never wrong bytes.
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class EncodedEllStreamBatch:
+    """ELL batch on the stream-once lane-dictionary wire (fields
+    [D, ...] per data shard). ``raw_words`` is the row-major bit stream
+    of the raw lanes at ``raw_bits`` each; ``code_words`` the row-major
+    dictionary codes (``ucols``) of the dict lanes at ``code_bits``;
+    ``table_words`` the concatenated per-lane sorted unique slots
+    (``uslots``) at ``raw_bits``, ``lane_starts`` their start offsets.
+    Bits past each live prefix are zero; garbage decodes on padding
+    rows are gated by the row mask exactly like the bits wire."""
+
+    y_bits: np.ndarray  # [D, ceil(R/8)] uint8 little-endian sign bits
+    counts: np.ndarray  # [D] int32 live rows
+    raw_words: np.ndarray  # [D, Wr] uint32
+    code_words: np.ndarray  # [D, Wc] uint32
+    table_words: np.ndarray  # [D, Wt] uint32
+    lane_starts: np.ndarray  # [D, n_dict] int32
+    n_uniq: np.ndarray  # [D] int32 live table entries
+    rows: int = dataclasses.field(metadata=dict(static=True), default=0)
+    lanes: int = dataclasses.field(metadata=dict(static=True), default=0)
+    dict_lanes: tuple = dataclasses.field(
+        metadata=dict(static=True), default=()
+    )
+    code_bits: int = dataclasses.field(metadata=dict(static=True), default=0)
+    dict_pad: int = dataclasses.field(metadata=dict(static=True), default=0)
+    raw_bits: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def num_examples(self) -> int:
+        return int(np.asarray(self.counts).sum())
+
+    def static_key(self) -> tuple:
+        return (
+            self.rows, self.lanes, self.dict_lanes, self.code_bits,
+            self.dict_pad, self.raw_bits,
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class EncodedEllStreamSuperBatch:
+    """T stacked EncodedEllStreamBatches (fields [T, D, ...]) — one
+    scan launch decodes and runs T sequential ministeps."""
+
+    y_bits: np.ndarray
+    counts: np.ndarray
+    raw_words: np.ndarray
+    code_words: np.ndarray
+    table_words: np.ndarray
+    lane_starts: np.ndarray
+    n_uniq: np.ndarray
+    rows: int = dataclasses.field(metadata=dict(static=True), default=0)
+    lanes: int = dataclasses.field(metadata=dict(static=True), default=0)
+    dict_lanes: tuple = dataclasses.field(
+        metadata=dict(static=True), default=()
+    )
+    code_bits: int = dataclasses.field(metadata=dict(static=True), default=0)
+    dict_pad: int = dataclasses.field(metadata=dict(static=True), default=0)
+    raw_bits: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def steps(self) -> int:
+        return int(self.counts.shape[0])
+
+    @property
+    def num_examples(self) -> int:
+        return int(np.asarray(self.counts).sum())
+
+    def static_key(self) -> tuple:
+        return (
+            self.rows, self.lanes, self.dict_lanes, self.code_bits,
+            self.dict_pad, self.raw_bits,
+        )
+
+
+def stack_stream_batches(
+    parts: List[EncodedEllStreamBatch],
+) -> EncodedEllStreamSuperBatch:
+    """Stack T stream-wire minibatches into one scan superbatch.
+    Statics must agree across T (they pin ONE decode program)."""
+    if not parts:
+        raise ValueError("empty superbatch")
+    key = parts[0].static_key()
+    assert all(p.static_key() == key for p in parts), (
+        "stream superbatch needs uniform static encoding parameters"
+    )
+    arrays = (
+        "y_bits", "counts", "raw_words", "code_words", "table_words",
+        "lane_starts", "n_uniq",
+    )
+    return EncodedEllStreamSuperBatch(
+        **{f: np.stack([getattr(p, f) for p in parts]) for f in arrays},
+        rows=parts[0].rows,
+        lanes=parts[0].lanes,
+        dict_lanes=parts[0].dict_lanes,
+        code_bits=parts[0].code_bits,
+        dict_pad=parts[0].dict_pad,
+        raw_bits=parts[0].raw_bits,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamStatics:
+    """Pinned static parameters of the stream wire (one decode
+    program). Derived from the worker's first batch, then every encode
+    verifies against them — the `_padding` pattern."""
+
+    lanes: int
+    dict_lanes: tuple
+    code_bits: int
+    dict_pad: int
+    raw_bits: int
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(0, int(max(1, n)) - 1).bit_length()
+
+
+def _lane_code_bits(n_uniq: int) -> int:
+    """Bit width of a lane's dictionary codes, with 25% headroom padded
+    to a power of two so small vocabulary drift between batches cannot
+    flip the static width (each flip would compile a new decode
+    program)."""
+    return max(1, (_pow2ceil(n_uniq + (n_uniq >> 2)) - 1).bit_length())
+
+
+def derive_stream_statics(
+    keys: np.ndarray, lanes: int, hash_num_slots: int, num_slots: int
+) -> Optional[StreamStatics]:
+    """Derive the pinned stream-wire statics from one batch's key
+    stream (uniform ``lanes``-wide rows, row-major). Returns None when
+    no lane-dictionary split wins over the plain bits wire — the
+    caller then stays on the bits wire for the run.
+
+    The lane rule is the lane's own net win: shipping codes at the
+    lane's padded code width instead of raw bits must save more row
+    bits than the lane's padded uslot table costs to ship. That keeps
+    high-vocabulary lanes raw automatically — an all-unique lane
+    (hashed categorical tokens, ~98% unique per batch) pays a
+    rows-sized table for zero code savings, and past the hash those
+    streams are ~incompressible anyway (measured: delta-coding the
+    global unique slot set loses at the criteo-law ~65% unique
+    fraction). A final combined check re-verifies the win at the
+    SHARED code width (the widest chosen lane's) before pinning."""
+    from ..utils.bitpack import slot_bits as _slot_bits
+    from ..utils.murmur import hash_slots
+
+    k = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+    if lanes <= 0 or k.size == 0 or k.size % lanes:
+        return None
+    raw_bits = _slot_bits(num_slots)
+    cols = hash_slots(k, hash_num_slots).reshape(-1, lanes)
+    n_rows = cols.shape[0]
+    lane_u = [int(len(np.unique(cols[:, j]))) for j in range(lanes)]
+    dict_lanes = tuple(
+        j
+        for j in range(lanes)
+        if n_rows * (raw_bits - _lane_code_bits(lane_u[j]))
+        > _pow2ceil(lane_u[j] + (lane_u[j] >> 2)) * raw_bits
+    )
+    if not dict_lanes:
+        return None
+    code_bits = max(_lane_code_bits(lane_u[j]) for j in dict_lanes)
+    total = sum(lane_u[j] for j in dict_lanes)
+    dict_pad = _pow2ceil(total + (total >> 2))
+    # net-win check against the plain bits wire at THIS batch's shape:
+    # per-row code savings must beat the shipped table + offsets
+    rows = cols.shape[0]
+    saved_bits = rows * len(dict_lanes) * (raw_bits - code_bits)
+    table_bits = dict_pad * raw_bits + 32 * len(dict_lanes)
+    if saved_bits <= table_bits:
+        return None
+    return StreamStatics(
+        lanes=lanes, dict_lanes=dict_lanes, code_bits=code_bits,
+        dict_pad=dict_pad, raw_bits=raw_bits,
+    )
+
+
+def _encode_stream_shard_py(
+    slots: np.ndarray, nsub: int, rows_pad: int, st: StreamStatics
+):
+    """NumPy reference encode of ONE shard's hashed slot matrix —
+    bit-identical to the native fused pass (parity tier-1 tested).
+    Returns (raw_words, code_words, table_words, lane_starts, n_uniq)
+    or None when the batch falls outside the pinned statics."""
+    from ..utils.bitpack import pack_bits
+
+    n_dict = len(st.dict_lanes)
+    n_raw = st.lanes - n_dict
+    cols = slots.reshape(nsub, st.lanes)
+    dict_set = frozenset(st.dict_lanes)
+    raw_lanes = [j for j in range(st.lanes) if j not in dict_set]
+    tables = []
+    lane_starts = np.zeros(n_dict, np.int32)
+    codes = np.empty((nsub, n_dict), np.int32)
+    total = 0
+    for i, j in enumerate(st.dict_lanes):
+        u, inv = np.unique(cols[:, j], return_inverse=True)
+        if len(u) > (1 << st.code_bits) or total + len(u) > st.dict_pad:
+            return None
+        lane_starts[i] = total
+        total += len(u)
+        tables.append(u.astype(np.int32, copy=False))
+        codes[:, i] = inv
+    raw_vals = (
+        cols[:, raw_lanes].reshape(-1) if n_raw else np.zeros(0, np.int32)
+    )
+    table_vals = np.concatenate(tables) if tables else np.zeros(0, np.int32)
+    raw_words = stream_to_words(
+        pack_bits(raw_vals, st.raw_bits), rows_pad * n_raw, st.raw_bits
+    )
+    code_words = stream_to_words(
+        pack_bits(codes.reshape(-1), st.code_bits),
+        rows_pad * n_dict,
+        st.code_bits,
+    )
+    table_words = stream_to_words(
+        pack_bits(table_vals, st.raw_bits), st.dict_pad, st.raw_bits
+    )
+    return raw_words, code_words, table_words, lane_starts, np.int32(total)
+
+
+def encode_stream_shard(
+    keys: np.ndarray,
+    nsub: int,
+    rows_pad: int,
+    hash_num_slots: int,
+    st: StreamStatics,
+    seed: int = 0,
+):
+    """Fused hash→unique→remap→bit-pack over ONE shard's key stream
+    (the Localizer-prep host stage, fused): native one-pass C ABI call
+    when ``libpsnative`` is loaded, bit-identical NumPy fallback
+    otherwise. STATELESS + deterministic (pool-able prep stage).
+    Returns (raw_words, code_words, table_words, lane_starts, n_uniq)
+    or None when the shard falls outside the pinned statics (caller
+    ships the raw bits wire)."""
+    import ctypes
+
+    from ..cpp import native
+    from ..utils.bitpack import packed_nwords
+    from ..utils.murmur import hash_slots
+
+    k = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+    assert k.size == nsub * st.lanes, (k.size, nsub, st.lanes)
+    lib = native()
+    if (
+        lib is None
+        or getattr(lib, "ps_stream_encode", None) is None
+        or k.size < 4096
+    ):
+        return _encode_stream_shard_py(
+            hash_slots(k, hash_num_slots, seed), nsub, rows_pad, st
+        )
+    n_dict = len(st.dict_lanes)
+    n_raw = st.lanes - n_dict
+    dict_mask = np.zeros(st.lanes, np.uint8)
+    dict_mask[list(st.dict_lanes)] = 1
+    # zeroed full-capacity buffers: the native packers write only the
+    # live prefix; the zero tail is part of the wire bytes (parity)
+    raw_buf = np.zeros(
+        packed_nwords(rows_pad * n_raw, st.raw_bits) * 4, np.uint8
+    )
+    code_buf = np.zeros(
+        packed_nwords(rows_pad * n_dict, st.code_bits) * 4, np.uint8
+    )
+    table_buf = np.zeros(
+        packed_nwords(st.dict_pad, st.raw_bits) * 4, np.uint8
+    )
+    starts = np.zeros(n_dict + 1, np.int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    got = lib.ps_stream_encode(
+        k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.c_int64(nsub),
+        ctypes.c_int32(st.lanes),
+        ctypes.c_uint64(seed),
+        ctypes.c_uint64(hash_num_slots),
+        dict_mask.ctypes.data_as(u8p),
+        ctypes.c_uint32(st.raw_bits),
+        ctypes.c_uint32(st.code_bits),
+        ctypes.c_int32(st.dict_pad),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        raw_buf.ctypes.data_as(u8p),
+        code_buf.ctypes.data_as(u8p),
+        table_buf.ctypes.data_as(u8p),
+    )
+    if got < 0:
+        return None
+    return (
+        raw_buf.view("<u4"),
+        code_buf.view("<u4"),
+        table_buf.view("<u4"),
+        starts[:n_dict].copy(),
+        np.int32(got),
+    )
+
+
+def decode_stream_shard(enc: EncodedEllStreamBatch, d: int):
+    """Decode ONE data shard of an EncodedEllStreamBatch with the REAL
+    jit-side ops (ops/wire_codec) — the shared body the device step
+    traces and the host parity oracle runs on CPU. Returns
+    ``(y, mask, slots)`` with ``slots`` int32 [rows, lanes]."""
+    from ..ops import wire_codec as wc
+
+    y = wc.decode_sign_labels(enc.y_bits[d], enc.counts[d], enc.rows)
+    mask = wc.decode_mask(enc.counts[d], enc.rows)
+    slots = wc.decode_stream_slots(
+        enc.raw_words[d],
+        enc.code_words[d],
+        enc.table_words[d],
+        enc.lane_starts[d],
+        rows=enc.rows,
+        lanes=enc.lanes,
+        dict_lanes=enc.dict_lanes,
+        code_bits=enc.code_bits,
+        dict_pad=enc.dict_pad,
+        raw_bits=enc.raw_bits,
+    )
+    return y, mask, slots
+
+
 class UploadCache:
     """Key caching on the host→device leg: a repeated array re-uses its
     device-resident buffer, identified by crc32c signature and VERIFIED
@@ -670,3 +1018,109 @@ class MessageWireCodec:
     def decode(self, msg: Message) -> Tuple[Optional[np.ndarray], List[np.ndarray]]:
         out = self._decode_chain.decode(msg)
         return out.key, list(out.values)
+
+
+# ---------------------------------------------------------------------------
+# LZ on the host→device STAGING leg (the reference's compressing filter,
+# upload edition).
+#
+# The reference compresses every filtered message's value arrays on the
+# wire (src/filter/compressing.h, snappy). Our upload path's analog is
+# the STAGING leg: prep-pool workers compress each encoded batch's
+# leaves into self-describing codec frames (utils/codec.py — native LZ,
+# zlib fallback, incompressible payloads ride raw), and the serial
+# uploader thread decompresses them immediately before ``device_put``.
+# That split honors the stateless-or-feeder rule (compress is stateless
+# → pool; decompress rides the single uploader thread) and mirrors the
+# reference's chain order: quantize/encode first, byte-codec last.
+#
+# Byte accounting: ``ps_wire_bytes_total{encoding="<mode>+lz"}`` and
+# ``ps_wire_saved_bytes_total{reason="compression"}`` record the staged
+# (compressed) bytes — the modeled disaggregated feeder→device-host
+# leg — while ``ps_ingest_uploaded_bytes_total`` stays the REALIZED
+# PJRT link traffic (arrays decompress BEFORE device_put, so the
+# tunnel itself ships decoded wire bytes; doc/PERFORMANCE.md "Wire
+# format" spells out which legs compression does and does not shrink).
+# ---------------------------------------------------------------------------
+
+
+class CompressedBatch:
+    """A host-prepped batch tree with its array leaves compressed into
+    codec frames — the staging-leg container handed from the prep pool
+    to the uploader. NOT a jax pytree: it never reaches a jitted step;
+    ``decompress_batch`` restores the original tree bit-identically
+    (np.frombuffer of the decoded frame, dtype/shape from the retained
+    meta)."""
+
+    __slots__ = (
+        "frames", "meta", "treedef", "n", "raw_nbytes", "wire_nbytes",
+        "encoding",
+    )
+
+    def __init__(self, frames, meta, treedef, n, raw_nbytes, wire_nbytes,
+                 encoding):
+        self.frames = frames  # List[bytes] codec frames, leaf order
+        self.meta = meta  # List[(dtype str, shape)] per leaf
+        self.treedef = treedef
+        self.n = n  # example count (uploader telemetry)
+        self.raw_nbytes = raw_nbytes
+        self.wire_nbytes = wire_nbytes  # staged bytes, net of compression
+        self.encoding = encoding
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.n)
+
+
+def compress_batch(prepped, encoding: str = "") -> CompressedBatch:
+    """Compress a host-prepped batch tree's leaves for the staging leg
+    (STATELESS — pool-able prep stage). Incompressible leaves ride raw
+    inside their self-describing frame (utils/codec.compress), so the
+    worst case is one header byte per leaf."""
+    from ..utils import codec
+
+    leaves, treedef = jax.tree.flatten(prepped)
+    frames, meta = [], []
+    raw_nbytes = wire_nbytes = 0
+    for leaf in leaves:
+        arr = np.ascontiguousarray(leaf)
+        frame = codec.compress(arr.tobytes())
+        frames.append(frame)
+        meta.append((arr.dtype.str, arr.shape))
+        raw_nbytes += arr.nbytes
+        wire_nbytes += len(frame)
+    n = getattr(prepped, "num_examples", 0)
+    out = CompressedBatch(
+        frames, meta, treedef, n, raw_nbytes, wire_nbytes, encoding
+    )
+    tel = wire_instruments()
+    if tel is not None:
+        if encoding:
+            tel["bytes"].labels(encoding=f"{encoding}+lz").inc(wire_nbytes)
+        tel["saved_bytes"].labels(reason="compression").inc(
+            max(0, raw_nbytes - wire_nbytes)
+        )
+    return out
+
+
+def decompress_batch(cb: CompressedBatch):
+    """Uploader-side inverse of :func:`compress_batch`: restore the
+    original batch tree bit-for-bit before ``device_put``. Runs on the
+    single uploader/staging thread (the feeder half of the
+    stateless-or-feeder rule)."""
+    from ..utils import codec
+
+    leaves = []
+    for frame, (dtype, shape) in zip(cb.frames, cb.meta):
+        dt = np.dtype(dtype)
+        expected = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        raw = codec.decompress(frame, expected_size=expected)
+        leaves.append(np.frombuffer(raw, dtype=dt).reshape(shape))
+    return jax.tree.unflatten(cb.treedef, leaves)
+
+
+def maybe_decompress(item):
+    """Identity for plain batch trees; frame decode for CompressedBatch
+    (the uploader calls this on every staged item so compression stays
+    a config choice, not a code path fork)."""
+    return decompress_batch(item) if isinstance(item, CompressedBatch) else item

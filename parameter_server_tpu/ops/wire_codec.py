@@ -20,6 +20,8 @@ utils/bitpack.py):
 - ``decode_binary_vals``    nnz count → the all-ones value array
 - ``decode_fixed_point``    u8/u16 codes + per-shard (lo, hi) → float32
 - ``decode_bf16``           bfloat16 values → float32
+- ``decode_stream_slots``   lane-dictionary wire (per-lane ``uslots``
+  tables + packed ``ucols`` + raw-lane bitstream) → ELL slot matrix
 
 Each is the exact inverse of its host encoder over the encoder's
 declared domain (the encoder VERIFIES the domain per batch and falls
@@ -112,3 +114,61 @@ def decode_fixed_point(q: jnp.ndarray, lo, hi, num_bytes: int) -> jnp.ndarray:
 def decode_bf16(v: jnp.ndarray) -> jnp.ndarray:
     """bfloat16 value stream → float32 (widening is exact)."""
     return v.astype(jnp.float32)
+
+
+def decode_stream_slots(
+    raw_words: jnp.ndarray,
+    code_words: jnp.ndarray,
+    table_words: jnp.ndarray,
+    lane_starts: jnp.ndarray,
+    *,
+    rows: int,
+    lanes: int,
+    dict_lanes: tuple,
+    code_bits: int,
+    dict_pad: int,
+    raw_bits: int,
+) -> jnp.ndarray:
+    """Stream-once lane-dictionary wire → the int32 [rows, lanes] ELL
+    slot matrix (learner/wire.EncodedEllStreamBatch's host encode,
+    inverted on device).
+
+    Dictionary lanes decode as ``uslots[lane_start + ucol]``: unpack
+    the ``code_bits``-wide ucol stream, add each dict lane's static
+    table offset, gather from the unpacked ``uslots`` table; raw lanes
+    unpack straight from the ``raw_bits`` stream. The static lane split
+    then interleaves both column groups back into original lane order
+    with one compile-time permutation (a free layout choice for XLA).
+
+    Garbage on PADDING rows is in-bounds by construction — codes are
+    ``code_bits`` wide and the clamp keeps ``start + code`` inside the
+    power-of-two ``dict_pad`` table, whose dead entries are packed
+    zeros (slot 0) — and every padding row's contribution is gated by
+    the row mask inside the step, exactly like the bits wire."""
+    n_dict = len(dict_lanes)
+    n_raw = lanes - n_dict
+    parts = []
+    if n_dict:
+        table = unpack_bits(table_words, dict_pad, raw_bits)
+        ucols = unpack_bits(code_words, rows * n_dict, code_bits).reshape(
+            rows, n_dict
+        )
+        idx = jnp.minimum(lane_starts[None, :] + ucols, dict_pad - 1)
+        parts.append(table[idx])
+    if n_raw:
+        parts.append(
+            unpack_bits(raw_words, rows * n_raw, raw_bits).reshape(
+                rows, n_raw
+            )
+        )
+    cols = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    # static inverse permutation: concat order is (dict lanes in lane
+    # order, then raw lanes in lane order) → original lane order
+    dict_set = frozenset(dict_lanes)
+    concat_order = list(dict_lanes) + [
+        j for j in range(lanes) if j not in dict_set
+    ]
+    perm = [0] * lanes
+    for pos, j in enumerate(concat_order):
+        perm[j] = pos
+    return cols[:, tuple(perm)]

@@ -242,6 +242,14 @@ def wire_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "ps_wire_cache_misses_total",
             "upload key-cache misses (array uploaded and retained)",
         ),
+        "fallbacks": reg.ensure_counter(
+            "ps_wire_fallback_total",
+            "batches an encoder refused (domain verify failed — ragged "
+            "rows, non-sign labels, pinned-statics overflow, ...) and "
+            "shipped on the raw wire instead, by reason; the "
+            "verify-or-raw contract's visibility half",
+            labelnames=("reason",),
+        ),
     }
 
 

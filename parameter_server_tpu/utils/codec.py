@@ -67,8 +67,23 @@ def decompress(
     if tag == _RAW:
         return bytes(body)
     if tag == _ZLIB:
+        # decompressobj + max_length, not zlib.decompress: a hostile
+        # frame header can claim a multi-GB expansion (the >4GB-frame
+        # edge) and the one-shot API would allocate it before failing —
+        # the bound must hold BEFORE the bytes exist
         try:
-            return zlib.decompress(body)
+            d = zlib.decompressobj()
+            out = d.decompress(body, max_size)
+            if d.unconsumed_tail:
+                raise ValueError("zlib frame output exceeds max_size")
+            if not d.eof:
+                # decompressobj (unlike the one-shot API) returns
+                # partial output on a truncated stream — the untrusted-
+                # frame contract requires a raise, never silent bytes
+                raise ValueError("truncated zlib frame")
+            if d.unused_data:
+                raise ValueError("trailing garbage after zlib frame")
+            return out
         except zlib.error as e:
             raise ValueError(f"bad zlib frame: {e}") from e
     if tag == _LZ:

@@ -78,6 +78,13 @@ METADATA_SECTIONS = frozenset(
         # with the chip the record was taken on, not with the code,
         # so banding them would false-flag every capture-host change
         "device",
+        # which wire the e2e stream rode (config + per-encoding
+        # bytes/example + pinned lane statics + fallback counts — both
+        # the synthetic and the --real records carry it since the
+        # stream-once wire flip): disclosure metadata, not a
+        # throughput the sentinel may band
+        "e2e_wire",
+        "e2e_upload_cache",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

@@ -42,6 +42,7 @@ SCOPE = (
     "parameter_server_tpu/ops/ftrl_sparse.py",
     "parameter_server_tpu/ops/quantize.py",
     "parameter_server_tpu/ops/flash_attention.py",
+    "parameter_server_tpu/ops/wire_codec.py",
 )
 
 _NP_IMPURE = {

@@ -33,3 +33,26 @@ def mesh8():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def require_native(symbol: str = None):
+    """The ONE require-or-skip gate for native-library tests: returns
+    the loaded libpsnative handle, skipping gracefully when it (or the
+    named ``symbol``) is absent — unless PS_REQUIRE_NATIVE=1 (`make
+    native-test`), which turns the skip into a loud failure."""
+    from parameter_server_tpu.cpp import native
+
+    lib = native()
+    missing = lib is None or (
+        symbol is not None and getattr(lib, symbol, None) is None
+    )
+    if missing:
+        what = f"libpsnative.so ({symbol})" if symbol else "libpsnative.so"
+        if os.environ.get("PS_REQUIRE_NATIVE"):
+            pytest.fail(
+                f"PS_REQUIRE_NATIVE=1 but {what} is unavailable — run "
+                "`make native` (the tier-1 suite skips gracefully; this "
+                "environment promised the library)"
+            )
+        pytest.skip(f"{what} unavailable (graceful tier-1 skip)")
+    return lib

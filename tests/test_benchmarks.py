@@ -14,7 +14,7 @@ def test_benchmarks_smoke_all(capsys):
     assert set(REGISTRY) == {
         "kv_vector", "kv_map", "kv_layer", "network", "sparse_matrix",
         "attention", "step_phases", "executor", "host_ingest", "wire",
-        "serve", "trace", "ftrl_sparse_ab", "ftrl_chain",
+        "stream_prep", "serve", "trace", "ftrl_sparse_ab", "ftrl_chain",
         "recovery_drill", "roofline",
     }
     for name, fn in sorted(REGISTRY.items()):

@@ -663,3 +663,346 @@ class TestMessageWireCodec:
         assert k is None
         step = (vals.max() - vals.min()) / 65535
         assert np.abs(v[0] - vals).max() <= step + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Stream-once lane-dictionary wire (wire='stream') — the cache-free
+# encoding for single-epoch data, plus its native fused prep and the
+# staging-leg codec. Same contract as the exact wire above: decode is
+# BIT-IDENTICAL, encode never guesses (domain verify → raw fallback),
+# stateless stages pool.
+# ---------------------------------------------------------------------------
+
+
+def _criteo_like_batches(n_batches, rows=256, lanes=8, vocab_small=60,
+                         seed=7):
+    """Uniform-lane binary batches with the criteo-law lane split:
+    half the lanes draw from a tiny per-lane vocabulary (the integer
+    count fields), half from a ~2^40 space (hashed categoricals)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        small = rng.integers(0, vocab_small, (rows, lanes // 2))
+        wide = rng.integers(0, 1 << 40, (rows, lanes - lanes // 2))
+        keys = np.concatenate(
+            [small + (np.arange(lanes // 2) << 50), wide], axis=1
+        ).astype(np.int64)
+        y = rng.choice((-1.0, 1.0), rows).astype(np.float32)
+        out.append(SparseBatch(
+            y=y,
+            indptr=np.arange(0, rows * lanes + 1, lanes),
+            indices=keys.ravel(),
+        ))
+    return out
+
+
+class TestStreamStatics:
+    NUM_SLOTS = 1 << 18
+
+    def test_lane_split_derivation(self):
+        b = _criteo_like_batches(1)[0]
+        st = wire.derive_stream_statics(
+            b.indices, 8, self.NUM_SLOTS, self.NUM_SLOTS
+        )
+        assert st is not None
+        # the tiny-vocab lanes (0-3) take the dictionary, wide stay raw
+        assert st.dict_lanes == (0, 1, 2, 3)
+        assert 2 * st.code_bits <= st.raw_bits
+
+    def test_no_win_returns_none(self):
+        # every lane wide-vocab: no dictionary split can win
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, 1 << 40, 256 * 8).astype(np.int64)
+        assert wire.derive_stream_statics(
+            keys, 8, self.NUM_SLOTS, self.NUM_SLOTS
+        ) is None
+
+    def test_table_cost_guard(self):
+        # tiny batch: per-row savings cannot amortize the table → None
+        b = _criteo_like_batches(1, rows=4)[0]
+        assert wire.derive_stream_statics(
+            b.indices, 8, self.NUM_SLOTS, self.NUM_SLOTS
+        ) is None
+
+
+class TestStreamWireParity:
+    NUM_SLOTS = 1 << 18
+
+    def _prep(self, b, st, rows_pad=None, shards=2, lanes=8):
+        from parameter_server_tpu.apps.linear.async_sgd import (
+            prep_batch_ell_stream,
+        )
+
+        d = KeyDirectory(self.NUM_SLOTS, hashed=True)
+        rows_pad = rows_pad or -(-b.n // shards)
+        return prep_batch_ell_stream(
+            b, d, shards, rows_pad, lanes, self.NUM_SLOTS, st
+        )
+
+    def _statics(self, b, lanes=8):
+        return wire.derive_stream_statics(
+            b.indices, lanes, self.NUM_SLOTS, self.NUM_SLOTS
+        )
+
+    def test_decode_bit_identical(self):
+        from parameter_server_tpu.utils.murmur import hash_slots
+
+        for b in _criteo_like_batches(3):
+            st = self._statics(b)
+            enc = self._prep(b, st)
+            assert enc is not None
+            per = -(-b.n // 2)
+            for d in range(2):
+                lo, hi = min(d * per, b.n), min((d + 1) * per, b.n)
+                seg = slice(b.indptr[lo], b.indptr[hi])
+                want = hash_slots(
+                    np.ascontiguousarray(b.indices[seg], np.uint64),
+                    self.NUM_SLOTS,
+                ).reshape(hi - lo, 8)
+                y, mask, slots = wire.decode_stream_shard(enc, d)
+                got = np.asarray(slots)
+                assert got.dtype == np.int32
+                np.testing.assert_array_equal(got[: hi - lo], want)
+                np.testing.assert_array_equal(
+                    np.asarray(y)[: hi - lo], b.y[lo:hi]
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(mask),
+                    (np.arange(enc.rows) < (hi - lo)).astype(np.float32),
+                )
+
+    def test_fixture_refuses_ragged(self):
+        # the committed wire_parity.libsvm fixture is ragged (3-10
+        # features/row) — outside the uniform-lane stream domain: the
+        # encoder must REFUSE (raw fallback), never mis-encode; the
+        # exact wire stays the fixture's encoded path (tested above)
+        for b in fixture_batches(binary=True):
+            st = wire.StreamStatics(
+                lanes=8, dict_lanes=(0,), code_bits=4, dict_pad=64,
+                raw_bits=18,
+            )
+            assert self._prep(b, st) is None
+
+    def test_valued_and_regression_refused(self):
+        b = _criteo_like_batches(1)[0]
+        st = self._statics(b)
+        valued = SparseBatch(
+            y=b.y, indptr=b.indptr, indices=b.indices,
+            values=np.ones(b.nnz, np.float32) * 2.0,
+        )
+        assert self._prep(valued, st) is None
+        regress = SparseBatch(
+            y=np.linspace(-2, 2, b.n).astype(np.float32),
+            indptr=b.indptr, indices=b.indices,
+        )
+        assert self._prep(regress, st) is None
+
+    def test_statics_overflow_falls_back(self):
+        # pinned statics from a tiny-vocab batch; a batch whose lane
+        # vocabulary blows past the padded code space must fall back
+        b0 = _criteo_like_batches(1, vocab_small=16)[0]
+        st = self._statics(b0)
+        big = _criteo_like_batches(1, vocab_small=250, seed=9)[0]
+        assert self._prep(big, st) is None
+        assert self._prep(b0, st) is not None
+
+    def test_superbatch_stack_and_static_mismatch(self):
+        batches = _criteo_like_batches(3)
+        st = self._statics(batches[0])
+        encs = [self._prep(b, st) for b in batches]
+        sb = wire.stack_stream_batches(encs)
+        assert sb.steps == 3
+        assert sb.num_examples == sum(e.num_examples for e in encs)
+        other = dataclasses.replace(encs[0], code_bits=encs[0].code_bits + 1)
+        with pytest.raises(AssertionError):
+            wire.stack_stream_batches([encs[0], other])
+
+    def test_wire_shrinks_vs_bits(self):
+        from parameter_server_tpu.apps.linear.async_sgd import (
+            prep_batch_ell_bits,
+        )
+
+        b = _criteo_like_batches(1, rows=1024)[0]
+        st = self._statics(b)
+        enc = self._prep(b, st, rows_pad=512)
+        d = KeyDirectory(self.NUM_SLOTS, hashed=True)
+        bits = prep_batch_ell_bits(b, d, 2, 512, 8, self.NUM_SLOTS)
+        assert wire.tree_nbytes(enc) < wire.tree_nbytes(bits)
+
+
+def _native_or_skip():
+    from conftest import require_native
+
+    return require_native("ps_stream_encode")
+
+
+class TestNativeFusedPrep:
+    """C-vs-Python fused unique+remap+encode parity: the native one-
+    pass ps_stream_encode must be BYTE-IDENTICAL to the NumPy path on
+    the committed ingest fixture's key stream. Skips gracefully when
+    the library is absent (tier-1 on a bare checkout); `make
+    native-test` sets PS_REQUIRE_NATIVE=1 to fail loudly instead."""
+
+    NUM_SLOTS = 1 << 18
+    LANES = 8
+
+    def _fixture_keys(self):
+        # the committed ingest fixture's real key bytes, reshaped to
+        # uniform lanes (the stream wire's domain): same keys the PR-3
+        # ingest parity contract pins
+        import os as _os
+
+        from parameter_server_tpu.data.stream_reader import StreamReader
+
+        fx = _os.path.join(
+            _os.path.dirname(__file__), "data", "ingest_parity.libsvm"
+        )
+        idx = np.concatenate(
+            [b.indices for b in StreamReader([fx], "libsvm").minibatches(64)]
+        )
+        n = (idx.size // self.LANES) * self.LANES
+        # fold some keys into a small per-lane vocabulary so the lane
+        # dictionary engages (fixture keys are near-unique)
+        keys = idx[:n].copy()
+        rows = n // self.LANES
+        km = keys.reshape(rows, self.LANES)
+        km[:, : self.LANES // 2] = (km[:, : self.LANES // 2] % 48) + (
+            np.arange(self.LANES // 2) << 50
+        )
+        return keys, rows
+
+    def test_byte_identical_on_ingest_fixture(self):
+        from parameter_server_tpu.utils.murmur import hash_slots
+
+        _native_or_skip()
+        keys, rows = self._fixture_keys()
+        st = wire.derive_stream_statics(
+            keys, self.LANES, self.NUM_SLOTS, self.NUM_SLOTS
+        )
+        assert st is not None and st.dict_lanes
+        rows_pad = rows + 13  # exercise the zero tail too
+        nat = wire.encode_stream_shard(
+            keys, rows, rows_pad, self.NUM_SLOTS, st
+        )
+        py = wire._encode_stream_shard_py(
+            hash_slots(np.ascontiguousarray(keys, np.uint64),
+                       self.NUM_SLOTS),
+            rows, rows_pad, st,
+        )
+        assert nat is not None and py is not None
+        for name, a, c in zip(
+            ("raw_words", "code_words", "table_words", "lane_starts",
+             "n_uniq"), nat, py,
+        ):
+            a, c = np.asarray(a), np.asarray(c)
+            assert a.dtype == c.dtype and a.shape == c.shape, name
+            np.testing.assert_array_equal(a, c, err_msg=name)
+
+    def test_overflow_agreement(self):
+        # both paths must refuse the SAME batches (the fallback is part
+        # of the wire format): shrink the pinned table/code space and
+        # check C and Python agree on rejection
+        from parameter_server_tpu.utils.murmur import hash_slots
+
+        _native_or_skip()
+        keys, rows = self._fixture_keys()
+        st = wire.derive_stream_statics(
+            keys, self.LANES, self.NUM_SLOTS, self.NUM_SLOTS
+        )
+        tight = dataclasses.replace(st, dict_pad=8, code_bits=2)
+        nat = wire.encode_stream_shard(
+            keys, rows, rows, self.NUM_SLOTS, tight
+        )
+        py = wire._encode_stream_shard_py(
+            hash_slots(np.ascontiguousarray(keys, np.uint64),
+                       self.NUM_SLOTS),
+            rows, rows, tight,
+        )
+        assert nat is None and py is None
+
+
+class TestStreamTrainParity:
+    """The PR-5 whole-trajectory invariant, extended to the stream
+    encoder: training on the stream wire (per-minibatch AND scan-fused
+    AND staging-leg-compressed, pipelined) is bit-identical to the raw
+    bits wire."""
+
+    def _conf(self, wire_fmt, spl=1, compress=""):
+        conf = Config()
+        conf.penalty = PenaltyConfig(type="l1", lambda_=[0.05])
+        conf.learning_rate = LearningRateConfig(
+            type="decay", alpha=0.5, beta=1.0
+        )
+        conf.async_sgd = SGDConfig(
+            algo="ftrl", minibatch=256, num_slots=1 << 16, max_delay=0,
+            ell_lanes=8, wire=wire_fmt, steps_per_launch=spl,
+            wire_compress=compress,
+        )
+        return conf
+
+    def _run(self, mesh8, wire_fmt, spl=1, compress="", pipelined=None):
+        Postoffice.reset()
+        worker = AsyncSGDWorker(self._conf(wire_fmt, spl, compress),
+                                mesh=mesh8)
+        worker.train(iter(_criteo_like_batches(6)), pipelined=pipelined)
+        return worker, {k: np.asarray(v) for k, v in worker.state.items()}
+
+    def test_trajectory_bit_identical(self, mesh8):
+        _, raw = self._run(mesh8, "bits")
+        worker, enc = self._run(mesh8, "stream")
+        assert any(k[0] == "ell_stream" for k in worker._steps), (
+            "the stream path did not run"
+        )
+        for k in raw:
+            np.testing.assert_array_equal(raw[k], enc[k], err_msg=k)
+
+    def test_scan_compressed_pipelined_bit_identical(self, mesh8):
+        _, raw = self._run(mesh8, "bits")
+        worker, enc = self._run(
+            mesh8, "stream", spl=2, compress="lz", pipelined=True
+        )
+        assert any(k[0] == "ell_stream_scan" for k in worker._steps)
+        for k in raw:
+            np.testing.assert_array_equal(raw[k], enc[k], err_msg=k)
+
+    def test_bad_compress_config_rejected(self, mesh8):
+        with pytest.raises(ValueError, match="wire_compress"):
+            AsyncSGDWorker(self._conf("bits", compress="zstd"), mesh=mesh8)
+
+
+class TestStagingLegCodec:
+    def test_roundtrip_bit_identical(self):
+        b = _criteo_like_batches(1)[0]
+        st = wire.derive_stream_statics(b.indices, 8, 1 << 18, 1 << 18)
+        from parameter_server_tpu.apps.linear.async_sgd import (
+            prep_batch_ell_stream,
+        )
+
+        d = KeyDirectory(1 << 18, hashed=True)
+        enc = prep_batch_ell_stream(b, d, 2, 128, 8, 1 << 18, st)
+        cb = wire.compress_batch(enc, encoding="stream")
+        assert cb.num_examples == enc.num_examples
+        assert cb.wire_nbytes <= cb.raw_nbytes + len(cb.frames)
+        dec = wire.decompress_batch(cb)
+        assert type(dec) is type(enc)
+        for f in dataclasses.fields(type(enc)):
+            want = getattr(enc, f.name)
+            got = getattr(dec, f.name)
+            if isinstance(want, np.ndarray):
+                assert want.dtype == got.dtype, f.name
+                np.testing.assert_array_equal(want, got, err_msg=f.name)
+            else:
+                assert want == got, f.name
+
+    def test_maybe_decompress_identity(self):
+        x = {"a": np.arange(4)}
+        assert wire.maybe_decompress(x) is x
+
+    def test_incompressible_leaves_ride_raw(self):
+        rng = np.random.default_rng(3)
+        noise = {"x": rng.integers(0, 256, 1 << 15).astype(np.uint8)}
+        cb = wire.compress_batch(noise)
+        # raw frame: one header byte of overhead, nothing more
+        assert cb.wire_nbytes <= cb.raw_nbytes + len(cb.frames)
+        got = wire.decompress_batch(cb)
+        np.testing.assert_array_equal(got["x"], noise["x"])
