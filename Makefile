@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench roofline trace bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -137,6 +137,19 @@ trace:
 	env JAX_PLATFORMS=cpu PS_TRACE_OUT=$${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} \
 		python -m parameter_server_tpu.benchmarks trace
 	@echo "timeline: $${PS_TRACE_OUT:-/tmp/ps_timeline_trace.json} (open at https://ui.perfetto.dev)"
+
+# capture a diagnostic bundle from a live mini-cluster
+# (doc/OBSERVABILITY.md "Flight recorder & diagnostic bundles"): the
+# flight-recorder rings of every node (one deliberately silent ->
+# marked stale), metrics snapshot, alert states, executor state, and a
+# Perfetto-ready trace — the same artifact an alert firing, a
+# DegradedError, a shard death, or a wedged executor wait auto-captures,
+# and what /debug/bundle serves live. Override the output with
+# PS_BUNDLE_OUT=/path.json
+bundle:
+	env JAX_PLATFORMS=cpu PS_BUNDLE_OUT=$${PS_BUNDLE_OUT:-/tmp/ps_bundle.json} \
+		python -m parameter_server_tpu.benchmarks bundle
+	@echo "bundle: $${PS_BUNDLE_OUT:-/tmp/ps_bundle.json} (open its 'trace' member at https://ui.perfetto.dev)"
 
 # cluster metrics plane demo (doc/OBSERVABILITY.md "Cluster metrics
 # plane"): a tiny live system on the CPU mesh with the full plane up —

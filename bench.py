@@ -344,11 +344,20 @@ def ensure_trace_sink() -> "str | None":
     timeline is the raw material of the record's ``attribution``
     section — every stage span (prep/stack/upload on their threads,
     executor step phases) lands here, flow-correlated per superbatch.
+
+    The flight recorder (telemetry/blackbox.py) arms as a tee over the
+    sink, so every bench run also carries the always-on black box —
+    an alert firing or a wedged wait mid-run auto-captures a
+    diagnostic bundle with the last ring of spans in it (the record's
+    ``blackbox.bundles_captured`` discloses how many).
     """
     import tempfile
 
+    from parameter_server_tpu.telemetry import blackbox
+
     sink = telemetry_spans.get_sink()
     if sink is not None:
+        blackbox.arm()
         return getattr(sink, "path", None)
     path = os.path.join(
         tempfile.gettempdir(), f"ps_bench_trace_{os.getpid()}.jsonl"
@@ -356,6 +365,7 @@ def ensure_trace_sink() -> "str | None":
     with contextlib.suppress(OSError):
         os.remove(path)  # fresh capture: never mix runs
     telemetry_spans.install_sink(telemetry_spans.JsonlSink(path))
+    blackbox.arm()
     return path
 
 
@@ -651,6 +661,44 @@ def attach_recovery(rec_or_headline: dict, smoke: bool) -> None:
             rec_or_headline["recovery"] = recovery_drill(smoke)
     except Exception as e:
         rec_or_headline["recovery_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
+def attach_blackbox(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the flight-recorder evidence under ``blackbox``
+    in every bench record: the steady-state overhead paired-median A/B
+    (armed ring vs no sink on the same span-instrumented work stream —
+    the PR 9 disarmed-overhead pattern; the honest claim is the ratio
+    straddling this host's noise floor, with the tight-loop absolute
+    ns/event that a capacity flap cannot fake), the run's ring
+    occupancy, and how many diagnostic bundles the trigger plane
+    captured during the run. Run METADATA, not a throughput metric —
+    script/bench_diff.py excludes this section from banding
+    (METADATA_SECTIONS); never breaks a record."""
+    try:
+        from parameter_server_tpu.telemetry import blackbox
+
+        # parked: the A/B measures its own private tee — the run's
+        # JSONL sink must neither pay for nor record the probe spans
+        with telemetry_spans.parked_sink():
+            overhead = blackbox.overhead_ab(reps=3 if smoke else 5)
+        section: dict = {"overhead": overhead}
+        rec = blackbox.installed_recorder()
+        if rec is not None:
+            d = rec.dump()
+            section["ring"] = {
+                "node": d["node"],
+                "events": len(d["events"]),
+                "events_total": d["events_total"],
+                "dropped": d["dropped"],
+                "capacity": d["capacity"],
+                "metrics_samples": len(d["metrics_samples"]),
+            }
+        section["bundles_captured"] = len(blackbox.bundles())
+        rec_or_headline["blackbox"] = section
+    except Exception as e:
+        rec_or_headline["blackbox_error"] = (
             f"{type(e).__name__}: {str(e)[:200]}"
         )
 
@@ -1876,6 +1924,8 @@ def run_real(args) -> int:
     attach_serve(headline, args.smoke)
     _beat("recovery")
     attach_recovery(headline, args.smoke)
+    _beat("blackbox")
+    attach_blackbox(headline, args.smoke)
     _beat("e2e", **headline)
 
     wire_fallback = {"parts": 0, "rows": 0}
@@ -2414,6 +2464,10 @@ def run_synthetic(args) -> int:
     # bit-parity + degraded/shed accounting, doc/ROBUSTNESS.md)
     _beat("recovery")
     attach_recovery(headline, args.smoke)
+    # flight-recorder overhead A/B + ring state (doc/OBSERVABILITY.md
+    # "Flight recorder & diagnostic bundles")
+    _beat("blackbox")
+    attach_blackbox(headline, args.smoke)
     # disclose which wire the e2e stream actually rode (the flip's
     # whole point is that BENCH_r06 stops quoting the raw bits bytes)
     headline["e2e_wire"] = {

@@ -1453,6 +1453,88 @@ def trace_perf(smoke: bool = False) -> None:
     report("trace_capture_events_per_sec", len(events) / capture_s, "events/sec")
 
 
+@benchmark("bundle")
+def bundle_probe(smoke: bool = False) -> None:
+    """Capture a diagnostic bundle from a live mini-cluster and write
+    it where ``PS_BUNDLE_OUT`` points (``make bundle``; default
+    ``<tmp>/ps_bundle.json``) — the operator's "what was the system
+    doing just now" artifact, identical in shape to what an alert
+    firing or a shard death auto-captures (telemetry/blackbox.py).
+
+    Drives the real pieces: the flight recorder armed as a tee (zero
+    file IO), per-node recorders with metrics-delta samples, traced
+    work under flow scopes, an AuxRuntime with two registered nodes —
+    one of which goes SILENT before capture, so the bundle demonstrably
+    carries staleness instead of a fabricated ring. The ``trace``
+    section opens directly at https://ui.perfetto.dev."""
+    import json as _json
+    import os
+    import tempfile
+    import time as _time
+
+    from ..system.aux_runtime import AuxRuntime
+    from ..telemetry import blackbox
+    from ..telemetry import spans as telemetry_spans
+
+    out_path = os.environ.get("PS_BUNDLE_OUT") or os.path.join(
+        tempfile.gettempdir(), "ps_bundle.json"
+    )
+    # targeted setup/cleanup like recovery_drill's (never a global
+    # blackbox.reset(): that would disarm an enclosing run's tee, drop
+    # its recorders, and clobber its rate-limit interval)
+    prev_interval = blackbox.set_min_interval(0.0)
+    was_armed = blackbox.installed_recorder() is not None
+    aux = AuxRuntime(heartbeat_timeout=5.0, stale_after_s=0.08)
+    try:
+        aux.register("W0")
+        aux.register("S0")
+        blackbox.arm()
+        for nid in ("W0", "S0"):
+            blackbox.recorder(nid).clear()
+            blackbox.recorder(nid).sample_metrics()
+        # traced work: flows whose spans land in the ring
+        n = 8 if smoke else 32
+        work = np.random.default_rng(0).random(1 << 14)
+        for i in range(n):
+            with telemetry_spans.flow_scope(telemetry_spans.new_flow()):
+                with telemetry_spans.span("bundle.demo", i=i):
+                    float(np.sort(work).sum())
+        for nid in ("W0", "S0"):
+            blackbox.recorder(nid).sample_metrics()
+        # S0 goes silent: only W0 keeps reporting past the staleness
+        # window, so the capture must mark S0 stale (the honest half
+        # of "ring dumps from every node")
+        _time.sleep(0.1)
+        aux.report_node("W0", wire=False)
+        t0 = _time.perf_counter()
+        bundle = aux.bundle(trigger="manual", force=True)
+        capture_ms = (_time.perf_counter() - t0) * 1e3
+        with open(out_path, "w", encoding="utf-8") as f:
+            _json.dump(bundle, f, default=str)
+        summary = blackbox.summarize_bundle(bundle)
+        stale_nodes = [
+            nid for nid, d in summary["nodes"].items() if d.get("stale")
+        ]
+        assert "S0" in stale_nodes, "silent node S0 not marked stale"
+        assert summary["nodes"].get("W0", {}).get("events") is not None or (
+            summary["nodes"].get("W0", {}).get("stale") is False
+        ), "live node W0 has no ring dump"
+        # no free-form print here: the benchmark runner's stdout is one
+        # JSON line per metric (test_benchmarks parses every line); the
+        # Makefile target echoes the output path for humans
+        report("bundle_ring_nodes", len(summary["nodes"]), "nodes")
+        report("bundle_stale_nodes", len(stale_nodes), "nodes")
+        report("bundle_trace_events", summary["trace_events"], "events")
+        report("bundle_capture_ms", capture_ms, "ms")
+    finally:
+        blackbox.set_min_interval(prev_interval)
+        blackbox.drop_recorder("W0")
+        blackbox.drop_recorder("S0")
+        if not was_armed:
+            blackbox.disarm()
+        aux.stop()
+
+
 def _drill_batch(seed: int, i: int, key_space: int, n: int, k: int):
     """Deterministic training batch ``i`` — regenerable by index, which
     is what lets the recovery handler REPLAY acked-but-unbacked updates
@@ -1557,6 +1639,33 @@ def recovery_drill(smoke: bool = False) -> dict:
 
     # -- the drilled store + chaos-plane wiring --
     faults.reset()
+    # flight recorder (telemetry/blackbox.py): armed for the whole
+    # drill so the shard death auto-captures a diagnostic bundle with
+    # the pre-death evidence still in the rings. Per-node recorders for
+    # the drill's logical nodes; min capture interval dropped so the
+    # death trigger is never rate-limit-suppressed by an earlier
+    # capture. The bench sink is parked around the drill
+    # (attach_recovery) — the tee records into memory only. Cleanup is
+    # TARGETED, not a global reset: the drill restores exactly the
+    # state it touched (its recorders, the interval, its tee), so an
+    # enclosing bench run's bundle deque — which attach_blackbox
+    # discloses as bundles_captured — survives the drill.
+    from ..telemetry import alerts as alerts_mod
+    from ..telemetry import blackbox
+    from ..telemetry import registry as telemetry_registry
+
+    prev_min_interval = blackbox.set_min_interval(0.0)
+    was_armed = blackbox.installed_recorder() is not None
+    blackbox.arm()
+    blackbox.recorder("W0").clear()  # a prior drill in this process
+    blackbox.recorder("S0").clear()  # must not leak into this bundle
+    node_alerts = None
+    if telemetry_registry.enabled():
+        node_alerts = alerts_mod.AlertManager(
+            [r for r in alerts_mod.default_rules()
+             if r.name == "node_deaths"]
+        )
+        node_alerts.evaluate()  # baseline sample: rate needs a window
     kv = KVVector(
         mesh=mesh, k=k, num_slots=num_slots, hashed=True, name="drill_live"
     )
@@ -1627,9 +1736,19 @@ def recovery_drill(smoke: bool = False) -> dict:
     stop_beat = threading.Event()
 
     def beater() -> None:
+        beats = 0
         while not stop_beat.wait(0.04):
             collector.report("S0", HeartbeatReport(hostname="S0"))
             collector.report("W0", HeartbeatReport(hostname="W0"))
+            beats += 1
+            if beats % 3 == 0:
+                # periodic metrics-delta samples into the survivors'
+                # flight-recorder rings (the report-timer cadence —
+                # what a bundle's per-node metrics history is made of)
+                for nid in ("W0", "S0"):
+                    rec = blackbox.recorder(nid, create=False)
+                    if rec is not None:
+                        rec.sample_metrics()
 
     t_kill = [0.0]
     t_detect = [0.0]
@@ -1738,27 +1857,74 @@ def recovery_drill(smoke: bool = False) -> dict:
         # phase 4: the trainer finishes the stream
         deadline = _time.perf_counter() + 90
         while t_recovered[0] == 0.0 and _time.perf_counter() < deadline:
+            if node_alerts is not None:
+                node_alerts.evaluate()
             _time.sleep(0.005)
         assert t_recovered[0] > 0.0, "recovery never completed"
+        # the node_deaths rule sees the coordinator's deaths counter
+        # tick and walks pending->firing (for_s=0: one evaluation)
+        if node_alerts is not None:
+            alert_deadline = _time.perf_counter() + 10
+            while (
+                "node_deaths" not in node_alerts.firing()
+                and _time.perf_counter() < alert_deadline
+            ):
+                node_alerts.evaluate()
+                _time.sleep(0.01)
         trainer_t.join(timeout=120)
         assert not trainer_t.is_alive(), "trainer wedged"
         if train_err:
             raise train_err[0]
     finally:
-        faults.reset()
-        rm.stop_periodic()
-        stop_serve.set()
-        stop_beat.set()
-        rc.stop()
-        for t in (serve_t, beat_t, trainer_t):
-            if t.ident is not None:
-                t.join(timeout=60)
-        fe.close()
+        try:
+            faults.reset()
+            rm.stop_periodic()
+            stop_serve.set()
+            stop_beat.set()
+            rc.stop()
+            for t in (serve_t, beat_t, trainer_t):
+                if t.ident is not None:
+                    t.join(timeout=60)
+            fe.close()
+        finally:
+            # grab the death's bundle BY TRIGGER KIND — last_bundle()
+            # could be a later capture (a straggling DegradedError from
+            # the dead window fires the degraded trigger with the
+            # interval still 0) whose rings carry no staleness override
+            # for S0
+            death_bundle = next(
+                (b for b in reversed(blackbox.bundles())
+                 if b["trigger"]["kind"] == "node_death"),
+                None,
+            )
+            # targeted cleanup (never a global reset — see the arm
+            # comment): the rate-limit override, the drill's per-node
+            # recorders, and the drill's tee (only if the drill armed
+            # it) must not leak past the drill even when it raises —
+            # its OWN nested finally, so a failing teardown step above
+            # (a wedged join, a close error) cannot skip it
+            blackbox.set_min_interval(prev_min_interval)
+            blackbox.drop_recorder("W0")
+            blackbox.drop_recorder("S0")
+            if not was_armed:
+                blackbox.disarm()
 
     kv.executor.wait_all(pop=False, timeout=60)
     t_drill = np.array(kv.table(0, copy=True))
     fe_stats = fe.stats()
     kv.executor.stop()
+    # the shard death's auto-captured diagnostic bundle (the
+    # RecoveryCoordinator's node_death trigger): summarized into the
+    # record under ``blackbox`` — drill METADATA the bench-diff
+    # sentinel never bands (script/bench_diff.py METADATA_SECTIONS)
+    blackbox_section: dict = {"captured": death_bundle is not None}
+    if death_bundle is not None:
+        blackbox_section = blackbox.summarize_bundle(death_bundle)
+    if node_alerts is not None:
+        st = node_alerts.states().get("node_deaths")
+        blackbox_section["node_deaths_alert"] = (
+            st.state_name if st is not None else "absent"
+        )
     bit_identical = (
         t_ref.dtype == t_drill.dtype
         and t_ref.shape == t_drill.shape
@@ -1833,6 +1999,7 @@ def recovery_drill(smoke: bool = False) -> dict:
         "backup_version_used": (rm.meta(kv.name) or {}).get("version"),
         "trainer_parked": trainer_parked[0],
         "trajectory_bit_identical": bool(bit_identical),
+        "blackbox": blackbox_section,
         "serve": {
             "requests": counts["ok"] + counts["shed"] + counts["failed"],
             "completed_ok": counts["ok"],
@@ -1869,6 +2036,19 @@ def recovery_drill_perf(smoke: bool = False) -> None:
         "so recovery never ran against live load — size n_batches/"
         "pacing so the stream outlives the heartbeat timeout"
     )
+    bb = out["blackbox"]
+    assert bb.get("captured"), (
+        "shard death did not auto-capture a diagnostic bundle"
+    )
+    assert bb["nodes"].get("S0", {}).get("stale"), (
+        "dead shard S0 is not marked stale in the bundle"
+    )
+    assert not bb["nodes"].get("W0", {}).get("stale", True), (
+        "surviving node W0's ring dump is missing from the bundle"
+    )
+    assert bb.get("node_deaths_alert", "firing") == "firing", (
+        "node_deaths alert never reached firing during the drill"
+    )
     report("recovery_detection_ms", out["detection_ms"], "ms")
     report("recovery_recovery_ms", out["recovery_ms"], "ms")
     report("recovery_mttr_ms", out["mttr_ms"], "ms")
@@ -1886,6 +2066,9 @@ def recovery_drill_perf(smoke: bool = False) -> None:
         out["disarmed_overhead"]["check_ns_per_call"], "ns/call",
     )
     report("recovery_bit_identical", 1.0, "bool")
+    report(
+        "recovery_bundle_ring_nodes", len(bb.get("nodes", {})), "nodes"
+    )
 
 
 def _sparse_touch_pattern(p: int, u: int, seed: int = 0):

@@ -23,6 +23,7 @@ from .system.executor import NodeGroups
 from .system.manager import Node
 from .system.message import Message, Task
 from .system.postoffice import Postoffice
+from .telemetry import spans as telemetry_spans
 from .utils.range import Range
 
 __all__ = [
@@ -180,21 +181,31 @@ def submit(
                 target.remote_nodes.get(app.name),
                 req,
             )
-            # each node's receive path is serialized (the reference runs one
-            # executor thread per customer), so hello-style apps may mutate
-            # unlocked state in process_request
-            with target._ps_recv_lock:
-                # the receiver's hooks run under its node identity (in the
-                # reference they run in the receiver's process)...
-                _set_current_node(target.node)
-                try:
-                    target.process_request(req)
-                finally:
-                    _set_current_node(me)
-            # ...while the auto-ack delivers process_response inline to the
-            # sender, which must see its own identity
-            if not getattr(req, "replied", False):
-                target.reply(req)
+            # the wire trace context re-activates on the RECEIVING side
+            # (spans.activate_trace): the handler — and anything it
+            # submits onto the receiver's executor — stays on the
+            # request's flow, so one RPC is ONE flow across the Van
+            # even when the receiver is a remote process
+            with telemetry_spans.activate_trace(
+                getattr(req.task, "trace", None)
+            ):
+                # each node's receive path is serialized (the reference
+                # runs one executor thread per customer), so
+                # hello-style apps may mutate unlocked state in
+                # process_request
+                with target._ps_recv_lock:
+                    # the receiver's hooks run under its node identity
+                    # (in the reference they run in the receiver's
+                    # process)...
+                    _set_current_node(target.node)
+                    try:
+                        target.process_request(req)
+                    finally:
+                        _set_current_node(me)
+                # ...while the auto-ack delivers process_response
+                # inline to the sender, which must see its own identity
+                if not getattr(req, "replied", False):
+                    target.reply(req)
             # message receipt doubles as a liveness signal (the reference
             # piggybacks heartbeat info on messages)
             target.po.beat(target.node.id)

@@ -558,6 +558,14 @@ class ServeFrontend:
             )
         if tel is not None:
             tel["degraded"].labels(outcome="error").inc()
+        # a request the degraded path could not save is a flight-
+        # recorder trigger: the live store just failed AND the replica
+        # could not cover — the last few seconds of spans/metrics are
+        # the diagnosis, and they are about to be evicted. Best-effort,
+        # rate-limited, never alters the error the caller sees.
+        from ..telemetry import blackbox
+
+        blackbox.trigger_bundle("degraded", detail=f"{reason}: {detail}")
         raise DegradedError(reason, detail) from cause
 
     def _pull_values(self, keys: np.ndarray) -> np.ndarray:

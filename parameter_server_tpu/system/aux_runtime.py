@@ -37,7 +37,12 @@ from typing import Callable, Dict, Optional, Tuple
 from ..telemetry import registry as telemetry_registry
 from ..telemetry.aggregate import ClusterAggregator
 from .dashboard import Dashboard
-from .heartbeat import HeartbeatCollector, HeartbeatInfo, HeartbeatReport
+from .heartbeat import (
+    ClockSync,
+    HeartbeatCollector,
+    HeartbeatInfo,
+    HeartbeatReport,
+)
 from .message import Command, Message, Task
 from .recovery import RecoveryCoordinator
 
@@ -60,6 +65,10 @@ class AuxRuntime:
         # every layer records into — doc/OBSERVABILITY.md)
         self.dashboard = Dashboard(registry="default")
         self.coordinator = RecoveryCoordinator(self.collector)
+        # node-death bundles captured through this coordinator get the
+        # full cluster context (Van-fetched rings, merged metrics,
+        # alert states, clock offsets) instead of a process-local view
+        self.coordinator.bundle_context = self
         self.print_fn = print_fn
         #: this PROCESS's identity on the cluster metrics plane — the
         #: node the default registry's export is reported under. One
@@ -74,6 +83,18 @@ class AuxRuntime:
         )
         #: optional AlertManager (telemetry/alerts.py) — set_alerts()
         self.alerts = None
+        #: per-peer clock-offset estimates from metric-report round
+        #: trips (heartbeat.ClockSync) — the alignment input of the
+        #: merged multi-node timeline and of every diagnostic bundle
+        self.clock = ClockSync()
+        #: when True (default), an alert's pending→firing transition
+        #: auto-captures a diagnostic bundle (telemetry/blackbox.py —
+        #: rate-limited there); the evidence is gone by the time a
+        #: human reads the page, so capture rides the transition
+        self.bundle_on_alerts = True
+        self._last_bundle: Optional[dict] = None  # guarded-by: _bundle_lock
+        self._last_bundle_t = 0.0  # guarded-by: _bundle_lock
+        self._bundle_lock = threading.Lock()
         self._tel = None
         if telemetry_registry.enabled():
             from ..telemetry.instruments import heartbeat_instruments
@@ -88,6 +109,11 @@ class AuxRuntime:
         #: heartbeat.report fault point's call counter) at scrape rate
         self.scrape_refresh_min_s = 0.2
         self._last_sweep = 0.0  # monotonic; single float, atomic in CPython
+        # serializes the scrape-time floor check-and-sweep: N handler
+        # threads scraping concurrently must collapse to ONE sweep per
+        # floor window, not each pass the age check before any sweep
+        # lands (the MonitorMaster.maybe_print race, PR 10, same shape)
+        self._sweep_lock = threading.Lock()
         self._infos: Dict[str, HeartbeatInfo] = {}  # guarded-by: _lock
         # per-node PRIVATE registries for the metrics plane:
         # node id -> (registry, instruments, last-lifetime-totals)
@@ -291,11 +317,30 @@ class AuxRuntime:
             )
             tx, rx = self._wire_pair(node_id)
             try:
-                payload = van.transfer(tx, rx, msg).task.payload
+                t0 = time.perf_counter()
+                out = van.transfer(tx, rx, msg)
+                delivery_s = time.perf_counter() - t0
             except Exception as e:  # injected drop / torn frame: the
                 # report is LOST — staleness tracking is how it shows
                 _LOG.debug("metric report from %s lost: %s", node_id, e)
                 return False
+            payload = out.task.payload
+            # clock sync: the frame's trace context carries the send
+            # wall time on the NODE's clock; paired with our receive
+            # time + the measured delivery duration it yields one
+            # offset sample (heartbeat.ClockSync — merged timelines
+            # align on these). The whole measured window IS the
+            # one-way delivery on this loopback leg (transfer returns
+            # when the receiver has decoded), so it is passed as
+            # delay_s whole — halving it would bias every offset by
+            # +delay/2, which an injected van delay fault would turn
+            # into a real misalignment of bundle timelines
+            trace = getattr(out.task, "trace", None)
+            if isinstance(trace, dict) and trace.get("t_send") is not None:
+                self.clock.observe(
+                    node_id, float(trace["t_send"]), time.time(),
+                    delivery_s,
+                )
         self.handle_metrics_message(payload)
         return True
 
@@ -311,19 +356,128 @@ class AuxRuntime:
             self.collector.report(node, hb)
             self.dashboard.add_report(node, hb)
 
+    # -- flight-recorder rings + diagnostic bundles (PR 14) --
+
+    def fetch_rings(self, wire: Optional[bool] = None) -> Dict[str, dict]:
+        """One ring dump per known node, fetched over the Van message
+        plane (real serialization through the restricted unpickler,
+        byte accounting, the ``van.transfer`` fault point) — the PR 10
+        report path, reused for incident evidence. Staleness semantics
+        for silent nodes: a node whose metric reports are already stale
+        is NOT fetched (a crashed node answers nothing — pretending to
+        dump its ring would fabricate evidence), and a fetch lost on
+        the wire records the loss instead of the ring. This process's
+        own node dumps locally (there is no wire to itself)."""
+        from ..telemetry import blackbox
+
+        rings: Dict[str, dict] = {}
+        ages = self.cluster.node_ages()
+        stale = set(self.cluster.stale_nodes())
+        with self._lock:
+            node_ids = set(self._infos)
+        node_ids.add(self.node_id)
+        van = None
+        if wire is not False:
+            from .postoffice import Postoffice
+
+            po = Postoffice._instance  # never create the singleton here
+            van = po.van if po is not None else None
+        for nid in sorted(node_ids):
+            # this process's OWN node dumps locally FIRST, before any
+            # staleness verdict: a stalled aux loop marks self stale —
+            # exactly the wedged-process incident a bundle diagnoses —
+            # but the in-memory ring needs no wire and is provably
+            # alive (this code is executing); skipping it would drop
+            # the prime evidence from its own capture
+            if nid == self.node_id:
+                rec = blackbox.recorder(nid, create=False)
+                if rec is None:
+                    rec = blackbox.installed_recorder()
+                rings[nid] = (
+                    rec.dump() if rec is not None
+                    else {"absent": True,
+                          "reason": "no flight recorder registered"}
+                )
+                continue
+            if nid in stale:
+                rings[nid] = {
+                    "stale": True,
+                    "reason": "metric reports stale — node silent",
+                    "report_age_s": round(ages.get(nid, -1.0), 3),
+                }
+                continue
+            rec = blackbox.recorder(nid, create=False)
+            if rec is None:
+                rings[nid] = {
+                    "absent": True,
+                    "reason": "no flight recorder registered",
+                }
+                continue
+            dump = rec.dump()
+            if van is None:
+                rings[nid] = dump
+                continue
+            msg = Message(
+                task=Task(
+                    cmd=Command.DUMP_BLACKBOX,
+                    payload={"node": nid, "dump": dump},
+                ),
+                sender=nid,
+                recver=self.node_id,
+            )
+            tx, rx = self._wire_pair(nid)
+            try:
+                rings[nid] = van.transfer(tx, rx, msg).task.payload["dump"]
+            except Exception as e:  # injected drop / torn frame
+                rings[nid] = {
+                    "stale": True,
+                    "reason": f"ring fetch lost on the wire: {e}",
+                    "report_age_s": round(ages.get(nid, -1.0), 3),
+                }
+        return rings
+
+    def bundle(self, trigger: str = "scrape", force: bool = False) -> dict:
+        """The /debug/bundle body: a full diagnostic bundle
+        (telemetry/blackbox.capture_bundle) with this runtime's cluster
+        context. ``scrape`` captures are floored at
+        :attr:`scrape_refresh_min_s` like /metrics — a tight scrape
+        loop (or N concurrent handler threads) serves the cached bundle
+        instead of re-driving the message plane and ticking fault-point
+        call counters per GET. A non-``scrape`` trigger always captures
+        fresh: serving a cached bundle stamped with a different trigger
+        kind would misreport why the artifact exists."""
+        from ..telemetry import blackbox
+
+        with self._bundle_lock:
+            now = time.monotonic()
+            if (
+                not force
+                and trigger == "scrape"
+                and self._last_bundle is not None
+                and now - self._last_bundle_t < self.scrape_refresh_min_s
+            ):
+                return self._last_bundle
+            b = blackbox.capture_bundle(trigger=trigger, aux=self)
+            self._last_bundle, self._last_bundle_t = b, now
+            return b
+
     def metrics_text(self, refresh: bool = True) -> str:
         """The /metrics scrape body: refresh local nodes' reports (each
         passing the heartbeat fault gate — a silenced node goes stale,
         it does not freeze) and render the node-labeled merged view.
         Refreshes are floored at :attr:`scrape_refresh_min_s` so a
         tight scrape loop reads the merged view instead of re-driving
-        the message plane per GET."""
-        if (
-            refresh
-            and time.monotonic() - self._last_sweep
-            >= self.scrape_refresh_min_s
-        ):
-            self.report_all()
+        the message plane per GET — and the floor check-and-sweep is
+        ONE critical section, so N concurrent scrapers (the exposition
+        server is threaded) collapse to one sweep per window instead of
+        each passing the age check before any sweep lands."""
+        if refresh:
+            with self._sweep_lock:
+                if (
+                    time.monotonic() - self._last_sweep
+                    >= self.scrape_refresh_min_s
+                ):
+                    self.report_all()
         return self.cluster.render_text()
 
     def health(self, now: Optional[float] = None) -> Tuple[bool, dict]:
@@ -351,13 +505,29 @@ class AuxRuntime:
 
     def set_alerts(self, manager) -> None:
         """Attach an AlertManager: the aux loop evaluates it each pass,
-        its transitions land in the dashboard event log, and its firing
-        rules show in /healthz + the dashboard's alerts section."""
+        its transitions land in the dashboard event log, its firing
+        rules show in /healthz + the dashboard's alerts section, and —
+        when :attr:`bundle_on_alerts` — a pending→firing transition
+        auto-captures a diagnostic bundle (the flight-recorder evidence
+        of the breach, taken while it is still in the ring)."""
         self.alerts = manager
         manager.add_listener(
             lambda ev: self.dashboard.add_event(str(ev))
         )
+        manager.add_listener(self._maybe_bundle_on_alert)
         self.dashboard.set_alerts(manager)
+
+    def _maybe_bundle_on_alert(self, ev) -> None:
+        """Alert-transition listener: firing → capture (rate-limited in
+        blackbox; never raises — a broken capture must not stop the
+        alert from delivering to other listeners)."""
+        if not self.bundle_on_alerts or getattr(ev, "to", None) != "firing":
+            return
+        from ..telemetry import blackbox
+
+        blackbox.trigger_bundle(
+            "alert", detail=getattr(ev, "rule", ""), aux=self
+        )
 
     # -- scheduler-side background services --
 

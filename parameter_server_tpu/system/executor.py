@@ -24,6 +24,7 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -147,6 +148,24 @@ class TaskTracker:
             return self._inflight
 
 
+#: every live executor, weakly held — the diagnostic-bundle capture
+#: (telemetry/blackbox.py) walks this to snapshot pending/in-flight
+#: state at the moment of an incident; dead executors fall out with GC.
+#: WeakSet is not thread-safe: registration (any thread constructing
+#: an Executor) and the capture-thread copy both go through
+#: _live_lock, or an incident capture racing a construction would die
+#: with set-changed-size-during-iteration — replacing the executors
+#: section with an error string at exactly the moment it matters.
+_live_executors: "weakref.WeakSet" = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+def live_executors() -> List["Executor"]:
+    """The process's live executors (for diagnostics; order arbitrary)."""
+    with _live_lock:
+        return list(_live_executors)
+
+
 class Executor:
     def __init__(
         self,
@@ -184,6 +203,14 @@ class Executor:
         self._unmet: Dict[int, int] = {}  # guarded-by: _cv — pending ts -> unmet dep count
         self._dependents: Dict[int, List[int]] = {}  # guarded-by: _cv — dep ts -> waiters
         self._ready: List[int] = []  # guarded-by: _cv — heap of dispatchable timestamps
+        # ts -> (flow id, origin node) captured on the SUBMITTING
+        # thread; the dispatch loop re-activates it around the step
+        # body so spans emitted inside (a ps.py RPC's van.transfer, a
+        # wire encode) stay on the batch/request's flow — without this
+        # the flow dies at submit and the cross-node timeline cannot
+        # stitch the step's downstream work. Only populated while a
+        # flow is actually active (tracing on).
+        self._flows: Dict[int, Tuple[int, Optional[str]]] = {}  # guarded-by: _cv
         self._running: Optional[int] = None  # guarded-by: _cv — picked, step() executing now
         self._ran: set[int] = set()  # guarded-by: _cv — ran, not finished yet (pruned on finish)
         self._futures: Dict[int, Any] = {}  # guarded-by: _cv — ts -> pytree (run, maybe async)
@@ -197,10 +224,28 @@ class Executor:
         # telemetry: max |started \ finished| ever observed at dispatch time
         # (τ-bounded-delay proof for the darlin scheduler)
         self.max_dispatched_in_flight = 0
+        with _live_lock:
+            _live_executors.add(self)
 
     def time(self) -> int:
         with self._cv:
             return self._time
+
+    def debug_state(self, max_pending: int = 16) -> Dict[str, Any]:
+        """Point-in-time diagnostic snapshot for incident bundles
+        (telemetry/blackbox.py): logical clock, backlog depth and its
+        oldest timestamps, the step executing right now, in-flight
+        count. One lock acquire; safe from any thread."""
+        with self._cv:
+            pending = sorted(self._pending)
+            return {
+                "name": self.name,
+                "logical_time": self._time,
+                "pending": len(pending),
+                "pending_ts": pending[:max_pending],
+                "running": self._running,
+                "in_flight": self.tracker.in_flight(),
+            }
 
     def pending_count(self) -> int:
         """Submitted steps not yet picked by the dispatch thread — an
@@ -254,6 +299,9 @@ class Executor:
                     raise ValueError(f"dependency {dep} is not before step {ts}")
                 deps.append(dep)
             self._pending[ts] = (step, deps)
+            flow = telemetry_spans.current_flow()
+            if flow is not None:
+                self._flows[ts] = (flow, telemetry_spans.current_flow_node())
             if self._tel is not None:
                 # [t_submit, t_dispatch (0 = not picked yet),
                 #  run_s (-1 = run not completed yet), materialize_s,
@@ -261,8 +309,7 @@ class Executor:
                 #  flow correlation: the batch/request this step
                 #  serves) or None]
                 self._step_times[ts] = [
-                    time.perf_counter(), 0.0, -1.0, 0.0,
-                    telemetry_spans.current_flow(),
+                    time.perf_counter(), 0.0, -1.0, 0.0, flow,
                 ]
             # readiness accounting: a dep not yet done registers this
             # step as its dependent; _finish(dep) decrements the count
@@ -353,6 +400,7 @@ class Executor:
                 else:
                     ts, step = pick
                     self._running = ts
+                    step_flow = self._flows.pop(ts, None)
             if pick is None:
                 if dep_fut is not None:
                     self._materialize_fut(dep, dep_fut)
@@ -378,7 +426,14 @@ class Executor:
                 # without raising). Inside the try so an injected raise
                 # rides the organic error path bit-for-bit.
                 faults.inject("executor.step", detail=f"{self.name}:{ts}")
-                result = step()
+                # the submitter's flow rides into the step body so
+                # spans it emits (ps.py RPC transfers, nested submits)
+                # keep the unit-of-work correlation across the dispatch
+                # thread; flow_scope(None) is a free passthrough
+                with telemetry_spans.flow_scope(
+                    *(step_flow or (None, None))
+                ):
+                    result = step()
                 err = None
             except BaseException as e:  # propagate to the waiter
                 result, err = None, e
@@ -514,6 +569,7 @@ class Executor:
             self.tracker.finish(ts)
         with self._cv:
             self._ran.discard(ts)
+            self._flows.pop(ts, None)  # externally-finished steps
             for t in self._dependents.pop(ts, ()):
                 left = self._unmet.get(t)
                 if left is None:
@@ -553,6 +609,7 @@ class Executor:
         and a later wait() can still claim its result.
         """
         deadline = Deadline(timeout)
+        timed_out: Optional[DeadlineExceeded] = None
         with self._cv:
             known = (
                 ts in self._pending
@@ -572,11 +629,32 @@ class Executor:
                 if left is None:
                     self._cv.wait()
                 elif left <= 0:
-                    raise self._wait_timeout_locked(ts, timeout)
+                    timed_out = self._wait_timeout_locked(ts, timeout)
+                    break
                 else:
                     self._cv.wait(left)
-            err = self._errors.pop(ts, None) if pop else self._errors.get(ts)
-            fut = self._futures.pop(ts, None) if pop else self._futures.get(ts)
+            if timed_out is None:
+                err = (
+                    self._errors.pop(ts, None) if pop
+                    else self._errors.get(ts)
+                )
+                fut = (
+                    self._futures.pop(ts, None) if pop
+                    else self._futures.get(ts)
+                )
+        if timed_out is not None:
+            # a wedged wait is a flight-recorder trigger (the evidence
+            # — recent spans, executor state — is exactly what rots if
+            # diagnosis waits). Raised OUTSIDE the cv: the bundle
+            # capture reads executor state through the public API and
+            # must not deadlock on our own lock. Best-effort,
+            # rate-limited, never masks the diagnostic error.
+            from ..telemetry import blackbox
+
+            blackbox.trigger_bundle(
+                "executor_wait_timeout", detail=str(timed_out)
+            )
+            raise timed_out
         if err is not None:
             self._finish(ts)
             raise err
@@ -660,6 +738,7 @@ class Executor:
                     self._callbacks.pop(ts, None)
                     self._unmet.pop(ts, None)
                     self._step_times.pop(ts, None)  # never dispatched
+                    self._flows.pop(ts, None)
                 # purge, don't lazy-skip: an explicit timestamp may be
                 # REUSED after cancellation, and a stale heap entry
                 # (or a stale _dependents registration decrementing

@@ -132,6 +132,80 @@ class HeartbeatInfo:
         )
 
 
+class ClockSync:
+    """Per-peer clock-offset estimation from metric-report exchanges.
+
+    A merged multi-node timeline (telemetry/timeline.merge_node_events)
+    is only readable if every node's wall clocks are aligned; real
+    hosts drift. Each metric report that crosses the Van carries its
+    send wall time (``Task.trace["t_send"]``, the sender's clock), the
+    observer records its own receive time, and the CALLER supplies its
+    best estimate of the one-way delivery delay between the two stamps
+    (``delay_s``: the measured transfer duration for the in-process
+    loopback leg — the whole measured window IS the delivery — or
+    rtt/2 for a genuine request/response round trip). The sample is::
+
+        offset = t_recv - delay_s - t_send    # node clock + offset
+                                              #   ≈ observer clock
+
+    and the retained estimate per peer is the sample with the SMALLEST
+    observed delay (queueing inflates delay; the min-delay exchange
+    bounds the error by that delay — the Cristian bound, disclosed
+    alongside the estimate). In today's single-process runs offsets
+    measure ~0 EVEN under injected ``van.transfer`` delay faults —
+    the delay is measured, not assumed — which is the machinery's
+    sanity check.
+    """
+
+    def __init__(self, keep_best: bool = True):
+        self.keep_best = keep_best
+        # node -> (offset_s, delay_s, n_samples)
+        self._est: Dict[str, tuple] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def observe(
+        self, node_id: str, t_send: float, t_recv: float, delay_s: float
+    ) -> None:
+        """Fold one exchange in. Nonsensical samples (negative delay —
+        a clock step mid-exchange) are dropped."""
+        if delay_s < 0.0:
+            return
+        offset = t_recv - delay_s - t_send
+        with self._lock:
+            cur = self._est.get(node_id)
+            n = (cur[2] + 1) if cur else 1
+            if cur is None or not self.keep_best or delay_s < cur[1]:
+                self._est[node_id] = (offset, delay_s, n)
+            else:
+                self._est[node_id] = (cur[0], cur[1], n)
+
+    def offset(self, node_id: str) -> Optional[float]:
+        """Seconds to ADD to ``node_id``'s clock to land on this
+        process's clock, or None before any exchange."""
+        with self._lock:
+            cur = self._est.get(node_id)
+            return cur[0] if cur else None
+
+    def offsets(self) -> Dict[str, float]:
+        """node id -> best offset estimate (merge_node_events shape)."""
+        with self._lock:
+            return {n: est[0] for n, est in self._est.items()}
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Diagnostic view: offset + the delivery delay that produced
+        it + sample count per peer (the delay IS the error bound)."""
+        with self._lock:
+            return {
+                n: {
+                    "offset_s": round(est[0], 6),
+                    "delay_s": round(est[1], 6),
+                    "error_bound_s": round(est[1], 6),
+                    "samples": est[2],
+                }
+                for n, est in sorted(self._est.items())
+            }
+
+
 class HeartbeatCollector:
     """Scheduler-side liveness tracking (manager.cc heartbeat handling)."""
 

@@ -111,6 +111,55 @@ class Command(enum.Enum):
     SAVE_MODEL = "save_model"
     RECOVER = "recover"
     HEARTBEAT = "heartbeat"
+    # flight-recorder ring fetch (telemetry/blackbox.py): a node ships
+    # its bounded event ring to the scheduler for a diagnostic bundle
+    DUMP_BLACKBOX = "dump_blackbox"
+
+
+#: the closed key set a wire trace context may carry (Task.trace)
+_TRACE_KEYS = {"flow", "node", "t_send"}
+
+
+def _validate_trace(trace: Any) -> Optional[dict]:
+    """Validate a decoded header's trace context (Task.trace).
+
+    The field rides the restricted unpickler like the rest of the Task,
+    but the unpickler only bounds WHICH types can be named — a hostile
+    peer could still smuggle an arbitrarily nested container or a
+    numpy payload into the slot the receiver later re-activates as a
+    flow scope. This narrows it to the closed shape
+    :func:`telemetry.spans.trace_context` emits: a flat dict of at
+    most {flow: int, node: short str, t_send: finite float}. Anything
+    else raises ``ValueError`` loudly (the from_bytes malformed-frame
+    contract); absent/None decodes as None (legacy peers, tracing off).
+    """
+    if trace is None:
+        return None
+    if type(trace) is not dict or set(trace) - _TRACE_KEYS:
+        raise ValueError(
+            f"wire frame carries malformed trace context: {trace!r:.120}"
+        )
+    flow = trace.get("flow")
+    if flow is not None and (
+        type(flow) is not int or not 0 < flow < (1 << 63)
+    ):
+        raise ValueError(
+            f"wire frame trace context has non-int flow {flow!r:.80}"
+        )
+    node = trace.get("node")
+    if node is not None and (type(node) is not str or len(node) > 64):
+        raise ValueError(
+            f"wire frame trace context has bad node id {node!r:.80}"
+        )
+    t_send = trace.get("t_send")
+    if t_send is not None and (
+        type(t_send) not in (int, float)
+        or not (-1e12 < float(t_send) < 1e12)
+    ):
+        raise ValueError(
+            f"wire frame trace context has bad t_send {t_send!r:.80}"
+        )
+    return trace
 
 
 @dataclasses.dataclass
@@ -139,6 +188,14 @@ class Task:
     push: bool = False  # push vs pull for parameter tasks
     more: bool = False  # scheduler hint: more blocks coming (ref darlin)
     payload: Any = None  # app-specific (workload descriptors, progress, ...)
+    #: wire trace context (telemetry/spans.trace_context): the sending
+    #: thread's flow id + origin node + send wall time, stamped by
+    #: Van.transfer so one batch/request stays ONE flow across
+    #: processes. Plain scalars only — validated on decode
+    #: (_validate_trace): a hostile blob here is rejected loudly, and a
+    #: legacy header without the field decodes as None (rolling
+    #: upgrades).
+    trace: Optional[dict] = None
 
     def fresh_copy(self) -> "Task":
         """Per-send copy. Filter ``extra`` dicts are per-message side
@@ -220,6 +277,13 @@ class Message:
         try:
             (hlen,) = struct.unpack_from("<I", blob, 0)
             header = _restricted_loads(bytes(blob[4 : 4 + hlen]))
+            task = header["task"]
+            # trace-context hardening + rolling-upgrade tolerance: a
+            # legacy peer's Task pickle predates the field entirely
+            # (dataclass unpickling restores __dict__ verbatim, no
+            # __init__ defaults) — normalize to None; a present field
+            # is narrowed to the closed trace shape or rejected loudly
+            task.trace = _validate_trace(getattr(task, "trace", None))
             off = 4 + hlen
             arrays = []
             for dtype, shape in zip(header["dtypes"], header["shapes"]):

@@ -65,6 +65,12 @@ class RecoveryCoordinator:
         #: DEFAULT_HANDLER_RETRY (or your own policy) for handlers
         #: that are safe to re-run from the top.
         self.handler_retry = handler_retry
+        #: cluster context for the node-death diagnostic bundle: the
+        #: owning AuxRuntime sets this to itself so the capture gets
+        #: Van-fetched rings with staleness, the merged metrics
+        #: snapshot, alert states and clock offsets — a standalone
+        #: coordinator (drills, tests) captures process-local.
+        self.bundle_context = None
         self._recovered: set = set()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -137,6 +143,20 @@ class RecoveryCoordinator:
                         self._tel["handler_failures"].inc()
             if self._tel is not None:
                 self._tel["seconds"].observe(time.perf_counter() - t0)
+            # a node death is a flight-recorder trigger: capture the
+            # diagnostic bundle while the pre-death spans are still in
+            # every survivor's ring. The dead node is marked STALE by
+            # the caller-visible staleness contract — the coordinator
+            # knows who died before any aggregator notices the silence.
+            # Best-effort + rate-limited (telemetry/blackbox.py).
+            from ..telemetry import blackbox
+
+            blackbox.trigger_bundle(
+                "node_death",
+                detail=nid,
+                aux=self.bundle_context,
+                stale={nid: "declared dead (heartbeat timeout)"},
+            )
             handled.append(nid)
         return handled
 

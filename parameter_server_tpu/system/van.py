@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as meshlib
 from ..telemetry import registry as telemetry_registry
+from ..telemetry import spans as telemetry_spans
 from . import faults
 from .message import Message
 
@@ -96,44 +97,62 @@ class Van:
         receiving process does its own ``from_wire``, never inflates
         this process's recv counter with sender-side frame lengths.
         Both directions also feed the nodes' HeartbeatInfo so the
-        dashboard reports true traffic."""
+        dashboard reports true traffic.
+
+        Trace context: the sending thread's active flow id (plus this
+        process's node id and the send wall time) is stamped onto
+        ``Task.trace`` before serialization — flow ids used to die
+        right here, making a multi-node timeline unstitchable. The
+        receiving side re-activates it (``spans.activate_trace``) so
+        one batch/request is ONE flow across processes, and the leg
+        itself is a ``van.transfer`` span (the ``network`` resource in
+        telemetry/attribution.py). An explicitly pre-set trace is
+        respected (re-sends keep their origin)."""
+        if getattr(msg.task, "trace", None) is None:
+            msg.task.trace = telemetry_spans.trace_context()
         blob = sender.to_wire(msg)
         sent = len(blob)
-        self.wire_sent_bytes += sent
-        self._account(msg.sender, out_bytes=sent)
-        # fault point (doc/ROBUSTNESS.md) — the wire between serialize
-        # and deliver, where real networks fail. Placed AFTER the send
-        # accounting so a dropped frame costs sender bytes but never
-        # receiver bytes (the side-correct counting contract above):
-        #   drop      → FaultError; the RPC layer sees a lost frame
-        #   delay     → the frame arrives late (delay_s)
-        #   duplicate → at-least-once delivery: from_wire runs twice,
-        #               probing receiver idempotence under redelivery
-        fault = faults.check(
-            "van.transfer", detail=f"{msg.sender}->{msg.recver}"
-        )
-        duplicate = False
-        if fault is not None:
-            if fault.delay_s:
-                import time as _time
+        with telemetry_spans.span(
+            "van.transfer", sender=msg.sender, recver=msg.recver,
+            bytes=sent,
+        ):
+            self.wire_sent_bytes += sent
+            self._account(msg.sender, out_bytes=sent)
+            # fault point (doc/ROBUSTNESS.md) — the wire between
+            # serialize and deliver, where real networks fail. Placed
+            # AFTER the send accounting so a dropped frame costs sender
+            # bytes but never receiver bytes (the side-correct counting
+            # contract above):
+            #   drop      → FaultError; the RPC layer sees a lost frame
+            #   delay     → the frame arrives late (delay_s)
+            #   duplicate → at-least-once delivery: from_wire runs
+            #               twice, probing receiver idempotence under
+            #               redelivery
+            fault = faults.check(
+                "van.transfer", detail=f"{msg.sender}->{msg.recver}"
+            )
+            duplicate = False
+            if fault is not None:
+                if fault.delay_s:
+                    import time as _time
 
-                _time.sleep(fault.delay_s)
-            if fault.kind == "drop":
-                raise fault.make_error(
-                    f"frame {msg.sender}->{msg.recver} dropped"
-                )
-            duplicate = fault.kind == "duplicate"
-        recv_before = recver.wire_recv_bytes
-        if duplicate:
-            recver.from_wire(blob)
-        out = recver.from_wire(blob)
-        recv = recver.wire_recv_bytes - recv_before
-        self.wire_recv_bytes += recv
-        self._account(msg.recver, in_bytes=recv)
-        if self._tel is not None:
-            self._tel["wire_sent_bytes"].inc(sent)
-            self._tel["wire_recv_bytes"].inc(recv)
-            self._tel["transfers"].inc()
+                    _time.sleep(fault.delay_s)
+                if fault.kind == "drop":
+                    raise fault.make_error(
+                        f"frame {msg.sender}->{msg.recver} dropped"
+                    )
+                duplicate = fault.kind == "duplicate"
+            recv_before = recver.wire_recv_bytes
+            if duplicate:
+                recver.from_wire(blob)
+            out = recver.from_wire(blob)
+            recv = recver.wire_recv_bytes - recv_before
+            self.wire_recv_bytes += recv
+            self._account(msg.recver, in_bytes=recv)
+            if self._tel is not None:
+                self._tel["wire_sent_bytes"].inc(sent)
+                self._tel["wire_recv_bytes"].inc(recv)
+                self._tel["transfers"].inc()
         return out
 
     def _account(self, ident: str, in_bytes: int = 0, out_bytes: int = 0) -> None:

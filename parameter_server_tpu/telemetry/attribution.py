@@ -3,11 +3,14 @@
 Answers the ROADMAP's standing question — *which resource binds this
 run?* — from the trace itself instead of hand-computed breakdowns (the
 BENCH_r05 "75% upload-bound at 107.4 B/ex" arithmetic). Every span in
-a timeline maps to one of seven categories:
+a timeline maps to one of eight categories:
 
     host_prep       parse/localize/remap/stack on host CPU
     encode          compact-wire encode (learner/wire.py, prep pool)
     upload          host→device staging (the tunnel/link wire time)
+    network         host-wire frames between nodes (Van.transfer — the
+                    control-plane/metric-report wire legs; distinct
+                    from ``upload``, the host→device link)
     queue_wait      time a unit sat waiting — executor queue, serve
                     admission queue, pipeline hand-off gaps
     device_compute  executor run + materialize (XLA step + forcing)
@@ -50,6 +53,7 @@ CATEGORIES = (
     "host_prep",
     "encode",
     "upload",
+    "network",
     "queue_wait",
     "device_compute",
     "decode",
@@ -58,7 +62,9 @@ CATEGORIES = (
 
 #: categories that are physical resources a pipeline can saturate (the
 #: binding resource is named among these; queue_wait/reply are symptoms)
-RESOURCE_CATEGORIES = ("host_prep", "encode", "upload", "device_compute", "decode")
+RESOURCE_CATEGORIES = (
+    "host_prep", "encode", "upload", "network", "device_compute", "decode",
+)
 
 #: device-track events (utils/profiling.device_track_events — a merged
 #: jax.profiler capture) are named ``device.<op>`` on ``device:<pid>``
@@ -82,6 +88,7 @@ NAME_CATEGORIES: Dict[str, str] = {
     "ingest.prep": "host_prep",
     "ingest.upload": "upload",
     "wire.encode": "encode",
+    "van.transfer": "network",
     "executor.queue_wait": "queue_wait",
     "executor.run": "device_compute",
     "executor.materialize": "device_compute",
@@ -210,21 +217,28 @@ def busy_by_category(
     The one exception is nesting ACROSS categories on one thread:
     ``wire.encode`` runs inside the prep call (worker.prep →
     encode_exact), so its interval sits inside a ``bench.prep`` /
-    ``ingest.prep`` span on the same thread. Those seconds belong to
-    the encode resource alone — they are carved out of ``host_prep``
-    so one CPU second is never billed to two stages."""
+    ``ingest.prep`` span on the same thread — and a ``van.transfer``
+    runs inside the RPC step body the executor dispatched, so its
+    interval sits inside that step's ``executor.run`` phase. Those
+    seconds belong to the nested (encode / network) resource alone —
+    they are carved out of the ENCLOSING span's category so one CPU
+    second is never billed to two stages."""
     expanded = [
         ev for ev in expand_executor_steps(events) if not ev.get("abandoned")
     ]
-    enc_by_thread: Dict[Any, List[Tuple[float, float]]] = {}
+    # intervals of the carve categories, per thread: encode nests in
+    # host_prep wrappers, network (van.transfer) nests in the
+    # executor.run phase of the RPC step that sent it
+    carve_cats = ("encode", "network")
+    carve_by_thread: Dict[Any, List[Tuple[float, float]]] = {}
     for ev in expanded:
-        if categorize_event(ev) == "encode":
+        if categorize_event(ev) in carve_cats:
             s = float(ev.get("t_wall", 0.0))
-            enc_by_thread.setdefault(ev.get("thread"), []).append(
+            carve_by_thread.setdefault(ev.get("thread"), []).append(
                 (s, s + float(ev.get("dur_s", 0.0)))
             )
-    enc_by_thread = {
-        t: _merge_intervals(iv) for t, iv in enc_by_thread.items()
+    carve_by_thread = {
+        t: _merge_intervals(iv) for t, iv in carve_by_thread.items()
     }
     busy = {cat: 0.0 for cat in CATEGORIES}
     for ev in expanded:
@@ -234,8 +248,8 @@ def busy_by_category(
         s = float(ev.get("t_wall", 0.0))
         d = float(ev.get("dur_s", 0.0))
         sec = _clip(s, d, window)
-        if cat == "host_prep":
-            for lo, hi in enc_by_thread.get(ev.get("thread"), ()):
+        if cat not in carve_cats:
+            for lo, hi in carve_by_thread.get(ev.get("thread"), ()):
                 ov_lo, ov_hi = max(lo, s), min(hi, s + d)
                 if ov_hi > ov_lo:
                     sec -= _clip(ov_lo, ov_hi - ov_lo, window)

@@ -10,7 +10,11 @@ port 0 test-friendly, clean join on shutdown) serving
 - ``/healthz`` — JSON heartbeat + recovery-coordinator state; **non-200
   (503)** while any shard is dead or its metric reports are stale;
 - ``/debug/snapshot`` — JSON registry export + cluster view + alert
-  states + the recent timeline tail, for humans mid-incident.
+  states + the recent timeline tail, for humans mid-incident;
+- ``/debug/bundle`` — a full diagnostic bundle (telemetry/blackbox.py:
+  per-node flight-recorder rings with staleness, metrics snapshot,
+  alert states, executors, Perfetto trace), floored at the scrape
+  refresh interval so hammering it cannot re-drive the message plane.
 
 Wiring is one call: :func:`expose_cluster` stands the endpoint up over
 a started Postoffice (aux runtime + metric-report timer + default
@@ -50,10 +54,12 @@ class ExpositionServer:
         snapshot_fn: Optional[Callable[[], dict]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        bundle_fn: Optional[Callable[[], dict]] = None,
     ):
         self.metrics_fn = metrics_fn
         self.health_fn = health_fn
         self.snapshot_fn = snapshot_fn
+        self.bundle_fn = bundle_fn
         self.host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -106,10 +112,21 @@ class ExpositionServer:
                         body = (json.dumps(snap, indent=2, default=str)
                                 + "\n").encode()
                         self._send(200, body, "application/json")
+                    elif path == "/debug/bundle":
+                        if outer.bundle_fn is None:
+                            self._send(
+                                404, b"no bundle source\n", "text/plain"
+                            )
+                        else:
+                            body = (json.dumps(
+                                outer.bundle_fn(), default=str
+                            ) + "\n").encode()
+                            self._send(200, body, "application/json")
                     elif path == "/":
                         body = (
                             b"parameter_server_tpu metrics endpoint\n"
-                            b"/metrics /healthz /debug/snapshot\n"
+                            b"/metrics /healthz /debug/snapshot "
+                            b"/debug/bundle\n"
                         )
                         self._send(200, body, "text/plain; charset=utf-8")
                     else:
@@ -172,21 +189,31 @@ def serve_registry(
     return ExpositionServer(metrics, None, snapshot, host=host, port=port).start()
 
 
-def _timeline_tail(n: int = 64) -> list:
+def _timeline_tail(n: int = 64) -> dict:
     """Last ``n`` span events from the installed JSONL sink (tolerant
-    of torn tails), or [] when no sink is installed."""
+    of torn tails), with the sink's state DISCLOSED: an empty events
+    list under ``sink: parked`` (a sink exists but is temporarily
+    uninstalled — an embedded A/B is running) or ``sink: absent`` (no
+    sink was ever installed) means "no trace captured", which is not
+    the same claim as "nothing happened"."""
     from . import spans as telemetry_spans
 
     sink = telemetry_spans.get_sink()
     path = getattr(sink, "path", None)
+    tail: dict = {
+        "sink": telemetry_spans.sink_state(),
+        "path": path,
+        "events": [],
+    }
     if not path:
-        return []
+        return tail
     try:
         from . import timeline
 
-        return timeline.load_events(path)[-n:]
+        tail["events"] = timeline.load_events(path)[-n:]
     except Exception:
-        return []
+        pass
+    return tail
 
 
 def expose_cluster(
@@ -252,6 +279,7 @@ def expose_cluster(
         snapshot,
         host=host,
         port=port,
+        bundle_fn=aux.bundle,
     ).start()
     srv.aux = aux  # for close_cluster / callers that need the runtime
     return srv

@@ -577,6 +577,61 @@ def cluster_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def blackbox_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Flight recorder (telemetry/blackbox.py): ring absorption volume
+    and occupancy. The per-event hot path never touches the registry —
+    these publish LAZILY from the sample/dump paths (the catalog's one
+    deliberately-coarse family: a counter that lags by up to one
+    metrics-sample interval, bought for a sub-noise-floor emit path)."""
+    return {
+        "events": reg.ensure_counter(
+            "ps_blackbox_events_total",
+            "span events absorbed by the flight-recorder ring "
+            "(published lazily at sample/dump time, not per event)",
+        ),
+        "samples": reg.ensure_counter(
+            "ps_blackbox_metrics_samples_total",
+            "periodic metrics-delta samples recorded into the ring",
+        ),
+        "ring_events": reg.ensure_gauge(
+            "ps_blackbox_ring_events",
+            "events currently held by this process's recorder ring "
+            "(<= capacity; older events have been evicted)",
+        ),
+    }
+
+
+def bundle_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Diagnostic-bundle trigger plane (telemetry/blackbox.py):
+    capture volume per trigger kind, rate-limit suppressions, capture
+    cost. ``trigger`` is the closed KIND set (alert / degraded /
+    node_death / executor_wait_timeout / scrape / manual — never the
+    rule or node name, which would be unbounded label cardinality)."""
+    return {
+        "captures": reg.ensure_counter(
+            "ps_bundle_captures_total",
+            "diagnostic bundles captured, by trigger kind",
+            labelnames=("trigger",),
+        ),
+        "suppressed": reg.ensure_counter(
+            "ps_bundle_suppressed_total",
+            "auto-capture triggers suppressed by the rate limit "
+            "(a trigger storm costs one bundle, not one per symptom)",
+        ),
+        "capture_seconds": reg.ensure_histogram(
+            "ps_bundle_capture_seconds",
+            "wall time of one full bundle capture (ring fetches over "
+            "the Van included)",
+            buckets=PHASE_BUCKETS,
+        ),
+        "last_ring_nodes": reg.ensure_gauge(
+            "ps_bundle_last_ring_nodes",
+            "nodes represented (ring dump or staleness entry) in the "
+            "most recent bundle",
+        ),
+    }
+
+
 #: alert states exported by ps_alert_state (telemetry/alerts.py):
 #: 0 inactive, 1 pending (condition holding, for_s not yet elapsed),
 #: 2 firing, 3 resolved (recently cleared, held resolve_hold_s)
@@ -679,6 +734,8 @@ cached_serve_instruments = _cached_family(serve_instruments)
 cached_wire_instruments = _cached_family(wire_instruments)
 cached_ftrl_instruments = _cached_family(ftrl_instruments)
 cached_device_instruments = _cached_family(device_instruments)
+cached_blackbox_instruments = _cached_family(blackbox_instruments)
+cached_bundle_instruments = _cached_family(bundle_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -695,6 +752,8 @@ INSTRUMENT_FAMILIES = (
     node_instruments,
     cluster_instruments,
     alert_instruments,
+    blackbox_instruments,
+    bundle_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -26,6 +26,16 @@ without the stages knowing about each other. The scope is a
 thread-local; crossing a thread boundary means carrying the id in the
 hand-off (a queue tuple, a ticket field) and re-entering the scope on
 the far side.
+
+Crossing a *process* boundary (the Van wire) means carrying the id in
+the message header instead: :func:`trace_context` builds the
+wire-safe ``{"flow", "node", "t_send"}`` dict ``Van.transfer`` stamps
+onto ``Task.trace``, and :func:`activate_trace` re-enters the scope on
+the receiving side. Flow ids are per-process counters, so the context
+also names the ORIGIN node — spans emitted under a received flow carry
+``flow_node`` and the multi-node timeline merge
+(:func:`telemetry.timeline.merge_node_events`) namespaces flows by
+``(origin node, id)`` so two nodes' local flow 7 never alias.
 """
 
 from __future__ import annotations
@@ -64,6 +74,7 @@ class JsonlSink:
 
 _sink_lock = threading.Lock()
 _sink: Optional[JsonlSink] = None
+_parked_depth = 0  # guarded-by: _sink_lock — nested parked_sink() count
 
 
 def install_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
@@ -77,6 +88,19 @@ def install_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
 
 def get_sink() -> Optional[JsonlSink]:
     return _sink
+
+
+def sink_state() -> str:
+    """One of ``active`` / ``parked`` / ``absent`` — so a reader of an
+    empty timeline tail (/debug/snapshot) can tell "no trace captured
+    because nothing is listening" apart from "nothing happened":
+    ``parked`` means a sink exists but is temporarily uninstalled
+    (:func:`parked_sink`, the embedded-A/B idiom), ``absent`` means no
+    sink was ever installed (or it was closed)."""
+    with _sink_lock:
+        if _sink is not None:
+            return "active"
+        return "parked" if _parked_depth > 0 else "absent"
 
 
 def close_sink() -> None:
@@ -125,11 +149,21 @@ def parked_sink():
     """Temporarily uninstall the span sink for a block — used around
     embedded A/B benches whose instrumented arms would otherwise pay a
     one-sided tracing tax and flood the run's trace with off-window
-    events. Restores the previous sink on exit."""
+    events. Restores the previous sink on exit. While parked,
+    :func:`sink_state` reports ``parked`` (only if a sink actually
+    existed — parking nothing is still ``absent``)."""
+    global _parked_depth
     prev = install_sink(None)
+    had_sink = prev is not None
+    if had_sink:
+        with _sink_lock:
+            _parked_depth += 1
     try:
         yield
     finally:
+        if had_sink:
+            with _sink_lock:
+                _parked_depth -= 1
         install_sink(prev)
 
 
@@ -138,22 +172,76 @@ def current_flow() -> Optional[int]:
     return getattr(_flow_local, "flow", None)
 
 
+def current_flow_node() -> Optional[str]:
+    """The ORIGIN node of the active flow, or None when the flow was
+    allocated locally (the overwhelmingly common case)."""
+    return getattr(_flow_local, "node", None)
+
+
 @contextlib.contextmanager
-def flow_scope(flow: Optional[int]):
+def flow_scope(flow: Optional[int], node: Optional[str] = None):
     """Run a block with ``flow`` as this thread's active flow id; spans
     emitted inside carry it automatically. ``flow_scope(None)`` is a
     no-op passthrough (tracing off / no id carried), so hand-off code
     can use it unconditionally. Scopes nest; the previous id is
-    restored on exit."""
+    restored on exit. ``node`` names the flow's ORIGIN process when the
+    id was received off the wire (:func:`activate_trace`) — spans then
+    carry ``flow_node`` so the cross-node merge can namespace the id."""
     if flow is None:
         yield
         return
     prev = getattr(_flow_local, "flow", None)
+    prev_node = getattr(_flow_local, "node", None)
     _flow_local.flow = flow
+    _flow_local.node = node
     try:
         yield
     finally:
         _flow_local.flow = prev
+        _flow_local.node = prev_node
+
+
+def node_id() -> str:
+    """This PROCESS's identity on the trace plane — the same id the
+    cluster metrics plane reports under (``PS_NODE_ID``, default H0)."""
+    import os
+
+    return os.environ.get("PS_NODE_ID", "H0")
+
+
+def trace_context() -> Dict[str, Any]:
+    """The wire trace context for an outgoing message — the
+    restricted-unpickler-safe dict ``Van.transfer`` stamps onto
+    ``Task.trace``: the sending thread's active flow id (when one is
+    active), this process's node id, and the send wall time. ``t_send``
+    and ``node`` are stamped even with tracing off: the receiver's
+    clock-offset estimator (system/heartbeat.ClockSync) needs the send
+    time on every report exchange, tracing or not — the cost is one
+    small dict per control-plane frame."""
+    ctx: Dict[str, Any] = {"node": current_flow_node() or node_id(),
+                           "t_send": time.time()}
+    fid = current_flow()
+    if fid is not None:
+        ctx["flow"] = int(fid)
+    return ctx
+
+
+def activate_trace(trace: Optional[Dict[str, Any]]):
+    """Re-enter a received message's flow on THIS thread (the receiving
+    executor) so the unit of work stays ONE flow across the Van:
+    ``with activate_trace(msg.task.trace): handle(msg)``. A context
+    without a flow (or None — legacy peer, tracing off) is a no-op
+    passthrough. The origin node rides along as ``flow_node`` on every
+    span emitted inside, unless the flow originated here."""
+    if not isinstance(trace, dict):
+        return contextlib.nullcontext()
+    fid = trace.get("flow")
+    if fid is None:
+        return contextlib.nullcontext()
+    origin = trace.get("node")
+    if origin == node_id():
+        origin = None  # local loopback: no namespacing needed
+    return flow_scope(int(fid), node=origin)
 
 
 def abandoned(name: str, reason: str, flow: Optional[int] = None, **attrs) -> None:
@@ -216,6 +304,9 @@ def span(name: str, ts: Optional[int] = None, histogram=None, **attrs):
         fid = current_flow()
         if fid is not None:
             event["flow"] = fid
+            fnode = current_flow_node()
+            if fnode is not None:
+                event["flow_node"] = fnode
         if error is not None:
             event["error"] = error
         event.update(attrs)

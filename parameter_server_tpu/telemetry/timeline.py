@@ -96,6 +96,69 @@ def flows(events: Iterable[Dict[str, Any]]) -> Dict[int, List[Dict[str, Any]]]:
     return out
 
 
+def merge_node_events(
+    events_by_node: Dict[str, Sequence[Dict[str, Any]]],
+    offsets: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Merge several nodes' span streams into ONE timeline.
+
+    Three things make per-node streams unmergeable raw, and this fixes
+    each: (1) wall clocks differ across hosts — ``offsets[node]``
+    (seconds to ADD to that node's clock, the
+    ``system/heartbeat.ClockSync`` convention) aligns every event onto
+    the caller's clock; (2) thread names collide ("MainThread" on every
+    node) — threads are tagged ``node/thread`` and the event gains a
+    ``node`` field (the Chrome export renders one process track per
+    node); (3) flow ids are per-process counters, so two nodes' local
+    flow 7 are different units — flows are renumbered by
+    ``(origin node, id)``, where the origin is the event's
+    ``flow_node`` (a flow that crossed the Van keeps its origin, which
+    is exactly how the sending span and the receiving executor land on
+    the SAME merged flow and draw the cross-node arrow).
+
+    Inputs are unmodified; returns a new time-sorted list.
+    """
+    offsets = offsets or {}
+    flow_map: Dict[Tuple[str, int], int] = {}
+
+    def global_flow(origin: str, fid: Any) -> int:
+        key = (origin, int(fid))
+        if key not in flow_map:
+            flow_map[key] = len(flow_map) + 1
+        return flow_map[key]
+
+    merged: List[Dict[str, Any]] = []
+    for node in sorted(events_by_node):
+        off = float(offsets.get(node, 0.0))
+        for ev in events_by_node[node]:
+            ev = dict(ev)
+            ev["node"] = node
+            if "t_wall" in ev:
+                ev["t_wall"] = float(ev["t_wall"]) + off
+            ev["thread"] = f"{node}/{ev.get('thread', '?')}"
+            origin = str(ev.pop("flow_node", None) or node)
+            if ev.get("flow") is not None:
+                ev["flow"] = global_flow(origin, ev["flow"])
+            if isinstance(ev.get("flows"), (list, tuple)):
+                ev["flows"] = [
+                    global_flow(origin, f) for f in ev["flows"]
+                ]
+            merged.append(ev)
+    merged.sort(key=lambda e: _start_end(e)[0])
+    return merged
+
+
+def merge_node_sinks(
+    node_paths: Dict[str, str],
+    offsets: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """:func:`merge_node_events` over per-node JSONL sink files."""
+    return merge_node_events(
+        {node: load_events(path) for node, path in node_paths.items()},
+        offsets,
+    )
+
+
 def merge_device_track(
     host_events: Sequence[Dict[str, Any]],
     device_events: Sequence[Dict[str, Any]],
@@ -147,27 +210,44 @@ def to_chrome_trace(
     ``flows`` list additionally receives one arrow from each merged
     request's preceding span (fan-in). ``abandoned`` events render as
     instant (``"ph": "i"``) tombstones.
+
+    Node-tagged events (:func:`merge_node_events` sets ``ev["node"]``)
+    render as one Perfetto *process* per node (``process_name:
+    <name>:<node>``) — single-node traces keep the legacy single-pid
+    shape bit-for-bit. Flow arrows cross process tracks the same way
+    they cross threads, which is how a flow's Van hop draws as an arrow
+    from the sending node's span to the receiving node's executor step.
     """
     t_base, _ = events_window(events)
-    tids: Dict[str, int] = {}
-    trace: List[Dict[str, Any]] = [
-        {
-            "ph": "M",
-            "pid": pid,
-            "tid": 0,
-            "name": "process_name",
-            "args": {"name": process_name},
-        }
-    ]
+    pids: Dict[Any, int] = {}
+    tids: Dict[str, Tuple[int, int]] = {}  # thread -> (pid, tid)
+    trace: List[Dict[str, Any]] = []
 
-    def tid_of(thread: str) -> int:
-        if thread not in tids:
-            tids[thread] = len(tids) + 1
+    def pid_of(node) -> int:
+        if node not in pids:
+            pids[node] = pid + len(pids)
+            name = process_name if node is None else f"{process_name}:{node}"
             trace.append(
                 {
                     "ph": "M",
-                    "pid": pid,
-                    "tid": tids[thread],
+                    "pid": pids[node],
+                    "tid": 0,
+                    "name": "process_name",
+                    "args": {"name": name},
+                }
+            )
+        return pids[node]
+
+    def track_of(ev: Dict[str, Any]) -> Tuple[int, int]:
+        thread = str(ev.get("thread", "?"))
+        if thread not in tids:
+            p = pid_of(ev.get("node"))
+            tids[thread] = (p, len(tids) + 1)
+            trace.append(
+                {
+                    "ph": "M",
+                    "pid": p,
+                    "tid": tids[thread][1],
                     "name": "thread_name",
                     "args": {"name": thread},
                 }
@@ -179,15 +259,14 @@ def to_chrome_trace(
 
     meta_keys = ("kind", "name", "t_wall", "dur_s", "thread")
     for ev in events:
-        thread = str(ev.get("thread", "?"))
-        tid = tid_of(thread)
+        epid, tid = track_of(ev)
         start, end = _start_end(ev)
         args = {k: v for k, v in ev.items() if k not in meta_keys}
         if ev.get("abandoned"):
             trace.append(
                 {
                     "ph": "i",
-                    "pid": pid,
+                    "pid": epid,
                     "tid": tid,
                     "name": str(ev.get("name", "span")) + " (abandoned)",
                     "ts": us(start),
@@ -199,7 +278,7 @@ def to_chrome_trace(
         trace.append(
             {
                 "ph": "X",
-                "pid": pid,
+                "pid": epid,
                 "tid": tid,
                 "name": str(ev.get("name", "span")),
                 "ts": us(start),
@@ -217,11 +296,13 @@ def to_chrome_trace(
                 continue  # same track: adjacency already reads left-to-right
             _, prev_end = _start_end(prev)
             nxt_start, _ = _start_end(nxt)
+            s_pid, s_tid = track_of(prev)
+            f_pid, f_tid = track_of(nxt)
             arrows.append(
                 {
                     "ph": "s",
-                    "pid": pid,
-                    "tid": tid_of(str(prev.get("thread", "?"))),
+                    "pid": s_pid,
+                    "tid": s_tid,
                     "name": "flow",
                     "cat": "flow",
                     "id": fid,
@@ -232,8 +313,8 @@ def to_chrome_trace(
                 {
                     "ph": "f",
                     "bp": "e",
-                    "pid": pid,
-                    "tid": tid_of(str(nxt.get("thread", "?"))),
+                    "pid": f_pid,
+                    "tid": f_tid,
                     "name": "flow",
                     "cat": "flow",
                     "id": fid,
@@ -246,7 +327,7 @@ def to_chrome_trace(
         if not isinstance(merged, (list, tuple)) or ev.get("flow") is None:
             continue
         start, _ = _start_end(ev)
-        tid = tid_of(str(ev.get("thread", "?")))
+        e_pid, e_tid = track_of(ev)
         for fid in merged:
             seq = by_flow.get(int(fid))
             if not seq:
@@ -261,11 +342,12 @@ def to_chrome_trace(
                 continue
             prev = preceding[-1]
             _, prev_end = _start_end(prev)
+            s_pid, s_tid = track_of(prev)
             arrows.append(
                 {
                     "ph": "s",
-                    "pid": pid,
-                    "tid": tid_of(str(prev.get("thread", "?"))),
+                    "pid": s_pid,
+                    "tid": s_tid,
                     "name": "flow",
                     "cat": "flow",
                     "id": int(fid),
@@ -276,8 +358,8 @@ def to_chrome_trace(
                 {
                     "ph": "f",
                     "bp": "e",
-                    "pid": pid,
-                    "tid": tid,
+                    "pid": e_pid,
+                    "tid": e_tid,
                     "name": "flow",
                     "cat": "flow",
                     "id": int(fid),

@@ -85,6 +85,12 @@ METADATA_SECTIONS = frozenset(
         # throughput the sentinel may band
         "e2e_wire",
         "e2e_upload_cache",
+        # flight-recorder evidence (telemetry/blackbox.py): the
+        # steady-state overhead A/B quotes its own paired medians with
+        # its own disclosure, the drill's auto-captured bundle summary
+        # carries host-dependent counts — banding either would
+        # false-flag every round
+        "blackbox",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

@@ -66,6 +66,7 @@ SCOPE = (
     "parameter_server_tpu/system/faults.py",
     "parameter_server_tpu/telemetry/aggregate.py",
     "parameter_server_tpu/telemetry/alerts.py",
+    "parameter_server_tpu/telemetry/blackbox.py",
     "parameter_server_tpu/telemetry/device.py",
     "parameter_server_tpu/telemetry/exposition.py",
     "parameter_server_tpu/utils/concurrent.py",

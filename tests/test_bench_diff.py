@@ -174,6 +174,28 @@ class TestDiffMath:
         assert reported
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_blackbox_section_is_metadata_never_banded(self):
+        """The flight-recorder `blackbox` section carries the overhead
+        A/B's own paired medians and the drill bundle's host-dependent
+        counts — run metadata, not a throughput the sentinel may band.
+        A catastrophic-looking blackbox section must not flag; the
+        import-time assert bars WATCHED from pointing into it."""
+        assert "blackbox" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["blackbox"] = {  # recorder horrors, all ignored
+            "overhead": {"ratio_median": 1e9, "armed_ns_per_event": 1e12},
+            "ring": {"dropped": 1e9},
+            "bundles_captured": 1e9,
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):

@@ -513,8 +513,11 @@ class TestFrontend:
             max_queue_depth=3, depth_fn=ex.pending_count
         )
         ts = [ex.submit(gate.wait) for _ in range(4)]  # 1 runs, 3 pend
+        # pending reads 4 until the dispatch thread picks the first
+        # step (which then blocks on the gate) — wait for the settled
+        # backlog, not merely >=3, or the assert races the pickup
         deadline = time.monotonic() + 5
-        while ex.pending_count() < 3 and time.monotonic() < deadline:
+        while ex.pending_count() != 3 and time.monotonic() < deadline:
             time.sleep(0.001)
         assert ex.pending_count() == 3
         with pytest.raises(RejectedError) as ei:
