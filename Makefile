@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench roofline trace bundle bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench learning-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -117,6 +117,17 @@ serve-bench: native
 # embedded in every bench.py record under "recovery")
 chaos-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks recovery_drill
+
+# learning truth plane probe (components bench, doc/OBSERVABILITY.md
+# "Learning truth plane"): a bounded-delay training run through the
+# collect path — realized staleness vs the configured τ (asserted),
+# sketch-vs-exact key-heat parity, per-shard load shares + imbalance,
+# the loss/grad-norm trajectory from the in-jit side outputs, and the
+# seeded LR-blow-up divergence drill (shipped loss_divergence rule to
+# firing with a diagnostic bundle attached). Fast, CPU-only; the same
+# dict is embedded in every bench.py record under "learning"
+learning-bench:
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks learning
 
 # device truth plane probe (components bench, doc/OBSERVABILITY.md
 # "Device truth plane"): an HBM-bound FTRL chain + a FLOPs-bound flash

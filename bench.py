@@ -703,6 +703,71 @@ def attach_blackbox(rec_or_headline: dict, smoke: bool) -> None:
         )
 
 
+def attach_learning(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the learning truth plane under ``learning`` in
+    every bench record (benchmarks/components.learning_truth +
+    telemetry/learning.py): the RUN's own planes first (realized
+    staleness of the submissions made so far, with the in-record
+    observed<=τ verdict, key-heat shard shares, convergence tail),
+    then the self-contained probe — a bounded-delay training run with
+    the staleness histogram, sketch-vs-exact heat parity, shard
+    balance, loss/grad-norm trajectory, and the seeded LR-blow-up
+    divergence drill (shipped ``loss_divergence`` rule to firing, with
+    a diagnostic bundle attached). Convergence trajectories are run
+    METADATA, never banded as perf — script/bench_diff.py excludes
+    this section (METADATA_SECTIONS); never breaks a record. Harvest
+    order matters: the probe builds its own mini-cluster
+    (Postoffice.reset), which drops the run's registered planes — so
+    the run view is read FIRST."""
+    try:
+        from parameter_server_tpu.benchmarks.components import (
+            learning_truth,
+        )
+        from parameter_server_tpu.telemetry import learning as learning_mod
+
+        section: dict = {}
+        run = learning_mod.snapshot_all()
+        if run:
+            section["run"] = run
+        with telemetry_spans.parked_sink():
+            section["probe"] = learning_truth(smoke)
+        rec_or_headline["learning"] = section
+    except Exception as e:
+        rec_or_headline["learning_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
+def attach_learning_run(rec: dict, worker) -> None:
+    """Fold the MAIN run worker's own learning plane into the record's
+    ``learning`` section AFTER the timed windows — the plane object
+    rides the worker (module registration does not survive the
+    component sections' Postoffice resets), and harvesting here means
+    the staleness/trajectory view covers the e2e phase itself. Carries
+    the in-record bounded-delay verdict for the run's OWN submissions
+    (``run_staleness_within_bound``: observed max <= the configured
+    max_delay); the probe asserts its own. Never breaks a record."""
+    try:
+        plane = getattr(worker, "_learning", None)
+        if plane is None:
+            return
+        section = rec.setdefault("learning", {})
+        snap = plane.snapshot()
+        section.setdefault("run", {})[plane.worker] = snap
+        ok = all(
+            s["staleness"]["within_bound"]
+            for s in section["run"].values()
+        )
+        section["run_staleness_within_bound"] = ok
+        if not ok:
+            section["run_staleness_breaches"] = [
+                w for w, s in section["run"].items()
+                if not s["staleness"]["within_bound"]
+            ]
+    except Exception as e:
+        rec["learning_run_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+
 def attach_device(rec_or_headline: dict, smoke: bool) -> None:
     """Guarded embed of the device truth plane
     (parameter_server_tpu/telemetry/device.py) under ``device`` in
@@ -1926,6 +1991,12 @@ def run_real(args) -> int:
     attach_recovery(headline, args.smoke)
     _beat("blackbox")
     attach_blackbox(headline, args.smoke)
+    # learning truth plane (staleness vs τ, heat/shard balance,
+    # convergence trajectory, divergence drill). Runs LAST among the
+    # component sections: its probe resets the Postoffice, and the run
+    # planes it harvests first must still cover the phases above.
+    _beat("learning")
+    attach_learning(headline, args.smoke)
     _beat("e2e", **headline)
 
     wire_fallback = {"parts": 0, "rows": 0}
@@ -2025,6 +2096,9 @@ def run_real(args) -> int:
         }
     rec.update(headline)
     reconcile_link_ceiling(rec, wire_bytes_moved, done_ex, dt)
+    # the run worker's OWN learning plane, harvested after the timed
+    # stream so its staleness/trajectory view covers the e2e phase
+    attach_learning_run(rec, worker)
     # device truth plane AFTER the timed stream: the post-warmup
     # recompile count covers the phase that must not re-specialize
     attach_device(rec, args.smoke)
@@ -2468,6 +2542,11 @@ def run_synthetic(args) -> int:
     # "Flight recorder & diagnostic bundles")
     _beat("blackbox")
     attach_blackbox(headline, args.smoke)
+    # learning truth plane (staleness vs τ, heat/shard balance,
+    # convergence trajectory, divergence drill) — last among the
+    # component sections; see attach_learning's harvest-order note
+    _beat("learning")
+    attach_learning(headline, args.smoke)
     # disclose which wire the e2e stream actually rode (the flip's
     # whole point is that BENCH_r06 stops quoting the raw bits bytes)
     headline["e2e_wire"] = {
@@ -2565,6 +2644,9 @@ def run_synthetic(args) -> int:
     reconcile_link_ceiling(
         rec, wire_counter["bytes"], done * args.minibatch, dt
     )
+    # the run worker's OWN learning plane, harvested after the timed
+    # windows so its staleness/trajectory view covers the e2e phase
+    attach_learning_run(rec, worker)
     # device truth plane AFTER the timed windows (post-warmup
     # recompiles cover the phase that must not re-specialize)
     attach_device(rec, args.smoke)

@@ -869,6 +869,43 @@ def make_pull_lookup(updater, pull_quant: int, noise=None,
     return derive_wide, wide_lookup
 
 
+def _convergence_metrics(metrics, g_push, update, w_used,
+                         final_is_global: bool = False):
+    """Cheap in-jit convergence side outputs for the learning truth
+    plane (telemetry/learning.py): squared L2 norms of the per-worker
+    gradient actually pushed (summed over workers), the aggregated
+    post-filter update handed to the updater, and the weights the step
+    consumed (per-occurrence touched weights — a blow-up detector and
+    trend line, NOT the global table norm). Trace-pure raw scalars on
+    the metrics dict, metered host-side in ``ISGDCompNode.collect``
+    (the PR 8 jit-purity pattern); donation-safe — every input predates
+    the state update.
+
+    Replication contract (metrics ride out_specs P()): in the dense
+    formulations ``g_push`` is the ownership-masked (``ok``) gradient —
+    each real entry lives on exactly ONE server shard — so the grad
+    fold sums over BOTH axes; ``update`` is a per-server shard vector
+    replicated over data (server fold only); ``w_used`` is the
+    server-assembled weights replicated over server (data fold only).
+    ``final_is_global``: the sparse formulation's psum'd per-unique
+    update and its gathered weights are already identical on every
+    shard — only the per-worker gradient still folds over data."""
+    grad = jnp.sum(jnp.square(g_push))
+    upd = jnp.sum(jnp.square(update))
+    w = jnp.sum(jnp.square(w_used))
+    if final_is_global:
+        metrics["grad_sq"] = jax.lax.psum(grad, DATA_AXIS)
+        metrics["update_sq"] = upd
+        metrics["weight_sq"] = w
+    else:
+        metrics["grad_sq"] = jax.lax.psum(
+            jax.lax.psum(grad, SERVER_AXIS), DATA_AXIS
+        )
+        metrics["update_sq"] = jax.lax.psum(upd, SERVER_AXIS)
+        metrics["weight_sq"] = jax.lax.psum(w, DATA_AXIS)
+    return metrics
+
+
 def _progress_metrics(loss, y, xw, mask, with_aux: bool):
     """SGDProgress scalars (padding rows masked out of the objective); the
     per-example xw/y/mask aux — needed only for host-side AUC — costs three
@@ -988,13 +1025,13 @@ def make_train_step_ell(
         valid = valid_slots(slots, num_slots) if binary else (vals != 0)
         g_flat = jnp.where(valid, g_e, 0.0).reshape(-1)
 
-        g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
-            jnp.where(ok, g_flat, 0.0)
-        )
+        g_push = jnp.where(ok, g_flat, 0.0)
+        g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(g_push)
         g_shard, touched = push_touched(g_shard, seed)
         new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+        _convergence_metrics(metrics, g_push, g_shard, w_e * mask[:, None])
         return new_state, metrics
 
     def state_spec(state):
@@ -1057,15 +1094,20 @@ def _make_uniform_ell_mini_step(
             g_flat = jnp.broadcast_to(gr[:, None], slots.shape).reshape(-1)
 
         with jax.named_scope("ps_push"):
-            g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
-                jnp.where(ok, g_flat, 0.0)
-            )
+            g_push = jnp.where(ok, g_flat, 0.0)
+            g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(g_push)
             g_shard, touched = push_touched(g_shard, seed)
         with jax.named_scope("ps_update"):
             new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         with jax.named_scope("ps_metrics"):
             metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+            # padding rows' garbage-decoded slots gather real weights;
+            # the mask gates them out of the consumed-weight norm just
+            # like it gates their gradients
+            _convergence_metrics(
+                metrics, g_push, g_shard, w_e * mask[:, None]
+            )
         return new_state, metrics
 
     return mini_step
@@ -1377,13 +1419,13 @@ def make_train_step_hashed(
         gr = loss.row_grad(y, xw) * mask
         g_e = vals * gr[rows]
 
-        g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
-            jnp.where(ok, g_e, 0.0)
-        )
+        g_push = jnp.where(ok, g_e, 0.0)
+        g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(g_push)
         g_shard, touched = push_touched(g_shard, seed)
         new_state = updater.apply(live, g_shard, touched, seed=seed)
 
         metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+        _convergence_metrics(metrics, g_push, g_shard, w_e)
         return new_state, metrics
 
     def state_spec(state):
@@ -1510,6 +1552,7 @@ def _make_exact_mini_step(
                 # workers share one global uslots table, so gradient
                 # aggregation is an elementwise psum of the U-vector —
                 # no dense scatter, no shard-sized temp
+                g_local = g_u
                 g_u = jax.lax.psum(g_u, DATA_AXIS)
             with jax.named_scope("ps_update"):
                 new_state = apply_state_rows(
@@ -1517,6 +1560,11 @@ def _make_exact_mini_step(
                 )
             with jax.named_scope("ps_metrics"):
                 metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+                # g_u / w_u are the GLOBAL unique vectors (identical on
+                # every shard after their psums) — no further fold
+                _convergence_metrics(
+                    metrics, g_local, g_u, w_u, final_is_global=True
+                )
             return new_state, metrics
 
         return mini_step_sparse
@@ -1558,9 +1606,8 @@ def _make_exact_mini_step(
 
         # -- push (dense scatter into owned shard + psum over data axis) --
         with jax.named_scope("ps_push"):
-            g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(
-                jnp.where(ok, g_u, 0)
-            )
+            g_push = jnp.where(ok, g_u, 0.0)
+            g_shard = jnp.zeros((shard,), jnp.float32).at[rel].add(g_push)
             g_shard, touched = push_touched(g_shard, seed)
 
         with jax.named_scope("ps_update"):
@@ -1569,6 +1616,7 @@ def _make_exact_mini_step(
         # -- progress (ref SGDProgress fields) --
         with jax.named_scope("ps_metrics"):
             metrics = _progress_metrics(loss, y, xw, mask, with_aux)
+            _convergence_metrics(metrics, g_push, g_shard, w_u)
         return new_state, metrics
 
     return mini_step
@@ -2139,6 +2187,23 @@ class AsyncSGDWorker(ISGDCompNode):
         self._pads: Optional[Tuple[int, int, int]] = None
         self._num_shards_cache: Optional[int] = None
         self.progress = SGDProgress()
+        # learning truth plane (telemetry/learning.py): realized
+        # staleness per submission, key heat folded by server key
+        # range, convergence metering in collect(). Created fresh per
+        # worker so it binds the CURRENT default registry.
+        from ...telemetry import registry as telemetry_registry
+
+        if telemetry_registry.enabled():
+            from ...telemetry import learning as learning_mod
+
+            self._learning = learning_mod.plane(
+                self.name,
+                num_slots=self.num_slots,
+                num_shards=meshlib.num_servers(mesh),
+                max_delay=max(0, sgd.max_delay),
+            )
+        self._heat_counter = 0  # feeder/trainer thread only
+        self._snapshot_ts: Optional[int] = None  # submit thread only
 
     def _resolve_update_mode(self, sgd: SGDConfig) -> str:
         """``SGDConfig.update`` → concrete formulation. "auto" flips to
@@ -2236,9 +2301,26 @@ class AsyncSGDWorker(ISGDCompNode):
             self._pads = (rows, nnz, nnz)
         return self._pads
 
+    def _note_heat(self, batch: SparseBatch) -> None:
+        """Key-heat feed (learning truth plane): hash this batch's keys
+        to table slots and fold them into the worker's windowed count
+        sketch + per-shard load shares. Called ONLY from the
+        feeder/trainer thread (the sketch is stateful — the
+        stateless-or-feeder ingest rule), sampled every
+        ``plane.heat_every`` batches so the feeder never stalls on it;
+        the hash is the same vectorized murmur the prep pays."""
+        lp = self._learning
+        if lp is None or not batch.n:
+            return
+        self._heat_counter += 1
+        if self._heat_counter % lp.heat_every:
+            return
+        lp.note_slots(self.directory.slots(np.asarray(batch.indices)))
+
     def process_minibatch(self, batch: SparseBatch, report: bool = True) -> int:
         """Pull → gradient → push, one async step (ref UpdateModel inner loop
         + ComputeGradient)."""
+        self._note_heat(batch)
         return self._submit_prepped(self.prep(batch, device_put=False))
 
     def upload(self, prepped):
@@ -2591,6 +2673,14 @@ class AsyncSGDWorker(ISGDCompNode):
         # step RUNS on the executor's dispatch thread — self.state is only
         # advanced there, and steps execute in submission order
         do_snapshot = tau <= 0 or self._steps_since_snapshot >= tau
+        # realized staleness of THIS submission, in ministeps: how far
+        # its weight snapshot lags the apply clock. A snapshot-taking
+        # step applies against the snapshot it itself pulls (staleness
+        # 0); otherwise the snapshot is _steps_since_snapshot ministeps
+        # old. Steps run in submission order (no deps → the executor's
+        # ready heap dispatches by timestamp), so the submit-time value
+        # IS the realized one.
+        staleness = 0 if do_snapshot else self._steps_since_snapshot
         if do_snapshot:
             self._steps_since_snapshot = 0
         step_fn = self._get_step(prepped, with_aux)
@@ -2627,7 +2717,18 @@ class AsyncSGDWorker(ISGDCompNode):
 
         self._steps_since_snapshot += n_steps
         self._note_ftrl_dispatch(prepped, n_steps)
-        return self.submit(step, Task())
+        ts = self.submit(step, Task())
+        if self._learning is not None:
+            # logical-clock stamp: the executor timestamp of the
+            # snapshot-taking submission vs this one (the Executor
+            # timestamps the contract is defined over)
+            if do_snapshot or self._snapshot_ts is None:
+                self._snapshot_ts = ts
+            self._learning.note_submit(
+                staleness, n_steps=n_steps,
+                clock_lag=ts - self._snapshot_ts,
+            )
+        return ts
 
     def _note_ftrl_dispatch(self, prepped, n_steps: int) -> None:
         """Host-side FTRL update-path accounting (ps_ftrl_rows_total /
@@ -2880,6 +2981,10 @@ class AsyncSGDWorker(ISGDCompNode):
                         and not self._stream_statics_set
                     ):
                         self._get_stream_statics(batch)
+                    # key heat rides the FEEDER thread (this generator
+                    # runs on the ingest pipeline's feeder) — the
+                    # stateless-or-feeder home for the stateful sketch
+                    self._note_heat(batch)
                     group.append(batch)
                     if len(group) >= T:
                         yield group
@@ -2956,6 +3061,7 @@ class AsyncSGDWorker(ISGDCompNode):
             group.clear()
 
         for batch in batches:
+            self._note_heat(batch)
             group.append(batch)
             if len(group) >= T:
                 flush_group()

@@ -107,6 +107,11 @@ class ISGDCompNode(App, Checkpointable):
         # training volume, counted in collect() where the step's metrics
         # land — a cold path shared by every SGD-family worker
         self._examples_counter = None
+        # learning truth plane (telemetry/learning.py): workers that
+        # know their table geometry install one (AsyncSGDWorker does);
+        # collect() folds the step's device-confirmed example count and
+        # the in-jit convergence side outputs into it
+        self._learning = None
         from ..telemetry import registry as telemetry_registry
 
         if telemetry_registry.enabled():
@@ -135,6 +140,11 @@ class ISGDCompNode(App, Checkpointable):
             return self.progress
         if self._examples_counter is not None:
             self._examples_counter.inc(int(metrics["num_ex"]))
+        if self._learning is not None:
+            # the progress plane's device-confirmed side: the step's
+            # own num_ex output plus the in-jit loss/grad/update/weight
+            # side outputs, metered host-side (PR 8 jit-purity pattern)
+            self._learning.note_step(metrics)
         prog = SGDProgress(
             objective=[float(metrics["objective"])],
             num_examples_processed=int(metrics["num_ex"]),

@@ -45,29 +45,57 @@ class MonitorMaster(Generic[P]):
         # both pass it and print the same window twice (pslint
         # guarded-access; regression test in tests/test_system_aux.py)
         self._last_print = 0.0
+        # guarded-by: _lock — redelivery idempotence: highest report
+        # seq merged per node. The over_van path is at-least-once (a
+        # dropped frame is retransmitted; a van `duplicate` fault
+        # delivers one frame twice), and a re-merged progress delta
+        # would double-count into cluster progress — so a seq at or
+        # below the high-water mark is dropped, not merged.
+        self._seq: Dict[str, int] = {}
+        self._dup_dropped = 0  # guarded-by: _lock
 
-    def set_data_merger(self, fn: Callable[[P, P], None]) -> None:
+    def set_data_merger(self, fn: Optional[Callable[[P, P], None]]) -> None:
         self._merger = fn
 
     def set_printer(self, fn: Callable[[float, Dict[str, P]], None], interval: float = 1.0) -> None:
         self._printer = fn
         self._interval = interval
 
-    def report(self, node_id: str, progress: P) -> None:
+    def report(
+        self, node_id: str, progress: P, seq: Optional[int] = None
+    ) -> bool:
+        """Merge one report; returns False when the seq guard rejected
+        it as a redelivery (``seq`` <= the node's high-water mark).
+        Direct in-process callers pass no seq and merge unconditionally
+        — exactly-once is their call discipline, not the wire's."""
         with self._lock:
+            if seq is not None:
+                if seq <= self._seq.get(node_id, -1):
+                    self._dup_dropped += 1
+                    return False
+                self._seq[node_id] = seq
             cur = self._progress.get(node_id)
             if cur is None or self._merger is None:
                 self._progress[node_id] = progress
             else:
                 self._merger(progress, cur)
         self.maybe_print()
+        return True
 
-    def handle_message(self, msg: Message) -> None:
+    def duplicates_dropped(self) -> int:
+        """Reports the seq guard rejected (redelivery accounting)."""
+        with self._lock:
+            return self._dup_dropped
+
+    def handle_message(self, msg: Message) -> bool:
         """Receiver side of the message-plane path: unwrap one slaver
-        report (``task.payload = {"node": id, "progress": P}``) and
-        merge it like a direct call."""
+        report (``task.payload = {"node": id, "progress": P, "seq":
+        n}``) and merge it like a direct call — through the seq guard,
+        because this path really does redeliver (van `duplicate`)."""
         payload = msg.task.payload or {}
-        self.report(payload["node"], payload["progress"])
+        return self.report(
+            payload["node"], payload["progress"], seq=payload.get("seq")
+        )
 
     def maybe_print(self, force: bool = False) -> None:
         if self._printer is None:
@@ -108,6 +136,11 @@ class MonitorSlaver(Generic[P]):
         self.wire = wire
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guarded-by: _seq_lock — wire-path report sequence (the
+        # master's redelivery guard keys on it); the periodic timer and
+        # a manual report() may race, so the stamp is lock-claimed
+        self._seq = 0
+        self._seq_lock = threading.Lock()
 
     @classmethod
     def over_van(
@@ -116,26 +149,69 @@ class MonitorSlaver(Generic[P]):
         node_id: str,
         van,
         master_id: str = "H0",
+        max_attempts: int = 3,
     ) -> "MonitorSlaver[P]":
         """A slaver whose reports ride ``van.transfer`` between a fresh
         RemoteNode endpoint pair (node → scheduler), landing in
         ``master.handle_message`` — the reference's report-over-message
-        flow inside one process."""
+        flow inside one process.
+
+        At-least-once hardening (PR 15): delivery happens at the
+        receiving endpoint's DECODE (``from_wire``), so a van
+        ``duplicate`` fault — one frame decoded twice — really does
+        redeliver into the master (whose seq guard dedupes it), and a
+        ``drop`` fault (FaultError before decode) is retransmitted up
+        to ``max_attempts`` times with the SAME seq instead of losing
+        the report. Exactly the failure semantics a real wire has, with
+        the master idempotent under them (tests/test_system_aux.py).
+        """
         from .remote_node import RemoteNode
 
-        tx, rx = RemoteNode(master_id), RemoteNode(node_id)
+        class _Delivering(RemoteNode):
+            """Receiver endpoint that delivers each decoded report
+            frame to the master — at-least-once means delivery count
+            == decode count, not transfer-return count."""
+
+            def from_wire(self, blob: bytes) -> Message:
+                out = super().from_wire(blob)
+                if out.task.cmd == Command.EVALUATE_PROGRESS:
+                    master.handle_message(out)
+                return out
+
+        tx, rx = RemoteNode(master_id), _Delivering(node_id)
 
         def wire(msg: Message) -> None:
-            master.handle_message(van.transfer(tx, rx, msg))
+            from . import faults as faults_mod
+
+            last: Optional[BaseException] = None
+            for _ in range(max(1, max_attempts)):
+                try:
+                    van.transfer(tx, rx, msg)
+                    return
+                except faults_mod.FaultError as e:
+                    # injected drop: the frame died before decode —
+                    # retransmit the SAME message (same seq; a
+                    # successful earlier delivery is impossible here,
+                    # and a duplicated retransmit dedupes at the master)
+                    last = e
+            if last is not None:
+                raise last
 
         return cls(master, node_id, wire=wire)
 
     def report(self, progress: P) -> None:
         if self.wire is not None:
+            with self._seq_lock:
+                self._seq += 1
+                seq = self._seq
             self.wire(Message(
                 task=Task(
                     cmd=Command.EVALUATE_PROGRESS,
-                    payload={"node": self.node_id, "progress": progress},
+                    payload={
+                        "node": self.node_id,
+                        "progress": progress,
+                        "seq": seq,
+                    },
                 ),
                 sender=self.node_id,
                 recver="H0",

@@ -52,6 +52,12 @@ class Postoffice:
             cls._instance = None
         telemetry_registry.reset_default_registry()
         telemetry_spans.close_sink()
+        # learning truth planes bind per-worker registries; drop them
+        # with the spine so a hermetic test never reads a prior run's
+        # staleness/heat through learning.snapshot_all()
+        from ..telemetry import learning as telemetry_learning
+
+        telemetry_learning.reset()
 
     def start(
         self,

@@ -264,12 +264,19 @@ def expose_cluster(
     )
 
     def snapshot() -> dict:
+        from . import learning as learning_mod
+
         return {
             "node_id": aux.node_id,
             "metrics": telemetry_registry.default_registry().snapshot(),
             "cluster": aux.cluster.snapshot(),
             "alerts": aux.alerts.snapshot() if aux.alerts else None,
             "health": aux.health()[1],
+            # the learning truth plane per worker: staleness vs τ,
+            # shard shares + imbalance, the top-k hot-slot table,
+            # divergence accounting (doc/OBSERVABILITY.md "Learning
+            # truth plane")
+            "learning": learning_mod.snapshot_all(),
             "timeline_tail": _timeline_tail(),
         }
 

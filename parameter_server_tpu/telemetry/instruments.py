@@ -658,6 +658,115 @@ def alert_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+#: realized-staleness buckets (ps_learning_staleness): integer ministep
+#: counts land between the .5 edges, so each small staleness value gets
+#: its own bucket up to the configured-τ range anyone sanely runs
+STALENESS_BUCKETS = (
+    0.5, 1.5, 2.5, 3.5, 4.5, 6.5, 8.5, 12.5, 16.5, 24.5, 32.5, 48.5, 64.5,
+)
+
+#: reasons the divergence counter ticks (telemetry/learning.py):
+#: nonfinite (NaN/Inf loss or gradient) or spike (grad norm far past
+#: its recent median)
+DIVERGENCE_REASONS = ("nonfinite", "spike")
+
+
+def learning_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Learning truth plane (telemetry/learning.py): the staleness the
+    bounded-delay contract actually REALIZES (vs the configured
+    ``SGDConfig.max_delay`` τ), per-server-shard key heat from the
+    windowed count sketch, and the convergence trajectory metered
+    host-side from the step builders' in-jit side outputs. Five planes
+    watch the system (seconds, bytes, FLOPs, incidents); this family
+    watches the learning — a NaN'd table or a τ breach becomes a
+    metric, an alert rule, and a bench-record section instead of a
+    silent 200."""
+    return {
+        "staleness": reg.ensure_histogram(
+            "ps_learning_staleness_ministeps",
+            "realized weight-snapshot staleness of one submitted step, "
+            "in ministeps since the snapshot was pulled (the "
+            "bounded-delay contract's MEASURED side; observed max must "
+            "stay <= the configured SGDConfig.max_delay)",
+            labelnames=("worker",),
+            buckets=STALENESS_BUCKETS,
+        ),
+        "staleness_max": reg.ensure_gauge(
+            "ps_learning_staleness_max",
+            "largest realized staleness this worker has observed "
+            "(ministeps; process lifetime)",
+            labelnames=("worker",),
+        ),
+        "staleness_over_tau": reg.ensure_gauge(
+            "ps_learning_staleness_over_tau",
+            "observed-max staleness minus the configured max_delay τ — "
+            "<= 0 while the bounded-delay contract holds; > 0 is a "
+            "contract breach (the staleness_breach alert rule fires "
+            "on this gauge)",
+            labelnames=("worker",),
+        ),
+        "examples": reg.ensure_counter(
+            "ps_learning_examples_total",
+            "device-confirmed training examples folded into the "
+            "progress plane by ISGDCompNode.collect (the step's own "
+            "num_ex output, not a host-side submission count)",
+            labelnames=("worker",),
+        ),
+        "loss": reg.ensure_gauge(
+            "ps_learning_loss",
+            "per-example training loss of the worker's last collected "
+            "step (objective / num_ex)",
+            labelnames=("worker",),
+        ),
+        "grad_norm": reg.ensure_gauge(
+            "ps_learning_grad_norm",
+            "L2 norm of the last collected step's per-worker gradient "
+            "contributions (sqrt of the in-jit grad_sq side output)",
+            labelnames=("worker",),
+        ),
+        "update_norm": reg.ensure_gauge(
+            "ps_learning_update_norm",
+            "L2 norm of the aggregated (post-filter) update handed to "
+            "the updater on the last collected step",
+            labelnames=("worker",),
+        ),
+        "weight_norm": reg.ensure_gauge(
+            "ps_learning_weight_norm",
+            "L2 magnitude of the weights the last collected step "
+            "consumed (per-occurrence touched weights, not the global "
+            "table norm — a blow-up detector and trend line)",
+            labelnames=("worker",),
+        ),
+        "divergence": reg.ensure_counter(
+            "ps_learning_divergence_total",
+            "collected steps judged divergent host-side, by reason: "
+            "nonfinite (NaN/Inf loss or gradient) or spike (grad norm "
+            "far past its recent median) — the loss_divergence alert "
+            "rule fires on this counter's rate",
+            labelnames=("worker", "reason"),
+        ),
+        "heat_slots": reg.ensure_counter(
+            "ps_learning_heat_slots_total",
+            "slot observations folded into the key-heat sketch "
+            "(pushed/pulled slots noted on the feeder/uploader threads)",
+            labelnames=("worker",),
+        ),
+        "shard_share": reg.ensure_gauge(
+            "ps_learning_shard_share",
+            "this server shard's fraction of the windowed key-heat "
+            "load (sums to ~1 across shards while traffic flows) — the "
+            "direct input a declarative partitioner rebalances on",
+            labelnames=("shard",),
+        ),
+        "shard_imbalance": reg.ensure_gauge(
+            "ps_learning_shard_imbalance",
+            "max/mean of per-shard windowed key-heat load — 1.0 is "
+            "perfectly balanced; the shard_imbalance alert rule fires "
+            "past its threshold",
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -734,6 +843,7 @@ cached_serve_instruments = _cached_family(serve_instruments)
 cached_wire_instruments = _cached_family(wire_instruments)
 cached_ftrl_instruments = _cached_family(ftrl_instruments)
 cached_device_instruments = _cached_family(device_instruments)
+cached_learning_instruments = _cached_family(learning_instruments)
 cached_blackbox_instruments = _cached_family(blackbox_instruments)
 cached_bundle_instruments = _cached_family(bundle_instruments)
 
@@ -748,6 +858,7 @@ INSTRUMENT_FAMILIES = (
     serve_instruments,
     ftrl_instruments,
     device_instruments,
+    learning_instruments,
     recovery_instruments,
     node_instruments,
     cluster_instruments,

@@ -77,3 +77,26 @@ class CountMin:
 
     def clear(self) -> None:
         self.data.fill(0)
+
+
+class DecayCountMin(CountMin):
+    """Count-min with windowed exponential decay — the key-heat sketch
+    of the learning truth plane (telemetry/learning.py).
+
+    Same CM machinery the ingest tail filter rides, but the counters
+    track the RECENT stream instead of lifetime totals: ``decay()``
+    halves every counter, so calling it once per window gives every
+    observation a half-life of one window. Heat ranking only needs
+    relative magnitudes, so the integer floor-halving bias (a stuck 1
+    decays to 0) is immaterial — and exactly what lets a cold key fall
+    out of the top-k. The cap is raised from the tail filter's 255
+    (there the question is "below freq?"; here hot keys must keep
+    separating long past 255).
+    """
+
+    def __init__(self, n: int = 1 << 16, k: int = 2, cap: int = 1 << 30):
+        super().__init__(n=n, k=k, cap=cap)
+
+    def decay(self) -> None:
+        """Advance one window: halve every counter in place."""
+        self.data >>= 1

@@ -91,6 +91,12 @@ METADATA_SECTIONS = frozenset(
         # carries host-dependent counts — banding either would
         # false-flag every round
         "blackbox",
+        # the learning truth plane (telemetry/learning.py): realized
+        # staleness, key-heat shard shares, loss/grad-norm convergence
+        # trajectories, the divergence drill — LEARNING evidence, not
+        # throughput; banding a loss trajectory as perf would flag
+        # every data/seed change as a regression
+        "learning",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

@@ -120,12 +120,15 @@ def lint(root: "str | None" = None) -> list:
     for name, inst in sorted(instruments.items()):
         if inst.kind == "counter" and not name.endswith("_total"):
             problems.append(f"counter {name!r} should end in '_total'")
-        if inst.kind == "histogram" and not (
-            name.endswith("_seconds") or name.endswith("_bytes")
+        # histograms carry their unit in the name; ministeps is the
+        # learning plane's staleness unit (a logical count, like the
+        # Prometheus convention's base units — never an alias for time)
+        if inst.kind == "histogram" and not name.endswith(
+            ("_seconds", "_bytes", "_ministeps")
         ):
             problems.append(
                 f"histogram {name!r} should carry a unit suffix "
-                "('_seconds' or '_bytes')"
+                "('_seconds', '_bytes' or '_ministeps')"
             )
 
     # exposition must parse even with every series present: record one

@@ -43,6 +43,10 @@ SCOPE = (
     "parameter_server_tpu/ops/quantize.py",
     "parameter_server_tpu/ops/flash_attention.py",
     "parameter_server_tpu/ops/wire_codec.py",
+    # the learning plane is host-side by design — in scope so a future
+    # jit sneaking telemetry calls inside a traced body is caught here
+    # like it would be in ops/
+    "parameter_server_tpu/telemetry/learning.py",
 )
 
 _NP_IMPURE = {

@@ -196,6 +196,32 @@ class TestDiffMath:
         assert reported
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_learning_section_is_metadata_never_banded(self):
+        """The learning truth plane's `learning` section carries loss /
+        grad-norm trajectories, staleness histograms and heat shares —
+        LEARNING evidence that moves with data and seeds, never a
+        throughput the sentinel may band (a convergence trajectory
+        banded as perf would flag every data change as a regression).
+        The import-time assert bars WATCHED from pointing into it."""
+        assert "learning" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["learning"] = {  # divergence horrors, all ignored
+            "probe": {
+                "staleness": {"observed_max": 1e9, "within_bound": False},
+                "shards": {"imbalance": 1e9},
+                "trajectory_tail": [{"loss": 1e30, "grad_norm": 1e30}],
+                "divergence_drill": {"fired": True},
+            },
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):
