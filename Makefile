@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench learning-bench roofline trace bundle bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -128,6 +128,15 @@ chaos-bench: native
 # dict is embedded in every bench.py record under "learning"
 learning-bench:
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks learning
+
+# history plane overhead probe (components bench, doc/OBSERVABILITY.md
+# "History plane"): the multi-resolution ring-cascade fold hook priced
+# against the identical metric-churn workload without it — paired
+# back-to-back reps (on, off, off, on), MEDIAN ratio quoted, plus the
+# tight-loop per-fold cost over the full instrument catalog. The same
+# dict is embedded in every bench.py record under "history"
+history-bench:
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks history_ab
 
 # device truth plane probe (components bench, doc/OBSERVABILITY.md
 # "Device truth plane"): an HBM-bound FTRL chain + a FLOPs-bound flash

@@ -703,6 +703,55 @@ def attach_blackbox(rec_or_headline: dict, smoke: bool) -> None:
         )
 
 
+def attach_history(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the history plane under ``history`` in every
+    bench record (telemetry/history.py, doc/OBSERVABILITY.md "History
+    plane"): the fold-hook overhead paired-median A/B (the identical
+    metric-churn workload with the ring cascade installed vs absent —
+    the honest claim is the ratio straddling this host's noise floor,
+    with the tight-loop per-fold cost over the full instrument catalog
+    that a capacity flap cannot fake) plus the run's own installed
+    store's retention/occupancy snapshot when one is live. Run
+    METADATA, not a throughput metric — script/bench_diff.py excludes
+    this section from banding (METADATA_SECTIONS); never breaks a
+    record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import history_ab
+        from parameter_server_tpu.telemetry import history as history_mod
+
+        # parked: the A/B churns its own private registries — the
+        # run's JSONL sink must neither pay for nor record the probe
+        with telemetry_spans.parked_sink():
+            section: dict = {"overhead": history_ab(smoke)}
+        store = history_mod.installed_store()
+        if store is not None:
+            store.fold(force=True)
+            section["store"] = store.snapshot()
+        rec_or_headline["history"] = section
+    except Exception as e:
+        rec_or_headline["history_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
+def attach_history_drift(rec: dict, samples) -> None:
+    """Live steady-state drift verdict over the run's OWN timed
+    (elapsed_s, examples/sec) windows, folded into the record's
+    ``history`` section after the e2e phase: the tail of the run judged
+    against its post-warmup baseline — same host, same run, so no
+    cross-run capacity drift can alibi or fake the verdict
+    (telemetry/history.drift_check; the online twin of bench_diff's
+    cross-run sentinel). Never breaks a record."""
+    try:
+        from parameter_server_tpu.telemetry.history import drift_check
+
+        rec.setdefault("history", {})["live_drift"] = drift_check(
+            list(samples)
+        )
+    except Exception as e:
+        rec["history_drift_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+
+
 def attach_learning(rec_or_headline: dict, smoke: bool) -> None:
     """Guarded embed of the learning truth plane under ``learning`` in
     every bench record (benchmarks/components.learning_truth +
@@ -1991,6 +2040,10 @@ def run_real(args) -> int:
     attach_recovery(headline, args.smoke)
     _beat("blackbox")
     attach_blackbox(headline, args.smoke)
+    # history-plane fold-hook overhead A/B + the live store snapshot
+    # (doc/OBSERVABILITY.md "History plane")
+    _beat("history")
+    attach_history(headline, args.smoke)
     # learning truth plane (staleness vs τ, heat/shard balance,
     # convergence trajectory, divergence drill). Runs LAST among the
     # component sections: its probe resets the Postoffice, and the run
@@ -2045,9 +2098,19 @@ def run_real(args) -> int:
     done_ex = 0
     wire_bytes_moved = 0
     pending = []
+    # (elapsed_s, examples/sec) per ~2 s stretch for the live_drift
+    # verdict (no flush per sample: submissions are pipelined, so each
+    # stretch's rate is approximate — the drift check medians segments)
+    drift_samples = []
+    win_ex, win_t = 0, t0
     pipe = UploadPipeline(prepped_stream(), T)
     for dev_sb, n_ex, nb, fid in pipe:
         done_ex += n_ex
+        win_ex += n_ex
+        _now = time.perf_counter()
+        if _now - win_t >= 2.0:
+            drift_samples.append((_now - t0, win_ex / (_now - win_t)))
+            win_ex, win_t = 0, _now
         wire_bytes_moved += nb  # actual staged bytes, not a dtype model
         _beat()
         # device_put returned with the transfer possibly still in
@@ -2099,6 +2162,9 @@ def run_real(args) -> int:
     # the run worker's OWN learning plane, harvested after the timed
     # stream so its staleness/trajectory view covers the e2e phase
     attach_learning_run(rec, worker)
+    # live steady-state drift: the run's tail stretches vs its own
+    # post-warmup baseline (doc/OBSERVABILITY.md "History plane")
+    attach_history_drift(rec, drift_samples)
     # device truth plane AFTER the timed stream: the post-warmup
     # recompile count covers the phase that must not re-specialize
     attach_device(rec, args.smoke)
@@ -2542,6 +2608,10 @@ def run_synthetic(args) -> int:
     # "Flight recorder & diagnostic bundles")
     _beat("blackbox")
     attach_blackbox(headline, args.smoke)
+    # history-plane fold-hook overhead A/B + the live store snapshot
+    # (doc/OBSERVABILITY.md "History plane")
+    _beat("history")
+    attach_history(headline, args.smoke)
     # learning truth plane (staleness vs τ, heat/shard balance,
     # convergence trajectory, divergence drill) — last among the
     # component sections; see attach_learning's harvest-order note
@@ -2585,6 +2655,7 @@ def run_synthetic(args) -> int:
 
         cache = UploadCache(max_bytes=conf.async_sgd.wire_cache_mb << 20)
     rates = []
+    drift_samples = []  # (elapsed_s, window examples/sec) for live_drift
     done = 0
     wire_counter["bytes"] = 0  # count the TIMED phase only (not warmup)
     # warmup mark for the device inventory (see run_real): the timed
@@ -2615,6 +2686,7 @@ def run_synthetic(args) -> int:
             flush(worker)
             now = time.perf_counter()
             rates.append(win_done * T * args.minibatch / (now - win_t0))
+            drift_samples.append((now - t0, rates[-1]))
             win_done, win_t0 = 0, now
     for ts in pending:
         worker.executor.wait(ts)
@@ -2647,6 +2719,9 @@ def run_synthetic(args) -> int:
     # the run worker's OWN learning plane, harvested after the timed
     # windows so its staleness/trajectory view covers the e2e phase
     attach_learning_run(rec, worker)
+    # live steady-state drift: the run's tail windows vs its own
+    # post-warmup baseline (doc/OBSERVABILITY.md "History plane")
+    attach_history_drift(rec, drift_samples)
     # device truth plane AFTER the timed windows (post-warmup
     # recompiles cover the phase that must not re-specialize)
     attach_device(rec, args.smoke)

@@ -1535,6 +1535,128 @@ def bundle_probe(smoke: bool = False) -> None:
         aux.stop()
 
 
+def history_ab(smoke: bool = False) -> dict:
+    """Steady-state overhead of the history plane (telemetry/history.py)
+    priced the bench-discipline way: the SAME metric-churn workload
+    (counter bumps, gauge sets, histogram observes, a periodic registry
+    export — the report timer's read, which is exactly where the
+    installed fold hook rides) with the HistoryStore installed vs
+    absent, both orders inside one rep (on, off, off, on) so a monotone
+    capacity drift on this flapping host cancels out of the paired
+    ratio. The quoted claim is the MEDIAN ratio; because a seconds-scale
+    capacity flap can still fake a stream ratio, the absolute per-fold
+    cost is ALSO priced as a tight-loop ``fold_us_median`` over the
+    full canonical instrument catalog. The A/B store runs at a 10 ms
+    base resolution — two orders of magnitude HOTTER than the
+    production 1 s cadence — so the quoted overhead is an upper bound,
+    never a best case."""
+    import time as _time
+
+    from ..telemetry.history import HistoryStore
+    from ..telemetry.instruments import install_all
+    from ..telemetry.registry import MetricsRegistry
+
+    n = 1500 if smoke else 6000
+    reps = 3 if smoke else 5
+
+    def build(with_history: bool):
+        reg = MetricsRegistry()
+        cs = [
+            reg.counter(f"ab_hist_c{i}_total", "history A/B churn",
+                        labelnames=("k",))
+            for i in range(4)
+        ]
+        gs = [reg.gauge(f"ab_hist_g{i}", "history A/B churn")
+              for i in range(4)]
+        hist = reg.histogram(
+            "ab_hist_lat_seconds", "history A/B churn",
+            buckets=(1e-5, 1e-4, 1e-3, 1e-2),
+        )
+        if with_history:
+            HistoryStore(reg, resolutions=((0.01, 600), (0.1, 720))).install()
+        return reg, cs, gs, hist
+
+    def run(world) -> None:
+        reg, cs, gs, hist = world
+        for i in range(n):
+            cs[i & 3].labels(k=str(i & 7)).inc()
+            gs[i & 3].set(float(i))
+            hist.observe((i & 15) * 1e-4 + 1e-5)
+            if i % 50 == 0:
+                # the scrape/report read; with the store installed this
+                # is what invokes the (rate-limited) fold hook
+                reg.export_state()
+
+    on, off = build(True), build(False)
+
+    def timed(world) -> float:
+        t0 = _time.perf_counter()
+        run(world)
+        return _time.perf_counter() - t0
+
+    timed(on)  # warm both shapes
+    timed(off)
+    ratios, on_s, off_s = [], [], []
+    for _ in range(reps):
+        a1 = timed(on)
+        o = (timed(off) + timed(off)) / 2
+        a2 = timed(on)
+        ratios.append(((a1 + a2) / 2) / max(o, 1e-9))
+        on_s.append((a1 + a2) / 2)
+        off_s.append(o)
+    ratios.sort()
+    on_s.sort()
+    off_s.sort()
+
+    # tight-loop absolute: one forced fold over the FULL canonical
+    # catalog (every instrument family, one live series each) — the
+    # pure per-fold cost no workload flap can fake
+    cat_reg = MetricsRegistry()
+    instruments = install_all(cat_reg)
+    for inst in instruments.values():
+        target = (
+            inst.labels(**{ln: "probe" for ln in inst.labelnames})
+            if inst.labelnames else inst
+        )
+        if inst.kind == "histogram":
+            target.observe(0.001)
+        elif inst.kind == "gauge":
+            target.set(1.0)
+        else:
+            target.inc()
+    store = HistoryStore(cat_reg)
+    m = 50 if smoke else 200
+    folds = []
+    for _ in range(m):
+        t0 = _time.perf_counter()
+        store.fold(force=True)
+        folds.append(_time.perf_counter() - t0)
+    folds.sort()
+    snap = store.snapshot()
+    return {
+        "reps": reps,
+        "steps_per_rep": n,
+        "ratio_median": round(ratios[len(ratios) // 2], 3),
+        "on_ms_median": round(on_s[len(on_s) // 2] * 1e3, 3),
+        "off_ms_median": round(off_s[len(off_s) // 2] * 1e3, 3),
+        "fold_us_median": round(folds[len(folds) // 2] * 1e6, 1),
+        "fold_series": snap["series"],
+        "resolutions": snap["resolutions"],
+    }
+
+
+@benchmark("history_ab")
+def history_ab_perf(smoke: bool = False) -> None:
+    """History-plane overhead A/B (see history_ab): metric-churn
+    workload with the ring-cascade fold hook installed vs absent,
+    paired-median ratio + tight-loop per-fold cost over the full
+    instrument catalog."""
+    out = history_ab(smoke)
+    report("history_overhead_ratio_median", out["ratio_median"], "x")
+    report("history_fold_us_median", out["fold_us_median"], "us")
+    report("history_fold_series", out["fold_series"], "series")
+
+
 def _drill_batch(seed: int, i: int, key_space: int, n: int, k: int):
     """Deterministic training batch ``i`` — regenerable by index, which
     is what lets the recovery handler REPLAY acked-but-unbacked updates

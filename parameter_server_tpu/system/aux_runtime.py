@@ -108,6 +108,10 @@ class AuxRuntime:
         #: plane traffic or tick per-node report counters (and the
         #: heartbeat.report fault point's call counter) at scrape rate
         self.scrape_refresh_min_s = 0.2
+        #: window of down-sampled history each metric report carries
+        #: (telemetry/history.py export_ring) — the retention the
+        #: scheduler-side range queries serve for remote nodes
+        self.history_ship_window_s = 600.0
         self._last_sweep = 0.0  # monotonic; single float, atomic in CPython
         # serializes the scrape-time floor check-and-sweep: N handler
         # threads scraping concurrently must collapse to ONE sweep per
@@ -209,7 +213,10 @@ class AuxRuntime:
         if not self._deliver(node_id, report):
             return False  # silenced: a crashed node reports NOTHING
         export = self._node_export(node_id, info, report)
-        return self._ship(node_id, export, report, wire)
+        return self._ship(
+            node_id, export, report, wire,
+            history_ring=self._node_history_ring(node_id),
+        )
 
     def report_all(self, wire: Optional[bool] = None) -> int:
         """One metrics-plane sweep: every registered node reports, plus
@@ -229,9 +236,50 @@ class AuxRuntime:
                     telemetry_registry.default_registry().export_state(),
                     None,
                     wire,
+                    history_ring=self._default_history_ring(),
                 ):
                     landed += 1
         return landed
+
+    def _default_history_ring(self) -> Optional[dict]:
+        """The process default store's shipped ring (fold first so the
+        ring covers this sweep's registry state); None on any failure —
+        history must never break the metric report that carries it."""
+        try:
+            from ..telemetry import history as history_mod
+
+            store = history_mod.default_store()
+            store.fold()
+            return store.export_ring(window_s=self.history_ship_window_s)
+        except Exception:
+            return None
+
+    def _node_history_ring(self, node_id: str) -> Optional[dict]:
+        """One node's shipped history ring: its private registry's
+        store, merged (for THIS process's node) with the default
+        store's ring the same way :meth:`_node_export` merges the
+        registries themselves."""
+        try:
+            with self._lock:
+                entry = self._node_regs.get(node_id)
+            if entry is None:
+                return None
+            store = entry[3]
+            store.fold()
+            ring = store.export_ring(window_s=self.history_ship_window_s)
+            if node_id == self.node_id:
+                spine = self._default_history_ring()
+                if spine is not None:
+                    merged = dict(spine["metrics"])
+                    merged.update(ring["metrics"])
+                    ring = dict(spine)
+                    ring["metrics"] = merged
+                    ring["series"] = sum(
+                        len(m["series"]) for m in merged.values()
+                    )
+            return ring
+        except Exception:
+            return None
 
     def _node_export(
         self, node_id: str, info: HeartbeatInfo, report: HeartbeatReport
@@ -240,6 +288,7 @@ class AuxRuntime:
         return the export. Counters advance by LIFETIME-total deltas so
         they stay monotone no matter how report windows interleave with
         hot-loop beats (which drain the per-report deltas)."""
+        from ..telemetry.history import HistoryStore
         from ..telemetry.instruments import node_instruments
         from ..telemetry.registry import MetricsRegistry
 
@@ -247,10 +296,14 @@ class AuxRuntime:
             entry = self._node_regs.get(node_id)
             if entry is None:
                 reg = MetricsRegistry()
+                # each node's metrics plane gets its own ring cascade —
+                # the per-node history the scheduler's fleet-wide range
+                # queries serve (shipped by _node_history_ring)
                 entry = self._node_regs[node_id] = (
                     reg, node_instruments(reg), {"t": None},
+                    HistoryStore(reg),
                 )
-            reg, tel, state = entry
+            reg, tel, state = entry[:3]
             now = time.monotonic()
             for key, total in (
                 ("busy", info.total_busy_ms / 1e3),
@@ -296,13 +349,19 @@ class AuxRuntime:
         export: dict,
         report: Optional[HeartbeatReport],
         wire: Optional[bool],
+        history_ring: Optional[dict] = None,
     ) -> bool:
         """Move one report to the aggregator — through ``van.transfer``
         (real serialization + byte accounting + the ``van.transfer``
-        fault point) when the system is started, directly otherwise."""
+        fault point) when the system is started, directly otherwise.
+        The node's down-sampled history ring piggybacks on the same
+        frame: a dropped frame loses the shipment (staleness shows it),
+        never half of it."""
         payload = {"node": node_id, "metrics": export}
         if report is not None:
             payload["heartbeat"] = report
+        if history_ring is not None:
+            payload["history"] = history_ring
         van = None
         if wire is not False:
             from .postoffice import Postoffice
@@ -351,6 +410,13 @@ class AuxRuntime:
         reports already delivered through :meth:`_deliver`)."""
         node = payload["node"]
         self.cluster.update(node, payload["metrics"])
+        # history rides the same frame but folds separately: a torn /
+        # partial payload without a well-formed ring drops THIS
+        # shipment only — the aggregator's stored ring for the node is
+        # never replaced with garbage (it goes stale by age instead)
+        hist = payload.get("history")
+        if isinstance(hist, dict) and isinstance(hist.get("metrics"), dict):
+            self.cluster.update_history(node, hist)
         hb = payload.get("heartbeat")
         if hb is not None and self.info(node) is None:
             self.collector.report(node, hb)
@@ -563,6 +629,10 @@ class AuxRuntime:
                         _LOG.exception("metrics-plane sweep failed")
                 if self.alerts is not None:
                     try:
+                        # the loop IS the evaluator's schedule: its lag
+                        # meta-gauge must be judged against this period
+                        if self.alerts.period_s != check_interval:
+                            self.alerts.period_s = check_interval
                         self.alerts.evaluate()
                     except Exception:
                         _LOG.exception("alert evaluation failed")

@@ -25,7 +25,14 @@ One low-overhead spine for every layer's observability (see
   /debug/snapshot) over the cluster aggregate;
 - :mod:`alerts` — declarative threshold/burn-rate SLO rules evaluated
   in-process on a sliding window, pending→firing→resolved state
-  exported as ``ps_alert_state``;
+  exported as ``ps_alert_state``; multi-window (fast+slow burn) and
+  ``trend`` (drift/leak) conditions evaluate from the history plane;
+- :mod:`history` — the time plane: a bounded multi-resolution ring
+  cascade (1 s × 10 m → 10 s × 2 h → 60 s × 12 h) over the registry
+  with typed downsampling (counters→rate deltas, gauges→last/min/max,
+  histograms→bucket-delta merges), range queries, robust trend
+  estimation and steady-state drift checks
+  (``doc/OBSERVABILITY.md`` "History plane");
 - :mod:`device` — the device truth plane: a compiled-function
   inventory over the jit entry points (per-name cost/memory analysis,
   recompile detection, runtime donation-aliasing verification), live
@@ -37,6 +44,14 @@ from .aggregate import CLUSTER_NODE, ClusterAggregator
 from .alerts import AlertManager, AlertRule, default_rules, load_rules
 from .device import DeviceInventory, HbmMonitor, aot_analyze, instrument
 from .exposition import ExpositionServer, close_cluster, expose_cluster
+from .history import (
+    HistoryStore,
+    default_store,
+    drift_check,
+    installed_store,
+    reset_default_store,
+    set_default_store,
+)
 
 from .registry import (
     Counter,
@@ -74,13 +89,19 @@ __all__ = [
     "ExpositionServer",
     "Gauge",
     "HbmMonitor",
+    "HistoryStore",
     "Histogram",
     "JsonlSink",
     "MetricsRegistry",
     "aot_analyze",
     "close_cluster",
     "default_rules",
+    "default_store",
+    "drift_check",
     "expose_cluster",
+    "installed_store",
+    "reset_default_store",
+    "set_default_store",
     "instrument",
     "load_rules",
     "close_sink",

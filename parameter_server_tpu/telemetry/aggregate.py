@@ -111,6 +111,13 @@ class ClusterAggregator:
         self._exports: Dict[str, Dict[str, dict]] = {}  # guarded-by: _lock
         self._last_t: Dict[str, float] = {}  # guarded-by: _lock
         self._reports: Dict[str, int] = {}  # guarded-by: _lock
+        # per-node down-sampled history rings (telemetry/history.py
+        # export_ring shape) with their own arrival times — histories
+        # are PER-NODE evidence: they are never folded into the
+        # node="cluster" rollup (range queries disclose each node's
+        # ring and its staleness instead)
+        self._histories: Dict[str, dict] = {}  # guarded-by: _lock
+        self._history_t: Dict[str, float] = {}  # guarded-by: _lock
         # distinct (node, metric) pairs ever rejected from the merge —
         # a SET so one persistently-bad export counts once, not once
         # per scrape (merged() runs at the scrape rate)
@@ -134,6 +141,98 @@ class ClusterAggregator:
             self._last_t[node] = t
             self._reports[node] = self._reports.get(node, 0) + 1
 
+    def update_history(
+        self, node: str, ring: dict, t: Optional[float] = None
+    ) -> None:
+        """Fold one node's shipped history ring in (wholesale replace,
+        like :meth:`update` — rings are self-contained dumps). A report
+        frame that arrives WITHOUT a ring leaves the previous one in
+        place untouched: its age keeps growing, so a torn shipment
+        shows as staleness, never as a poisoned or vanished ring."""
+        if node == CLUSTER_NODE:
+            raise ValueError(
+                f"node id {CLUSTER_NODE!r} is reserved for merged series"
+            )
+        t = self._clock() if t is None else t
+        with self._lock:
+            self._histories[node] = ring
+            self._history_t[node] = t
+
+    def history_ages(self, now: Optional[float] = None) -> Dict[str, float]:
+        now = self._clock() if now is None else now
+        with self._lock:
+            return {n: now - t for n, t in self._history_t.items()}
+
+    def history_query(
+        self,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        window_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Fleet-wide range query over the shipped per-node rings:
+        node-keyed series for one metric, each node carrying its ring
+        age and staleness verdict. A stale node's last ring is still
+        DISCLOSED (it is evidence) but flagged — and no cross-node
+        rollup exists to silently absorb it."""
+        ages = self.history_ages(now)
+        with self._lock:
+            rings = dict(self._histories)
+        out: Dict[str, dict] = {"name": name, "nodes": {}}
+        for node in sorted(rings):
+            ring = rings[node]
+            age = ages.get(node, -1.0)
+            entry: dict = {
+                "age_s": round(age, 3),
+                "stale": age > self.stale_after_s,
+                "ring_t": ring.get("t"),
+            }
+            decl = ring.get("metrics", {}).get(name)
+            if decl is not None:
+                series = [
+                    s for s in decl.get("series", ())
+                    if labels is None or all(
+                        str(s.get("labels", {}).get(k)) == str(v)
+                        for k, v in labels.items()
+                    )
+                ]
+                if window_s is not None and ring.get("t") is not None:
+                    cutoff = float(ring["t"]) - float(window_s)
+                    series = [
+                        {
+                            **s,
+                            "points": [
+                                p for p in s.get("points", ())
+                                if p.get("t", 0.0) >= cutoff
+                            ],
+                        }
+                        for s in series
+                    ]
+                entry["kind"] = decl.get("kind")
+                entry["resolution"] = decl.get("resolution")
+                entry["series"] = series
+            out["nodes"][node] = entry
+        return out
+
+    def history_snapshot(self, now: Optional[float] = None) -> dict:
+        """Per-node ring occupancy + staleness (/debug/snapshot)."""
+        ages = self.history_ages(now)
+        with self._lock:
+            rings = dict(self._histories)
+        return {
+            "stale_after_s": self.stale_after_s,
+            "nodes": {
+                n: {
+                    "age_s": round(ages.get(n, -1.0), 3),
+                    "stale": ages.get(n, 0.0) > self.stale_after_s,
+                    "series": rings[n].get("series"),
+                    "window_s": rings[n].get("window_s"),
+                    "metrics": len(rings[n].get("metrics", {})),
+                }
+                for n in sorted(rings)
+            },
+        }
+
     def forget(self, node: str) -> None:
         """Drop a decommissioned node (elastic shrink — a node removed
         on purpose must not linger as 'stale' forever)."""
@@ -141,6 +240,8 @@ class ClusterAggregator:
             self._exports.pop(node, None)
             self._last_t.pop(node, None)
             self._reports.pop(node, None)
+            self._histories.pop(node, None)
+            self._history_t.pop(node, None)
 
     # -- staleness --
 

@@ -21,11 +21,23 @@ Rule kinds (``AlertRule.kind``):
   delta across the window (the registry's own percentile() is
   since-birth; alerting needs "p99 over the last 30s");
 - ``burn_rate`` — ``ratio`` divided by the rule's error ``budget``:
-  burn 1.0 consumes the budget exactly; sustained burn ≫ 1 pages.
+  burn 1.0 consumes the budget exactly; sustained burn ≫ 1 pages;
+- ``trend`` — a robust monotonic-slope test over a LONG window of the
+  history plane (telemetry/history.py): Theil-Sen median slope gated
+  by an up/down concordance fraction, for drift/leak rules (HBM
+  high-water, live-buffer total, queue depth, staleness growth) that
+  no instantaneous threshold can catch.
 
-A rule with no data (empty window, zero denominator) evaluates to
-None, which never satisfies the condition — missing traffic resolves
-an alert rather than wedging it.
+Multi-window conditions: ``counter_rate``/``ratio``/``burn_rate``/
+``quantile`` rules with ``slow_window_s > 0`` evaluate from the
+history plane over BOTH windows and the condition must hold on both —
+the fast window catches a real overload quickly, the slow window keeps
+a brief spike (shorter than the fast window's worth of budget) from
+paging. Single-window rules keep the original in-process sample list.
+
+A rule with no data (empty window, zero denominator, too few history
+points) evaluates to None, which never satisfies the condition —
+missing traffic resolves an alert rather than wedging it.
 
 The default production rule set ships in ``configs/alerts/default.json``
 (:func:`default_rules`); doc/OBSERVABILITY.md documents the syntax.
@@ -45,7 +57,10 @@ from . import registry as telemetry_registry
 
 STATE_INACTIVE, STATE_PENDING, STATE_FIRING, STATE_RESOLVED = 0, 1, 2, 3
 STATE_NAMES = {0: "inactive", 1: "pending", 2: "firing", 3: "resolved"}
-KINDS = ("gauge", "counter_rate", "ratio", "quantile", "burn_rate")
+KINDS = ("gauge", "counter_rate", "ratio", "quantile", "burn_rate", "trend")
+
+#: kinds that may carry a slow window (fast+slow multi-window pairs)
+_MULTI_WINDOW_KINDS = ("counter_rate", "ratio", "burn_rate", "quantile")
 _OPS = {
     ">": lambda v, t: v > t,
     ">=": lambda v, t: v >= t,
@@ -67,9 +82,12 @@ class AlertRule:
     den: Sequence[str] = ()      # ratio/burn_rate denominator metrics
     q: float = 0.99              # quantile kind
     budget: float = 0.0          # burn_rate error budget (fraction)
-    window_s: float = 30.0       # sliding-window width
+    window_s: float = 30.0       # sliding-window width (the FAST window)
+    slow_window_s: float = 0.0   # > 0: multi-window pair, from history
     for_s: float = 0.0           # condition must hold this long to fire
     resolve_hold_s: float = 30.0  # how long 'resolved' shows before inactive
+    min_points: int = 4          # trend: fewest history cells to judge
+    monotonic_frac: float = 0.6  # trend: concordance gate (frac of steps)
     severity: str = "warn"       # page | warn (routing hint, not logic)
     description: str = ""
 
@@ -84,6 +102,27 @@ class AlertRule:
             raise ValueError(f"rule {self.name!r}: {self.kind} needs den=[...]")
         if not 0.0 < self.q < 1.0:
             raise ValueError(f"rule {self.name!r}: q outside (0, 1)")
+        if self.slow_window_s:
+            if self.kind not in _MULTI_WINDOW_KINDS:
+                raise ValueError(
+                    f"rule {self.name!r}: slow_window_s only applies to "
+                    f"{_MULTI_WINDOW_KINDS}"
+                )
+            if self.slow_window_s <= self.window_s:
+                raise ValueError(
+                    f"rule {self.name!r}: slow_window_s "
+                    f"({self.slow_window_s}) must exceed window_s "
+                    f"({self.window_s})"
+                )
+        if self.kind == "trend":
+            if self.min_points < 2:
+                raise ValueError(
+                    f"rule {self.name!r}: trend needs min_points >= 2"
+                )
+            if not 0.0 <= self.monotonic_frac <= 1.0:
+                raise ValueError(
+                    f"rule {self.name!r}: monotonic_frac outside [0, 1]"
+                )
 
 
 @dataclasses.dataclass
@@ -203,6 +242,7 @@ class AlertManager:
         rules: Sequence[AlertRule],
         registry=None,
         clock: Callable[[], float] = time.monotonic,
+        history=None,
     ):
         names = [r.name for r in rules]
         if len(set(names)) != len(names):
@@ -210,11 +250,29 @@ class AlertManager:
         self.rules = list(rules)
         self._registry = registry  # None = resolve default at sample time
         self._clock = clock
+        #: HistoryStore the trend / multi-window rules evaluate from;
+        #: None resolves the process default (or lazily binds a private
+        #: store when ``registry`` is private) at evaluate time
+        self._history = history
+        self._own_history = None
+        #: expected evaluation period (seconds) — the baseline the
+        #: ps_alert_eval_lag_seconds meta-gauge is judged against; set
+        #: by :meth:`start` / the aux loop
+        self.period_s = 1.0
+        self._last_eval_t: Optional[float] = None  # guarded-by: _lock
         self._metrics = sorted(
             {r.metric for r in self.rules}
             | {m for r in self.rules for m in r.den}
         )
-        self._window = max((r.window_s for r in self.rules), default=30.0)
+        # the sample list only serves single-window non-trend rules —
+        # history-backed kinds must not inflate its retention
+        self._window = max(
+            (
+                r.window_s for r in self.rules
+                if r.kind != "trend" and not r.slow_window_s
+            ),
+            default=30.0,
+        )
         self._samples: List[Tuple[float, dict]] = []  # guarded-by: _lock
         self._states: Dict[str, _RuleState] = {  # guarded-by: _lock
             r.name: _RuleState() for r in self.rules
@@ -246,10 +304,49 @@ class AlertManager:
 
     # -- evaluation --
 
+    def _history_store(self):
+        """The HistoryStore backing trend / multi-window rules: the
+        explicit one, the process default (tracks registry swaps), or a
+        lazily-bound private store over an explicit private registry."""
+        if self._history is not None:
+            return self._history
+        from . import history as history_mod
+
+        if self._registry is None:
+            return history_mod.default_store()
+        if self._own_history is None:
+            self._own_history = history_mod.HistoryStore(
+                self._registry, clock=self._clock
+            ).install()
+        return self._own_history
+
     def evaluate(self, now: Optional[float] = None) -> List[AlertEvent]:
         """One tick: sample, compute every rule, advance state
         machines; returns (and delivers) the transitions."""
         now = self._clock() if now is None else now
+        t_wall0 = time.perf_counter()
+        # meta-monitoring BEFORE sampling, so the starvation rule reads
+        # THIS tick's lag from this tick's own sample
+        with self._lock:
+            prev_t = self._last_eval_t
+            self._last_eval_t = now
+        if self._tel is not None and prev_t is not None:
+            lag = max(0.0, (now - prev_t) - self.period_s)
+            self._tel["eval_lag"].set(lag)
+        # fold the history at this tick so history-backed rules see the
+        # current registry state. The store folds and is queried on ITS
+        # OWN clock (wall time for the process default; the evaluator's
+        # clock may be monotonic — a different time base entirely), so
+        # no explicit ``now`` is passed down. Fake-clock tests hand the
+        # manager a HistoryStore built on the same fake clock.
+        needs_history = any(
+            r.kind == "trend" or r.slow_window_s for r in self.rules
+        )
+        if needs_history:
+            try:
+                self._history_store().fold()
+            except Exception:
+                pass  # a broken fold must not stop threshold alerting
         sample = self._sample()
         with self._lock:
             self._samples.append((now, sample))
@@ -273,6 +370,10 @@ class AlertManager:
                     fn(ev)
                 except Exception:
                     pass  # a broken listener must not stop alerting
+        if self._tel is not None:
+            self._tel["eval_seconds"].observe(
+                time.perf_counter() - t_wall0
+            )
         return events
 
     def _window_pair(
@@ -295,9 +396,65 @@ class AlertManager:
             return None
         return old, new
 
+    def _history_value(
+        self, rule: AlertRule, window_s: float
+    ) -> Optional[float]:
+        """One window's value from the history plane (multi-window
+        kinds): rates and quantiles computed from ring-cell deltas.
+        Queries pass ``now=None`` so the store anchors the window on
+        ITS OWN clock — the evaluator's clock may be a different time
+        base (monotonic vs the default store's wall time)."""
+        h = self._history_store()
+        if rule.kind == "counter_rate":
+            return h.window_rate(rule.metric, rule.labels, window_s)
+        if rule.kind in ("ratio", "burn_rate"):
+            num = h.window_rate(rule.metric, rule.labels, window_s)
+            dens = [
+                h.window_rate(m, rule.labels, window_s)
+                for m in rule.den
+            ]
+            if num is None or any(d is None for d in dens):
+                return None
+            den = sum(dens)
+            if den <= 0:
+                return None
+            value = num / den
+            return value / rule.budget if rule.kind == "burn_rate" else value
+        return h.window_quantile(
+            rule.metric, rule.labels, window_s, rule.q
+        )
+
     def _compute(
         self, rule: AlertRule, samples, now: float
     ) -> Optional[float]:
+        if rule.kind == "trend":
+            try:
+                tr = self._history_store().trend(
+                    rule.metric, rule.labels, rule.window_s,
+                    min_points=rule.min_points,
+                )
+            except Exception:
+                return None
+            if tr is None:
+                return None
+            frac = (
+                tr["frac_down"] if rule.op in ("<", "<=") else tr["frac_up"]
+            )
+            if frac < rule.monotonic_frac:
+                return 0.0  # noise around a level, not a sustained drift
+            return tr["slope_per_s"]
+        if rule.slow_window_s:
+            # fast AND slow must both breach: report the less-violating
+            # window's value so the condition is the conjunction
+            try:
+                fast = self._history_value(rule, rule.window_s)
+                slow = self._history_value(rule, rule.slow_window_s)
+            except Exception:
+                return None
+            if fast is None or slow is None:
+                return None
+            pick = min if rule.op in (">", ">=") else max
+            return pick(fast, slow)
         if rule.kind == "gauge":
             if not samples:
                 return None
@@ -443,6 +600,7 @@ class AlertManager:
     def start(self, interval: float = 1.0) -> "AlertManager":
         if self._thread is not None:
             return self
+        self.period_s = float(interval)
         self._stop.clear()
 
         def loop() -> None:

@@ -22,8 +22,10 @@ A bundle carries ring dumps from every node — fetched over the Van
 message plane with staleness semantics for silent nodes
 (``AuxRuntime.fetch_rings``) — the aggregated metrics snapshot, alert
 states, executor pending/timestamps, the device-truth section, per-peer
-clock offsets, and a Perfetto-ready ``trace`` (open ``bundle["trace"]``
-at https://ui.perfetto.dev). It is served live at ``/debug/bundle``
+clock offsets, the down-sampled **history hour** before the trigger
+(telemetry/history.py — the installed ring exported at the coarsest
+resolution covering 3600 s), and a Perfetto-ready ``trace`` (open
+``bundle["trace"]`` at https://ui.perfetto.dev). It is served live at ``/debug/bundle``
 (telemetry/exposition.py) and on demand via ``make bundle``.
 
 Threading: the recorder is **lock-annotated** shared state (spans are
@@ -376,6 +378,20 @@ def capture_bundle(
     def _clock():
         return aux.clock.snapshot() if aux is not None else {}
 
+    def _history():
+        # the down-sampled hour before the trigger: the installed
+        # process history ring (telemetry/history.py), folded once so
+        # the open second lands in the capture. installed_store never
+        # creates — a process without a history plane bundles None,
+        # which is a disclosed absence, not an empty ring.
+        from . import history as history_mod
+
+        store = history_mod.installed_store()
+        if store is None:
+            return None
+        store.fold(force=True)
+        return store.export_ring(window_s=3600.0)
+
     def _trace():
         from . import timeline as timeline_mod
 
@@ -399,6 +415,7 @@ def capture_bundle(
         "executors": _guarded(_executors, errors, "executors"),
         "device": _guarded(_device, errors, "device"),
         "clock_offsets": _guarded(_clock, errors, "clock_offsets"),
+        "history": _guarded(_history, errors, "history"),
         "trace": _guarded(_trace, errors, "trace"),
     }
     if extra:
@@ -441,6 +458,7 @@ def summarize_bundle(bundle: Dict[str, Any]) -> Dict[str, Any]:
         name: st.get("state_name")
         for name, st in (alerts.get("states") or {}).items()
     }
+    hist = bundle.get("history") or {}
     return {
         "captured": True,
         "trigger": dict(bundle.get("trigger", {})),
@@ -448,6 +466,8 @@ def summarize_bundle(bundle: Dict[str, Any]) -> Dict[str, Any]:
         "alert_states": states,
         "trace_events": len((bundle.get("trace") or {}).get(
             "traceEvents", ())),
+        "history_series": int(hist.get("series", 0)),
+        "history_window_s": hist.get("window_s"),
         "section_errors": bundle.get("section_errors", {}),
     }
 

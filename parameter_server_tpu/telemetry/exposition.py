@@ -7,6 +7,10 @@ port 0 test-friendly, clean join on shutdown) serving
 
 - ``/metrics`` — Prometheus text of the node-labeled cluster aggregate
   (telemetry/aggregate.py), text-format escaping included;
+- ``/metrics/history`` — JSON range query over the history plane
+  (telemetry/history.py): ``?name=<metric>[&window=600][&resolution=10]
+  [&q=0.99][&labels={"k":"v"}]`` returns this node's ring cells plus
+  every shipped per-node ring (staleness disclosed per node);
 - ``/healthz`` — JSON heartbeat + recovery-coordinator state; **non-200
   (503)** while any shard is dead or its metric reports are stale;
 - ``/debug/snapshot`` — JSON registry export + cluster view + alert
@@ -37,14 +41,59 @@ from . import registry as telemetry_registry
 CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def _parse_history_query(raw_path: str):
+    """``/metrics/history`` query string → (params dict, error string).
+
+    Recognized params: ``name`` (required), ``window`` (seconds,
+    default 600), ``resolution`` (seconds, optional — the store snaps
+    to the coarsest level that still covers the window otherwise),
+    ``q`` (quantile in (0, 1], histograms only), ``labels`` (a JSON
+    object; subset match). A malformed value is a 400, not a guess —
+    mid-incident a silently-defaulted window is worse than an error.
+    """
+    from urllib.parse import parse_qs, urlsplit
+
+    try:
+        qs = parse_qs(urlsplit(raw_path).query)
+    except ValueError as e:
+        return None, f"bad query string: {e}"
+    name = (qs.get("name") or [""])[0].strip()
+    if not name:
+        return None, "missing required query param: name"
+    params: dict = {"name": name, "window_s": 600.0}
+    try:
+        if "window" in qs:
+            params["window_s"] = float(qs["window"][0])
+        if "resolution" in qs:
+            params["resolution"] = float(qs["resolution"][0])
+        if "q" in qs:
+            params["q"] = float(qs["q"][0])
+    except ValueError as e:
+        return None, f"bad numeric query param: {e}"
+    if params["window_s"] <= 0:
+        return None, "window must be > 0"
+    if "labels" in qs:
+        try:
+            labels = json.loads(qs["labels"][0])
+        except ValueError as e:
+            return None, f"labels must be a JSON object: {e}"
+        if not isinstance(labels, dict):
+            return None, "labels must be a JSON object"
+        params["labels"] = {str(k): str(v) for k, v in labels.items()}
+    return params, None
+
+
 class ExpositionServer:
     """One daemon HTTP server over three content callables.
 
     ``metrics_fn() -> str`` (Prometheus text), ``health_fn() ->
     (ok, detail_dict)`` (503 when not ok), ``snapshot_fn() -> dict``
-    (JSON). ``port=0`` binds an ephemeral port (read :attr:`port` after
-    :meth:`start`); :meth:`close` shuts the server down and JOINS the
-    serving thread — no leaks for the tier-1 suite's thread guard.
+    (JSON). ``history_fn(params) -> dict`` (optional) answers
+    ``/metrics/history`` range queries with the parsed query params
+    (see :func:`_parse_history_query`); absent → 404. ``port=0`` binds
+    an ephemeral port (read :attr:`port` after :meth:`start`);
+    :meth:`close` shuts the server down and JOINS the serving thread —
+    no leaks for the tier-1 suite's thread guard.
     """
 
     def __init__(
@@ -55,11 +104,13 @@ class ExpositionServer:
         host: str = "127.0.0.1",
         port: int = 0,
         bundle_fn: Optional[Callable[[], dict]] = None,
+        history_fn: Optional[Callable[[dict], dict]] = None,
     ):
         self.metrics_fn = metrics_fn
         self.health_fn = health_fn
         self.snapshot_fn = snapshot_fn
         self.bundle_fn = bundle_fn
+        self.history_fn = history_fn
         self.host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -93,6 +144,22 @@ class ExpositionServer:
                     if path == "/metrics":
                         body = outer.metrics_fn().encode("utf-8")
                         self._send(200, body, CONTENT_TYPE_METRICS)
+                    elif path == "/metrics/history":
+                        if outer.history_fn is None:
+                            self._send(
+                                404, b"no history source\n", "text/plain"
+                            )
+                            return
+                        params, err = _parse_history_query(self.path)
+                        if err is not None:
+                            self._send(
+                                400, (err + "\n").encode(), "text/plain"
+                            )
+                            return
+                        body = (json.dumps(
+                            outer.history_fn(params), default=str
+                        ) + "\n").encode()
+                        self._send(200, body, "application/json")
                     elif path == "/healthz":
                         ok, detail = (
                             outer.health_fn()
@@ -125,8 +192,8 @@ class ExpositionServer:
                     elif path == "/":
                         body = (
                             b"parameter_server_tpu metrics endpoint\n"
-                            b"/metrics /healthz /debug/snapshot "
-                            b"/debug/bundle\n"
+                            b"/metrics /metrics/history?name=<metric> "
+                            b"/healthz /debug/snapshot /debug/bundle\n"
                         )
                         self._send(200, body, "text/plain; charset=utf-8")
                     else:
@@ -264,8 +331,17 @@ def expose_cluster(
     )
 
     def snapshot() -> dict:
+        from . import history as history_mod
         from . import learning as learning_mod
 
+        try:
+            hist = {
+                "local": history_mod.default_store().snapshot(),
+                "cluster": aux.cluster.history_snapshot(),
+            }
+        except Exception as e:  # noqa: BLE001 — the snapshot must
+            # render even if the history plane is mid-teardown
+            hist = {"error": f"{type(e).__name__}: {e}"}
         return {
             "node_id": aux.node_id,
             "metrics": telemetry_registry.default_registry().snapshot(),
@@ -277,7 +353,34 @@ def expose_cluster(
             # divergence accounting (doc/OBSERVABILITY.md "Learning
             # truth plane")
             "learning": learning_mod.snapshot_all(),
+            # retention config + ring occupancy for this node, plus
+            # per-node shipped-ring ages (doc/OBSERVABILITY.md
+            # "History plane")
+            "history": hist,
             "timeline_tail": _timeline_tail(),
+        }
+
+    def history_query(params: dict) -> dict:
+        from . import history as history_mod
+
+        store = history_mod.default_store()
+        store.fold()  # capture the open second before answering
+        local = store.query(
+            params["name"],
+            labels=params.get("labels"),
+            window_s=params["window_s"],
+            resolution=params.get("resolution"),
+            q=params.get("q"),
+        )
+        cluster = aux.cluster.history_query(
+            params["name"],
+            labels=params.get("labels"),
+            window_s=params["window_s"],
+        )
+        return {
+            "query": params,
+            "local": local,
+            "nodes": cluster["nodes"],
         }
 
     srv = ExpositionServer(
@@ -287,6 +390,7 @@ def expose_cluster(
         host=host,
         port=port,
         bundle_fn=aux.bundle,
+        history_fn=history_query,
     ).start()
     srv.aux = aux  # for close_cluster / callers that need the runtime
     return srv

@@ -655,6 +655,57 @@ def alert_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "alert state transitions, by rule and destination state",
             labelnames=("rule", "to"),
         ),
+        # meta-monitoring (who watches the watcher): the evaluator's
+        # own duration and schedule lag — the alert_evaluator_starved
+        # default rule fires on the lag gauge
+        "eval_seconds": reg.ensure_histogram(
+            "ps_alert_eval_seconds",
+            "wall seconds one alert-evaluation tick took (sample + "
+            "every rule's compute + state advance)",
+        ),
+        "eval_lag": reg.ensure_gauge(
+            "ps_alert_eval_lag_seconds",
+            "seconds the latest evaluation started BEHIND its expected "
+            "period (gap since the previous tick minus the period, "
+            "floored at 0) — sustained lag means the evaluator thread "
+            "is starving and alerts are going blind",
+        ),
+    }
+
+
+def history_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """History plane (telemetry/history.py): the multi-resolution ring
+    cascade's own accounting — fold cost, series occupancy, and the
+    cardinality escape valve. ``dropped`` is the loud signal that a
+    label explosion hit the caps: rings stay bounded, the overflow
+    series lose history (never memory)."""
+    return {
+        "folds": reg.ensure_counter(
+            "ps_history_folds_total",
+            "registry-state folds landed in the ring cascade",
+        ),
+        "fold_seconds": reg.ensure_histogram(
+            "ps_history_fold_seconds",
+            "wall seconds one history fold took (read the registry "
+            "export + update every resolution level)",
+        ),
+        "series": reg.ensure_gauge(
+            "ps_history_series",
+            "series currently tracked by the ring cascade",
+        ),
+        "dropped": reg.ensure_counter(
+            "ps_history_dropped_series_total",
+            "series REFUSED by the cardinality caps (per-metric or "
+            "process-wide), by metric — each distinct series counts "
+            "once; nonzero means some label set has no history",
+            labelnames=("metric",),
+        ),
+        "collect_seconds": reg.ensure_gauge(
+            "ps_registry_collect_seconds",
+            "wall seconds the registry's last collector pass took "
+            "(every snapshot/scrape runs it; the history fold "
+            "publishes the registry's own measurement)",
+        ),
     }
 
 
@@ -863,6 +914,7 @@ INSTRUMENT_FAMILIES = (
     node_instruments,
     cluster_instruments,
     alert_instruments,
+    history_instruments,
     blackbox_instruments,
     bundle_instruments,
     app_instruments,

@@ -432,6 +432,11 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._instruments: Dict[str, Instrument] = {}
         self._collectors: List[object] = []  # weakref.ref / WeakMethod
+        #: wall seconds the last collect() pass took — the history
+        #: plane publishes it as ``ps_registry_collect_seconds`` (meta-
+        #: monitoring: who watches the watcher). Single float, atomic
+        #: in CPython; None until the first pass runs.
+        self.last_collect_s: Optional[float] = None
 
     def add_collector(self, fn) -> None:
         """Register a flush hook (bound methods are weakly referenced)."""
@@ -447,6 +452,9 @@ class MetricsRegistry:
 
     def collect(self) -> None:
         """Run every live collector; prune the dead ones."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self._lock:
             refs = list(self._collectors)
         dead = []
@@ -459,6 +467,7 @@ class MetricsRegistry:
                 fn()
             except Exception:
                 pass  # a broken producer must not poison the snapshot
+        self.last_collect_s = _time.perf_counter() - t0
         if dead:
             with self._lock:
                 self._collectors = [
@@ -551,14 +560,18 @@ class MetricsRegistry:
             }
         return out
 
-    def export_state(self) -> Dict[str, dict]:
+    def export_state(self, collect: bool = True) -> Dict[str, dict]:
         """Raw serializable state of every instrument — the unit a node
         ships over the message plane for cluster aggregation
         (telemetry/aggregate.py). Plain dicts/lists/floats only, so the
         export survives the restricted wire unpickler and ``json.dumps``
         alike. Histograms keep raw bucket counts (mergeable); the
-        derived-percentile view stays in :meth:`snapshot`."""
-        self.collect()
+        derived-percentile view stays in :meth:`snapshot`.
+        ``collect=False`` skips the collector pass — the history fold
+        (telemetry/history.py) runs AS a collector and reading back
+        through :meth:`collect` would recurse."""
+        if collect:
+            self.collect()
         return {
             inst.name: inst._export_decl()
             for inst in self._sorted_instruments()

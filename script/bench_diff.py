@@ -97,6 +97,12 @@ METADATA_SECTIONS = frozenset(
         # throughput; banding a loss trajectory as perf would flag
         # every data/seed change as a regression
         "learning",
+        # the history plane (telemetry/history.py): the fold-hook
+        # overhead A/B quotes its own paired medians, the store
+        # snapshot is retention config, and live_drift is the run
+        # judging ITSELF (tail vs its own baseline) — banding any of
+        # it cross-run would double-count the e2e metric it rides on
+        "history",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

@@ -69,6 +69,7 @@ SCOPE = (
     "parameter_server_tpu/telemetry/blackbox.py",
     "parameter_server_tpu/telemetry/device.py",
     "parameter_server_tpu/telemetry/exposition.py",
+    "parameter_server_tpu/telemetry/history.py",
     "parameter_server_tpu/telemetry/learning.py",
     "parameter_server_tpu/utils/concurrent.py",
     "parameter_server_tpu/parameter/parameter.py",

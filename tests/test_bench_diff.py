@@ -222,6 +222,29 @@ class TestDiffMath:
         assert reported
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_history_section_is_metadata_never_banded(self):
+        """The history plane's `history` section quotes the fold-hook
+        A/B's own paired medians, the store's retention config, and
+        live_drift — the run judging ITSELF against its own baseline.
+        Banding any of it cross-run would double-count the e2e metric
+        it rides on; a horror-valued section must not flag."""
+        assert "history" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["history"] = {  # drift/overhead horrors, all ignored
+            "ab": {"ratio_median": 1e9, "fold_us_median": 1e12},
+            "store": {"series": 1e9, "series_dropped": 1e9},
+            "live_drift": {"drifting": True, "ratio": 0.01,
+                           "verdict": "drift-down"},
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):
