@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint mesh-test ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench rebalance-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -50,8 +50,21 @@ smoke: native
 pslint:
 	python script/pslint/cli.py
 
-# all static checks (currently = the pslint suite)
-lint: pslint
+# the multi-device partitioning suite on a FORCED 8-device CPU
+# platform: partitioner spec resolution, mesh auto-shaping (8 -> 4x2,
+# never 3x2-with-2-idle), the sharded-table parity tests, and the
+# live-rebalance / migration drills — multi-chip paths exercised on
+# every dev box, not only when silicon appears (tier-1: the same
+# tests run under tests/ via conftest's forced device count)
+mesh-test:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m pytest tests/test_partition.py tests/test_rebalance.py \
+		-q -p no:cacheprovider
+
+# all static checks + the multi-device partitioning suite (mesh-test
+# rides along so layout changes can't pass lint while breaking the
+# 8-device paths)
+lint: pslint mesh-test
 
 # alias: the telemetry-catalog pass alone (duplicate / non-snake_case
 # names, naming drift, unparseable exposition; also a tier-1 test in
@@ -117,6 +130,19 @@ serve-bench: native
 # embedded in every bench.py record under "recovery")
 chaos-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks recovery_drill
+
+# heat-driven live-repartitioning drill (components bench,
+# doc/PERFORMANCE.md "Declarative partitioning"): a heat-skewed
+# workload drives the shipped shard_imbalance alert to firing, the
+# RebalanceController recomputes slot ownership from the measured
+# hot-slot/load-share tables and migrates rows online through the
+# consistent-snapshot machinery — serve stream completes every request
+# across the move, post-rebalance imbalance re-measured below the
+# alert threshold, post-migration table bit-identical to an
+# undisturbed run (8 forced CPU devices, deterministic)
+rebalance-bench:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m parameter_server_tpu.benchmarks rebalance
 
 # learning truth plane probe (components bench, doc/OBSERVABILITY.md
 # "Learning truth plane"): a bounded-delay training run through the

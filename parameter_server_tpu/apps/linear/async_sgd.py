@@ -42,6 +42,7 @@ from ...learner.sgd import ISGDCompNode, ISGDScheduler, SGDProgress
 from ...ops.kv_ops import localize, slot_sentinel, valid_slots
 from ...ops.wire_codec import decode_u24
 from ...parallel import mesh as meshlib
+from ...parallel import partition as partlib
 from ...parallel.mesh import DATA_AXIS, SERVER_AXIS
 from ...system.message import Task
 from ...utils import evaluation
@@ -1035,9 +1036,9 @@ def make_train_step_ell(
         return new_state, metrics
 
     def state_spec(state):
-        return jax.tree.map(
-            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-        )
+        # declared in parallel/partition.py — one spec rule for every
+        # updater-state leaf, fitted to rank (scalars replicate)
+        return partlib.state_partition_spec(state)
 
     def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
@@ -1164,9 +1165,8 @@ def _make_stream_mini_step(
 
 
 def _bits_state_spec(state):
-    return jax.tree.map(
-        lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-    )
+    # declared in parallel/partition.py (same rule as state_spec)
+    return partlib.state_partition_spec(state)
 
 
 def make_train_step_ell_bits(
@@ -1429,9 +1429,9 @@ def make_train_step_hashed(
         return new_state, metrics
 
     def state_spec(state):
-        return jax.tree.map(
-            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-        )
+        # declared in parallel/partition.py — one spec rule for every
+        # updater-state leaf, fitted to rank (scalars replicate)
+        return partlib.state_partition_spec(state)
 
     def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
@@ -1669,9 +1669,9 @@ def make_train_step_scan(
         return new_state, metrics
 
     def state_spec(state):
-        return jax.tree.map(
-            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-        )
+        # declared in parallel/partition.py — one spec rule for every
+        # updater-state leaf, fitted to rank (scalars replicate)
+        return partlib.state_partition_spec(state)
 
     def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
@@ -1847,9 +1847,9 @@ def make_train_step(
         )
 
     def state_spec(state):
-        return jax.tree.map(
-            lambda leaf: P(SERVER_AXIS) if leaf.ndim >= 1 else P(), state
-        )
+        # declared in parallel/partition.py — one spec rule for every
+        # updater-state leaf, fitted to rank (scalars replicate)
+        return partlib.state_partition_spec(state)
 
     def step_impl(live_state, pull_state, batch, seed=np.uint32(0)):
         specs = state_spec(live_state)
@@ -3208,12 +3208,8 @@ class AsyncSGDWorker(ISGDCompNode):
                         leaf.dtype,
                     )
                     leaf = np.concatenate([leaf, pad])
-            return jax.device_put(
-                leaf,
-                NamedSharding(
-                    self.mesh, P(SERVER_AXIS) if leaf.ndim >= 1 else P()
-                ),
-            )
+            spec = partlib.state_partition_spec(leaf)
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
 
         self.state = jax.tree.map(fit, snap["state"])
         self._pull_state = self.state
@@ -3237,7 +3233,8 @@ class AsyncSGDWorker(ISGDCompNode):
             lambda leaf: jax.device_put(
                 np.asarray(leaf),
                 NamedSharding(
-                    self.mesh, P(SERVER_AXIS) if np.ndim(leaf) >= 1 else P()
+                    self.mesh,
+                    partlib.state_partition_spec(np.asarray(leaf)),
                 ),
             ),
             tree["state"],

@@ -2909,3 +2909,301 @@ def roofline_probe(smoke: bool = False) -> None:
                    tl["frac_of_hbm_peak"] * 100.0, "pct")
         if tl.get("mfu") is not None:
             report(f"{name}_mfu_pct", tl["mfu"] * 100.0, "pct")
+
+
+def rebalance_drill(smoke: bool = False) -> dict:
+    """Heat-driven live-repartitioning drill on a forced 8-device mesh
+    (doc/PERFORMANCE.md "Declarative partitioning" — the ISSUE's
+    one-mesh-every-chip acceptance, embedded in MULTICHIP-style records
+    under ``rebalance``).
+
+    The script:
+
+    1. **mesh** — auto-shaping is demonstrated (num_server=3 on 8
+       devices becomes 4x2, never 3x2-with-2-idle) and the drill mesh
+       (1x8: 8 server shards, so the max/mean imbalance ratio CAN
+       exceed the shipped 4.0 threshold) is asserted to use 8/8
+       devices, 0 idle.
+    2. **parity** — a table spanning 2 server shards (4x2) trains
+       bit-identically to the single-shard path (4x1) on the same
+       data-axis width.
+    3. **skew → alert → rebalance** — a live train stream (80% of
+       traffic on one shard's keys) feeds the KeyHeat plane; the
+       measured imbalance rides the ``ps_learning_shard_imbalance``
+       gauge into the SHIPPED ``shard_imbalance`` rule; the attached
+       RebalanceController plans from the hot-slot/load-share tables
+       and migrates rows online through the consistent-snapshot
+       machinery while a ``rebalance.migrate`` delay fault widens the
+       journal window (pushes landing mid-move must journal + replay).
+    4. **verify** — a closed-loop serve stream across the move
+       completes EVERY request (degraded-to-lock-latency allowed,
+       errors not); post-rebalance traffic re-measures imbalance below
+       the alert threshold; the final base-layout table is
+       bit-identical to an undisturbed run; and the live phase compiles
+       nothing new (``recompiles_post_warmup == 0``).
+    """
+    import threading
+    import time as _time
+
+    import jax
+
+    from ..parallel import mesh as meshlib
+    from ..parallel import partition as partlib
+    from ..parameter.kv_vector import KVVector
+    from ..system import faults
+    from ..system.postoffice import Postoffice
+    from ..telemetry import alerts as alerts_mod
+    from ..telemetry import device as _device
+    from ..telemetry import registry as telemetry_registry
+    from ..telemetry.instruments import learning_instruments
+    from ..telemetry.learning import KeyHeat
+
+    n_dev = len(jax.devices())
+    assert n_dev == 8, (
+        f"rebalance drill needs the forced 8-device platform, got "
+        f"{n_dev} (run via `make rebalance-bench`)"
+    )
+    Postoffice.reset()
+    faults.reset()
+    _device.reset()
+
+    # -- 1. mesh: auto-shape demo + the 1x8 drill mesh, 0 idle --------
+    demo = meshlib.make_mesh(num_server=3)  # -> 4x2, never 3x2+2 idle
+    assert demo.devices.size == n_dev, dict(demo.shape)
+    assert dict(demo.shape) == {meshlib.DATA_AXIS: 4, meshlib.SERVER_AXIS: 2}
+    mesh = meshlib.make_mesh(num_data=1, num_server=8)
+    mesh_section = {
+        "devices_total": n_dev,
+        "devices_used": int(mesh.devices.size),
+        "devices_idle": n_dev - int(mesh.devices.size),
+        "shape": {"data": int(mesh.shape[meshlib.DATA_AXIS]),
+                  "server": int(mesh.shape[meshlib.SERVER_AXIS])},
+        "auto_shape_demo": {
+            "requested_server": 3,
+            "chosen": {"data": int(demo.shape[meshlib.DATA_AXIS]),
+                       "server": int(demo.shape[meshlib.SERVER_AXIS])},
+            "devices_idle": n_dev - int(demo.devices.size),
+        },
+    }
+    assert mesh_section["devices_idle"] == 0, mesh_section
+    assert mesh_section["auto_shape_demo"]["devices_idle"] == 0
+
+    k = 4
+    keys = np.arange(48, dtype=np.int64)
+    hot = keys[:8]  # one server shard's key range (slots 0..7 of 64)
+    n_batches = 160 if smoke else 280
+    batch_n = 64
+
+    def mk_batch(i: int):
+        r = np.random.default_rng(1000 + i)
+        pick_hot = r.random(batch_n) < 0.8
+        ks = np.where(
+            pick_hot,
+            r.choice(hot, size=batch_n),
+            r.choice(keys[8:], size=batch_n),
+        ).astype(np.int64)
+        vals = r.normal(size=(batch_n, k)).astype(np.float32)
+        return ks, vals
+
+    batches = [mk_batch(i) for i in range(n_batches)]
+
+    def new_store(name: str, m=mesh) -> KVVector:
+        kv = KVVector(mesh=m, k=k, num_slots=64, hashed=False, name=name)
+        kv.set_keys(0, keys)
+        return kv
+
+    def train(kv: KVVector, bs) -> np.ndarray:
+        for ks, vs in bs:
+            kv.push(kv.request(channel=0), keys=ks, values=vs)
+        kv.executor.wait_all(pop=False)
+        return kv.get_replica()[0]
+
+    # -- 2. >1-server-shard table trains bit-identically to 1-shard ---
+    devs = jax.devices()[:4]
+    single = train(
+        new_store("reb_1shard",
+                  meshlib.make_mesh(num_data=4, num_server=1,
+                                    devices=devs)),
+        batches[:6],
+    )
+    multi = train(
+        new_store("reb_2shard",
+                  meshlib.make_mesh(num_data=4, num_server=2)),
+        batches[:6],
+    )
+    parity_single_multi = single.tobytes() == multi.tobytes()
+    assert parity_single_multi, (
+        "2-server-shard table diverged from the single-shard run"
+    )
+
+    # -- undisturbed reference (doubles as shape warmup for the live
+    # run: push [64,k], pull [48], snapshot/install/replay) -----------
+    ref = new_store("reb_ref")
+    ref_table = train(ref, batches)
+    np.asarray(ref.wait_pull(ref.pull(ref.request(channel=0), keys=keys)))
+    scratch = new_store("reb_scratch")
+    train(scratch, batches[:1])
+    scratch.migrate(np.random.default_rng(2).permutation(64))
+    np.asarray(
+        scratch.wait_pull(
+            scratch.pull(scratch.request(channel=0), keys=keys)
+        )
+    )
+    _device.mark_warmup()
+
+    # -- 3. the live phase: skewed train + serve + alert + controller -
+    kv = new_store("reb_live")
+    heat = KeyHeat(num_slots=kv.num_slots, num_shards=8, top_k=16,
+                   decay_every=1 << 30)
+    ctl = partlib.RebalanceController(kv, heat)
+    reg = telemetry_registry.default_registry()
+    gauge = learning_instruments(reg)["shard_imbalance"]
+    mgr = alerts_mod.AlertManager(alerts_mod.default_rules(),
+                                  registry=reg)
+    transitions = []
+    mgr.add_listener(
+        lambda ev: transitions.append(f"{ev.frm}->{ev.to}")
+        if ev.rule == "shard_imbalance" else None
+    )
+    ctl.attach(mgr)
+    # widen the copy window so the serve/train streams demonstrably
+    # cross the move (journaled + replayed pushes > 0)
+    faults.arm("rebalance.migrate", kind="delay", delay_s=0.25,
+               once=True)
+
+    progress = {"t": 0.0, "acked": 0}
+    serve_stats = {"ok": 0, "failed": 0}
+    stop_serve = threading.Event()
+
+    def serve():
+        while not stop_serve.is_set():
+            try:
+                got = kv.wait_pull(
+                    kv.pull(kv.request(channel=0), keys=keys)
+                )
+                np.asarray(got)
+                serve_stats["ok"] += 1
+            except Exception:
+                serve_stats["failed"] += 1
+            _time.sleep(0.001)
+
+    def trainer():
+        for i, (ks, vs) in enumerate(batches):
+            kv.push(kv.request(channel=0), keys=ks, values=vs)
+            progress["acked"] += 1
+            heat.note(np.asarray(kv.slots(0, ks)))
+            imb = heat.shares().get("imbalance")
+            if imb is not None:
+                gauge.set(imb)
+            progress["t"] = float(i + 1)  # the drill's logical clock
+            _time.sleep(0.002)
+
+    serve_t = threading.Thread(target=serve, name="reb-serve")
+    train_t = threading.Thread(target=trainer, name="reb-train")
+    serve_t.start()
+    train_t.start()
+    # evaluate the shipped rules on the drill's LOGICAL clock (batch
+    # index), so the for_s dwell is deterministic, not host-paced
+    while train_t.is_alive():
+        mgr.evaluate(now=progress["t"])
+        _time.sleep(0.004)
+    train_t.join()
+    mgr.evaluate(now=progress["t"] + 6.0)  # let the alert resolve
+    stop_serve.set()
+    serve_t.join(timeout=30)
+    kv.executor.wait_all(pop=False)
+
+    # -- 4. verify ----------------------------------------------------
+    hist = ctl.history()
+    assert len(hist) == 1, (
+        f"expected exactly one alert-triggered rebalance, got {hist}"
+    )
+    rec = dict(hist[0])
+    assert kv.layout(0) is not None
+    assert rec["journaled_pushes"] > 0 and rec["replayed_pushes"] > 0, (
+        "the move missed the live stream: nothing journaled/replayed "
+        f"({rec})"
+    )
+    post_imb = ctl.refresh_post_imbalance()
+    assert post_imb is not None and post_imb < ctl.threshold, (
+        f"post-rebalance imbalance {post_imb} still over "
+        f"{ctl.threshold}"
+    )
+    assert serve_stats["failed"] == 0 and serve_stats["ok"] > 0, (
+        f"serve stream across the migration broke: {serve_stats}"
+    )
+    live_table = kv.get_replica()[0]
+    bit_identical = live_table.tobytes() == ref_table.tobytes()
+    assert bit_identical, (
+        "post-migration table diverged from the undisturbed run"
+    )
+    dev_snap = _device.snapshot()
+    rpw = dev_snap.get("recompiles_post_warmup")
+    assert rpw == 0, (
+        f"live rebalance phase compiled new programs: {rpw}"
+    )
+
+    return {
+        "mesh": mesh_section,
+        "rebalance": {
+            "alert": {
+                "rule": "shard_imbalance",
+                "threshold": ctl.threshold,
+                "transitions": transitions,
+            },
+            "imbalance_before": rec["imbalance_before"],
+            "predicted_imbalance": rec["predicted_imbalance"],
+            "post_rebalance_imbalance": round(float(post_imb), 4),
+            "rows_moved": rec["rows_moved"],
+            "moves": rec["moves"],
+            "migration_seconds": rec["migration_seconds"],
+            "journaled_pushes": rec["journaled_pushes"],
+            "replayed_pushes": rec["replayed_pushes"],
+            "attempts": rec["attempts"],
+            "barrier_ts": rec["barrier_ts"],
+            "install_ts": rec["install_ts"],
+            "acked_pushes": progress["acked"],
+            "serve": {
+                "requests": serve_stats["ok"] + serve_stats["failed"],
+                "completed_ok": serve_stats["ok"],
+                "failed": serve_stats["failed"],
+            },
+            "sharded_vs_single_bit_identical": parity_single_multi,
+            "trajectory_bit_identical": bit_identical,
+            "recompiles_post_warmup": rpw,
+        },
+        "device": {
+            "recompiles_post_warmup": rpw,
+            "backend": dev_snap.get("backend"),
+            "device_kind": dev_snap.get("device_kind"),
+        },
+    }
+
+
+@benchmark("rebalance")
+def rebalance_perf(smoke: bool = False) -> None:
+    """`make rebalance-bench`: the heat-driven live-repartitioning
+    acceptance drill. Every contract is asserted inside
+    :func:`rebalance_drill`; this wrapper reports the headline numbers
+    and writes the full record where ``PS_REBALANCE_OUT`` points
+    (default ``<tmp>/ps_rebalance.json``) for MULTICHIP-style capture."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    out_path = _os.environ.get("PS_REBALANCE_OUT") or _os.path.join(
+        _tempfile.gettempdir(), "ps_rebalance.json"
+    )
+    out = rebalance_drill(smoke)
+    reb = out["rebalance"]
+    report("rebalance_imbalance_before", reb["imbalance_before"], "ratio")
+    report("rebalance_post_imbalance", reb["post_rebalance_imbalance"],
+           "ratio")
+    report("rebalance_rows_moved", reb["rows_moved"], "rows")
+    report("rebalance_migration_seconds", reb["migration_seconds"], "s")
+    report("rebalance_replayed_pushes", reb["replayed_pushes"], "pushes")
+    # serve failures are asserted == 0 inside the drill and recorded in
+    # the JSON record; report the completions (always > 0) instead
+    report("rebalance_serve_ok", reb["serve"]["completed_ok"], "requests")
+    with open(out_path, "w") as f:
+        _json.dump({"rebalance_record": out}, f, indent=2)

@@ -47,8 +47,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..utils.compat import shard_map
 
 from ..parallel.mesh import DATA_AXIS, SERVER_AXIS
+from ..parallel.partition import BATCH_SPEC, REPLICATED_SPEC, TABLE_SPEC
 from ..telemetry import device as _device
 from ..telemetry.instruments import cached_kvops_instruments as _tel
+
+
+def index_spec(batch_sharded: bool) -> P:
+    """The slot-index spec: per-worker key sets ride the data axis,
+    replicated otherwise (spec constants owned by parallel/partition.py
+    — the declarative home of every layout here)."""
+    return BATCH_SPEC if batch_sharded else REPLICATED_SPEC
 
 
 def localize(idx: jnp.ndarray, shard: int):
@@ -98,7 +106,7 @@ def _pull_impl(table, idx, *, mesh: Mesh, batch_sharded: bool = True):
     p_total, _ = table.shape
     n_server = mesh.shape[SERVER_AXIS]
     shard = p_total // n_server
-    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+    idx_spec = index_spec(batch_sharded)
 
     def local(tbl, ix):
         rel, ok = localize(ix, shard)
@@ -108,7 +116,7 @@ def _pull_impl(table, idx, *, mesh: Mesh, batch_sharded: bool = True):
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(SERVER_AXIS, None), idx_spec),
+        in_specs=(TABLE_SPEC, idx_spec),
         out_specs=idx_spec,
     )(table, idx)
 
@@ -166,15 +174,15 @@ def _push_impl(
     n_server = mesh.shape[SERVER_AXIS]
     n_data = mesh.shape[DATA_AXIS]
     shard = p_total // n_server
-    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+    idx_spec = index_spec(batch_sharded)
     combined = batch_sharded and combine_data and n_data > 1
     local = _push_local_fn(shard, n_data, average, combined)
 
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(SERVER_AXIS, None), idx_spec, idx_spec),
-        out_specs=P(SERVER_AXIS, None),
+        in_specs=(TABLE_SPEC, idx_spec, idx_spec),
+        out_specs=TABLE_SPEC,
     )(table, idx, vals)
 
 
@@ -238,7 +246,7 @@ def _push_pull_impl(
     n_server = mesh.shape[SERVER_AXIS]
     n_data = mesh.shape[DATA_AXIS]
     shard = p_total // n_server
-    idx_spec = P(DATA_AXIS) if batch_sharded else P()
+    idx_spec = index_spec(batch_sharded)
     combined = batch_sharded and combine_data and n_data > 1
     push_local = _push_local_fn(shard, n_data, average, combined)
 
@@ -251,8 +259,8 @@ def _push_pull_impl(
     return shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(SERVER_AXIS, None), idx_spec, idx_spec, idx_spec),
-        out_specs=(P(SERVER_AXIS, None), idx_spec),
+        in_specs=(TABLE_SPEC, idx_spec, idx_spec, idx_spec),
+        out_specs=(TABLE_SPEC, idx_spec),
     )(table, idx, vals, pull_idx)
 
 

@@ -60,13 +60,35 @@ def make_mesh(
     """
     devs = list(devices if devices is not None else jax.devices())
     n = len(devs)
+    log = logging.getLogger(__name__)
     if num_data is None:
-        num_data = max(1, n // num_server)
+        # auto-shape must factor the FULL device count: the old
+        # ``n // num_server`` rounding made num_server=3 on 8 devices a
+        # 2x3 mesh with 2 chips idle. When the requested server count
+        # does not divide n, step it down to the largest divisor of n
+        # that still fits — 8 devices never run 6-wide.
+        num_server = max(1, min(int(num_server), n))
+        if n % num_server != 0:
+            adjusted = next(
+                d for d in range(num_server, 0, -1) if n % d == 0
+            )
+            log.warning(
+                "auto-shape: %d server shards do not divide %d devices; "
+                "using %d server shards (largest divisor <= requested) "
+                "so no chip idles",
+                num_server, n, adjusted,
+            )
+            num_server = adjusted
+        num_data = n // num_server
+        log.info(
+            "auto-shaped mesh %dx%d (data x server) over %d devices, 0 idle",
+            num_data, num_server, n,
+        )
     need = num_data * num_server
     if need > n:
         raise ValueError(f"mesh {num_data}x{num_server} needs {need} > {n} devices")
     if need < n:
-        logging.getLogger(__name__).warning(
+        log.warning(
             "mesh %dx%d leaves %d of %d devices idle",
             num_data, num_server, n - need, n,
         )
@@ -78,8 +100,11 @@ def make_mesh(
 
 def table_sharding(mesh: Mesh) -> NamedSharding:
     """Parameter tables: sharded by key range over the server axis,
-    replicated over data."""
-    return NamedSharding(mesh, P(SERVER_AXIS, None))
+    replicated over data. Resolved through the mesh's (cached)
+    declarative partitioner — parallel/partition.py owns the spec."""
+    from . import partition  # deferred: partition imports our axis names
+
+    return partition.for_mesh(mesh).table_sharding()
 
 
 def init_sharded(init_fn, mesh: Mesh, axis: str = SERVER_AXIS):
@@ -95,12 +120,12 @@ def init_sharded(init_fn, mesh: Mesh, axis: str = SERVER_AXIS):
     (~23 MB/s through the tunnel). jit + out_shardings writes zeros/
     random values straight into the sharded buffers; on-device PRNG
     (jax.random.*) inside ``init_fn`` stays device-resident too."""
+    from . import partition
+
     shapes = jax.eval_shape(init_fn)
     shardings = jax.tree.map(
         lambda s: NamedSharding(
-            mesh,
-            P(axis, *([None] * (len(s.shape) - 1)))
-            if len(s.shape) >= 1 else P(),
+            mesh, partition.fit_spec(P(axis), len(s.shape))
         ),
         shapes,
     )
@@ -109,11 +134,17 @@ def init_sharded(init_fn, mesh: Mesh, axis: str = SERVER_AXIS):
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Example batches: sharded over the data axis, replicated over server."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Example batches: sharded over the data axis, replicated over
+    server (spec owned by parallel/partition.py)."""
+    from . import partition
+
+    return partition.for_mesh(mesh).batch_sharding()
+
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    from . import partition
+
+    return partition.for_mesh(mesh).replicated()
 
 
 def num_servers(mesh: Mesh) -> int:

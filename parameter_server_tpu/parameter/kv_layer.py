@@ -32,10 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
-from ..parallel import mesh as meshlib
-from ..parallel.mesh import SERVER_AXIS
+from ..parallel import partition as partlib
 from ..system.message import Task
 from .parameter import Parameter
 
@@ -72,19 +71,14 @@ class KVLayer(Parameter):
         self.partition_thr = int(partition_thr)
         self.updater = updater or SGDUpdater()
         self.donate = bool(donate)
+        # placement policy lives in the declarative partitioner now
+        # (parallel/partition.py layer_sharding) — resolved once per mesh
+        self.partitioner = partlib.for_mesh(mesh)
         self.layers: Dict[object, jax.Array] = {}
         self._update_fns: Dict[object, Callable] = {}
 
     def _sharding(self, shape) -> NamedSharding:
-        size = int(np.prod(shape))
-        n_server = meshlib.num_servers(self.mesh)
-        if size >= self.partition_thr:
-            for dim, d in enumerate(shape):
-                if d % n_server == 0:
-                    spec = [None] * len(shape)
-                    spec[dim] = SERVER_AXIS
-                    return NamedSharding(self.mesh, P(*spec))
-        return meshlib.replicated(self.mesh)
+        return self.partitioner.layer_sharding(shape, self.partition_thr)
 
     def init_layer(self, key, shape, dtype=jnp.float32) -> jax.Array:
         arr = self.updater.init(key, shape, dtype)

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.kv_ops import localize
 from ..parallel import mesh as meshlib
+from ..parallel import partition as partlib
 from ..parallel.mesh import SERVER_AXIS
 from ..system.message import Task
 from .parameter import KeyDirectory, Parameter, pad_slots
@@ -105,7 +106,10 @@ class KVMap(Parameter):
             keys=keys,
             hashed=is_hashed,
         )
-        sharding = meshlib.table_sharding(mesh)
+        # resolved ONCE through the mesh's declarative partitioner
+        # (parallel/partition.py owns the table spec)
+        self.partitioner = partlib.for_mesh(mesh)
+        sharding = self.partitioner.table_sharding()
         self.state: Dict[str, jax.Array] = {
             name_: jax.device_put(arr, sharding)
             for name_, arr in entry.init(self.num_slots, self.k).items()
@@ -131,7 +135,12 @@ class KVMap(Parameter):
                 state,
             )
 
-        state_specs = {k_: P(SERVER_AXIS) for k_ in self.state}
+        # declared, not hand-built: the updater-state spec tree is
+        # the partitioner's one rule (every array leaf row-sharded
+        # over the server key ranges)
+        state_specs = partlib.state_partition_spec(
+            {k_: self.state[k_] for k_ in self.state}
+        )
 
         # the store owns self.state exclusively and replaces it on every
         # push, so the state buffers are donated: the entry update runs
@@ -211,7 +220,7 @@ class KVMap(Parameter):
         return {k_: np.asarray(v) for k_, v in self.state.items()}
 
     def set_replica(self, snapshot: dict) -> None:
-        sharding = meshlib.table_sharding(self.mesh)
+        sharding = self.partitioner.table_sharding()
         self.state = {
             k_: jax.device_put(jnp.asarray(v), sharding) for k_, v in snapshot.items()
         }

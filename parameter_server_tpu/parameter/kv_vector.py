@@ -28,7 +28,8 @@ across later pushes. See doc/PERFORMANCE.md "Donation rules".
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +39,7 @@ from ..utils import file as psfile
 
 from ..ops import kv_ops
 from ..parallel import mesh as meshlib
+from ..parallel import partition as partlib
 from ..system.message import Task
 from .parameter import KeyDirectory, Parameter, pad_slots
 
@@ -48,6 +50,20 @@ class _Channel:
         self.table = table
         self.key: Optional[np.ndarray] = None  # last key set (ref data_[chl].key)
         self.buffers: Dict[int, jax.Array] = {}  # ts -> staged pushes
+        # -- live-migration state (KVVector.migrate) --
+        # remap_lock serializes slot-resolution+submit against a
+        # migration's install+directory-flip: every push/pull is
+        # atomically either fully-before (old slots, ts < install) or
+        # fully-after (new slots, ts > install) the layout change.
+        self.remap_lock = threading.Lock()
+        #: open push journal while a migration is snapshotting:
+        #: (ts, slots, vals) triples; pushes past the snapshot barrier
+        #: replay onto the migrated image in ts order
+        self.journal: Optional[List[Tuple[int, np.ndarray, np.ndarray]]] = None  # guarded-by: remap_lock
+        #: composed base-slot → current-slot permutation (None until the
+        #: first migration); snapshots store BASE layout through it
+        self.perm: Optional[np.ndarray] = None  # guarded-by: remap_lock
+        self.migrations = 0  # guarded-by: remap_lock
 
 
 class KVVector(Parameter):
@@ -78,7 +94,19 @@ class KVVector(Parameter):
         self.num_slots_config = int(num_slots)
         self.num_slots = pad_slots(num_slots, meshlib.num_servers(mesh))
         self.hashed = hashed
+        # the table spec resolves ONCE per store through the mesh's
+        # declarative partitioner (parallel/partition.py) — no more
+        # per-callsite NamedSharding construction
+        self.partitioner = partlib.for_mesh(mesh)
+        self._table_sharding = self.partitioner.table_sharding()
         self._channels: Dict[int, _Channel] = {}
+        # serializes migrations (and consistent snapshots against them)
+        self._migration_lock = threading.Lock()
+        #: bumped by note_external_restore() BEFORE a recovery install
+        #: is submitted; an in-flight migration whose snapshot predates
+        #: the bump discards its image and re-snapshots
+        self._restore_generation = 0  # guarded-by: _gen_lock
+        self._gen_lock = threading.Lock()
 
     # -- channel management (ref operator[]/Clear) --
 
@@ -100,7 +128,7 @@ class KVVector(Parameter):
 
     def _zeros(self) -> jax.Array:
         arr = jnp.zeros((self.num_slots, self.k), self.dtype)
-        return jax.device_put(arr, meshlib.table_sharding(self.mesh))
+        return jax.device_put(arr, self._table_sharding)
 
     def set_keys(self, ch: int, keys: np.ndarray) -> None:
         """Install an exact ordered key set for a channel (ref: the worker
@@ -114,8 +142,14 @@ class KVVector(Parameter):
         ``channel(ch).key``."""
         c = self.channel(ch)
         keys = np.unique(np.asarray(keys, dtype=np.int64))
-        c.directory = KeyDirectory(self.num_slots, keys=keys, hashed=False)
-        c.key = keys
+        with c.remap_lock:
+            directory = KeyDirectory(self.num_slots, keys=keys, hashed=False)
+            if c.perm is not None:
+                # a rebuilt directory must keep routing into the
+                # migrated layout
+                directory.set_remap(c.perm)
+            c.directory = directory
+            c.key = keys
 
     # -- push/pull --
 
@@ -134,17 +168,25 @@ class KVVector(Parameter):
         """Async pull; returns the timestamp. Result via ``wait_pull``."""
         ch = task.key_channel
         c = self.channel(ch)
-        if slots is None:
-            assert keys is not None
-            c.key = np.asarray(keys, dtype=np.int64)
-            slots = self.slots(ch, keys)
+        # slot-resolution + submit are atomic against a live migration
+        # (remap_lock): a pull is either fully-before the layout flip
+        # (old slots, runs before the install step) or fully-after —
+        # reads stay correct mid-migration, they never error
+        with c.remap_lock:
+            if slots is None:
+                assert keys is not None
+                c.key = np.asarray(keys, dtype=np.int64)
+                slots = self.slots(ch, keys)
+            resolved = slots
 
-        def step():
-            return kv_ops.pull(c.table, slots, mesh=self.mesh, batch_sharded=False)
+            def step():
+                return kv_ops.pull(
+                    c.table, resolved, mesh=self.mesh, batch_sharded=False
+                )
 
-        return self.instrumented_submit(
-            "pull", ch, len(slots), step, task, callback
-        )
+            return self.instrumented_submit(
+                "pull", ch, len(resolved), step, task, callback
+            )
 
     def wait_pull(self, ts: int) -> jax.Array:
         return self.executor.pop_result(ts)
@@ -160,37 +202,53 @@ class KVVector(Parameter):
         """Async additive push (gradient aggregation); returns timestamp."""
         ch = task.key_channel
         c = self.channel(ch)
-        if slots is None:
-            assert keys is not None
-            slots = self.slots(ch, keys)
-        vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
+        # atomic against a live migration, same contract as pull(); a
+        # push that lands while the migration snapshot is open is also
+        # JOURNALED — if its ts is past the snapshot barrier it replays
+        # onto the migrated image in ts order (doc/ROBUSTNESS.md)
+        with c.remap_lock:
+            if slots is None:
+                assert keys is not None
+                slots = self.slots(ch, keys)
+            resolved = slots
+            vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
 
-        if self.buffer_value and task.time >= 0:
-            # stage into a per-timestamp buffer (ref buffer_[timestamp]);
-            # the channel owns its staging buffers, so they update in
-            # place too (donated) — merge_buffer readers copy on read
-            def step():
-                buf = c.buffers.get(task.time)
-                if buf is None:
-                    buf = self._zeros()
-                c.buffers[task.time] = kv_ops.push_donated(
-                    buf, slots, vals, mesh=self.mesh, batch_sharded=False
+            if self.buffer_value and task.time >= 0:
+                # stage into a per-timestamp buffer (ref
+                # buffer_[timestamp]); the channel owns its staging
+                # buffers, so they update in place too (donated) —
+                # merge_buffer readers copy on read
+                def step():
+                    buf = c.buffers.get(task.time)
+                    if buf is None:
+                        buf = self._zeros()
+                    c.buffers[task.time] = kv_ops.push_donated(
+                        buf, resolved, vals, mesh=self.mesh,
+                        batch_sharded=False,
+                    )
+                    return c.buffers[task.time]
+
+            else:
+
+                def step():
+                    # in-place: the channel owns its table; the previous
+                    # table buffer is consumed (zero-copy contract above)
+                    c.table = kv_ops.push_donated(
+                        c.table, resolved, vals, mesh=self.mesh,
+                        batch_sharded=False,
+                    )
+                    return c.table
+
+            ts = self.instrumented_submit(
+                "push", ch, len(resolved), step, task, callback
+            )
+            if c.journal is not None and not (
+                self.buffer_value and task.time >= 0
+            ):
+                c.journal.append(
+                    (ts, np.asarray(resolved), np.asarray(vals))
                 )
-                return c.buffers[task.time]
-
-        else:
-
-            def step():
-                # in-place: the channel owns its table; the previous
-                # table buffer is consumed (zero-copy contract above)
-                c.table = kv_ops.push_donated(
-                    c.table, slots, vals, mesh=self.mesh, batch_sharded=False
-                )
-                return c.table
-
-        return self.instrumented_submit(
-            "push", ch, len(slots), step, task, callback
-        )
+            return ts
 
     def push_pull(
         self,
@@ -219,24 +277,31 @@ class KVVector(Parameter):
             )
         ch = task.key_channel
         c = self.channel(ch)
-        if slots is None:
-            assert keys is not None
-            slots = self.slots(ch, keys)
-        pull_slots = (
-            None if pull_keys is None else self.slots(ch, pull_keys)
-        )
-        vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
-
-        def step():
-            c.table, pulled = kv_ops.push_pull_donated(
-                c.table, slots, vals, pull_slots,
-                mesh=self.mesh, batch_sharded=False,
+        with c.remap_lock:  # atomic vs live migration (see push/pull)
+            if slots is None:
+                assert keys is not None
+                slots = self.slots(ch, keys)
+            resolved = slots
+            pull_slots = (
+                None if pull_keys is None else self.slots(ch, pull_keys)
             )
-            return pulled
+            vals = jnp.asarray(values, self.dtype).reshape(-1, self.k)
 
-        return self.instrumented_submit(
-            "push_pull", ch, len(slots), step, task, callback
-        )
+            def step():
+                c.table, pulled = kv_ops.push_pull_donated(
+                    c.table, resolved, vals, pull_slots,
+                    mesh=self.mesh, batch_sharded=False,
+                )
+                return pulled
+
+            ts = self.instrumented_submit(
+                "push_pull", ch, len(resolved), step, task, callback
+            )
+            if c.journal is not None:
+                c.journal.append(
+                    (ts, np.asarray(resolved), np.asarray(vals))
+                )
+            return ts
 
     def snapshot(self, ch: int = 0, callback=None) -> int:
         """Async donation-immune copy of the channel table; returns the
@@ -288,15 +353,169 @@ class KVVector(Parameter):
     def set_table(self, ch: int, table: jax.Array) -> None:
         self.channel(ch).table = table
 
+    # -- live migration (heat-driven repartitioning) --
+
+    def _to_base(self, c: _Channel, arr: np.ndarray) -> np.ndarray:
+        """Translate a current-layout host table to BASE (pre-migration)
+        slot order. Snapshots/checkpoints are stored base-layout, so a
+        backup taken before a migration restores correctly after one
+        (set_replica re-applies the live permutation) and bit-parity
+        checks compare layout-independent bytes."""
+        with c.remap_lock:
+            perm = c.perm
+        return arr if perm is None else np.asarray(arr)[perm]
+
+    def layout(self, ch: int = 0) -> Optional[np.ndarray]:
+        """The channel's composed base→current slot permutation (copy),
+        or None while the layout is untouched."""
+        c = self.channel(ch)
+        with c.remap_lock:
+            return None if c.perm is None else c.perm.copy()
+
+    def note_external_restore(self) -> None:
+        """MUST be called before submitting a recovery install
+        (ReplicaManager.recover does): an in-flight ``migrate`` whose
+        snapshot predates this bump discards its stale image and
+        re-snapshots, so a recovery landing mid-migration is never
+        overwritten by pre-recovery bytes."""
+        with self._gen_lock:
+            self._restore_generation += 1
+
+    def _generation(self) -> int:
+        with self._gen_lock:
+            return self._restore_generation
+
+    def _submit_push_locked(self, c: _Channel, ch: int,
+                            slots_np: np.ndarray,
+                            vals_np: np.ndarray) -> int:  # holds-lock: c.remap_lock
+        """Replay one journaled push through the SAME donated push
+        kernel (same shapes → same executable → same accumulation
+        order: the bit-identity contract)."""
+        slots = jnp.asarray(slots_np.astype(np.int32))
+        vals = jnp.asarray(vals_np, self.dtype).reshape(-1, self.k)
+
+        def step():
+            c.table = kv_ops.push_donated(
+                c.table, slots, vals, mesh=self.mesh, batch_sharded=False
+            )
+            return c.table
+
+        return self.instrumented_submit(
+            "push", ch, len(slots_np), step, self.request(channel=ch), None
+        )
+
+    def migrate(self, perm: np.ndarray, ch: int = 0,
+                max_attempts: int = 5) -> dict:
+        """Online slot migration: move rows to the layout ``perm`` (row
+        ``j`` → row ``perm[j]``) WITHOUT stopping the push/pull stream.
+
+        Protocol (the PR 9 consistent-snapshot machinery):
+
+        1. open the channel's push journal, then take a submitted
+           ``snapshot`` copy — its executor timestamp is the barrier
+           that bounds exactly which pushes are in the snapshot;
+        2. permute the snapshot on host into the new layout;
+        3. under ``remap_lock``: submit the install of the permuted
+           image, replay journaled pushes with ts PAST the barrier in
+           timestamp order with translated slots, and flip the
+           directory remap — every concurrent push/pull is atomically
+           fully-before or fully-after the flip (serving degrades to
+           lock/queue latency; it never errors).
+
+        A recovery that lands mid-flight bumps the restore generation
+        (``note_external_restore``) and the migration re-snapshots —
+        recovery wins wholesale, then journal/replay correctness is
+        re-established on the retry (tests/test_rebalance.py composes
+        the two live). Post-migration state is bit-identical to an
+        undisturbed run, compared in base layout.
+        """
+        from ..system import faults
+
+        perm = np.asarray(perm, dtype=np.int64)
+        n = self.num_slots
+        if perm.shape != (n,) or not np.array_equal(
+            np.sort(perm), np.arange(n)
+        ):
+            raise ValueError(
+                "perm must be a bijection over the padded slot "
+                f"capacity ({n})"
+            )
+        c = self.channel(ch)
+        rows_moved = int(np.count_nonzero(perm != np.arange(n)))
+        with self._migration_lock:
+            attempts = 0
+            while True:
+                attempts += 1
+                if attempts > max_attempts:
+                    raise RuntimeError(
+                        "migration could not complete: a recovery "
+                        f"interleaved {max_attempts} times"
+                    )
+                gen0 = self._generation()
+                with c.remap_lock:
+                    c.journal = []
+                barrier_ts = self.snapshot(ch)
+                snap = np.asarray(self.executor.wait(barrier_ts))
+                # fault point: the drill stalls here to widen the
+                # copy window / force the kill to land mid-migration
+                faults.inject("rebalance.migrate")
+                img = np.empty_like(snap)
+                img[perm] = snap
+                with c.remap_lock:
+                    if self._generation() != gen0:
+                        c.journal = None
+                        continue  # stale image: recovery landed first
+                    journal, c.journal = c.journal, None
+                    sharded = jax.device_put(
+                        jnp.asarray(img), self._table_sharding
+                    )
+
+                    def install(t=sharded):
+                        c.table = t
+                        return c.table
+
+                    install_ts = self.submit(
+                        install, self.request(channel=ch)
+                    )
+                    replayed = 0
+                    for ts, slots_np, vals_np in journal:
+                        if ts <= barrier_ts:
+                            continue  # already inside the snapshot
+                        safe = np.minimum(slots_np, n - 1)
+                        new_slots = np.where(
+                            slots_np < n, perm[safe], slots_np
+                        )
+                        self._submit_push_locked(c, ch, new_slots, vals_np)
+                        replayed += 1
+                    c.directory.set_remap(perm)
+                    c.perm = (
+                        perm.copy() if c.perm is None else perm[c.perm]
+                    )
+                    c.migrations += 1
+                    break
+        self.executor.wait_all(pop=False)
+        return {
+            "barrier_ts": barrier_ts,
+            "install_ts": install_ts,
+            "journaled": len(journal),
+            "replayed": replayed,
+            "rows_moved": rows_moved,
+            "attempts": attempts,
+        }
+
     # -- replica hooks --
 
     def get_replica(self) -> dict:
         # drain in-flight pushes (they donate table buffers on the
         # executor thread — a concurrent host read could hit a freshly
-        # deleted buffer), then take host COPIES: the snapshot is immune
-        # to every later donated push
+        # deleted buffer), then take host COPIES in BASE layout: the
+        # snapshot is immune to later donated pushes AND to layout
+        # changes (migrations)
         self.executor.wait_all(pop=False)
-        return {ch: np.asarray(c.table) for ch, c in self._channels.items()}
+        return {
+            ch: self._to_base(c, np.asarray(c.table))
+            for ch, c in self._channels.items()
+        }
 
     def get_replica_consistent(self) -> "tuple[dict, dict]":
         """Tear-free host snapshot THROUGH the executor: one submitted
@@ -308,26 +527,39 @@ class KVVector(Parameter):
         maps channel → the snapshot step's executor timestamp; every
         push submitted before it (lower ts) is IN the snapshot, every
         later one is not — the replay contract the recovery drill
-        exercises (ReplicaManager.backup_consistent)."""
-        barrier = {ch: self.snapshot(ch) for ch in list(self._channels)}
-        snap = {
-            ch: np.asarray(self.executor.wait(ts))
-            for ch, ts in barrier.items()
-        }
+        exercises (ReplicaManager.backup_consistent). Holding the
+        migration lock keeps the copy and its base-layout translation
+        on ONE layout; snapshots are stored layout-independent."""
+        with self._migration_lock:
+            barrier = {ch: self.snapshot(ch) for ch in list(self._channels)}
+            snap = {
+                ch: self._to_base(
+                    self._channels[ch], np.asarray(self.executor.wait(ts))
+                )
+                for ch, ts in barrier.items()
+            }
         return snap, barrier
 
     def set_replica(self, snapshot: dict) -> None:
         for ch, arr in snapshot.items():
             c = self.channel(ch)
-            c.table = jax.device_put(
-                jnp.asarray(arr), meshlib.table_sharding(self.mesh)
-            )
+            arr = np.asarray(arr)
+            with c.remap_lock:
+                perm = c.perm
+            if perm is not None:
+                # snapshots are base-layout; re-apply the live layout
+                cur = np.empty_like(arr)
+                cur[perm] = arr
+                arr = cur
+            c.table = jax.device_put(jnp.asarray(arr), self._table_sharding)
 
     def write_to_file(self, path: str, ch: int = 0) -> None:
         """Dump nonzero (key, value) pairs as text (ref WriteToFile)."""
         self.executor.wait_all(pop=False)  # donated pushes settle first
         c = self.channel(ch)
-        tbl = np.asarray(c.table)
+        # base layout: exact-directory key order must line up with rows
+        # even after a migration moved them
+        tbl = self._to_base(c, np.asarray(c.table))
         if c.directory.keys is not None:
             keys = c.directory.keys
             vals = tbl[: len(keys)]

@@ -169,6 +169,21 @@ class KeyDirectory:
         # move_to_end/popitem sequence is not atomic on its own.
         self._slot_cache: "OrderedDict[tuple, list]" = OrderedDict()  # guarded-by: _slot_cache_lock
         self._slot_cache_lock = threading.Lock()
+        # composed slot permutation installed by live migrations
+        # (KVVector.migrate): computed slots route through it; the miss
+        # sentinel (>= len(remap)) passes through untouched
+        self._remap: Optional[np.ndarray] = None  # guarded-by: _slot_cache_lock
+
+    def set_remap(self, perm: np.ndarray) -> None:
+        """Compose a slot permutation onto the directory (a migration
+        moved row ``j`` to ``perm[j]``) and drop the slot cache — its
+        entries hold pre-move slots (and their device uploads)."""
+        perm = np.asarray(perm, dtype=np.int64)
+        with self._slot_cache_lock:
+            self._remap = (
+                perm.copy() if self._remap is None else perm[self._remap]
+            )
+            self._slot_cache.clear()
 
     def _signature(self, keys: np.ndarray) -> tuple:
         return (
@@ -204,16 +219,27 @@ class KeyDirectory:
 
     def _compute_slots(self, keys: np.ndarray) -> np.ndarray:
         if self.hashed:
-            return hash_slots(keys, self.num_slots)
-        assert self.keys is not None, "exact directory requires keys"
-        pos = np.searchsorted(self.keys, keys)
-        posc = np.minimum(pos, len(self.keys) - 1) if len(self.keys) else pos
-        hit = (
-            (pos < len(self.keys)) & (self.keys[posc] == keys)
-            if len(self.keys)
-            else np.zeros(len(keys), dtype=bool)
-        )
-        return np.where(hit, pos, self.num_slots).astype(np.int32)
+            base = hash_slots(keys, self.num_slots)
+        else:
+            assert self.keys is not None, "exact directory requires keys"
+            pos = np.searchsorted(self.keys, keys)
+            posc = (
+                np.minimum(pos, len(self.keys) - 1) if len(self.keys) else pos
+            )
+            hit = (
+                (pos < len(self.keys)) & (self.keys[posc] == keys)
+                if len(self.keys)
+                else np.zeros(len(keys), dtype=bool)
+            )
+            base = np.where(hit, pos, self.num_slots)
+        with self._slot_cache_lock:
+            remap = self._remap
+        if remap is not None:
+            # sentinel / out-of-range slots pass through: only rows the
+            # migration actually owns get rerouted
+            safe = np.minimum(base, len(remap) - 1)
+            base = np.where(base < len(remap), remap[safe], base)
+        return np.asarray(base, dtype=np.int32)
 
     def slots(self, keys: np.ndarray) -> np.ndarray:
         """Map global keys to dense int32 slot ids; misses map to the

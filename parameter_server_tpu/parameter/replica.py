@@ -354,6 +354,11 @@ class ReplicaManager:
         if snap is None:
             return False
         if through_executor and hasattr(parameter, "submit"):
+            # an in-flight live migration (KVVector.migrate) must learn
+            # BEFORE this install is submitted that its snapshot is
+            # stale — recovery wins wholesale, the migration re-snapshots
+            if hasattr(parameter, "note_external_restore"):
+                parameter.note_external_restore()
             ts = parameter.submit(
                 lambda: parameter.recover(snap),
                 parameter.request(),

@@ -61,6 +61,8 @@ POINTS = (
     "ingest.prep",         # ingest pool workers: raise mid-batch
     "serve.pull",          # serving live-pull store path: raise / stall
     "serve.refresh",       # read-replica refresh store path: raise
+    "rebalance.migrate",   # live migration, post-snapshot host phase:
+                           # stall (widen the journal window) / raise
 )
 
 

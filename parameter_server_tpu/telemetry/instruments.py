@@ -818,6 +818,36 @@ def learning_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def partition_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Declarative partitioning + heat-driven live repartitioning
+    (parallel/partition.py RebalanceController, KVVector.migrate)."""
+    return {
+        "rebalances": reg.ensure_counter(
+            "ps_partition_rebalances_total",
+            "live rebalances executed (shard_imbalance-triggered or "
+            "forced): one consistent-snapshot migration each",
+        ),
+        "rows_moved": reg.ensure_counter(
+            "ps_partition_rows_moved_total",
+            "table rows relocated across server key ranges by live "
+            "rebalances (hot slots + the cold slots they swapped with)",
+        ),
+        "migration_seconds": reg.ensure_histogram(
+            "ps_partition_migration_seconds",
+            "wall seconds per online migration: snapshot barrier -> "
+            "host permute -> install + journal replay + directory flip",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        ),
+        "post_imbalance": reg.ensure_gauge(
+            "ps_partition_post_imbalance",
+            "max/mean shard load imbalance after the latest rebalance "
+            "(plan prediction, replaced by the re-measured value once "
+            "post-rebalance traffic flows) — should sit below the "
+            "shard_imbalance alert threshold",
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -897,6 +927,7 @@ cached_device_instruments = _cached_family(device_instruments)
 cached_learning_instruments = _cached_family(learning_instruments)
 cached_blackbox_instruments = _cached_family(blackbox_instruments)
 cached_bundle_instruments = _cached_family(bundle_instruments)
+cached_partition_instruments = _cached_family(partition_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -917,6 +948,7 @@ INSTRUMENT_FAMILIES = (
     history_instruments,
     blackbox_instruments,
     bundle_instruments,
+    partition_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -182,6 +182,29 @@ class KeyHeat:
         with self._lock:
             self._decay_locked()
 
+    def rebase(self, perm: Optional[np.ndarray] = None) -> None:
+        """Start a fresh measurement window after a layout change (a
+        live rebalance moved rows, so the accumulated per-shard counts
+        describe the OLD slot→shard assignment and must not leak into
+        the post-rebalance imbalance reading). The sketch and exact
+        shard counts reset; with ``perm`` (old slot → new slot) the
+        hot-slot candidate set is translated so the hot keys stay
+        identified across the move, otherwise it clears too."""
+        with self._lock:
+            self._sketch.clear()
+            self._shard_counts[:] = 0.0
+            if perm is None:
+                self._candidates = {}
+            else:
+                perm = np.asarray(perm)
+                self._candidates = {
+                    int(perm[s]): v
+                    for s, v in self._candidates.items()
+                    if 0 <= s < len(perm)
+                }
+            self._notes = 0
+            self._slots_total = 0
+
     def estimate(self, slots: np.ndarray) -> np.ndarray:
         """Sketch frequency estimates for the given slots (upper-biased
         CM semantics; the parity probe compares these against exact

@@ -103,6 +103,18 @@ METADATA_SECTIONS = frozenset(
         # judging ITSELF (tail vs its own baseline) — banding any of
         # it cross-run would double-count the e2e metric it rides on
         "history",
+        # mesh shape disclosure (parallel/mesh.py auto-shaping): which
+        # (data, server) factorization was chosen and that 0 devices
+        # idle — capture-host facts, asserted in the record itself,
+        # not a throughput the sentinel may band
+        "mesh",
+        # the live-rebalance drill (parallel/partition.py
+        # RebalanceController + KVVector.migrate): imbalance
+        # before/after, rows moved, migration wall seconds, serve
+        # continuity counts, the bit-parity verdict — drill evidence
+        # with host-dependent wall times; banding it would false-flag
+        # every round
+        "rebalance",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

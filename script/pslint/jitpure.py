@@ -47,6 +47,10 @@ SCOPE = (
     # jit sneaking telemetry calls inside a traced body is caught here
     # like it would be in ops/
     "parameter_server_tpu/telemetry/learning.py",
+    # the declarative partitioner: spec resolution and rebalance
+    # planning are host-side; only init_sharded jits (an init_fn it
+    # does not author) — keep it honest under the same purity rules
+    "parameter_server_tpu/parallel/partition.py",
 )
 
 _NP_IMPURE = {

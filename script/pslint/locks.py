@@ -72,7 +72,9 @@ SCOPE = (
     "parameter_server_tpu/telemetry/history.py",
     "parameter_server_tpu/telemetry/learning.py",
     "parameter_server_tpu/utils/concurrent.py",
+    "parameter_server_tpu/parallel/partition.py",
     "parameter_server_tpu/parameter/parameter.py",
+    "parameter_server_tpu/parameter/kv_vector.py",
     "parameter_server_tpu/parameter/replica.py",
     "parameter_server_tpu/learner/ingest.py",
     "parameter_server_tpu/learner/workload_pool.py",

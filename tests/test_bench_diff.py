@@ -245,6 +245,33 @@ class TestDiffMath:
         assert reported
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_mesh_rebalance_sections_are_metadata_never_banded(self):
+        """The `mesh` section is the auto-shaping disclosure (chosen
+        factorization, 0-idle assertion) and `rebalance` is the
+        live-repartitioning drill (imbalance before/after, rows moved,
+        migration wall seconds, serve continuity, bit-parity verdict) —
+        both host-dependent drill evidence, never throughput the
+        sentinel may band."""
+        assert "mesh" in bench_diff.METADATA_SECTIONS
+        assert "rebalance" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["mesh"] = {"devices_total": 8, "devices_used": 8, "idle": 0}
+        new["rebalance"] = {  # drill horrors, all ignored
+            "migration_seconds": 1e9,
+            "rows_moved": 1e9,
+            "imbalance_before": 1e9,
+            "post_imbalance": 1e9,
+            "serve": {"failed": 1e9},
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
 
 class TestCli:
     def test_flags_seeded_regression_exit_1(self):

@@ -16,6 +16,7 @@ def test_benchmarks_smoke_all(capsys):
         "attention", "step_phases", "executor", "host_ingest", "wire",
         "stream_prep", "serve", "trace", "ftrl_sparse_ab", "ftrl_chain",
         "recovery_drill", "roofline", "bundle", "learning", "history_ab",
+        "rebalance",
     }
     for name, fn in sorted(REGISTRY.items()):
         fn(True)
