@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint mesh-test ingest-bench wire-bench stream-prep-bench serve-bench ftrl-bench chaos-bench rebalance-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint mesh-test ingest-bench wire-bench stream-prep-bench serve-bench decode-bench ftrl-bench chaos-bench rebalance-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -120,6 +120,16 @@ ftrl-bench: native
 # every bench.py record under "serve")
 serve-bench: native
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks serve
+
+# continuous-batching decode A/B (components bench, doc/SERVING.md
+# "Continuous batching"): batched vs sequential speculative decode
+# tokens/s at each slot count under join/leave churn — wave admission +
+# fused round blocks, token parity asserted in-bench, plus the
+# device-resident replica serving a table over the host budget with
+# zero degrades (the same dict is embedded in every bench.py record
+# under "decode_batching")
+decode-bench: native
+	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks decode_batching
 
 # chaos-plane recovery drill (components bench, doc/ROBUSTNESS.md):
 # kill a server shard via injected heartbeat silence under concurrent

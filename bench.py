@@ -640,6 +640,31 @@ def attach_serve(rec_or_headline: dict, smoke: bool) -> None:
         rec_or_headline["serve_error"] = f"{type(e).__name__}: {str(e)[:200]}"
 
 
+def attach_decode_batching(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the continuous-batching decode A/B
+    (benchmarks/components.decode_batching_ab — serving/batcher.py,
+    doc/SERVING.md "Continuous batching") under ``decode_batching`` in
+    every bench record: batched-vs-sequential tokens/s at each slot
+    count under join/leave churn (median of paired reps, token parity
+    asserted in-bench), the ``speedup_at_8`` headline with its
+    ``onchip_target``, and the device-resident replica serving a table
+    over the host budget with zero degrades; never breaks a record."""
+    try:
+        from parameter_server_tpu.benchmarks.components import (
+            decode_batching_ab,
+        )
+
+        # parked: the A/B times back-to-back decode lanes at
+        # millisecond granularity — per-line fsync in the span sink
+        # would load the very dispatch overhead being measured
+        with telemetry_spans.parked_sink():
+            rec_or_headline["decode_batching"] = decode_batching_ab(smoke)
+    except Exception as e:
+        rec_or_headline["decode_batching_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def attach_recovery(rec_or_headline: dict, smoke: bool) -> None:
     """Guarded embed of the kill-one-shard recovery drill
     (benchmarks/components.recovery_drill — the chaos plane,
@@ -2036,6 +2061,8 @@ def run_real(args) -> int:
     attach_ftrl(headline, args.smoke)
     _beat("serve")
     attach_serve(headline, args.smoke)
+    _beat("decode_batching")
+    attach_decode_batching(headline, args.smoke)
     _beat("recovery")
     attach_recovery(headline, args.smoke)
     _beat("blackbox")
@@ -2600,6 +2627,11 @@ def run_synthetic(args) -> int:
     # admission/coalescing evidence, doc/SERVING.md)
     _beat("serve")
     attach_serve(headline, args.smoke)
+    # continuous-batching decode A/B rides along (batched-vs-sequential
+    # tokens/s under churn + the device-replica-over-budget gate,
+    # doc/SERVING.md "Continuous batching")
+    _beat("decode_batching")
+    attach_decode_batching(headline, args.smoke)
     # chaos-plane recovery drill rides along (kill-one-shard MTTR +
     # bit-parity + degraded/shed accounting, doc/ROBUSTNESS.md)
     _beat("recovery")

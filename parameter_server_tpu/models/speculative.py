@@ -32,7 +32,7 @@ static.
 from __future__ import annotations
 
 import functools
-from typing import Dict, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -339,3 +339,281 @@ def _spec_jit(tparams, dparams, prompt, lengths, temperature, eos, key, *,
         "accepted_frac": acc / jnp.maximum(prop, 1),
     }
     return out, stats
+
+
+# -- continuous batching: the round-stepped API ---------------------------
+#
+# serving/batcher.py runs ONE speculative-decode state machine for many
+# concurrent sessions (Orca-style iteration-level scheduling): the body
+# of _spec_jit's while_loop is lifted out so the HOST decides, between
+# rounds, when to step, who joins a free slot and who retires.
+# ``spec_batch_alloc`` builds the shared fixed-capacity state,
+# ``_spec_join_jit`` prefills one session into a slot at a round
+# boundary, ``_spec_round_jit`` advances every slot by one speculative
+# round. GREEDY only: the batcher's correctness contract is
+# token-for-token parity with each session's own sequential
+# ``speculative_generate(temperature=None)`` run, and greedy is the
+# variant with a deterministic stream to pin.
+#
+# Slot lifecycle is encoded entirely in (committed, limit): a FREE or
+# retired slot has ``committed == limit``, so its per-round commit is
+# capped at zero tokens (everything lands in the trash slot) and its
+# toks row never changes after retirement — the host can read it out
+# at leisure. The slot's cache rows keep receiving garbage writes while
+# idle; they obey the same overwrite-before-admissible invariant as
+# rejected proposals (a join's prefill rewrites [0, max_prompt) and the
+# contiguous round windows rewrite every later position before the
+# first query whose mask includes it), so a rejoin is exact.
+#
+# Per-row ``eos`` uses -1 as the "no stop token" sentinel: vocab ids
+# are >= 0, so -1 never matches a commit and the eos math degenerates
+# to the has_eos=False path row-wise — one compiled round serves mixed
+# eos/no-eos sessions.
+
+
+class SpecBatchState(NamedTuple):
+    """Device-resident state of one continuous decode batch (a pytree:
+    passes through jit whole). ``toks [S, cap]`` the committed token
+    rows, ``committed/limit/eos [S]`` per-slot clocks (free slot ==
+    ``committed == limit``), plus both models' KV caches at batch
+    capacity. ``cap`` must be ``max_prompt + max_new + gamma + 1``
+    (speculation overshoot + trash slot — same slack as _spec_jit)."""
+
+    toks: jax.Array
+    committed: jax.Array
+    limit: jax.Array
+    eos: jax.Array
+    tk: Tuple
+    tv: Tuple
+    dk: Tuple
+    dv: Tuple
+
+
+def spec_batch_alloc(
+    tcfg: LMConfig, dcfg: LMConfig, slots: int, capacity: int
+) -> SpecBatchState:
+    """A fresh all-slots-free batch state. ``committed = limit = 1`` (not
+    0) so an idle slot's round input ``toks[s, committed-1]`` indexes a
+    valid position; idle rows decode garbage whose commits are capped to
+    the trash slot."""
+    if tcfg.vocab != dcfg.vocab:
+        raise ValueError(
+            f"vocab mismatch: target {tcfg.vocab} vs draft {dcfg.vocab} "
+            "— the models must share a tokenizer"
+        )
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    tk, tv = _alloc_kv_caches(tcfg, slots, capacity)
+    dk, dv = _alloc_kv_caches(dcfg, slots, capacity)
+    return SpecBatchState(
+        toks=jnp.zeros((slots, capacity), jnp.int32),
+        committed=jnp.ones((slots,), jnp.int32),
+        limit=jnp.ones((slots,), jnp.int32),
+        eos=jnp.full((slots,), -1, jnp.int32),
+        tk=tk, tv=tv, dk=dk, dv=dv,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tcfg", "dcfg"), donate_argnums=(2,)
+)
+def _spec_join_jit(tparams, dparams, state, prompt, length, steps, eos_id,
+                   slot, *, tcfg, dcfg):
+    """Admit one session into ``slot`` at a round boundary: prefill both
+    models on the (padded, fixed-width) ``prompt [1, P]``, scatter the
+    single-row caches into the batch caches, commit the first target
+    token — exactly _spec_jit's pre-loop phase, per slot. ``length``,
+    ``steps``, ``eos_id`` (-1 = none) and ``slot`` are traced scalars,
+    so joins at any slot share ONE compilation per prompt width."""
+    cap = state.toks.shape[1]
+    p_len = prompt.shape[1]
+    prompt = prompt.astype(jnp.int32)
+    rtk, rtv = _alloc_kv_caches(tcfg, 1, cap)
+    rdk, rdv = _alloc_kv_caches(dcfg, 1, cap)
+    t_logits, rtk, rtv = _prefill(tparams, tcfg, prompt, rtk, rtv)
+    _, rdk, rdv = _prefill(dparams, dcfg, prompt, rdk, rdv)
+
+    def scatter(full, row):
+        # full [L, S, kvh, T, ...], row [L, 1, kvh, T, ...] — works for
+        # both cache data and (optional) int8 scale leaves
+        return full.at[:, slot].set(row[:, 0])
+
+    tk = jax.tree.map(scatter, state.tk, rtk)
+    tv = jax.tree.map(scatter, state.tv, rtv)
+    dk = jax.tree.map(scatter, state.dk, rdk)
+    dv = jax.tree.map(scatter, state.dv, rdv)
+    col = jnp.arange(p_len)
+    row_toks = jnp.zeros((cap,), jnp.int32).at[:p_len].set(
+        jnp.where(col < length, prompt[0], 0)
+    )
+    # first committed token: the target prefill's logits at the row's
+    # last real position (greedy — the batcher contract)
+    first = jnp.argmax(t_logits[0, length - 1], axis=-1).astype(jnp.int32)
+    row_toks = row_toks.at[length].set(first)
+    limit_new = length + steps
+    # a first token that IS the stop token finishes the session now
+    committed_new = jnp.where(first == eos_id, limit_new, length + 1)
+    return SpecBatchState(
+        toks=state.toks.at[slot].set(row_toks),
+        committed=state.committed.at[slot].set(committed_new),
+        limit=state.limit.at[slot].set(limit_new),
+        eos=state.eos.at[slot].set(eos_id),
+        tk=tk, tv=tv, dk=dk, dv=dv,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tcfg", "dcfg"), donate_argnums=(2,)
+)
+def _spec_join_many_jit(tparams, dparams, state, prompts, lengths, steps,
+                        eos_ids, slots, *, tcfg, dcfg):
+    """Admit R sessions in ONE call: the vectorized `_spec_join_jit`.
+
+    Per-row join cost is dominated by fixed per-call dispatch (the
+    prefill itself is a handful of matmuls), so a wave of joiners pays
+    it R times when admitted one by one. Here both prefills run over
+    ``prompts [R, P]`` at once and all R rows scatter into the batch in
+    one update. Callers pad R to a power of two BY REPEATING THE LAST
+    ROW (same slot, same values — duplicate scatter indices then write
+    identical data, so XLA's pick-any-duplicate semantics is harmless),
+    which bounds compilations to log2(slots)+1 per prompt width.
+    ``lengths``/``steps``/``eos_ids``/``slots`` are traced ``[R]``
+    vectors (per-row eos lets one wave mix requests)."""
+    cap = state.toks.shape[1]
+    r, p_len = prompts.shape
+    prompts = prompts.astype(jnp.int32)
+    rtk, rtv = _alloc_kv_caches(tcfg, r, cap)
+    rdk, rdv = _alloc_kv_caches(dcfg, r, cap)
+    t_logits, rtk, rtv = _prefill(tparams, tcfg, prompts, rtk, rtv)
+    _, rdk, rdv = _prefill(dparams, dcfg, prompts, rdk, rdv)
+
+    def scatter(full, rows):
+        # full [L, S, kvh, T, ...], rows [L, R, kvh, T, ...]
+        return full.at[:, slots].set(rows)
+
+    tk = jax.tree.map(scatter, state.tk, rtk)
+    tv = jax.tree.map(scatter, state.tv, rtv)
+    dk = jax.tree.map(scatter, state.dk, rdk)
+    dv = jax.tree.map(scatter, state.dv, rdv)
+    col = jnp.arange(p_len)[None, :]
+    row_toks = jnp.zeros((r, cap), jnp.int32).at[:, :p_len].set(
+        jnp.where(col < lengths[:, None], prompts, 0)
+    )
+    # first committed token per row: target prefill logits at each
+    # row's last real position (greedy — the batcher contract)
+    last = jnp.take_along_axis(
+        t_logits, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    row_toks = row_toks.at[jnp.arange(r), lengths].set(first)
+    limit_new = lengths + steps
+    committed_new = jnp.where(first == eos_ids, limit_new, lengths + 1)
+    return SpecBatchState(
+        toks=state.toks.at[slots].set(row_toks),
+        committed=state.committed.at[slots].set(committed_new),
+        limit=state.limit.at[slots].set(limit_new),
+        eos=state.eos.at[slots].set(eos_ids),
+        tk=tk, tv=tv, dk=dk, dv=dv,
+    )
+
+
+def _round_core(tparams, dparams, state, tcfg, dcfg, gamma):
+    """One speculative round over the whole batch — _spec_jit's
+    ``round_body``, greedy branch, with per-row eos. Returns
+    ``(state, accepted, proposed)``; the stats count only live slots so
+    idle-slot spin never skews the acceptance rate. Traced helper
+    shared by :func:`_spec_round_jit` (one round per dispatch) and
+    :func:`_spec_round_block_jit` (K rounds fused in one dispatch)."""
+    toks, committed, limit, eos = (
+        state.toks, state.committed, state.limit, state.eos,
+    )
+    tk, tv, dk, dv = state.tk, state.tv, state.dk, state.dv
+    b, total = toks.shape
+    trash = total - 1
+    rows = jnp.arange(b)
+    live = committed < limit
+    x0 = toks[rows, committed - 1]
+    d_toks = []
+    cur = x0
+    for j in range(gamma):
+        dl, dk, dv = _chunk_decode(
+            dparams, dcfg, cur[:, None], dk, dv, committed - 1 + j
+        )
+        cur = jnp.argmax(dl[:, 0], axis=-1).astype(jnp.int32)
+        d_toks.append(cur)
+    # the extra draft step (see round_body): writes d_gamma's own slot
+    _, dk, dv = _chunk_decode(
+        dparams, dcfg, cur[:, None], dk, dv, committed - 1 + gamma
+    )
+    d = jnp.stack(d_toks, axis=1)
+    chunk = jnp.concatenate([x0[:, None], d], axis=1)
+    tl, tk, tv = _chunk_decode(tparams, tcfg, chunk, tk, tv, committed - 1)
+    j_idx = jnp.arange(gamma + 1)[None, :]
+    tpred = jnp.argmax(tl, axis=-1).astype(jnp.int32)
+    agree = d == tpred[:, :gamma]
+    n = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
+    correction = tpred[rows, n]
+    commit_row = jnp.where(
+        j_idx < n[:, None],
+        jnp.pad(d, ((0, 0), (0, 1))),
+        correction[:, None],
+    )
+    n_eff = jnp.minimum(n + 1, limit - committed)
+    is_eos = (commit_row == eos[:, None]) & (j_idx < n_eff[:, None])
+    first_eos = jnp.min(jnp.where(is_eos, j_idx, gamma + 1), axis=1)
+    n_eff = jnp.minimum(n_eff, first_eos + 1)
+    dest = jnp.where(
+        j_idx < n_eff[:, None], committed[:, None] + j_idx, trash
+    )
+    toks = toks.at[rows[:, None], dest].set(commit_row)
+    committed = committed + n_eff
+    committed = jnp.where(first_eos <= gamma, limit, committed)
+    acc = jnp.sum(jnp.where(live, jnp.minimum(n, n_eff), 0))
+    prop = jnp.sum(jnp.where(live, gamma, 0))
+    return (
+        SpecBatchState(
+            toks=toks, committed=committed, limit=limit, eos=eos,
+            tk=tk, tv=tv, dk=dk, dv=dv,
+        ),
+        acc,
+        prop,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tcfg", "dcfg", "gamma"), donate_argnums=(2,)
+)
+def _spec_round_jit(tparams, dparams, state, *, tcfg, dcfg, gamma):
+    """One round, one dispatch — see :func:`_round_core`."""
+    return _round_core(tparams, dparams, state, tcfg, dcfg, gamma)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tcfg", "dcfg", "gamma"), donate_argnums=(2,)
+)
+def _spec_round_block_jit(tparams, dparams, state, k, *, tcfg, dcfg,
+                          gamma):
+    """``k`` rounds FUSED into one dispatch (``k`` is a traced scalar,
+    so every block size shares one compilation).
+
+    The host-stepped loop pays a fixed per-dispatch cost every round —
+    argument marshalling, donation bookkeeping, per-op launch — that a
+    round executed inside a compiled loop does not (the same ops run
+    ~10x cheaper per round inside ``speculative_generate``'s fused
+    while_loop; that gap is most of the batched lane's overhead at
+    small occupancy). Fusing K rounds amortizes it K-fold. The batcher
+    picks K so that NO row can reach its limit inside the block
+    (``ceil(min_remaining / (gamma+1))`` — a round commits at most
+    gamma+1 tokens), so fusion never delays a retirement and never
+    spins a finished row; rows CAN finish early via per-row eos, which
+    is why the batcher drops to single-round stepping while any
+    eos-armed session is resident."""
+    def body(_, carry):
+        st, a, p = carry
+        st, acc, prop = _round_core(tparams, dparams, st, tcfg, dcfg,
+                                    gamma)
+        return st, a + acc, p + prop
+
+    return jax.lax.fori_loop(
+        0, k, body, (state, jnp.int32(0), jnp.int32(0))
+    )

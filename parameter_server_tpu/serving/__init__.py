@@ -19,6 +19,11 @@ latency SLO needs —
   refreshed OFF the push path (the donation-safe ``table(copy=True)``
   contract from the zero-copy data plane), so serving reads never
   contend with — and can never be invalidated by — training pushes.
+- **continuous batching** (:mod:`.batcher`): concurrent decode
+  sessions share ONE running speculative-decode call, joining at round
+  boundaries into free batch slots and retiring between rounds — fleet
+  throughput from the batched-matmul weights-read-once property, with
+  per-session greedy token parity as the correctness contract.
 - **degraded-mode serving** (chaos plane, doc/ROBUSTNESS.md): a live
   pull that fails or misses ``live_pull_deadline_s`` falls back to the
   read replica inside a staleness bound; past it, requests fail with
@@ -33,6 +38,7 @@ recorder behind ``make serve-bench`` and the ``serve`` section of every
 """
 
 from .admission import AdmissionController, RejectedError, TokenBucket
+from .batcher import BatcherConfig, ContinuousBatcher
 from .coalescer import PullCoalescer
 from .frontend import (
     DecodeRequest,
@@ -47,6 +53,8 @@ from .replica import ReadReplica
 
 __all__ = [
     "AdmissionController",
+    "BatcherConfig",
+    "ContinuousBatcher",
     "DecodeRequest",
     "DegradedError",
     "LatencyStats",

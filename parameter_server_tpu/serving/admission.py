@@ -126,6 +126,16 @@ class AdmissionController:
 
         self._tel = cached_serve_instruments
 
+    def queue_retry_s(self, depth: int) -> float:
+        """Retry-after hint for a queue shed: the backlog drains at
+        ~the admitted rate, so tell the client to come back after its
+        share of it (capped; 50ms when no rate gate is configured).
+        Shared by the depth gate here and the frontend's per-lane
+        check-and-reserve gates, so both lanes quote the same
+        heuristic."""
+        rate = self.bucket.rate if self.bucket is not None else 0.0
+        return min(depth / rate, 5.0) if rate > 0 else 0.05
+
     def admit(self, cost: float = 1.0) -> None:
         """Admit one request (``cost`` tokens) or raise
         :class:`RejectedError`. Success returns None and consumes the
@@ -143,8 +153,4 @@ class AdmissionController:
                 tel = self._tel()
                 if tel is not None:
                     tel["shed"].labels(reason="queue").inc()
-                # heuristic: the backlog drains at ~the admitted rate;
-                # tell the client to come back after its share of it
-                rate = self.bucket.rate if self.bucket is not None else 0.0
-                retry = (depth / rate) if rate > 0 else 0.05
-                raise RejectedError("queue", min(retry, 5.0))
+                raise RejectedError("queue", self.queue_retry_s(depth))

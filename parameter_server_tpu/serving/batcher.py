@@ -1,0 +1,436 @@
+"""Continuous batching for the decode lane (Orca-style iteration-level
+scheduling over ONE speculative-decode call).
+
+PR 6 gave decode a dedicated worker, but each `DecodeRequest` still ran
+its own `speculative_generate` — one session per call, the chip idle at
+batch 1 while the kv2 capture shows decode sustaining its best HBM
+bandwidth at batch 8. The batcher turns per-session latency hardware
+into fleet throughput hardware: concurrent sessions share one
+device-resident :class:`~..models.speculative.SpecBatchState`, joining
+at ROUND boundaries into free batch slots and retiring between rounds
+without stalling the rest. The batched-matmul weights-read-once
+property speculative.py documents is exactly what cross-session
+batching amortizes — the target model's verify pass reads its weights
+once per round for the whole batch instead of once per session.
+
+Slot lifecycle (doc/SERVING.md "Continuous batching"):
+
+    free ──admit()── prefill+join (one _spec_join_many_jit per WAVE)
+      ▲                   │
+      │                   ▼
+    retire ◄──────── live rounds (_spec_round_jit, whole batch)
+    (committed >= limit, or EOS commit: slot freed between rounds)
+
+Threading contract (the PR 3 stateless-or-feeder rule): the batcher is
+SINGLE-OWNER — exactly one scheduler thread (the frontend's decode
+worker, running :meth:`ServeFrontend._batch_loop`) may call
+``admit``/``step``; the owner is recorded on first use and enforced.
+Cross-thread visibility is limited to :meth:`stats`, whose mirror
+counters are the only shared mutable state and sit behind ``_lock``
+(guarded-by annotations checked by pslint's ``locks`` pass).
+
+Correctness contract: GREEDY token parity — every session's output is
+token-for-token identical to its own sequential
+``speculative_generate(temperature=None)`` run (the greedy variant is
+itself pinned equal to plain greedy target decoding), regardless of
+who shared the batch or when they joined/left. Pinned by
+tests/test_batcher.py under join/leave churn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..models.speculative import (
+    SpecBatchState,
+    _spec_join_many_jit,
+    _spec_round_block_jit,
+    _spec_round_jit,
+    spec_batch_alloc,
+)
+
+
+@dataclasses.dataclass
+class BatcherConfig:
+    """Capacity knobs — all STATIC (they size the compiled state).
+
+    ``slots`` bounds concurrent sessions (occupancy-vs-latency knob:
+    more slots amortize the target pass further but add per-round work
+    that every resident session waits on — doc/SERVING.md quantifies).
+    ``max_prompt`` is the fixed prefill width every joining prompt is
+    right-padded to, so joins at any slot share one compilation;
+    ``max_new`` bounds per-session ``steps``; ``gamma`` is the shared
+    speculation depth (one batch, one draft schedule)."""
+
+    slots: int = 8
+    max_prompt: int = 64
+    max_new: int = 64
+    gamma: int = 4
+    # max rounds fused per dispatch by step_block() — a throughput
+    # knob, not a correctness one: blocks never overshoot a retirement
+    # (K is additionally bounded so no row can hit its limit inside
+    # the block) but they DO defer joins to block boundaries, so
+    # larger blocks trade admission latency for per-round overhead
+    max_block: int = 8
+
+    def capacity(self) -> int:
+        # speculation can overshoot a row's budget by gamma, plus the
+        # trash slot masked commits land in (same slack as _spec_jit)
+        return self.max_prompt + self.max_new + self.gamma + 1
+
+
+class _Session:
+    """One prompt row resident in one slot."""
+
+    __slots__ = ("handle", "row_idx", "slot", "length", "steps", "width",
+                 "limit")
+
+    def __init__(self, handle, row_idx, slot, length, steps, width):
+        self.handle = handle
+        self.row_idx = row_idx
+        self.slot = slot
+        self.length = length
+        self.steps = steps
+        self.width = width  # the request's ORIGINAL prompt width
+        self.limit = length + steps  # host mirror of the device clock
+
+
+class BatchHandle:
+    """One admitted DecodeRequest: its rows decode as independent
+    sessions; the handle completes (is returned from :meth:`step`) when
+    the LAST row retires, carrying the reassembled ``[B, P+steps]``
+    output in original row order."""
+
+    __slots__ = ("req", "context", "rows_left", "out")
+
+    def __init__(self, req, context, n_rows: int, width: int):
+        self.req = req
+        self.context = context  # caller cookie (the frontend's Ticket)
+        self.rows_left = n_rows
+        self.out = np.zeros((n_rows, width + int(req.steps)), np.int32)
+
+
+class ContinuousBatcher:
+    """ONE running speculative decode shared by concurrent sessions.
+
+    Greedy-only by construction (the parity contract); both configs
+    must share a vocab. The compiled round is built lazily on the
+    first admit; ``warmup()`` forces it ahead of traffic.
+    """
+
+    def __init__(self, target_params, target_cfg, draft_params, draft_cfg,
+                 config: Optional[BatcherConfig] = None):
+        self.cfg = config or BatcherConfig()
+        if self.cfg.gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {self.cfg.gamma}")
+        if self.cfg.max_prompt < 1 or self.cfg.max_new < 1:
+            raise ValueError("max_prompt and max_new must be >= 1")
+        self.tparams = target_params
+        self.tcfg = target_cfg
+        self.dparams = draft_params
+        self.dcfg = draft_cfg
+        # spec_batch_alloc validates the shared-vocab contract
+        self.state: SpecBatchState = spec_batch_alloc(
+            target_cfg, draft_cfg, self.cfg.slots, self.cfg.capacity()
+        )
+        # scheduler-thread-only state (single-owner; no lock by design —
+        # the feeder rule, enforced via _check_owner)
+        self._free: List[int] = list(range(self.cfg.slots))
+        self._sessions: dict = {}  # slot -> _Session
+        self._owner: Optional[int] = None
+        self._lock = threading.Lock()
+        # cross-thread stats mirrors (stats() reads them off-thread)
+        self._occupancy = 0  # guarded-by: _lock
+        self._joins = 0  # guarded-by: _lock
+        self._leaves = 0  # guarded-by: _lock
+        self._rounds = 0  # guarded-by: _lock
+        self._retired = 0  # guarded-by: _lock
+        self._accepted = 0  # guarded-by: _lock
+        self._proposed = 0  # guarded-by: _lock
+        from ..telemetry.instruments import cached_serve_instruments
+
+        self._tel = cached_serve_instruments
+
+    # -- the feeder rule ------------------------------------------------
+
+    def _check_owner(self) -> None:
+        me = threading.get_ident()
+        if self._owner is None:
+            self._owner = me
+        elif self._owner != me:
+            raise RuntimeError(
+                "ContinuousBatcher is single-owner (PR 3 stateless-or-"
+                "feeder rule): admit/step must run on the one scheduler "
+                "thread that first used it"
+            )
+
+    # -- scheduler-thread API -------------------------------------------
+
+    def free_slots(self) -> int:
+        self._check_owner()
+        return len(self._free)
+
+    def active_sessions(self) -> int:
+        self._check_owner()
+        return len(self._sessions)
+
+    def warmup(self) -> None:
+        """Compile everything ahead of traffic: the round, the fused
+        block (zero-length: compiles the loop without running a round),
+        and one join per power-of-two wave size — joins pad to pow2
+        (see admit_many), so this is every join compilation traffic can
+        ever trigger. The warmup joins write dead rows (steps=1 with
+        the first token already committed ⇒ committed == limit, so the
+        rows never go live and no session maps to them)."""
+        self._check_owner()
+        import jax.numpy as jnp
+
+        self.state, _, _ = _spec_round_jit(
+            self.tparams, self.dparams, self.state,
+            tcfg=self.tcfg, dcfg=self.dcfg, gamma=self.cfg.gamma,
+        )
+        self.state, _, _ = _spec_round_block_jit(
+            self.tparams, self.dparams, self.state, jnp.int32(0),
+            tcfg=self.tcfg, dcfg=self.dcfg, gamma=self.cfg.gamma,
+        )
+        if self._sessions:
+            return  # joins write slot 0; only safe on an empty batch
+        r = 1
+        while r <= self.cfg.slots:
+            self.state = _spec_join_many_jit(
+                self.tparams, self.dparams, self.state,
+                jnp.zeros((r, self.cfg.max_prompt), jnp.int32),
+                jnp.ones((r,), jnp.int32), jnp.ones((r,), jnp.int32),
+                jnp.full((r,), -1, jnp.int32),
+                jnp.zeros((r,), jnp.int32),
+                tcfg=self.tcfg, dcfg=self.dcfg,
+            )
+            r *= 2
+
+    def validate(self, req) -> Tuple[np.ndarray, np.ndarray]:
+        """Shape/budget checks for one DecodeRequest (raises ValueError;
+        runs BEFORE any slot is consumed so a bad request never leaks
+        capacity). Returns ``(prompt [B, P] int32, lengths [B])``."""
+        prompt = np.asarray(req.prompt, np.int32)
+        if prompt.ndim != 2 or prompt.shape[1] < 1:
+            raise ValueError(f"prompt must be [B, P>=1], got {prompt.shape}")
+        b, p = prompt.shape
+        if p > self.cfg.max_prompt:
+            raise ValueError(
+                f"prompt width {p} > batcher max_prompt "
+                f"{self.cfg.max_prompt}"
+            )
+        if b > self.cfg.slots:
+            raise ValueError(
+                f"request batch {b} can never fit in {self.cfg.slots} slots"
+            )
+        steps = int(req.steps)
+        if not 1 <= steps <= self.cfg.max_new:
+            raise ValueError(
+                f"steps must be in [1, max_new={self.cfg.max_new}], "
+                f"got {steps}"
+            )
+        if req.eos_id is not None and not (
+            0 <= int(req.eos_id) < self.tcfg.vocab
+        ):
+            raise ValueError(
+                f"eos_id must be in [0, vocab={self.tcfg.vocab}), "
+                f"got {req.eos_id}"
+            )
+        if req.prompt_lengths is None:
+            lengths = np.full(b, p, np.int64)
+        else:
+            lengths = np.asarray(req.prompt_lengths, np.int64).ravel()
+            if lengths.shape != (b,):
+                raise ValueError(
+                    f"prompt_lengths must be [B={b}], got {lengths.shape}"
+                )
+            if (lengths < 1).any() or (lengths > p).any():
+                raise ValueError(
+                    f"prompt_lengths must be in [1, {p}]"
+                )
+        return prompt, lengths
+
+    def admit(self, req, context=None) -> BatchHandle:
+        """Join every row of ``req`` into free slots at this round
+        boundary. Raises ValueError on a malformed request and
+        RuntimeError when the batch lacks the slots (the frontend's
+        scheduler checks ``free_slots()`` first; the admission door
+        sheds before it ever gets here)."""
+        return self.admit_many([(req, context)])[0]
+
+    def admit_many(self, reqs) -> List[BatchHandle]:
+        """Join a WAVE of requests — every row of every ``(req,
+        context)`` pair — in ONE ``_spec_join_many_jit`` call, so the
+        fixed per-call join cost is paid once per round boundary
+        instead of once per session. All requests are validated before
+        any slot is consumed (a malformed wave never leaks capacity);
+        the whole wave must fit the free slots or RuntimeError."""
+        self._check_owner()
+        import jax.numpy as jnp
+
+        validated = [(req, ctx) + self.validate(req) for req, ctx in reqs]
+        total = sum(prompt.shape[0] for _, _, prompt, _ in validated)
+        if total == 0:
+            return []
+        if total > len(self._free):
+            raise RuntimeError(
+                f"batch full: {total} rows, {len(self._free)} free slots"
+            )
+        handles: List[BatchHandle] = []
+        padded = np.zeros((total, self.cfg.max_prompt), np.int32)
+        len_v = np.zeros(total, np.int32)
+        steps_v = np.zeros(total, np.int32)
+        eos_v = np.zeros(total, np.int32)
+        slots_v = np.zeros(total, np.int32)
+        row = 0
+        for req, ctx, prompt, lengths in validated:
+            b, width = prompt.shape
+            handle = BatchHandle(req, ctx, b, width)
+            handles.append(handle)
+            eos = -1 if req.eos_id is None else int(req.eos_id)
+            steps = int(req.steps)
+            for r in range(b):
+                slot = self._free.pop()
+                padded[row, :width] = prompt[r]
+                len_v[row] = lengths[r]
+                steps_v[row] = steps
+                eos_v[row] = eos
+                slots_v[row] = slot
+                self._sessions[slot] = _Session(
+                    handle, r, slot, int(lengths[r]), steps, width
+                )
+                row += 1
+        # pad the wave to a power of two by repeating the last row:
+        # same slot + same values, so the duplicate scatter writes are
+        # idempotent and compilations stay bounded at log2(slots)+1
+        pow2 = 1 << max(0, total - 1).bit_length()
+        if pow2 > total:
+            pad = pow2 - total
+            padded = np.concatenate(
+                [padded, np.repeat(padded[-1:], pad, axis=0)]
+            )
+            len_v, steps_v, eos_v, slots_v = (
+                np.concatenate([v, np.repeat(v[-1:], pad)])
+                for v in (len_v, steps_v, eos_v, slots_v)
+            )
+        self.state = _spec_join_many_jit(
+            self.tparams, self.dparams, self.state,
+            jnp.asarray(padded), jnp.asarray(len_v), jnp.asarray(steps_v),
+            jnp.asarray(eos_v), jnp.asarray(slots_v),
+            tcfg=self.tcfg, dcfg=self.dcfg,
+        )
+        occ = len(self._sessions)
+        with self._lock:
+            self._joins += total
+            self._occupancy = occ
+        tel = self._tel()
+        if tel is not None:
+            tel["batch_joins"].inc(total)
+            tel["batch_occupancy"].set(occ)
+        return handles
+
+    def step(self) -> List[BatchHandle]:
+        """Advance every resident session by one speculative round,
+        retire finished slots, and return the handles whose LAST row
+        just completed. No-op (empty list) on an empty batch."""
+        self._check_owner()
+        if not self._sessions:
+            return []
+        self.state, acc, prop = _spec_round_jit(
+            self.tparams, self.dparams, self.state,
+            tcfg=self.tcfg, dcfg=self.dcfg, gamma=self.cfg.gamma,
+        )
+        return self._retire(1, acc, prop)
+
+    def step_block(self) -> List[BatchHandle]:
+        """Advance by UP TO ``cfg.max_block`` rounds fused in one
+        dispatch, then retire — the throughput path (the host-stepped
+        per-round dispatch cost dominates round time at low occupancy;
+        see _spec_round_block_jit). The block size is bounded so no
+        row can reach its limit mid-block (a round commits at most
+        gamma+1 tokens), which keeps retirement latency identical to
+        single-round stepping; any resident eos-armed session CAN
+        finish early, so its presence drops the block to one round."""
+        self._check_owner()
+        if not self._sessions:
+            return []
+        k = self.cfg.max_block
+        if k > 1 and not any(
+            s.handle.req.eos_id is not None for s in self._sessions.values()
+        ):
+            committed = np.asarray(self.state.committed)
+            g1 = self.cfg.gamma + 1
+            shortest = min(
+                -(-(s.limit - int(committed[s.slot])) // g1)
+                for s in self._sessions.values()
+            )
+            k = max(1, min(k, shortest))
+        else:
+            k = 1
+        if k == 1:
+            return self.step()
+        import jax.numpy as jnp
+
+        self.state, acc, prop = _spec_round_block_jit(
+            self.tparams, self.dparams, self.state, jnp.int32(k),
+            tcfg=self.tcfg, dcfg=self.dcfg, gamma=self.cfg.gamma,
+        )
+        return self._retire(k, acc, prop)
+
+    def _retire(self, n_rounds: int, acc, prop) -> List[BatchHandle]:
+        """Scan for finished rows after a round (or block), free their
+        slots, and fold the round stats into the mirrors."""
+        committed = np.asarray(self.state.committed)
+        finished: List[BatchHandle] = []
+        n_retired = 0
+        for slot in list(self._sessions):
+            sess = self._sessions[slot]
+            if committed[slot] < sess.limit:
+                continue
+            # the slot's toks row is frozen once committed == limit
+            # (capped commits land in the trash slot), so this read is
+            # race-free even as later rounds keep stepping the batch
+            row = np.asarray(self.state.toks[slot, : sess.width + sess.steps])
+            sess.handle.out[sess.row_idx] = row
+            sess.handle.rows_left -= 1
+            if sess.handle.rows_left == 0:
+                finished.append(sess.handle)
+            del self._sessions[slot]
+            self._free.append(slot)
+            n_retired += 1
+        occ = len(self._sessions)
+        with self._lock:
+            self._rounds += n_rounds
+            self._retired += n_retired
+            self._leaves += n_retired
+            self._occupancy = occ
+            self._accepted += int(acc)
+            self._proposed += int(prop)
+        tel = self._tel()
+        if tel is not None:
+            tel["batch_rounds"].inc(n_rounds)
+            if n_retired:
+                tel["batch_retired"].inc(n_retired)
+                tel["batch_leaves"].inc(n_retired)
+            tel["batch_occupancy"].set(occ)
+        return finished
+
+    # -- cross-thread introspection -------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            acc, prop = self._accepted, self._proposed
+            return {
+                "slots": self.cfg.slots,
+                "occupancy": self._occupancy,
+                "joins": self._joins,
+                "leaves": self._leaves,
+                "rounds": self._rounds,
+                "retired": self._retired,
+                "accepted_frac": acc / prop if prop else 0.0,
+            }

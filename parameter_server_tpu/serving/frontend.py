@@ -121,6 +121,13 @@ class ServeConfig:
     replica: str = "full"
     hot_keys: Optional[np.ndarray] = None
     replica_refresh_s: Optional[float] = None  # None = manual refresh()
+    # device-resident replica (serving/replica.py): keep the snapshot
+    # as a (sharded) jax array and serve reads as jitted gathers —
+    # replica capacity scales with HBM, not host RAM. The host budget
+    # bounds what a HOST-mode replica may pin (a refresh past it fails
+    # loudly); device mode ignores it by design
+    replica_device: bool = False
+    replica_host_budget_bytes: Optional[int] = None
     # worker pool (pull/predict lane) — decode gets its own worker
     workers: int = 2
     # degraded-mode serving: a live (coalesced) pull that raises — or
@@ -194,11 +201,20 @@ class ServeFrontend:
         config: Optional[ServeConfig] = None,
         channel: int = 0,
         decode_fn: Optional[Callable[[DecodeRequest], np.ndarray]] = None,
+        batcher=None,
     ):
         self.cfg = config or ServeConfig()
         self.store = store
         self.channel = int(channel)
+        if decode_fn is not None and batcher is not None:
+            raise ValueError(
+                "pass decode_fn (one sequential call per request) OR "
+                "batcher (continuous batching), not both"
+            )
         self.decode_fn = decode_fn
+        # serving/batcher.py ContinuousBatcher: the decode worker
+        # becomes its single-owner scheduler thread (_batch_loop)
+        self.batcher = batcher
         self._cv = threading.Condition()
         self._queue: deque = deque()  # guarded-by: _cv — pull/predict lane
         self._decode_queue: deque = deque()  # guarded-by: _cv
@@ -234,10 +250,16 @@ class ServeFrontend:
             if self.cfg.hot_keys is None:
                 raise ValueError("replica='hot' needs ServeConfig.hot_keys")
             self.replica = ReadReplica(
-                store, channel, hot_keys=self.cfg.hot_keys
+                store, channel, hot_keys=self.cfg.hot_keys,
+                device=self.cfg.replica_device,
+                host_budget_bytes=self.cfg.replica_host_budget_bytes,
             )
         elif self.cfg.replica in ("full", "fallback"):
-            self.replica = ReadReplica(store, channel)
+            self.replica = ReadReplica(
+                store, channel,
+                device=self.cfg.replica_device,
+                host_budget_bytes=self.cfg.replica_host_budget_bytes,
+            )
         elif self.cfg.replica != "off":
             raise ValueError(
                 f"ServeConfig.replica must be 'off'|'full'|'hot'|"
@@ -267,7 +289,16 @@ class ServeFrontend:
             )
             t.start()
             self._threads.append(t)
-        if self.decode_fn is not None:
+        if self.batcher is not None:
+            # the continuous batcher's single-owner scheduler: same
+            # thread name and lane, different loop — it multiplexes the
+            # whole decode queue into one running speculative call
+            t = threading.Thread(
+                target=self._batch_loop, name="serve-decode", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        elif self.decode_fn is not None:
             t = threading.Thread(
                 target=self._worker_loop, args=(self._decode_queue,),
                 name="serve-decode", daemon=True,
@@ -357,17 +388,21 @@ class ServeFrontend:
             return self._in_flight
 
     def _queue_retry_s(self, depth: int) -> float:
-        # the backlog drains at ~the admitted rate; tell the client to
-        # come back after its share of it (the admission controller's
-        # heuristic, applied per lane)
-        bucket = self.admission.bucket
-        return min(depth / bucket.rate, 5.0) if bucket is not None else 0.05
+        # the admission controller's drain-rate heuristic, applied to
+        # this lane's depth (serving/admission.py queue_retry_s)
+        return self.admission.queue_retry_s(depth)
 
     def submit(self, req) -> Ticket:
         """Admit and enqueue one request; raises
         :class:`~.admission.RejectedError` (the 429) at the door."""
-        if isinstance(req, DecodeRequest) and self.decode_fn is None:
-            raise ValueError("this frontend has no decode_fn")
+        if (
+            isinstance(req, DecodeRequest)
+            and self.decode_fn is None
+            and self.batcher is None
+        ):
+            raise ValueError(
+                "this frontend has no decode lane (decode_fn or batcher)"
+            )
         if getattr(req, "channel", self.channel) != self.channel:
             # one frontend serves ONE channel (its replica and
             # coalescer are bound to it); silently answering another
@@ -511,6 +546,101 @@ class ServeFrontend:
                 tel["latency"].labels(kind=ticket.kind).observe(
                     ticket.latency_s()
                 )
+
+    def _finish_decode_ticket(self, ticket: Ticket, value, err) -> None:
+        """Completion bookkeeping for one batched decode request —
+        the tail of _worker_loop, factored out for _batch_loop (which
+        completes tickets at round boundaries, not per pop)."""
+        ticket._complete(value, err)
+        if ticket.flow is not None:
+            telemetry_spans.emit(
+                {
+                    "kind": "span",
+                    "name": "serve.reply",
+                    "t_wall": time.time(),
+                    "dur_s": 0.0,
+                    "flow": ticket.flow,
+                    "latency_s": ticket.latency_s(),
+                    "req": ticket.kind,
+                    **({"error": type(err).__name__} if err else {}),
+                }
+            )
+        with self._cv:
+            self._in_flight_decode -= 1
+            self.completed += 1
+            self._cv.notify_all()
+        tel = self._tel()
+        if tel is not None:
+            tel["latency"].labels(kind=ticket.kind).observe(
+                ticket.latency_s()
+            )
+
+    def _batch_loop(self) -> None:
+        """The continuous batcher's single-owner scheduler (PR 3
+        stateless-or-feeder rule): this thread alone calls
+        ``batcher.admit_many``/``step_block``. Sessions join at round
+        boundaries
+        into free slots; finished sessions retire between rounds
+        without stalling the rest; requests too wide for the current
+        free set wait at the head of the queue (admission sheds past
+        the lane depth bound long before that).
+
+        Pause semantics differ from _worker_loop on purpose: ``pause``
+        gates NEW joins (the queue holds), but resident sessions keep
+        stepping — decode rounds touch only device model state, never
+        the store, so serving continues straight through an elastic
+        resize or live rebalance (pinned by tests). Rounds therefore do
+        not count into ``_executing``/:meth:`quiesce`."""
+        b = self.batcher
+        active = False
+        while True:
+            admits = []
+            with self._cv:
+                while (
+                    (not self._decode_queue or self._paused)
+                    and not self._closed
+                    and not active
+                ):
+                    self._cv.wait()
+                if self._closed and not self._decode_queue and not active:
+                    return
+                if not self._paused or self._closed:  # closing drains
+                    free = b.free_slots()
+                    while self._decode_queue:
+                        req, _t = self._decode_queue[0]
+                        try:
+                            rows = int(np.asarray(req.prompt).shape[0])
+                        except Exception:
+                            rows = 1  # malformed: admit() rejects it below
+                        if rows > free:
+                            break
+                        admits.append(self._decode_queue.popleft())
+                        free -= rows
+            if admits:
+                try:
+                    # the whole wave joins in ONE fused call (the
+                    # per-call join cost dominates admission otherwise)
+                    b.admit_many(admits)
+                except ValueError:
+                    # a malformed request poisons the wave-validate;
+                    # re-admit one by one so only the bad ones fail
+                    for req, ticket in admits:
+                        try:
+                            b.admit(req, context=ticket)
+                        except BaseException as e:
+                            self._finish_decode_ticket(ticket, None, e)
+                except BaseException as e:
+                    for _req, ticket in admits:
+                        self._finish_decode_ticket(ticket, None, e)
+            for handle in b.step_block():
+                out = handle.out
+                tel = self._tel()
+                if tel is not None:
+                    tel["decode_tokens"].inc(
+                        out.shape[0] * int(handle.req.steps)
+                    )
+                self._finish_decode_ticket(handle.context, out, None)
+            active = b.active_sessions() > 0
 
     def _live_pull(self, keys: np.ndarray) -> np.ndarray:
         """One coalesced pull against the live store, bounded by
@@ -669,5 +799,8 @@ class ServeFrontend:
                 "version": self.replica.version,
                 "age_s": round(self.replica.age_s(), 3),
                 "nbytes": self.replica.nbytes(),
+                "device": self.replica.device,
             }
+        if self.batcher is not None:
+            out["batcher"] = self.batcher.stats()
         return out

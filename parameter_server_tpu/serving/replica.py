@@ -29,17 +29,39 @@ the serving working set of a power-law key distribution is orders of
 magnitude smaller than the training table, so refresh stays O(hot)
 instead of O(table). Keys outside the hot set report a miss and the
 frontend falls through to the coalesced live-pull path.
+
+``device=True`` mode: the snapshot STAYS on device as a (sharded) jax
+array — ``KVVector.snapshot`` already returns a donation-immune device
+copy, so holding it instead of ``np.asarray``-ing it to host is free,
+and replica capacity scales with HBM instead of host RAM (hot-key mode
+keeps a compact device ``[H, k]`` block). Reads become ONE jitted
+device gather (row indices resolved host-side by the directory, padded
+to a power of two so gather widths reuse a handful of compilations)
+with a batched host shim for the numpy-facing ``pull`` contract.
+``host_budget_bytes`` bounds what a HOST-mode replica may pin: a
+refresh whose snapshot exceeds it fails loudly (keeping the last good
+snapshot) instead of silently eating the serving host's RAM — the
+device mode ignores the bound, which is exactly the point.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Optional
 
+import jax
 import numpy as np
 
 from ..system import faults
+
+
+@functools.partial(jax.jit)
+def _gather_rows(table, slots):
+    """Device gather for the device-resident replica: ``table[slots]``
+    compiled once per (table shape, padded slot width)."""
+    return table[slots]
 
 
 class ReadReplica:
@@ -58,6 +80,8 @@ class ReadReplica:
         store,
         channel: int = 0,
         hot_keys: Optional[np.ndarray] = None,
+        device: bool = False,
+        host_budget_bytes: Optional[int] = None,
     ):
         self.store = store
         self.channel = int(channel)
@@ -66,8 +90,13 @@ class ReadReplica:
             if hot_keys is None
             else np.unique(np.asarray(hot_keys, dtype=np.int64))
         )
+        self.device = bool(device)
+        self.host_budget_bytes = (
+            None if host_budget_bytes is None else int(host_budget_bytes)
+        )
         self._lock = threading.Lock()
-        self._table: Optional[np.ndarray] = None  # guarded-by: _lock
+        # host numpy snapshot, or a device jax array when device=True
+        self._table = None  # guarded-by: _lock
         self.version = 0  # guarded-by: _lock
         self._refreshed_at = 0.0  # guarded-by: _lock
         from ..telemetry.instruments import cached_serve_instruments
@@ -102,16 +131,31 @@ class ReadReplica:
             ts = self.store.pull(
                 self.store.request(channel=self.channel), keys=self.hot_keys
             )
-            host = np.asarray(self.store.wait_pull(ts))
+            fresh = self.store.wait_pull(ts)  # never aliases the table
         elif hasattr(self.store, "snapshot"):
-            host = np.asarray(
-                self.store.executor.wait(self.store.snapshot(self.channel))
-            )
+            # the submitted copy step: already donation-immune, so the
+            # device mode keeps the returned (sharded) array as-is
+            fresh = self.store.executor.wait(self.store.snapshot(self.channel))
         else:  # stores without a snapshot step: checkpoint-path contract
             self.store.executor.wait_all(pop=False)
-            host = np.asarray(self.store.table(self.channel, copy=True))
+            fresh = self.store.table(self.channel, copy=True)
+        if not self.device:
+            fresh = np.asarray(fresh)
+            if (
+                self.host_budget_bytes is not None
+                and fresh.nbytes > self.host_budget_bytes
+            ):
+                # fail BEFORE publishing: the last good snapshot keeps
+                # serving (its age judged by the degraded staleness
+                # bound) instead of this refresh silently pinning more
+                # host RAM than the serving host was budgeted
+                raise MemoryError(
+                    f"host replica snapshot {fresh.nbytes} B exceeds "
+                    f"host_budget_bytes={self.host_budget_bytes} — use "
+                    "device=True to hold it in HBM instead"
+                )
         with self._lock:
-            self._table = host
+            self._table = fresh
             self.version += 1
             self._refreshed_at = time.monotonic()
             version = self.version
@@ -130,12 +174,28 @@ class ReadReplica:
 
     # -- the read path (no store executor, no live-table reads) --
 
+    def _rows(self, table, idx: np.ndarray) -> np.ndarray:
+        """Gather snapshot rows by position: numpy fancy-indexing for a
+        host snapshot, one jitted device gather + batched host shim for
+        a device snapshot. Device indices are padded to the next power
+        of two so arbitrary request sizes reuse a handful of gather
+        compilations instead of one per width."""
+        if not self.device:
+            return table[idx]
+        import jax.numpy as jnp
+
+        m = int(idx.shape[0])
+        mp = max(8, 1 << max(0, m - 1).bit_length())
+        padded = np.zeros(mp, np.int32)
+        padded[:m] = idx
+        return np.asarray(_gather_rows(table, jnp.asarray(padded)))[:m]
+
     def pull(self, keys: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
         """Rows for ``keys`` from the snapshot: ``(values [n, k],
         hit_mask [n])``. Full-table replicas always hit (keys the
         directory doesn't know read 0, the device range-mask contract);
-        hot-key replicas report misses so the caller can fall through
-        to a live pull."""
+        hot-key replicas report misses PER KEY so the caller can fall
+        through to a live pull for exactly the missed rows."""
         keys = np.asarray(keys, dtype=np.int64).ravel()
         with self._lock:
             table = self._table
@@ -143,7 +203,7 @@ class ReadReplica:
         if self.hot_keys is None:
             slots = self._directory().slots(keys)
             miss = slots >= table.shape[0]
-            vals = table[np.minimum(slots, table.shape[0] - 1)]
+            vals = self._rows(table, np.minimum(slots, table.shape[0] - 1))
             if miss.any():
                 vals = np.where(miss[:, None], 0, vals)
             if tel is not None:
@@ -154,7 +214,7 @@ class ReadReplica:
         hit = (pos < len(self.hot_keys)) & (self.hot_keys[posc] == keys)
         vals = np.zeros((len(keys), table.shape[1]), table.dtype)
         if hit.any():
-            vals[hit] = table[posc[hit]]
+            vals[hit] = self._rows(table, posc[hit])
         if tel is not None:
             n_hit = int(hit.sum())
             tel["replica_hits"].inc(n_hit)

@@ -320,6 +320,32 @@ def serve_instruments(reg: MetricsRegistry) -> Dict[str, object]:
             "tokens generated by served decode requests "
             "(rows x steps, host-side count)",
         ),
+        "batch_occupancy": reg.ensure_gauge(
+            "ps_serve_batch_occupancy",
+            "decode sessions resident in the continuous batch, sampled "
+            "at every join and round boundary (occupancy/slots is the "
+            "chip-fill ratio the batcher exists to raise)",
+        ),
+        "batch_joins": reg.ensure_counter(
+            "ps_serve_batch_joins_total",
+            "decode sessions joined into free batch slots at round "
+            "boundaries (one per prompt row, not per request)",
+        ),
+        "batch_leaves": reg.ensure_counter(
+            "ps_serve_batch_leaves_total",
+            "batch slots released between rounds (EOS or token-budget "
+            "retirement) — join/leave churn without stalling residents",
+        ),
+        "batch_rounds": reg.ensure_counter(
+            "ps_serve_batch_rounds_total",
+            "speculative rounds stepped over the shared batch (one "
+            "target verify pass serves every resident session)",
+        ),
+        "batch_retired": reg.ensure_counter(
+            "ps_serve_batch_retired_total",
+            "decode sessions retired complete (their token stream is "
+            "pinned identical to a sequential speculative run)",
+        ),
         "degraded": reg.ensure_counter(
             "ps_serve_degraded_total",
             "requests that hit the degraded path after the live store "

@@ -66,6 +66,11 @@ METADATA_SECTIONS = frozenset(
         "host_ingest",
         "kv_dataplane",
         "ftrl_sparse",
+        # continuous-batching decode A/B: quotes its own paired-rep
+        # medians (batched vs sequential tokens/s under churn) with the
+        # on-chip target stated in-record — self-disclosing A/B, not a
+        # series the sentinel may band
+        "decode_batching",
         "attribution",
         "telemetry",
         # the --expose-port self-scrape summary (node list, series-line

@@ -76,6 +76,8 @@ SCOPE = (
     "parameter_server_tpu/parameter/parameter.py",
     "parameter_server_tpu/parameter/kv_vector.py",
     "parameter_server_tpu/parameter/replica.py",
+    "parameter_server_tpu/serving/batcher.py",
+    "parameter_server_tpu/system/autoscale.py",
     "parameter_server_tpu/learner/ingest.py",
     "parameter_server_tpu/learner/workload_pool.py",
     "parameter_server_tpu/learner/wire.py",

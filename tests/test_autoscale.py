@@ -1,0 +1,221 @@
+"""Alert-driven autoscaling (system/autoscale.py): the alert→action
+edge. The overload drill is the acceptance criterion made executable:
+an induced decode-latency burn fires ``serve_p99_burn`` (the REAL
+multi-window quantile rule shape from configs/alerts/default.json),
+the listener grows the fleet, latency recovers, the alert resolves —
+no human in the loop — and the flight-recorder bundles show the whole
+overload → resize → resolve arc."""
+
+import numpy as np
+import pytest
+
+from parameter_server_tpu.system.autoscale import AlertDrivenScaler
+from parameter_server_tpu.system.postoffice import Postoffice
+from parameter_server_tpu.telemetry import blackbox
+from parameter_server_tpu.telemetry.alerts import (
+    AlertEvent,
+    AlertManager,
+    AlertRule,
+)
+from parameter_server_tpu.telemetry.history import HistoryStore
+from parameter_server_tpu.telemetry.registry import MetricsRegistry
+
+
+def _event(rule="serve_p99_burn", to="firing", frm="inactive", value=0.2):
+    return AlertEvent(
+        rule=rule, frm=frm, to=to, value=value, threshold=0.05,
+        op=">", t=0.0, severity="page",
+    )
+
+
+class _Manager:
+    """Stub AlertManager surface: just the listener registry."""
+
+    def __init__(self):
+        self.listeners = []
+
+    def add_listener(self, fn):
+        self.listeners.append(fn)
+
+    def deliver(self, ev):
+        for fn in self.listeners:
+            fn(ev)
+
+
+class _Fleet:
+    def __init__(self, size=2):
+        self.size = size
+
+    def add_worker(self):
+        self.size += 1
+        return self.size
+
+
+@pytest.fixture(autouse=True)
+def fresh_blackbox():
+    blackbox.reset()
+    yield
+    blackbox.reset()
+
+
+class TestAlertDrivenScaler:
+    def test_firing_grows_and_captures_bundle(self):
+        mgr, fleet = _Manager(), _Fleet(size=2)
+        sc = AlertDrivenScaler(mgr, fleet, cooldown_s=0.0)
+        blackbox.set_min_interval(0.0)
+        mgr.deliver(_event(to="firing"))
+        assert fleet.size == 3
+        assert sc.grown() == 1
+        assert [a["outcome"] for a in sc.actions()] == ["grew"]
+        b = blackbox.last_bundle()
+        assert b is not None and b["trigger"]["kind"] == "alert"
+        assert "serve_p99_burn firing -> grew" in b["trigger"]["detail"]
+
+    def test_other_rules_ignored(self):
+        mgr, fleet = _Manager(), _Fleet()
+        sc = AlertDrivenScaler(mgr, fleet, cooldown_s=0.0)
+        mgr.deliver(_event(rule="train_stale_exceeded", to="firing"))
+        mgr.deliver(_event(to="pending", frm="inactive"))
+        assert fleet.size == 2 and not sc.actions()
+
+    def test_cooldown_spaces_actions(self):
+        mgr, fleet = _Manager(), _Fleet()
+        t = [0.0]
+        sc = AlertDrivenScaler(
+            mgr, fleet, cooldown_s=60.0, clock=lambda: t[0]
+        )
+        mgr.deliver(_event(to="firing"))
+        t[0] = 30.0  # inside cooldown: a flapping alert must not saw
+        mgr.deliver(_event(to="firing", frm="resolved"))
+        assert fleet.size == 3
+        assert [a["outcome"] for a in sc.actions()] == [
+            "grew", "skipped-cooldown",
+        ]
+        t[0] = 90.0  # past it: acts again
+        mgr.deliver(_event(to="firing", frm="resolved"))
+        assert fleet.size == 4
+
+    def test_max_workers_bounds_growth(self):
+        mgr, fleet = _Manager(), _Fleet()
+        sc = AlertDrivenScaler(mgr, fleet, cooldown_s=0.0, max_workers=1)
+        mgr.deliver(_event(to="firing"))
+        mgr.deliver(_event(to="firing", frm="resolved"))
+        assert fleet.size == 3 and sc.grown() == 1
+        assert sc.actions()[-1]["outcome"] == "skipped-max-workers"
+
+    def test_grow_errors_are_fenced(self):
+        """An actuator failure must not raise into evaluate() and must
+        refund the grown count so capacity accounting stays truthful."""
+        mgr = _Manager()
+
+        def boom():
+            raise RuntimeError("resize wedged")
+
+        sc = AlertDrivenScaler(mgr, _Fleet(), cooldown_s=0.0, grow=boom)
+        mgr.deliver(_event(to="firing"))
+        assert sc.grown() == 0
+        act = sc.actions()[-1]
+        assert act["outcome"] == "error"
+        assert "resize wedged" in act["result"]
+
+    def test_resolved_without_action_stays_quiet(self):
+        mgr = _Manager()
+        AlertDrivenScaler(mgr, _Fleet())
+        blackbox.set_min_interval(0.0)
+        mgr.deliver(_event(to="resolved", frm="firing"))
+        assert blackbox.last_bundle() is None
+
+
+class TestRealCoordinatorEdge:
+    def test_default_action_is_add_worker(self, mesh8):
+        """The default actuator really is ElasticCoordinator.add_worker:
+        a firing event grows the data-worker count by one and rebuilds
+        the worker on the new mesh (the resize itself is tier-1-proven
+        in test_elastic.py; this pins the scaler→coordinator edge)."""
+        from tests.test_elastic import make_worker
+        from parameter_server_tpu.system.elastic import ElasticCoordinator
+
+        Postoffice.reset()
+        try:
+            co = ElasticCoordinator(make_worker, num_data=2, num_server=2)
+            co.start()
+            mgr = _Manager()
+            AlertDrivenScaler(mgr, co, cooldown_s=0.0)
+            before = co.num_data
+            mgr.deliver(_event(to="firing"))
+            assert co.num_data == before + 1
+            assert co.worker is not None
+        finally:
+            Postoffice.reset()
+
+
+class TestOverloadDrill:
+    def test_overload_resize_resolve_arc(self):
+        """The acceptance drill, on the fake clock: sustained decode
+        p99 burn → ``serve_p99_burn`` fires (real rule shape: 15s AND
+        120s windows over ``ps_serve_latency_seconds``) → the listener
+        grows the fleet → latency recovers → the alert resolves with no
+        human action — and ``blackbox.bundles()`` holds the captured
+        overload → resize → resolve arc."""
+        reg = MetricsRegistry()
+        lat = reg.histogram(
+            "ps_serve_latency_seconds", "decode latency",
+            buckets=(0.001, 0.01, 0.05, 0.25, 1.0),
+        )
+        t = [0.0]
+        st = HistoryStore(
+            reg, resolutions=((1.0, 600), (10.0, 720)), clock=lambda: t[0]
+        )
+        rule = AlertRule(
+            name="serve_p99_burn", kind="quantile",
+            metric="ps_serve_latency_seconds", q=0.99, op=">",
+            threshold=0.05, window_s=15.0, slow_window_s=120.0,
+            for_s=0.0, severity="page",
+        )
+        mgr = AlertManager(
+            [rule], registry=reg, clock=lambda: t[0], history=st
+        )
+        fleet = _Fleet(size=2)
+        scaler = AlertDrivenScaler(
+            mgr, fleet, cooldown_s=30.0, clock=lambda: t[0]
+        )
+        blackbox.set_min_interval(0.0)
+
+        transitions = []
+        mgr.add_listener(lambda ev: transitions.append(ev.to))
+
+        overload_from = 130.0  # healthy baseline first, then the burn
+        fired_at = resolved_at = None
+        for i in range(1, 60):  # 10s ticks, ~600s of cluster time
+            t[0] = 10.0 * i
+            # the simulated truth: an underprovisioned fleet serves
+            # decode at ~200ms p99, a grown one at ~5ms — the metric
+            # the rule watches is a pure function of fleet size once
+            # the induced overload begins
+            hot = t[0] >= overload_from and fleet.size < 3
+            per_req = 0.2 if hot else 0.005
+            for _ in range(20):
+                lat.observe(per_req)
+            mgr.evaluate()
+            name = mgr.states()["serve_p99_burn"].state_name
+            if name == "firing" and fired_at is None:
+                fired_at = t[0]
+            if t[0] < overload_from:
+                assert name == "inactive"  # quiet while healthy
+            if name == "resolved":
+                resolved_at = t[0]
+                break
+
+        # the arc happened, end to end, without a human:
+        assert fired_at is not None and fired_at >= overload_from
+        assert resolved_at is not None and resolved_at > fired_at
+        assert fleet.size == 3  # grew exactly once (cooldown held)
+        assert [a["outcome"] for a in scaler.actions()][:1] == ["grew"]
+
+        # and the flight recorder holds the evidence pair
+        details = [
+            b["trigger"]["detail"] for b in blackbox.bundles()
+            if b["trigger"]["kind"] == "alert"
+        ]
+        assert any("firing -> grew" in d for d in details), details
+        assert any("resolved after autoscale" in d for d in details), details

@@ -150,6 +150,28 @@ class TestDiffMath:
         assert reported  # the scalar metrics are still judged
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_decode_batching_section_is_metadata_never_banded(self):
+        """The continuous-batching `decode_batching` section quotes its
+        own paired-rep medians (batched vs sequential tokens/s under
+        churn) with the on-chip target stated in-record — a
+        self-disclosing A/B whose host-dependent wall clocks the
+        sentinel must never band."""
+        assert "decode_batching" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["decode_batching"] = {  # catastrophic A/B, all ignored
+            "speedup_at_8": 0.01,
+            "arms": [{"slots": 8, "batched_tokens_per_sec": 1.0}],
+            "device_replica": {"degraded_served": 1e9},
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
     def test_device_section_is_metadata_never_banded(self):
         """The device truth plane's `device` section carries roofline
         fracs and HBM high-water — capture-HARDWARE facts (they move

@@ -14,9 +14,9 @@ def test_benchmarks_smoke_all(capsys):
     assert set(REGISTRY) == {
         "kv_vector", "kv_map", "kv_layer", "network", "sparse_matrix",
         "attention", "step_phases", "executor", "host_ingest", "wire",
-        "stream_prep", "serve", "trace", "ftrl_sparse_ab", "ftrl_chain",
-        "recovery_drill", "roofline", "bundle", "learning", "history_ab",
-        "rebalance",
+        "stream_prep", "serve", "decode_batching", "trace",
+        "ftrl_sparse_ab", "ftrl_chain", "recovery_drill", "roofline",
+        "bundle", "learning", "history_ab", "rebalance",
     }
     for name, fn in sorted(REGISTRY.items()):
         fn(True)

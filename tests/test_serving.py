@@ -235,6 +235,57 @@ class TestReadReplica:
         assert hit[:4].all() and not hit[4:].any()
         np.testing.assert_allclose(vals[:4], kv.values(0, hot[:4]))
 
+    def test_miss_counter_counts_keys_not_requests(self, mesh8):
+        """Regression pin for the hot-replica accounting contract:
+        ``ps_serve_replica_misses_total`` advances by the number of
+        missed KEYS, not by 1 per request that had any miss — the miss
+        RATE (misses/keys) is what sizes the hot set, and a per-request
+        count would understate it by the batch width."""
+        kv, keys = _store(mesh8)
+        hot = keys[:32]
+        rep = ReadReplica(kv, hot_keys=hot)
+
+        def count(name):
+            snap = Postoffice.instance().metrics.snapshot()
+            return sum(snap.get(name, {}).get("values", {}).values())
+
+        misses0 = count("ps_serve_replica_misses_total")
+        hits0 = count("ps_serve_replica_hits_total")
+        mixed = np.concatenate([hot[:3], keys[-5:]])  # ONE request
+        _, hit = rep.pull(mixed)
+        assert hit[:3].all() and not hit[3:].any()
+        assert count("ps_serve_replica_misses_total") - misses0 == 5
+        assert count("ps_serve_replica_hits_total") - hits0 == 3
+
+    def test_live_pull_receives_exactly_the_missed_keys(self, mesh8):
+        """The fall-through contract: a mixed hot/cold pull live-pulls
+        ONLY the missed rows (pulling the hits again would double the
+        live-store read load the hot replica exists to absorb)."""
+        kv, keys = _store(mesh8)
+        hot, cold = keys[:32], keys[-6:]
+        fe = ServeFrontend(
+            kv, ServeConfig(replica="hot", hot_keys=hot,
+                            coalesce_window_s=0.001, workers=1),
+        ).start()
+        try:
+            seen = []
+            orig = fe._live_pull
+
+            def spy(ks):
+                seen.append(np.asarray(ks).copy())
+                return orig(ks)
+
+            fe._live_pull = spy
+            mixed = np.concatenate([hot[:4], cold])
+            got = fe.submit(PullRequest(keys=mixed)).result(30)
+            np.testing.assert_allclose(got, kv.values(0, mixed))
+            assert len(seen) == 1
+            np.testing.assert_array_equal(
+                np.sort(seen[0]), np.sort(cold)
+            )
+        finally:
+            fe.close()
+
     def test_snapshot_step_serializes_with_pushes(self, mesh8):
         """KVVector.snapshot is a SUBMITTED step: a snapshot requested
         after a push observes that push (timestamp order), unlike a
@@ -247,6 +298,115 @@ class TestReadReplica:
         got = snap[slots]
         want = kv.values(0, keys[:8])
         np.testing.assert_allclose(got, want)
+
+
+class TestDeviceReplica:
+    def test_device_matches_host_full_and_hot(self, mesh8):
+        """device=True serves byte-identical values to the host-mode
+        replica, across request widths (the pow2-padded gather) and in
+        both full and hot-key modes — and the snapshot really stays a
+        device array."""
+        import jax
+
+        kv, keys = _store(mesh8)
+        host = ReadReplica(kv)
+        dev = ReadReplica(kv, device=True)
+        assert isinstance(dev._table, jax.Array)
+        assert isinstance(host._table, np.ndarray)
+        for n in (1, 3, 8, 17, 100):
+            vh, _ = host.pull(keys[:n])
+            vd, hit = dev.pull(keys[:n])
+            assert hit.all()
+            np.testing.assert_array_equal(vh, vd)
+        hot = keys[:32]
+        hh = ReadReplica(kv, hot_keys=hot)
+        hd = ReadReplica(kv, hot_keys=hot, device=True)
+        mixed = np.concatenate([hot[:5], keys[-3:]])
+        vh, mh = hh.pull(mixed)
+        vd, md = hd.pull(mixed)
+        np.testing.assert_array_equal(mh, md)
+        np.testing.assert_array_equal(vh, vd)
+
+    def test_device_reads_survive_concurrent_donated_push_stream(
+        self, mesh8
+    ):
+        """The zero-copy hazard, device edition: the device snapshot is
+        the executor's submitted copy, so reads and refreshes stay
+        consistent while training pushes donate the live table."""
+        kv, keys = _store(mesh8)
+        rep = ReadReplica(kv, device=True)
+        stop = threading.Event()
+        push_err = []
+
+        def pusher():
+            try:
+                while not stop.is_set():
+                    kv.wait(kv.push(
+                        kv.request(channel=0), keys=keys[:64],
+                        values=np.ones((64, 1), np.float32),
+                    ))
+            except BaseException as e:
+                push_err.append(e)
+
+        t = threading.Thread(target=pusher)
+        t.start()
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            vals, hit = rep.pull(keys[:32])
+            assert hit.all() and vals.shape == (32, 1)
+            rep.refresh()
+        stop.set()
+        t.join(timeout=60)
+        assert not push_err
+
+    def test_host_budget_fails_loudly_device_ignores_it(self, mesh8):
+        """``host_budget_bytes`` below the table size: host mode
+        refuses the snapshot with MemoryError (pointing at device
+        mode); device mode serves the same table under the same budget
+        — capacity scales with HBM, not host RAM."""
+        kv, keys = _store(mesh8)
+        table_bytes = ReadReplica(kv).nbytes()
+        budget = table_bytes // 2
+        with pytest.raises(MemoryError, match="device=True"):
+            ReadReplica(kv, host_budget_bytes=budget)
+        dev = ReadReplica(kv, device=True, host_budget_bytes=budget)
+        vals, hit = dev.pull(keys[:8])
+        assert hit.all()
+        np.testing.assert_allclose(vals, kv.values(0, keys[:8]))
+
+    def test_device_frontend_over_host_budget_zero_degraded(self, mesh8):
+        """The acceptance arc: a frontend in device-replica mode serves
+        a table LARGER than the configured host-replica budget, with
+        background refreshes live, and zero DegradedErrors (and zero
+        degraded fallbacks) across the run."""
+        kv, keys = _store(mesh8)
+        budget = ReadReplica(kv).nbytes() // 2
+        fe = ServeFrontend(
+            kv,
+            ServeConfig(replica="full", workers=2,
+                        replica_device=True,
+                        replica_host_budget_bytes=budget,
+                        replica_refresh_s=0.02),
+        ).start()
+        try:
+            assert fe.replica.device
+            deadline = time.monotonic() + 0.5
+            served = 0
+            while time.monotonic() < deadline:
+                got = fe.submit(PullRequest(keys=keys[:16])).result(30)
+                np.testing.assert_allclose(got, kv.values(0, keys[:16]))
+                served += 1
+            assert served > 0
+            assert fe.degraded_served == 0
+            snap = Postoffice.instance().metrics.snapshot()
+            degraded = sum(
+                snap.get("ps_serve_degraded_total", {})
+                .get("values", {}).values()
+            )
+            assert degraded == 0
+            assert fe.stats()["replica"]["device"] is True
+        finally:
+            fe.close()
 
 
 class TestFrontend:
