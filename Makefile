@@ -43,12 +43,17 @@ smoke: native
 
 # the full static-analysis suite (script/pslint/, doc/STATIC_ANALYSIS.md):
 # lock-discipline race detector (+ lock-order deadlock cycles),
-# thread-lifecycle, jit-purity, donation, metrics — one engine, one
-# findings report (`path:line rule message`, editor-clickable), exit 1
-# on any unsuppressed finding (fast, no accelerator; also a tier-1
-# test in tests/test_pslint.py)
+# thread-lifecycle, jit-purity, donation, metrics, spans, plus the v2
+# interprocedural passes — use-after-donate dataflow, thread-affinity,
+# determinism, cross-artifact consistency — one engine, one findings
+# report (`path:line rule message`, editor-clickable), exit 1 on any
+# unsuppressed finding. --timings prints per-pass wall-clock and cache
+# hit counts; --budget fails the target (exit 2) if the suite drifts
+# past its stated wall-clock (cold run is ~7s; per-file passes cache
+# by content hash in .pslint-cache.json, gitignored). Fast, no
+# accelerator; also a tier-1 test in tests/test_pslint.py.
 pslint:
-	python script/pslint/cli.py
+	python script/pslint/cli.py --timings --budget 60
 
 # the multi-device partitioning suite on a FORCED 8-device CPU
 # platform: partitioner spec resolution, mesh auto-shaping (8 -> 4x2,

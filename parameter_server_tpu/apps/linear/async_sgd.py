@@ -1945,6 +1945,7 @@ def _wire_encoding_name(prepped) -> str:
     return "raw"
 
 
+# owner-thread: consumer
 class DeviceUploader:
     """Double-buffered host→device stage of the ingest pipeline.
 

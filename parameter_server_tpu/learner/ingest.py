@@ -35,6 +35,7 @@ occurred; ``close()`` joins every thread (early consumer exit leaks
 nothing). Telemetry (``ps_ingest_*``, doc/OBSERVABILITY.md) records
 per-stage latency histograms, queue-depth gauges, and volume counters.
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 
@@ -118,6 +119,7 @@ class IngestPipeline:
         stage span carries it — items flow on as (flow, batch)."""
         src = self._source
         while True:
+            # pslint: disable=determinism — trace/telemetry birth timestamp only; it rides span metadata, never the encoded batch bytes the replay contract covers
             t_wall = time.time()
             t0 = time.perf_counter()
             try:

@@ -52,6 +52,7 @@ hand-off, replay spill) and for chain round-trip tests — on the
 host→device leg the chain's transforms are realized by the jit-side
 decode ops instead, which is what keeps the decode inside the step.
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 

@@ -15,6 +15,7 @@ learning-rate decay for hot features.
 ``ftrl_update(z, n, g, touched, ...)`` auto-selects: Pallas on TPU backends,
 pure-jnp elsewhere (bit-identical math in f32; tests compare both).
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 

@@ -39,6 +39,7 @@ BIT-IDENTICAL to the jnp reference's position-hash dither
 tileable shapes, the XLA rows reference elsewhere (bit-identical
 formulation of updaters.apply_state_rows for the FTRL/decay case).
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 

@@ -29,6 +29,7 @@ back to the raw wire otherwise), so the default ``exact`` wire decodes
 bit-identical to the unencoded stream — parity-tested in
 tests/test_wire.py.
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 

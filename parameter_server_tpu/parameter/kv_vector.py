@@ -25,6 +25,7 @@ and ``table(copy=True)`` offers on device. Pull results never alias the
 table (gathers materialize fresh rows), so pulled values stay valid
 across later pushes. See doc/PERFORMANCE.md "Donation rules".
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 

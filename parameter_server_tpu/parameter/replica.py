@@ -9,6 +9,7 @@ orbax (resharding on restore handles server-count changes, the analog of
 key-range reassignment in ``reassign_server_key_range_ps.cc``), with a
 NumPy fallback writer for environments without orbax.
 """
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
 
 from __future__ import annotations
 
@@ -269,6 +270,7 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         self.wait()  # a half-written async step dir must not be listed
         steps = []
+        # pslint: disable=determinism — feeds max() below, an order-insensitive consumer; sorting the listing would buy nothing
         for name in os.listdir(self.directory):
             if name.startswith("step_"):
                 try:
@@ -320,6 +322,7 @@ class ReplicaManager:
             self._meta[name] = {
                 "barrier": dict(barrier),
                 "version": (prev["version"] + 1) if prev else 1,
+                # pslint: disable=determinism — operator-facing snapshot metadata ('when was this taken'), not part of the replayed/recovered bytes
                 "at": time.time(),
                 "consistent": consistent,
             }

@@ -114,6 +114,7 @@ class BatchHandle:
         self.out = np.zeros((n_rows, width + int(req.steps)), np.int32)
 
 
+# owner-thread: scheduler
 class ContinuousBatcher:
     """ONE running speculative decode shared by concurrent sessions.
 

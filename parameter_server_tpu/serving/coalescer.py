@@ -96,6 +96,7 @@ class PullTicket:
         return self._win.values[pos]
 
 
+# owner-thread: flusher
 class PullCoalescer:
     """Merge concurrent pulls against one store channel.
 

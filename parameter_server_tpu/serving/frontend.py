@@ -284,6 +284,7 @@ class ServeFrontend:
             return self
         for i in range(max(1, self.cfg.workers)):
             t = threading.Thread(
+                # pslint: disable=guarded-access — passing the deque REFERENCE to the worker before start(); Thread.start() is the happens-before edge, and the reference itself is never reassigned
                 target=self._worker_loop, args=(self._queue,),
                 name=f"serve-worker-{i}", daemon=True,
             )
@@ -300,6 +301,7 @@ class ServeFrontend:
             self._threads.append(t)
         elif self.decode_fn is not None:
             t = threading.Thread(
+                # pslint: disable=guarded-access — same reference-pass-before-start() as the worker spawn above
                 target=self._worker_loop, args=(self._decode_queue,),
                 name="serve-decode", daemon=True,
             )
@@ -491,6 +493,7 @@ class ServeFrontend:
     # -- workers --
 
     def _worker_loop(self, queue: deque) -> None:
+        # pslint: disable=guarded-access — identity check against a reference that is assigned once in __init__ and never rebound; no element access happens here
         decode_lane = queue is self._decode_queue
         while True:
             with self._cv:

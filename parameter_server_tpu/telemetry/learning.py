@@ -96,6 +96,7 @@ def _shard_starts(num_slots: int, num_shards: int) -> np.ndarray:
     return np.asarray(starts, dtype=np.int64)
 
 
+# owner-thread: feeder
 class KeyHeat:
     """Windowed key-heat accounting over table slots.
 

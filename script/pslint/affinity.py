@@ -1,0 +1,255 @@
+"""Thread-affinity pass (rule ``thread-affinity``).
+
+The stateless-or-feeder rule (PR 3, ROADMAP invariant): mutable state
+lives on exactly one owner thread; other threads only FEED it through
+locked handoff points. ``ContinuousBatcher._check_owner`` enforces this
+dynamically for one class — this pass makes the rule static for every
+class that declares its owner:
+
+    # owner-thread: scheduler
+    class ContinuousBatcher:
+        ...
+
+on the ``class`` line (all methods owned) or on an individual ``def``
+line (that method only; a method-level annotation overrides the class
+level, and the special owner ``any`` marks a method as intentionally
+thread-safe/shared, exempting it).
+
+A finding is an owned method reachable from **two or more distinct
+thread entry points** without a lock: the method's state can be touched
+concurrently, which is exactly what single-owner design forbids. Entry
+points are:
+
+- ``Thread(target=X)`` spawn sites — identified by the thread's
+  ``name=`` constant when given, else by spawn file:line (two spawns of
+  the same target ARE two entries: that target runs concurrently with
+  itself);
+- HTTP handler methods (``do_GET``-shaped methods of ``*Handler``
+  classes) — the stdlib server runs each on its service thread.
+
+"Reachable" is the call graph the symbol table can see, **two call
+levels deep** from the entry function: ``self.m()``, methods of typed
+attributes (``self.x = ClassName(...)``), and same-file module
+functions. Deeper chains, callbacks, and dynamic dispatch are
+invisible — the pass under-approximates reachability, never
+over-approximates an exemption.
+
+The lock escape: an owned method that takes any of its class's locks
+(lexical ``with self.<lock>:``) or declares ``# holds-lock:`` is a
+feeder handoff, not a violation. ``__init__``/``__del__`` are exempt
+(construction happens-before sharing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import (
+    ClassModel,
+    Finding,
+    Rule,
+    SourceFile,
+    callee_chain,
+    self_attr,
+    walk_package,
+)
+
+#: methods the stdlib HTTP machinery invokes on a service thread
+_HTTP_METHOD_RE = "do_"
+
+
+def _thread_ctor(call: ast.Call) -> bool:
+    return callee_chain(call)[-1] == "Thread"
+
+
+class ThreadAffinityRule(Rule):
+    name = "thread-affinity"
+    version = "1"
+
+    def __init__(self, scope: Optional[Sequence[str]] = None):
+        self.scope = tuple(scope) if scope is not None else None
+
+    def paths(self, root: str) -> Sequence[str]:
+        if self.scope is not None:
+            return self.scope
+        return walk_package(root)
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        project = self.get_project(files)
+        index = {
+            name: model
+            for name, model in project.class_index().items()
+            if model is not None and model.sf.rel in files
+        }
+        # module-level functions per file (for Thread(target=plain_name))
+        module_funcs: Dict[str, Dict[str, ast.AST]] = {}
+        for rel, sf in files.items():
+            module_funcs[rel] = {
+                n.name: n
+                for n in sf.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+
+        # -- entry points: (entry_id, model|None, fn, rel) -------------
+        entries: List[Tuple[str, Optional[ClassModel], ast.AST, str]] = []
+        for rel, sf in files.items():
+            models_here = {m.name: m for m in project.classes(rel)}
+            parents = None
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call) and _thread_ctor(node)):
+                    continue
+                target = None
+                tname = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                    elif kw.arg == "name" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        tname = str(kw.value.value)
+                if target is None and node.args:
+                    target = node.args[1] if len(node.args) > 1 else None
+                if target is None:
+                    continue
+                entry_id = tname or f"{rel}:{node.lineno}"
+                if parents is None:
+                    parents = sf.parents()
+                resolved = self._resolve_target(
+                    target, node, parents, models_here, index,
+                    module_funcs.get(rel, {}),
+                )
+                if resolved is not None:
+                    model, fn = resolved
+                    entries.append((entry_id, model, fn, rel))
+            # HTTP handlers: each do_* method is its own service-thread
+            # entry into the process
+            for model in models_here.values():
+                if not model.name.endswith("Handler"):
+                    continue
+                for mname, fn in model.methods.items():
+                    if mname.startswith(_HTTP_METHOD_RE):
+                        entries.append(
+                            (f"http:{model.name}.{mname}", model, fn, rel)
+                        )
+
+        # -- reachability, two call levels deep ------------------------
+        # owned (class, method) -> {entry ids that reach it}
+        reached: Dict[Tuple[str, str], Set[str]] = {}
+        sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for entry_id, model, fn, rel in entries:
+            seen: Set[int] = set()
+            frontier = [(model, fn, rel)]
+            for _depth in range(3):  # entry fn + two levels of callees
+                nxt: List[Tuple[Optional[ClassModel], ast.AST, str]] = []
+                for cmodel, cfn, crel in frontier:
+                    if id(cfn) in seen:
+                        continue
+                    seen.add(id(cfn))
+                    self._note(cmodel, cfn, entry_id, reached, sites)
+                    nxt.extend(
+                        self._callees(cmodel, cfn, crel, index, module_funcs)
+                    )
+                frontier = nxt
+        findings: List[Finding] = []
+        for (cname, mname), ids in sorted(reached.items()):
+            if len(ids) < 2:
+                continue
+            path, line = sites[(cname, mname)]
+            shown = ", ".join(sorted(ids))
+            findings.append(
+                Finding(
+                    path,
+                    line,
+                    "thread-affinity",
+                    f"{cname}.{mname} is owner-thread state but is "
+                    f"reachable from {len(ids)} thread entry points "
+                    f"({shown}) without a lock; add a lock/holds-lock, "
+                    "route through a locked feeder, or annotate the "
+                    "method '# owner-thread: any' if it is thread-safe",
+                )
+            )
+        return findings
+
+    # -- helpers ------------------------------------------------------
+
+    def _note(self, model, fn, entry_id, reached, sites) -> None:
+        if model is None or not isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            return
+        owner = model.method_owner.get(fn.name, model.owner_thread)
+        if owner is None or owner == "any":
+            return
+        if fn.name in ("__init__", "__del__"):
+            return
+        if model.acquires_any_lock(fn):
+            return
+        key = (model.name, fn.name)
+        reached.setdefault(key, set()).add(entry_id)
+        sites[key] = (model.sf.rel, fn.lineno)
+
+    def _resolve_target(
+        self, target, call, parents, models_here, index, funcs
+    ) -> Optional[Tuple[Optional[ClassModel], ast.AST]]:
+        """Thread target expr -> (owning class model | None, def)."""
+        attr = self_attr(target)
+        if attr is not None:
+            # enclosing class of the spawn site owns self
+            node = call
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, ast.ClassDef):
+                    model = models_here.get(node.name) or index.get(node.name)
+                    if model is not None and attr in model.methods:
+                        return model, model.methods[attr]
+                    return None
+            return None
+        if isinstance(target, ast.Name) and target.id in funcs:
+            return None, funcs[target.id]
+        if isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ):
+            # obj.method where obj's class is identifiable by unique name
+            for model in index.values():
+                if model.name == target.value.id:
+                    fn = model.methods.get(target.attr)
+                    if fn is not None:
+                        return model, fn
+        return None
+
+    def _callees(
+        self, model, fn, rel, index, module_funcs
+    ) -> List[Tuple[Optional[ClassModel], ast.AST, str]]:
+        out: List[Tuple[Optional[ClassModel], ast.AST, str]] = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                owner = f.value
+                if (
+                    isinstance(owner, ast.Name)
+                    and owner.id in ("self", "cls")
+                    and model is not None
+                ):
+                    m = model.methods.get(f.attr)
+                    if m is not None:
+                        out.append((model, m, model.sf.rel))
+                    continue
+                oattr = self_attr(owner)
+                if (
+                    oattr is not None
+                    and model is not None
+                    and oattr in model.attr_types
+                ):
+                    other = index.get(model.attr_types[oattr])
+                    if other is not None:
+                        m = other.methods.get(f.attr)
+                        if m is not None:
+                            out.append((other, m, other.sf.rel))
+            elif isinstance(f, ast.Name):
+                funcs = module_funcs.get(rel, {})
+                if f.id in funcs:
+                    out.append((None, funcs[f.id], rel))
+        return out

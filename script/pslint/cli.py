@@ -4,11 +4,18 @@
     python script/pslint/cli.py              # all passes, repo root
     python script/pslint/cli.py --rules locks,threads
     python script/pslint/cli.py --list       # show registered passes
+    python script/pslint/cli.py --timings --budget 60   # CI shape
 
 Findings print one per line as ``path:line rule message`` (clickable
 in editors); exit 0 = clean, 1 = unsuppressed findings, 2 = usage or
-internal error. Run via ``make pslint`` (aggregate) — ``make
-metrics-lint`` / ``make donation-lint`` alias single passes.
+internal error (or budget exceeded with --budget). Run via ``make
+pslint`` (aggregate) — ``make metrics-lint`` / ``make donation-lint``
+alias single passes.
+
+Per-file passes cache their findings by content hash in
+``.pslint-cache.json`` at the repo root (gitignored); ``--no-cache``
+forces a cold run, which is also what ``--budget`` is calibrated
+against.
 """
 
 from __future__ import annotations
@@ -16,10 +23,13 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pslint.engine import Engine, default_rules  # noqa: E402
+
+CACHE_BASENAME = ".pslint-cache.json"
 
 
 def main(argv=None) -> int:
@@ -38,6 +48,29 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list", action="store_true", help="list registered passes and exit"
     )
+    parser.add_argument(
+        "--timings",
+        action="store_true",
+        help="report per-pass wall-clock and cache hit counts",
+    )
+    parser.add_argument(
+        "--budget",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="fail (exit 2) if total analysis wall-clock exceeds this "
+        "(CI keeps the suite honest about staying fast)",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and do not write the incremental cache (cold run)",
+    )
+    parser.add_argument(
+        "--cache-path",
+        default=None,
+        help=f"cache file location (default: <root>/{CACHE_BASENAME})",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -52,15 +85,42 @@ def main(argv=None) -> int:
             print(r.name)
         return 0
 
+    cache_path = None
+    if not args.no_cache:
+        cache_path = args.cache_path or os.path.join(
+            args.root, CACHE_BASENAME
+        )
+
+    t0 = time.perf_counter()
+    engine = Engine(args.root, rules, cache_path=cache_path)
     try:
-        findings, suppressed = Engine(args.root, rules).run()
+        findings, suppressed = engine.run()
     except Exception as e:  # engine bug, unreadable tree, ...
         print(f"pslint: internal error: {type(e).__name__}: {e}", file=sys.stderr)
         return 2
+    elapsed = time.perf_counter() - t0
 
     for f in findings:
         print(f.format())
+    if args.timings:
+        for name in sorted(engine.timings, key=engine.timings.get, reverse=True):
+            st = engine.stats.get(name, {})
+            print(
+                f"pslint: timing {name}: {engine.timings[name]:.3f}s "
+                f"(analyzed {st.get('analyzed', 0)}, "
+                f"cached {st.get('cached', 0)})",
+                file=sys.stderr,
+            )
+        print(f"pslint: timing total: {elapsed:.3f}s", file=sys.stderr)
     names = ",".join(r.name for r in rules)
+    if args.budget is not None and elapsed > args.budget:
+        print(
+            f"pslint: BUDGET EXCEEDED: {elapsed:.1f}s > {args.budget:.1f}s "
+            f"[{names}] — profile with --timings; the suite must stay "
+            "inside its stated wall-clock",
+            file=sys.stderr,
+        )
+        return 2
     if findings:
         print(
             f"pslint: FAILED ({len(findings)} findings, "

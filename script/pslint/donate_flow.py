@@ -1,0 +1,362 @@
+"""Use-after-donate dataflow pass (rule ``use-after-donate``).
+
+``donate_argnums`` hands a buffer's storage to XLA: after the call the
+Python binding still *names* the donated array, but touching it raises
+(or worse, silently reads freed storage on some backends). The runtime
+already papers over double-donation with the retry-undonated fallback
+(ops/kv_ops.py) — this pass makes the hazard a commit-time finding
+instead of a runtime fallback counter.
+
+What it tracks, per function body, in source order:
+
+- a call whose callee **donates** positional arguments marks each
+  donated argument expression's *binding path* (``buf``, ``c.table``,
+  ``box[0]`` — attribute chains and subscripts, subscripts wildcarded)
+  as dead from that line;
+- a later **read** of a dead path (or any extension of it —
+  ``c.table.shape`` after ``c.table`` was donated) is a finding; passing
+  it to another call (re-submit), ``len()``, returning it all count,
+  because they are all reads of the donated binding;
+- **rebinding kills**: assigning to the path (or a prefix of it)
+  revives the binding. Assignment VALUES are processed before their
+  targets, so the canonical ``c.table = kv_ops.push_donated(c.table,
+  ...)`` round-trip — donate then immediately rebind — is clean;
+- **branches don't see each other**: each arm of an ``if``/``try``
+  analyzes a copy of the state; a donation in one arm and a use in its
+  sibling never pair up. Donations do flow *out* of branches
+  (may-donate), and a kill in any arm clears (may-kill) — the pass
+  prefers missing a path-sensitive bug to flagging correct code.
+
+Which callees donate comes from the project symbol table
+(``Project.donating()``): ``donate_argnums=`` declarations anywhere in
+an assignment's value or a decorator, ``# donates: <pos>[,<pos>]``
+annotations on a ``def`` line, one level of wrapper propagation (a
+function that forwards its own parameter at a donated position of a
+donating callee donates that parameter), and the naming heuristic — an
+unresolvable callee whose terminal name ends in ``_donated`` donates
+its first positional argument (the ``push_donated`` /
+``kv_push_pull_donated`` wrapper shape).
+
+Blind spots, by design: aliasing (``alias = buf`` before donation is
+invisible), donation through container elements other than the exact
+subscript path, and flows deeper than one wrapper level. Escape hatch
+for deliberate post-donation touches (there should be almost none):
+``# donated-dead: <reason>`` on the use line, or the standard
+``# pslint: disable=use-after-donate — <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .engine import (
+    DONATED_NAME_RE,
+    Finding,
+    Rule,
+    SourceFile,
+    _donate_positions as _donate_pos,
+    callee_chain,
+    walk_package,
+)
+
+DONATED_DEAD_RE = re.compile(r"#\s*donated-dead:\s*\S")
+
+
+def _name_sources(value: ast.AST) -> List[str]:
+    """Plain names a value could be an alias of: ``a``, ``a if c else
+    b``, ``a or b`` — the donating-selector idioms."""
+    if isinstance(value, ast.Name):
+        return [value.id]
+    if isinstance(value, ast.IfExp):
+        return _name_sources(value.body) + _name_sources(value.orelse)
+    if isinstance(value, ast.BoolOp):
+        out: List[str] = []
+        for v in value.values:
+            out.extend(_name_sources(v))
+        return out
+    return []
+
+Path = Tuple[str, ...]
+
+
+def _path(node: ast.AST) -> Optional[Path]:
+    """Binding path of an expression: ``c.table`` -> ("c", "table"),
+    ``box[0]`` -> ("box", "[]"); None when not a plain chain."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            parts.append("[]")
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            break
+        else:
+            return None
+    return tuple(reversed(parts))
+
+
+def _extends(path: Path, dead: Path) -> bool:
+    """Does reading ``path`` touch the dead binding? True when ``path``
+    equals or descends from ``dead``."""
+    return len(path) >= len(dead) and path[: len(dead)] == dead
+
+
+class _FunctionAnalysis:
+    """Linear may-analysis over one function body."""
+
+    def __init__(self, rule: "UseAfterDonateRule", sf: SourceFile, donating):
+        self.rule = rule
+        self.sf = sf
+        self.donating = donating
+        self.local: Dict[str, Tuple[int, ...]] = {}
+        self.findings: List[Finding] = []
+
+    def seed_locals(self, fn: ast.AST) -> None:
+        """Function-local donating names (kept OUT of the project map so
+        a local ``fn = jax.jit(..., donate_argnums=...)`` cannot poison
+        unrelated files): direct assigns with ``donate_argnums``, nested
+        defs with donating decorators, and aliases of donating names —
+        including the ``fn = donating if flag else plain`` selector
+        idiom, which unions the arms (may-donate)."""
+        for _ in range(2):  # aliases of aliases settle on pass two
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    pos = set(_donate_pos(node.value))
+                    for src in _name_sources(node.value):
+                        pos.update(self.local.get(src, ()))
+                        pos.update(self.donating.get(src, ()))
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.local[t.id] = tuple(sorted(pos))
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if node is fn:
+                        continue
+                    for dec in node.decorator_list:
+                        dpos = _donate_pos(dec)
+                        if dpos:
+                            self.local[node.name] = dpos
+
+    def call_donates(self, call: ast.Call) -> Tuple[int, ...]:
+        name = callee_chain(call)[-1]
+        if isinstance(call.func, ast.Name) and call.func.id in self.local:
+            return self.local[call.func.id]
+        if name in self.donating:
+            return self.donating[name]
+        if DONATED_NAME_RE.search(name):
+            return (0,)
+        return ()
+
+    # -- statements ---------------------------------------------------
+
+    def stmts(self, body, dead: Dict[Path, Tuple[int, str]]) -> bool:
+        """Returns True when the body definitely terminates (return/
+        raise/break/continue) — its state must not flow past a branch."""
+        for stmt in body:
+            if self.stmt(stmt, dead):
+                return True
+        return False
+
+    def stmt(self, stmt, dead: Dict[Path, Tuple[int, str]]) -> bool:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return False  # nested defs analyze as their own functions
+        if isinstance(stmt, ast.Assign):
+            self.expr(stmt.value, dead)
+            for t in stmt.targets:
+                self.kill(t, dead)
+            return False
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.expr(stmt.value, dead)
+            self.kill(stmt.target, dead)
+            return False
+        if isinstance(stmt, ast.AugAssign):
+            self.expr(stmt.value, dead)
+            self.expr(stmt.target, dead)  # augmented assign READS too
+            self.kill(stmt.target, dead)
+            return False
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.expr(stmt.iter, dead)
+            self.kill(stmt.target, dead)
+            self.branches([stmt.body, stmt.orelse], dead)
+            return False
+        if isinstance(stmt, ast.While):
+            self.expr(stmt.test, dead)
+            self.branches([stmt.body, stmt.orelse], dead)
+            return False
+        if isinstance(stmt, ast.If):
+            self.expr(stmt.test, dead)
+            return self.branches([stmt.body, stmt.orelse], dead)
+        if isinstance(stmt, ast.Try):
+            self.branches(
+                [stmt.body + stmt.orelse]
+                + [h.body for h in stmt.handlers],
+                dead,
+            )
+            return self.stmts(stmt.finalbody, dead)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.expr(item.context_expr, dead)
+                if item.optional_vars is not None:
+                    self.kill(item.optional_vars, dead)
+            return self.stmts(stmt.body, dead)
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.expr(stmt.value, dead)
+            return True
+        if isinstance(stmt, ast.Raise):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.expr(child, dead)
+            return True
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(stmt, ast.Expr):
+            self.expr(stmt.value, dead)
+            return False
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self.kill(t, dead)  # del is an explicit drop, not a read
+            return False
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.expr(child, dead)
+            elif isinstance(child, ast.stmt):
+                self.stmt(child, dead)
+        return False
+
+    def branches(self, arms, dead: Dict[Path, Tuple[int, str]]) -> bool:
+        """Each arm runs on a copy; afterwards donations union out
+        (may-donate) and any arm's kill clears (may-kill). An arm that
+        definitely terminates contributes nothing to fall-through state
+        — `if cond: return donating(x)` leaves x alive after the if.
+        Returns True when EVERY arm terminates."""
+        base = dict(dead)
+        states = []
+        terminated_all = bool(arms)
+        for arm in arms:
+            s = dict(base)
+            if self.stmts(arm, s):
+                continue  # no fall-through from this arm
+            terminated_all = False
+            states.append(s)
+        if not states:
+            return terminated_all
+        killed = set()
+        for s in states:
+            for p in base:
+                if p not in s:
+                    killed.add(p)
+        dead.clear()
+        for s in states:
+            for p, v in s.items():
+                if p not in killed:
+                    dead.setdefault(p, v)
+        return False
+
+    # -- expressions --------------------------------------------------
+
+    def expr(self, node: ast.AST, dead: Dict[Path, Tuple[int, str]]):
+        if isinstance(node, ast.Lambda):
+            return  # deferred body: runs later, order unknowable here
+        if isinstance(node, ast.Call):
+            # uses are checked against the PRE-call dead set: the arg
+            # being donated by this very call is the donation itself,
+            # not a use — but an already-dead arg is a re-submit
+            for child in list(node.args) + [kw.value for kw in node.keywords]:
+                self.expr(child, dead)
+            self.expr(node.func, dead)
+            positions = self.call_donates(node)
+            for pos in positions:
+                if pos < len(node.args):
+                    p = _path(node.args[pos])
+                    if p is not None:
+                        dead[p] = (node.lineno, callee_chain(node)[-1])
+            return
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Name)):
+            p = _path(node)
+            if p is not None:
+                self.use(node, p, dead)
+                return
+            # unchained base (e.g. f().x): descend into the value
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.expr(child, dead)
+            elif isinstance(child, ast.comprehension):
+                self.expr(child.iter, dead)
+                for cond in child.ifs:
+                    self.expr(cond, dead)
+
+    def use(self, node: ast.AST, path: Path, dead):
+        for dpath, (dline, callee) in dead.items():
+            if not _extends(path, dpath):
+                continue
+            line = node.lineno
+            if DONATED_DEAD_RE.search(self.sf.comment_at_or_above(line)):
+                return
+            self.findings.append(
+                Finding(
+                    self.sf.rel,
+                    line,
+                    "use-after-donate",
+                    f"'{'.'.join(path)}' was donated to {callee}() on line "
+                    f"{dline} and is dead; rebind it from the call's result "
+                    "or mark the use '# donated-dead: <reason>'",
+                )
+            )
+            return
+
+    def kill(self, target: ast.AST, dead):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.kill(el, dead)
+            return
+        if isinstance(target, ast.Starred):
+            self.kill(target.value, dead)
+            return
+        p = _path(target)
+        if p is None:
+            return
+        # assigning to a path revives it and everything beneath it
+        for dpath in [d for d in dead if _extends(d, p)]:
+            del dead[dpath]
+        # subscript/attr writes into a dead buffer are themselves uses:
+        # box[0][3] = v after box[0] was donated writes freed storage
+        if len(p) > 1:
+            prefix = p[:-1]
+            for dpath in list(dead):
+                if _extends(prefix, dpath):
+                    self.use(target, prefix, dead)
+                    return
+
+
+class UseAfterDonateRule(Rule):
+    name = "use-after-donate"
+    version = "1"
+
+    def __init__(self, scope: Optional[Sequence[str]] = None):
+        self.scope = tuple(scope) if scope is not None else None
+
+    def paths(self, root: str) -> Sequence[str]:
+        if self.scope is not None:
+            return self.scope
+        return walk_package(root)
+
+    def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
+        project = self.get_project(files)
+        donating = project.donating()
+        findings: List[Finding] = []
+        for sf in files.values():
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                fa = _FunctionAnalysis(self, sf, donating)
+                fa.seed_locals(node)
+                fa.stmts(node.body, {})
+                findings.extend(fa.findings)
+        return findings

@@ -4,14 +4,29 @@ Responsibilities (and nothing else — rules own their logic):
 
 - **file discovery + parsing**: each scoped file is read, tokenized and
   ast-parsed exactly ONCE per run, then shared across passes;
+- **project symbol table**: a cross-file view built lazily over every
+  scoped file — per-class models (locks, guards, attribute types,
+  methods, owner-thread annotations), jit-target names, a donation map
+  (which callables consume which positional buffers, propagated one
+  wrapper level), and one-level call resolution. Passes that reason
+  across files (locks, use-after-donate, thread-affinity) consume THIS
+  table instead of growing private ones;
 - **suppressions**: ``# pslint: disable=<rule>[,<rule>] — <reason>``
   on the flagged line (or a standalone comment on the line above)
   silences that rule there. The reason is MANDATORY — a disable
   without one is itself a finding (rule ``suppression``) that cannot
   be suppressed;
+- **incremental cache**: per-file passes (``Rule.per_file = True``)
+  cache their findings keyed by the file's CONTENT HASH (+ engine and
+  rule version salts), so an unchanged file never re-analyzes and an
+  edited file always does — a stale entry can never hide a finding
+  because the key is the content itself. Cross-file passes are never
+  cached: one file's edit can change another file's findings, which is
+  exactly the staleness a per-file key cannot express;
 - **report + exit codes**: findings print one per line as
   ``path:line rule message`` (editor-clickable), exit 0 clean / 1
-  findings / 2 internal error.
+  findings / 2 internal error. Per-pass wall-clock lands in
+  ``Engine.timings`` (``cli.py --timings``).
 
 The engine imports only the standard library — no jax, no repo
 modules — so the static passes stay import-safe and fast. Dynamic
@@ -21,12 +36,19 @@ passes (metrics) do their own guarded imports inside ``check``.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
+import json
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: cache salt — bump whenever engine or pass semantics change so a
+#: stale cache from an older checkout cannot satisfy a newer rule
+PSLINT_VERSION = "2"
 
 
 @dataclass(frozen=True)
@@ -51,6 +73,15 @@ _SUPPRESS_RE = re.compile(
 
 _SUPPRESSION_RULE = "suppression"
 
+# shared annotation grammar (doc/STATIC_ANALYSIS.md):
+GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+HOLDS_LOCK_RE = re.compile(r"holds-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
+OWNER_THREAD_RE = re.compile(r"owner-thread:\s*([A-Za-z_][A-Za-z0-9_.-]*)")
+DONATES_RE = re.compile(r"#\s*donates:\s*([0-9]+(?:\s*,\s*[0-9]+)*)")
+BIT_IDENTICAL_RE = re.compile(r"#\s*bit-identical\b")
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
 
 class SourceFile:
     """One scoped file, parsed once and shared by every pass."""
@@ -61,6 +92,7 @@ class SourceFile:
         self.path = os.path.join(root, rel)
         with open(self.path, "r", encoding="utf-8") as f:
             self.text = f.read()
+        self.sha = hashlib.sha256(self.text.encode("utf-8")).hexdigest()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=self.rel)
         # line -> raw comment text (tokenize keeps comments ast drops)
@@ -80,6 +112,17 @@ class SourceFile:
             rules = {r.strip() for r in m.group("rules").split(",")}
             reason = (m.group("reason") or "").strip()
             self.suppressions[line] = (rules, bool(reason))
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """child AST node -> parent, built once and shared (threads,
+        spans and the dataflow passes all need the parent chain)."""
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
 
     def comment_at_or_above(self, line: int) -> str:
         """Trailing comment on ``line`` plus any comment line directly
@@ -108,6 +151,409 @@ class SourceFile:
         return False
 
 
+# -- symbol table -----------------------------------------------------
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` or ``cls.X`` -> ``X`` (instance and classmethod forms
+    address the same per-class state)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("self", "cls")
+    ):
+        return node.attr
+    return None
+
+
+def _lock_factory_call(node: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """``threading.Lock()`` etc -> (factory, wrapped_attr|None)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute) and fn.attr in LOCK_FACTORIES:
+        name = fn.attr
+    elif isinstance(fn, ast.Name) and fn.id in LOCK_FACTORIES:
+        name = fn.id
+    if name is None:
+        return None
+    wrapped = None
+    if name == "Condition" and node.args:
+        wrapped = self_attr(node.args[0])
+    return name, wrapped
+
+
+class ClassModel:
+    """Per-class facts shared by the locks / affinity / dataflow
+    passes: locks, aliases, guards, attribute types, methods, and the
+    single-owner annotations."""
+
+    def __init__(self, name: str, sf: SourceFile, lineno: int = 0):
+        self.name = name
+        self.sf = sf
+        self.lineno = lineno
+        self.locks: Set[str] = set()
+        self.alias: Dict[str, str] = {}  # condition attr -> wrapped lock
+        self.guards: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
+        self.attr_types: Dict[str, str] = {}  # attr -> class name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.owner_thread: Optional[str] = None  # class-level owner
+        self.method_owner: Dict[str, str] = {}  # per-method owner
+
+    def canonical(self, lock: str) -> str:
+        """Condition-over-lock aliases collapse to the wrapped lock."""
+        return self.alias.get(lock, lock)
+
+    def held_closure(self, lock: str) -> Set[str]:
+        """Every lock name satisfied by acquiring ``lock``."""
+        out = {lock}
+        wrapped = self.alias.get(lock)
+        if wrapped is not None:
+            out.add(wrapped)
+        for cond, target in self.alias.items():
+            if target == lock:
+                out.add(cond)
+        return out
+
+    def acquires_any_lock(self, fn: ast.AST) -> bool:
+        """Does ``fn`` lexically take any of this class's locks (or
+        declare holds-lock)? The affinity pass's "has a lock
+        annotation" escape."""
+        m = HOLDS_LOCK_RE.search(self.sf.comment_at_or_above(fn.lineno))
+        if m is not None:
+            return True
+        return bool(direct_acquires(fn, self))
+
+
+def direct_acquires(fn: ast.AST, model: ClassModel) -> Set[str]:
+    """Lock attrs this function acquires via ``with self.<L>:`` anywhere
+    in its body (canonicalized; used for one-level call resolution)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = self_attr(item.context_expr)
+                if attr is not None and attr in model.locks:
+                    out.add(model.canonical(attr))
+    return out
+
+
+def collect_class(cls: ast.ClassDef, sf: SourceFile) -> ClassModel:
+    model = ClassModel(cls.name, sf, cls.lineno)
+    m = OWNER_THREAD_RE.search(sf.comment_at_or_above(cls.lineno))
+    if m is not None:
+        model.owner_thread = m.group(1)
+
+    def scan_assign(target: ast.AST, value: Optional[ast.AST], line: int):
+        attr = None
+        if isinstance(target, ast.Name):  # class-level attribute
+            attr = target.id
+        else:
+            attr = self_attr(target)
+        if attr is None:
+            return
+        if value is not None:
+            fac = _lock_factory_call(value)
+            if fac is not None:
+                model.locks.add(attr)
+                if fac[1] is not None:
+                    model.alias[attr] = fac[1]
+            elif isinstance(value, ast.Call) and isinstance(
+                value.func, ast.Name
+            ):
+                model.attr_types.setdefault(attr, value.func.id)
+        g = GUARDED_BY_RE.search(sf.comment_at_or_above(line))
+        if g is not None:
+            model.guards.setdefault(attr, (g.group(1), line))
+
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.methods[node.name] = node
+            mo = OWNER_THREAD_RE.search(sf.comment_at_or_above(node.lineno))
+            if mo is not None:
+                model.method_owner[node.name] = mo.group(1)
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        scan_assign(t, stmt.value, stmt.lineno)
+                elif isinstance(stmt, ast.AnnAssign):
+                    scan_assign(stmt.target, stmt.value, stmt.lineno)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                scan_assign(t, node.value, node.lineno)
+        elif isinstance(node, ast.AnnAssign):
+            scan_assign(node.target, node.value, node.lineno)
+    return model
+
+
+def callee_chain(call: ast.Call) -> Tuple[str, ...]:
+    """Dotted callee parts: ``kv_ops.push_donated(...)`` ->
+    ("kv_ops", "push_donated"); unresolvable owners become "?"."""
+    parts: List[str] = []
+    node: ast.AST = call.func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        parts.append("?")
+    return tuple(reversed(parts))
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _is_jit_partial(node: ast.AST) -> bool:
+    """``(functools.)partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    is_partial = (
+        isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        or isinstance(fn, ast.Name) and fn.id == "partial"
+    )
+    return is_partial and bool(node.args) and _is_jit_ref(node.args[0])
+
+
+def jit_target_names(tree: ast.Module) -> Set[str]:
+    """Names of module-level functions that are jitted by reference:
+    ``jit(f)``, ``partial(jax.jit, ...)(f)``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_ref(node.func) or _is_jit_partial(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _donate_positions(expr: ast.AST) -> Tuple[int, ...]:
+    """Donated positions from any ``donate_argnums=`` keyword found
+    inside ``expr`` (jit call, partial(jit, ...), instrument wrapper)."""
+    out: Set[int] = set()
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        out.add(el.value)
+    return tuple(sorted(out))
+
+
+#: a callee whose terminal name matches this donates its first
+#: positional argument even when the definition is out of scope — the
+#: ``push_donated`` / ``kv_push_pull_donated`` wrapper naming shape
+DONATED_NAME_RE = re.compile(r"(^|_)donated$")
+
+
+class Project:
+    """Cross-file symbol table, built lazily over every file the run
+    loads. One instance per Engine.run; passes reach it via
+    ``self.project`` (falling back to a private build when a rule is
+    driven directly in tests)."""
+
+    def __init__(self) -> None:
+        self._files: Dict[str, SourceFile] = {}
+        self._classes: Dict[str, List[ClassModel]] = {}
+        self._jit_names: Dict[str, Set[str]] = {}
+        self._donating: Optional[Dict[str, Tuple[int, ...]]] = None
+        self._index: Optional[Dict[str, Optional[ClassModel]]] = None
+
+    @classmethod
+    def from_files(cls, files: Dict[str, "SourceFile"]) -> "Project":
+        p = cls()
+        for sf in files.values():
+            p.add(sf)
+        return p
+
+    def add(self, sf: SourceFile) -> None:
+        if sf.rel not in self._files:
+            self._files[sf.rel] = sf
+            self._donating = None  # new file may add donation facts
+            self._index = None
+
+    def files(self) -> Dict[str, SourceFile]:
+        return self._files
+
+    def classes(self, rel: str) -> List[ClassModel]:
+        if rel not in self._classes:
+            sf = self._files.get(rel)
+            models: List[ClassModel] = []
+            if sf is not None:
+                for node in sf.tree.body:
+                    if isinstance(node, ast.ClassDef):
+                        models.append(collect_class(node, sf))
+            self._classes[rel] = models
+        return self._classes[rel]
+
+    def class_index(self) -> Dict[str, Optional[ClassModel]]:
+        """name -> model, or None when two files reuse the name
+        (ambiguous names resolve to NO edges rather than wrong-class
+        edges — conservative, same policy as the locks pass)."""
+        if self._index is None:
+            index: Dict[str, Optional[ClassModel]] = {}
+            for rel in sorted(self._files):
+                for model in self.classes(rel):
+                    if model.name in index:
+                        index[model.name] = None
+                    else:
+                        index[model.name] = model
+            self._index = index
+        return self._index
+
+    def jit_targets(self, rel: str) -> Set[str]:
+        if rel not in self._jit_names:
+            sf = self._files.get(rel)
+            self._jit_names[rel] = (
+                jit_target_names(sf.tree) if sf is not None else set()
+            )
+        return self._jit_names[rel]
+
+    # -- donation map -------------------------------------------------
+
+    def donating(self) -> Dict[str, Tuple[int, ...]]:
+        """Terminal callable name -> donated positional indices
+        (``self`` excluded for methods). Seeded from ``donate_argnums``
+        declarations and ``# donates: <pos>`` def annotations, then
+        propagated one wrapper level: a function that passes its own
+        positional parameter at a donated position of a donating callee
+        donates that parameter too.
+
+        Only MODULE-LEVEL names (top-level defs/assigns and class
+        methods/attributes) enter this map: cross-module calls resolve
+        by terminal name, so a function-local ``fn = jax.jit(...,
+        donate_argnums=...)`` must not poison every unrelated ``fn``
+        in the project — locals are the use-after-donate pass's
+        per-function problem (``seed_locals``). A surviving name
+        collision between modules unions positions (over-approximate,
+        escape-hatched)."""
+        if self._donating is not None:
+            return self._donating
+        donating: Dict[str, Set[int]] = {}
+
+        def note(name: str, positions: Iterable[int]) -> None:
+            donating.setdefault(name, set()).update(positions)
+
+        def scan_scope(body, sf: SourceFile) -> None:
+            for node in body:
+                if isinstance(node, ast.ClassDef):
+                    scan_scope(node.body, sf)
+                elif isinstance(node, ast.Assign):
+                    pos = _donate_positions(node.value)
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                note(t.id, pos)
+                            else:
+                                attr = self_attr(t)
+                                if attr is not None:
+                                    note(attr, pos)
+                        # by-reference jit in the value ALSO donates the
+                        # referenced function: f2 = jit(f, donate...)
+                        for call in ast.walk(node.value):
+                            if isinstance(call, ast.Call) and (
+                                _is_jit_ref(call.func)
+                                or _is_jit_partial(call.func)
+                            ):
+                                cpos = _donate_positions(call)
+                                for arg in call.args:
+                                    if isinstance(arg, ast.Name) and cpos:
+                                        note(arg.id, cpos)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        pos = _donate_positions(dec)
+                        if pos:
+                            note(node.name, pos)
+                    m = DONATES_RE.search(sf.comment_at_or_above(node.lineno))
+                    if m is not None:
+                        note(
+                            node.name,
+                            (int(x) for x in m.group(1).split(",")),
+                        )
+                elif isinstance(node, ast.Expr) and isinstance(
+                    node.value, ast.Call
+                ):
+                    call = node.value
+                    if _is_jit_ref(call.func) or _is_jit_partial(call.func):
+                        # jit(f, donate_argnums=...) by reference
+                        pos = _donate_positions(call)
+                        if pos:
+                            for arg in call.args:
+                                if isinstance(arg, ast.Name):
+                                    note(arg.id, pos)
+
+        for sf in self._files.values():
+            scan_scope(sf.tree.body, sf)
+
+        def module_functions(sf: SourceFile):
+            for node in sf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node
+                elif isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            yield sub
+
+        # one wrapper level, run to a short fixed point so a wrapper of
+        # a wrapper still lands (module-level functions only)
+        for _ in range(2):
+            changed = False
+            for sf in self._files.values():
+                for fn in module_functions(sf):
+                    params = [
+                        a.arg
+                        for a in fn.args.posonlyargs + fn.args.args
+                        if a.arg not in ("self", "cls")
+                    ]
+                    if not params:
+                        continue
+                    for call in ast.walk(fn):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        name = callee_chain(call)[-1]
+                        positions = donating.get(name)
+                        if positions is None:
+                            positions = (
+                                {0} if DONATED_NAME_RE.search(name) else set()
+                            )
+                        for p in positions:
+                            if p >= len(call.args):
+                                continue
+                            arg = call.args[p]
+                            if (
+                                isinstance(arg, ast.Name)
+                                and arg.id in params
+                            ):
+                                i = params.index(arg.id)
+                                cur = donating.setdefault(fn.name, set())
+                                if i not in cur:
+                                    cur.add(i)
+                                    changed = True
+            if not changed:
+                break
+        self._donating = {k: tuple(sorted(v)) for k, v in donating.items()}
+        return self._donating
+
+
+# -- rules ------------------------------------------------------------
+
+
 class Rule:
     """Base class of an analysis pass.
 
@@ -115,15 +561,32 @@ class Rule:
     repo-relative files it wants parsed; ``check(files, root)`` returns
     findings. ``files`` holds a SourceFile for every path that exists
     (missing scoped files are reported by the engine).
+
+    ``per_file = True`` declares that ``check`` decomposes file-by-file
+    with no cross-file state — the engine then runs it one file at a
+    time and caches each file's findings by content hash. ``version``
+    salts that cache: bump it when the rule's semantics change.
+    ``self.project`` is the run's shared symbol table (set by the
+    engine; rules driven directly fall back to building their own).
     """
 
     name: str = "base"
+    version: str = "1"
+    per_file: bool = False
+    project: Optional[Project] = None
 
     def paths(self, root: str) -> Sequence[str]:
         return ()
 
     def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
         raise NotImplementedError
+
+    def get_project(self, files: Dict[str, SourceFile]) -> Project:
+        """The engine's shared project, or a private one over ``files``
+        when the rule is driven outside an Engine run (tests)."""
+        if self.project is not None:
+            return self.project
+        return Project.from_files(files)
 
 
 def walk_package(root: str, package: str = "parameter_server_tpu") -> List[str]:
@@ -139,15 +602,93 @@ def walk_package(root: str, package: str = "parameter_server_tpu") -> List[str]:
     return sorted(out)
 
 
+# -- incremental cache ------------------------------------------------
+
+
+class LintCache:
+    """Content-hash finding cache for per-file rules.
+
+    Entries key on ``(rule, rule.version, engine version, file sha,
+    path)`` — an edited file gets a NEW key, so a stale entry can never
+    satisfy it (stale entries are dropped at save). The value is the
+    rule's findings for that file BEFORE suppression filtering;
+    suppressions re-apply from the current source every run, so editing
+    only a suppression comment still changes the sha and recomputes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.entries: Dict[str, List[List]] = {}
+        self.hits = 0
+        self.misses = 0
+        self._touched: Set[str] = set()
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("version") == PSLINT_VERSION:
+                self.entries = data.get("entries", {})
+        except (OSError, ValueError):
+            pass  # absent/corrupt cache = cold run
+
+    @staticmethod
+    def _key(rule: Rule, sf: SourceFile) -> str:
+        return f"{rule.name}:{rule.version}:{sf.sha}:{sf.rel}"
+
+    def get(self, rule: Rule, sf: SourceFile) -> Optional[List[Finding]]:
+        key = self._key(rule, sf)
+        entry = self.entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._touched.add(key)
+        return [Finding(p, ln, r, m) for p, ln, r, m in entry]
+
+    def put(self, rule: Rule, sf: SourceFile, findings: List[Finding]) -> None:
+        key = self._key(rule, sf)
+        self.entries[key] = [
+            [f.path, f.line, f.rule, f.message] for f in findings
+        ]
+        self._touched.add(key)
+
+    def save(self) -> None:
+        """Persist only the entries this run touched — entries for
+        edited (old-sha) or deleted files age out instead of growing
+        the cache forever."""
+        data = {
+            "version": PSLINT_VERSION,
+            "entries": {k: self.entries[k] for k in sorted(self._touched)},
+        }
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # read-only checkout: run uncached
+
+
+# -- engine -----------------------------------------------------------
+
+
 class Engine:
-    def __init__(self, root: str, rules: Sequence[Rule]):
+    def __init__(
+        self,
+        root: str,
+        rules: Sequence[Rule],
+        cache_path: Optional[str] = None,
+    ):
         self.root = root
         self.rules = list(rules)
+        self.cache = LintCache(cache_path) if cache_path else None
+        self.timings: Dict[str, float] = {}  # pass name -> seconds
+        #: per pass: files analyzed fresh vs served from cache
+        self.stats: Dict[str, Dict[str, int]] = {}
 
     def run(self) -> Tuple[List[Finding], int]:
         """Returns (unsuppressed findings, suppressed count)."""
         cache: Dict[str, SourceFile] = {}
         findings: List[Finding] = []
+        project = Project()
 
         def load(rel: str) -> Optional[SourceFile]:
             if rel not in cache:
@@ -165,15 +706,37 @@ class Engine:
                         Finding(rel, e.lineno or 1, "parse", f"failed to parse: {e.msg}")
                     )
                     cache[rel] = None  # type: ignore[assignment]
-            return cache[rel]
+            sf = cache[rel]
+            if sf is not None:
+                project.add(sf)
+            return sf
 
         for rule in self.rules:
+            t0 = time.perf_counter()
+            rule.project = project
             files = {}
             for rel in rule.paths(self.root):
                 sf = load(rel)
                 if sf is not None:
                     files[rel] = sf
-            findings.extend(rule.check(files, self.root))
+            stats = self.stats.setdefault(
+                rule.name, {"analyzed": 0, "cached": 0}
+            )
+            if rule.per_file and self.cache is not None:
+                for rel, sf in files.items():
+                    hit = self.cache.get(rule, sf)
+                    if hit is not None:
+                        stats["cached"] += 1
+                        findings.extend(hit)
+                        continue
+                    fresh = rule.check({rel: sf}, self.root)
+                    self.cache.put(rule, sf, fresh)
+                    stats["analyzed"] += 1
+                    findings.extend(fresh)
+            else:
+                findings.extend(rule.check(files, self.root))
+                stats["analyzed"] += len(files)
+            self.timings[rule.name] = time.perf_counter() - t0
 
         # suppression hygiene over every file any pass touched: a
         # disable without a reason is a finding in its own right
@@ -208,12 +771,25 @@ class Engine:
                 continue
             kept.append(f)
         kept.sort(key=lambda f: (f.path, f.line, f.rule))
+        if self.cache is not None:
+            self.cache.save()
         return kept, suppressed
 
 
 def default_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
     """The registered passes, optionally filtered by name."""
-    from . import donation, jitpure, locks, metrics, spans, threads
+    from . import (
+        affinity,
+        artifacts,
+        determinism,
+        donate_flow,
+        donation,
+        jitpure,
+        locks,
+        metrics,
+        spans,
+        threads,
+    )
 
     rules: List[Rule] = [
         locks.LockDisciplineRule(),
@@ -222,6 +798,10 @@ def default_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
         donation.DonationRule(),
         metrics.MetricsRule(),
         spans.SpanDisciplineRule(),
+        donate_flow.UseAfterDonateRule(),
+        affinity.ThreadAffinityRule(),
+        determinism.DeterminismRule(),
+        artifacts.CrossArtifactRule(),
     ]
     if only is not None:
         wanted = set(only)

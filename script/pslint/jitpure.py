@@ -34,7 +34,14 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Sequence, Set
 
-from .engine import Finding, Rule, SourceFile
+from .engine import (
+    Finding,
+    Rule,
+    SourceFile,
+    _is_jit_partial,
+    _is_jit_ref,
+    jit_target_names,
+)
 
 SCOPE = (
     "parameter_server_tpu/ops/kv_ops.py",
@@ -62,36 +69,9 @@ _SYNC_METHODS = {"item", "tolist"}
 _TEL_METHODS = {"observe", "inc"}
 
 
-def _is_jit_ref(node: ast.AST) -> bool:
-    if isinstance(node, ast.Attribute) and node.attr == "jit":
-        return True
-    return isinstance(node, ast.Name) and node.id == "jit"
-
-
-def _is_jit_partial(node: ast.AST) -> bool:
-    """``(functools.)partial(jax.jit, ...)``."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    is_partial = (
-        isinstance(fn, ast.Attribute) and fn.attr == "partial"
-        or isinstance(fn, ast.Name) and fn.id == "partial"
-    )
-    return is_partial and bool(node.args) and _is_jit_ref(node.args[0])
-
-
-def _jit_target_names(tree: ast.Module) -> Set[str]:
-    """Names of module-level functions that are jitted by reference:
-    ``jit(f)``, ``partial(jax.jit, ...)(f)``."""
-    names: Set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _is_jit_ref(node.func) or _is_jit_partial(node.func):
-            for arg in node.args:
-                if isinstance(arg, ast.Name):
-                    names.add(arg.id)
-    return names
+# jit-target discovery now lives in the engine's symbol table; kept
+# under the old name for existing callers
+_jit_target_names = jit_target_names
 
 
 def _is_jitted_def(fn: ast.AST, by_name: Set[str]) -> bool:
@@ -109,6 +89,8 @@ def _is_jitted_def(fn: ast.AST, by_name: Set[str]) -> bool:
 
 class JitPurityRule(Rule):
     name = "jit-purity"
+    version = "2"
+    per_file = True  # no cross-file state: content-hash cacheable
 
     def __init__(self, scope: Sequence[str] = SCOPE):
         self.scope = tuple(scope)
@@ -118,8 +100,9 @@ class JitPurityRule(Rule):
 
     def check(self, files: Dict[str, SourceFile], root: str) -> List[Finding]:
         findings: List[Finding] = []
+        project = self.get_project(files)
         for sf in files.values():
-            by_name = _jit_target_names(sf.tree)
+            by_name = project.jit_targets(sf.rel)
             for node in ast.walk(sf.tree):
                 if _is_jitted_def(node, by_name):
                     findings.extend(self._check_body(node, sf))

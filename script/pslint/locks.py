@@ -43,15 +43,18 @@ repo's invariant is that the graph stays acyclic.
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Sequence, Set, Tuple
 
-from .engine import Finding, Rule, SourceFile
-
-GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
-HOLDS_LOCK_RE = re.compile(r"holds-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
-
-LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+from .engine import (
+    GUARDED_BY_RE,
+    HOLDS_LOCK_RE,
+    LOCK_FACTORIES,
+    ClassModel,
+    Finding,
+    Rule,
+    direct_acquires,
+    self_attr,
+)
 
 # the concurrency surface: every module with threads or locks on the
 # training/system path (doc/STATIC_ANALYSIS.md "Scope")
@@ -76,7 +79,12 @@ SCOPE = (
     "parameter_server_tpu/parameter/parameter.py",
     "parameter_server_tpu/parameter/kv_vector.py",
     "parameter_server_tpu/parameter/replica.py",
+    "parameter_server_tpu/serving/admission.py",
     "parameter_server_tpu/serving/batcher.py",
+    "parameter_server_tpu/serving/coalescer.py",
+    "parameter_server_tpu/serving/frontend.py",
+    "parameter_server_tpu/serving/loadgen.py",
+    "parameter_server_tpu/serving/replica.py",
     "parameter_server_tpu/system/autoscale.py",
     "parameter_server_tpu/learner/ingest.py",
     "parameter_server_tpu/learner/workload_pool.py",
@@ -84,120 +92,10 @@ SCOPE = (
     "parameter_server_tpu/apps/linear/async_sgd.py",
 )
 
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """``self.X`` or ``cls.X`` -> ``X`` (instance and classmethod forms
-    address the same per-class state)."""
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id in ("self", "cls")
-    ):
-        return node.attr
-    return None
-
-
-def _lock_factory_call(node: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
-    """``threading.Lock()`` etc -> (factory, wrapped_attr|None)."""
-    if not isinstance(node, ast.Call):
-        return None
-    fn = node.func
-    name = None
-    if isinstance(fn, ast.Attribute) and fn.attr in LOCK_FACTORIES:
-        name = fn.attr
-    elif isinstance(fn, ast.Name) and fn.id in LOCK_FACTORIES:
-        name = fn.id
-    if name is None:
-        return None
-    wrapped = None
-    if name == "Condition" and node.args:
-        wrapped = _self_attr(node.args[0])
-    return name, wrapped
-
-
-class _ClassModel:
-    """Per-class facts: locks, aliases, guards, attribute types."""
-
-    def __init__(self, name: str, sf: SourceFile):
-        self.name = name
-        self.sf = sf
-        self.locks: Set[str] = set()
-        self.alias: Dict[str, str] = {}  # condition attr -> wrapped lock
-        self.guards: Dict[str, Tuple[str, int]] = {}  # attr -> (lock, line)
-        self.attr_types: Dict[str, str] = {}  # attr -> class name
-        self.methods: Dict[str, ast.FunctionDef] = {}
-
-    def canonical(self, lock: str) -> str:
-        """Condition-over-lock aliases collapse to the wrapped lock."""
-        return self.alias.get(lock, lock)
-
-    def held_closure(self, lock: str) -> Set[str]:
-        """Every lock name satisfied by acquiring ``lock``."""
-        out = {lock}
-        wrapped = self.alias.get(lock)
-        if wrapped is not None:
-            out.add(wrapped)
-        # acquiring the wrapped lock does NOT satisfy a guard that names
-        # the condition? It does — same underlying mutex. Map both ways.
-        for cond, target in self.alias.items():
-            if target == lock:
-                out.add(cond)
-        return out
-
-
-def _collect_class(cls: ast.ClassDef, sf: SourceFile) -> _ClassModel:
-    model = _ClassModel(cls.name, sf)
-
-    def scan_assign(target: ast.AST, value: Optional[ast.AST], line: int):
-        attr = None
-        if isinstance(target, ast.Name):  # class-level attribute
-            attr = target.id
-        else:
-            attr = _self_attr(target)
-        if attr is None:
-            return
-        if value is not None:
-            fac = _lock_factory_call(value)
-            if fac is not None:
-                model.locks.add(attr)
-                if fac[1] is not None:
-                    model.alias[attr] = fac[1]
-            elif isinstance(value, ast.Call) and isinstance(
-                value.func, ast.Name
-            ):
-                model.attr_types.setdefault(attr, value.func.id)
-        m = GUARDED_BY_RE.search(sf.comment_at_or_above(line))
-        if m is not None:
-            model.guards.setdefault(attr, (m.group(1), line))
-
-    for node in cls.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            model.methods[node.name] = node
-            for stmt in ast.walk(node):
-                if isinstance(stmt, ast.Assign):
-                    for t in stmt.targets:
-                        scan_assign(t, stmt.value, stmt.lineno)
-                elif isinstance(stmt, ast.AnnAssign):
-                    scan_assign(stmt.target, stmt.value, stmt.lineno)
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                scan_assign(t, node.value, node.lineno)
-        elif isinstance(node, ast.AnnAssign):
-            scan_assign(node.target, node.value, node.lineno)
-    return model
-
-
-def _direct_acquires(fn: ast.AST, model: _ClassModel) -> Set[str]:
-    """Lock attrs this function acquires via ``with self.<L>:`` anywhere
-    in its body (canonicalized; used for one-level call resolution)."""
-    out: Set[str] = set()
-    for node in ast.walk(fn):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                attr = _self_attr(item.context_expr)
-                if attr is not None and attr in model.locks:
-                    out.add(model.canonical(attr))
-    return out
+# engine-hosted symbol-table pieces, re-exported for existing callers
+_ClassModel = ClassModel
+_self_attr = self_attr
+_direct_acquires = direct_acquires
 
 
 class LockDisciplineRule(Rule):
@@ -216,11 +114,10 @@ class LockDisciplineRule(Rule):
         # class from all checking. Cross-class call resolution uses
         # the by-name index and simply skips ambiguous names
         # (conservative: no edges rather than wrong-class edges).
+        project = self.get_project(files)
         all_models: List[_ClassModel] = []
-        for sf in files.values():
-            for node in sf.tree.body:
-                if isinstance(node, ast.ClassDef):
-                    all_models.append(_collect_class(node, sf))
+        for rel in files:
+            all_models.extend(project.classes(rel))
         models: Dict[str, _ClassModel] = {}
         ambiguous: set = set()
         for m in all_models:

@@ -46,6 +46,8 @@ def _is_span_call(node: ast.Call) -> bool:
 
 class SpanDisciplineRule(Rule):
     name = "spans"
+    version = "2"
+    per_file = True  # no cross-file state: content-hash cacheable
 
     def __init__(self, scope: Optional[Sequence[str]] = None):
         self.scope = scope
@@ -69,10 +71,7 @@ class SpanDisciplineRule(Rule):
         # contextmanager; its internals are not call sites
         if sf.rel.endswith("telemetry/spans.py"):
             return findings
-        parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(sf.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
+        parents = sf.parents()  # engine-shared parent chain
         for node in ast.walk(sf.tree):
             if not (isinstance(node, ast.Call) and _is_span_call(node)):
                 continue

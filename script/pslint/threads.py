@@ -65,6 +65,8 @@ def _contains_join(node: ast.AST) -> bool:
 
 class ThreadLifecycleRule(Rule):
     name = "threads"
+    version = "2"
+    per_file = True  # no cross-file state: content-hash cacheable
 
     def __init__(self, scope: Optional[Sequence[str]] = None):
         self.scope = scope
@@ -83,10 +85,8 @@ class ThreadLifecycleRule(Rule):
     def _check_file(self, sf: SourceFile) -> List[Finding]:
         findings: List[Finding] = []
         # parent chain: function defs and class defs enclosing each node
-        parents: Dict[ast.AST, ast.AST] = {}
-        for node in ast.walk(sf.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[child] = node
+        # (built once per file by the engine, shared across passes)
+        parents = sf.parents()
 
         def owners(node: ast.AST):
             cur = parents.get(node)

@@ -18,6 +18,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "script"))
 
+from pslint.affinity import ThreadAffinityRule  # noqa: E402
+from pslint.determinism import DeterminismRule  # noqa: E402
+from pslint.donate_flow import UseAfterDonateRule  # noqa: E402
 from pslint.engine import Engine, SourceFile, default_rules  # noqa: E402
 from pslint.jitpure import JitPurityRule  # noqa: E402
 from pslint.locks import LockDisciplineRule  # noqa: E402
@@ -816,6 +819,612 @@ class TestMetricsPass:
         assert MetricsRule().check({}, REPO) == []
 
 
+class TestUseAfterDonate:
+    DONATING_PRELUDE = """
+            import functools
+            import jax
+
+            step = functools.partial(jax.jit, donate_argnums=(0,))(lambda t, g: t + g)
+
+            def slow(t, g):
+                return t + g
+    """
+
+    def test_read_after_donating_call_flagged(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def train(table, grads):
+                out = step(table, grads)
+                return table.sum()
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert [f.rule for f in findings] == ["use-after-donate"]
+        assert "donated to step()" in findings[0].message
+
+    def test_reassignment_kills_the_donation(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def train(table, grads):
+                table = step(table, grads)
+                return table.sum()
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert findings == []
+
+    def test_donation_in_returning_branch_does_not_leak(self, tmp_path):
+        """Regression: a donate inside an ``if`` arm that *returns* must
+        not poison the fall-through sibling (the async_sgd selector
+        idiom was a false positive until branch termination landed)."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def train(table, grads, fast):
+                if fast:
+                    return step(table, grads)
+                return slow(table, grads)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert findings == []
+
+    def test_one_wrapper_level_propagation(self, tmp_path):
+        """A module function that forwards its arg into a donating
+        callee is itself donating — callers one level up are caught."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def apply(t, g):
+                return step(t, g)
+
+            def train(table, grads):
+                apply(table, grads)
+                return table.sum()
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert [f.rule for f in findings] == ["use-after-donate"]
+        assert "donated to apply()" in findings[0].message
+
+    def test_local_donating_name_does_not_poison_other_functions(
+        self, tmp_path
+    ):
+        """Regression: a function-LOCAL ``fn = jit(..., donate_argnums=...)``
+        must donate inside its own function only — a global name-keyed
+        map flagged every unrelated call named ``fn``."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import jax
+
+            def donating_scope(table, grads):
+                fn = jax.jit(lambda t, g: t + g, donate_argnums=(0,))
+                fn(table, grads)
+                return table.sum()
+
+            def innocent_scope(x):
+                fn = lambda v: v + 1
+                fn(x)
+                return x + 1
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert [(f.line, f.rule) for f in findings] == [
+            (7, "use-after-donate")
+        ]
+
+    def test_donated_dead_escape_comment(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def train(table, grads):
+                out = step(table, grads)
+                return table  # donated-dead: error-path echo only, never dereferenced
+            """,
+        )
+        findings, _ = run_rule(tmp_path, UseAfterDonateRule(), rel)
+        assert findings == []
+
+    def test_suppressible_with_reason(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            self.DONATING_PRELUDE
+            + """
+            def train(table, grads):
+                out = step(table, grads)
+                return table.sum()  # pslint: disable=use-after-donate — fixture: proving the disable path
+            """,
+        )
+        findings, suppressed = run_rule(
+            tmp_path, UseAfterDonateRule(), rel
+        )
+        assert findings == []
+        assert suppressed == 1
+
+
+class TestThreadAffinity:
+    def test_two_entry_points_without_lock_flagged(self, tmp_path):
+        """The seeded violation: an owner-thread method reachable from
+        two distinct Thread entry points with no lock on the path."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import threading
+
+            class Pump:  # owner-thread: scheduler
+                def __init__(self):
+                    self.q = []
+                    self._lock = threading.Lock()
+                    self._t1 = threading.Thread(target=self._run_a, name="ingest")
+                    self._t2 = threading.Thread(target=self._run_b, name="drain")
+
+                def _run_a(self):
+                    self.push(1)
+
+                def _run_b(self):
+                    self.push(2)
+
+                def push(self, x):
+                    self.q.append(x)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, ThreadAffinityRule(), rel)
+        assert [f.rule for f in findings] == ["thread-affinity"]
+        assert "Pump.push" in findings[0].message
+        # entry names surface in the message for triage
+        assert "ingest" in findings[0].message
+        assert "drain" in findings[0].message
+
+    def test_locked_method_is_exempt(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import threading
+
+            class Pump:  # owner-thread: scheduler
+                def __init__(self):
+                    self.q = []
+                    self._lock = threading.Lock()
+                    self._t1 = threading.Thread(target=self._run_a, name="ingest")
+                    self._t2 = threading.Thread(target=self._run_b, name="drain")
+
+                def _run_a(self):
+                    self.push(1)
+
+                def _run_b(self):
+                    self.push(2)
+
+                def push(self, x):
+                    with self._lock:
+                        self.q.append(x)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, ThreadAffinityRule(), rel)
+        assert findings == []
+
+    def test_single_entry_point_is_fine(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import threading
+
+            class Pump:  # owner-thread: scheduler
+                def __init__(self):
+                    self.q = []
+                    self._t1 = threading.Thread(target=self._run_a, name="ingest")
+
+                def _run_a(self):
+                    self.push(1)
+
+                def push(self, x):
+                    self.q.append(x)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, ThreadAffinityRule(), rel)
+        assert findings == []
+
+    def test_owner_thread_any_exempts_a_method(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import threading
+
+            class Pump:  # owner-thread: scheduler
+                def __init__(self):
+                    self.q = []
+                    self._t1 = threading.Thread(target=self._run_a, name="ingest")
+                    self._t2 = threading.Thread(target=self._run_b, name="drain")
+
+                def _run_a(self):
+                    self.push(1)
+
+                def _run_b(self):
+                    self.push(2)
+
+                def push(self, x):  # owner-thread: any
+                    self.q.append(x)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, ThreadAffinityRule(), rel)
+        assert findings == []
+
+    def test_unannotated_class_not_checked(self, tmp_path):
+        """No ``# owner-thread:`` declaration — the pass has no owner
+        contract to enforce; the locks pass covers such classes."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self.q = []
+                    self._t1 = threading.Thread(target=self._run_a, name="ingest")
+                    self._t2 = threading.Thread(target=self._run_b, name="drain")
+
+                def _run_a(self):
+                    self.push(1)
+
+                def _run_b(self):
+                    self.push(2)
+
+                def push(self, x):
+                    self.q.append(x)
+            """,
+        )
+        findings, _ = run_rule(tmp_path, ThreadAffinityRule(), rel)
+        assert findings == []
+
+
+class TestDeterminism:
+    def test_scoped_module_without_marker_flagged(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            '''
+            """Module under the contract but missing its marker."""
+
+            X = 1
+            ''',
+        )
+        findings, _ = run_rule(tmp_path, DeterminismRule(), rel)
+        assert [(f.line, f.rule) for f in findings] == [(1, "determinism")]
+        assert "bit-identical" in findings[0].message
+
+    def test_set_iteration_and_wall_clock_flagged(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            # bit-identical
+            import time
+
+            def pack(d):
+                return [k for k in set(d)]
+
+            def stamp():
+                return time.time()
+            """,
+        )
+        findings, _ = run_rule(tmp_path, DeterminismRule(), rel)
+        assert [f.line for f in findings] == [6, 9]
+        assert "order varies" in findings[0].message
+        assert "wall-clock" in findings[1].message
+
+    def test_sorted_set_and_perf_counter_pass(self, tmp_path):
+        """sorted(...) launders set order; perf_counter is a sanctioned
+        telemetry clock — neither is a finding."""
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            # bit-identical
+            import time
+
+            def pack(d):
+                return sorted(set(d))
+
+            def tick():
+                return time.perf_counter()
+            """,
+        )
+        findings, _ = run_rule(tmp_path, DeterminismRule(), rel)
+        assert findings == []
+
+    def test_unseeded_rng_and_unsorted_listdir_flagged(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            # bit-identical
+            import os
+            import random
+
+            def sample():
+                return random.random()
+
+            def shards(path):
+                return [p for p in os.listdir(path)]
+            """,
+        )
+        findings, _ = run_rule(tmp_path, DeterminismRule(), rel)
+        assert len(findings) == 2
+        assert "unseeded" in findings[0].message or "RNG" in findings[0].message
+        assert "sorted" in findings[1].message
+
+    def test_suppressible_with_reason(self, tmp_path):
+        rel = write(
+            tmp_path,
+            "m.py",
+            """
+            # bit-identical
+            import time
+
+            def stamp():
+                # pslint: disable=determinism — telemetry birth timestamp, never replayed bytes
+                return time.time()
+            """,
+        )
+        findings, suppressed = run_rule(tmp_path, DeterminismRule(), rel)
+        assert findings == []
+        assert suppressed == 1
+
+
+class TestCrossArtifact:
+    """Each sub-check drives a mini-repo holding both sides of one
+    artifact boundary, drifted on purpose."""
+
+    def _mini_repo(self, tmp_path, **overrides):
+        defaults = {
+            "parameter_server_tpu/__init__.py": "",
+            "parameter_server_tpu/system/__init__.py": "",
+            "parameter_server_tpu/system/faults.py": """
+                POINTS = ("push_drop", "pull_stall")
+            """,
+            "parameter_server_tpu/system/drill.py": """
+                from . import faults
+
+                def go():
+                    faults.arm("pull_stall")
+            """,
+            "parameter_server_tpu/telemetry/__init__.py": "",
+            "parameter_server_tpu/telemetry/instruments.py": """
+                NAMES = ("ps_push_total", "ps_pull_latency")
+            """,
+            "parameter_server_tpu/benchmarks/__init__.py": "",
+            "parameter_server_tpu/benchmarks/components.py": """
+                def benchmark(name):
+                    def deco(fn):
+                        return fn
+                    return deco
+
+                @benchmark("decode")
+                def bench_decode():
+                    return {"recovery": 1}
+            """,
+            "Makefile": """
+                bench:
+                \tpython -m parameter_server_tpu.benchmarks decode
+            """,
+            "tests/test_benchmarks.py": 'KEYS = ["decode"]\n',
+            "script/bench_diff.py": """
+                METADATA_SECTIONS = frozenset({"recovery"})
+            """,
+            "bench.py": "",
+        }
+        defaults.update(overrides)
+        for rel, body in defaults.items():
+            write(tmp_path, rel, body)
+        return tmp_path
+
+    def _run(self, tmp_path):
+        from pslint.artifacts import CrossArtifactRule
+
+        return Engine(str(tmp_path), [CrossArtifactRule()]).run()
+
+    def test_consistent_mini_repo_is_clean(self, tmp_path):
+        self._mini_repo(tmp_path)
+        findings, _ = self._run(tmp_path)
+        assert findings == []
+
+    def test_unknown_fault_point_flagged(self, tmp_path):
+        self._mini_repo(
+            tmp_path,
+            **{
+                "parameter_server_tpu/system/drill.py": """
+                    from . import faults
+
+                    def go():
+                        faults.inject("push_dorp")
+                """
+            },
+        )
+        findings, _ = self._run(tmp_path)
+        assert [f.rule for f in findings] == ["fault-point"]
+        assert "push_dorp" in findings[0].message
+
+    def test_unqualified_arm_call_not_matched(self, tmp_path):
+        """``blackbox.arm()`` is a different arm — only ``faults.``-
+        qualified calls are pinned to POINTS."""
+        self._mini_repo(
+            tmp_path,
+            **{
+                "parameter_server_tpu/system/drill.py": """
+                    def go(blackbox):
+                        blackbox.arm("not_a_point")
+                """
+            },
+        )
+        findings, _ = self._run(tmp_path)
+        assert findings == []
+
+    def test_alert_metric_drift_flagged(self, tmp_path):
+        self._mini_repo(tmp_path)
+        write(
+            tmp_path,
+            "configs/alerts/a.json",
+            '{"rules": [{"metric": "ps_pull_latency", "den": "ps_gone_total"}]}\n',
+        )
+        findings, _ = self._run(tmp_path)
+        assert [f.rule for f in findings] == ["alert-metric"]
+        assert "ps_gone_total" in findings[0].message
+        assert findings[0].path == "configs/alerts/a.json"
+
+    def test_makefile_unregistered_benchmark_flagged(self, tmp_path):
+        self._mini_repo(
+            tmp_path,
+            Makefile="""
+                bench:
+                \tpython -m parameter_server_tpu.benchmarks decode
+                \tpython -m parameter_server_tpu.benchmarks deocde
+            """,
+        )
+        findings, _ = self._run(tmp_path)
+        assert [f.rule for f in findings] == ["bench-wiring"]
+        assert "deocde" in findings[0].message
+        assert findings[0].path == "Makefile"
+
+    def test_unreferenced_registry_key_flagged(self, tmp_path):
+        self._mini_repo(
+            tmp_path,
+            **{
+                "parameter_server_tpu/benchmarks/components.py": """
+                    def benchmark(name):
+                        def deco(fn):
+                            return fn
+                        return deco
+
+                    @benchmark("decode")
+                    def bench_decode():
+                        return {"recovery": 1}
+
+                    @benchmark("ghost_bench_xyzzy")
+                    def bench_ghost():
+                        return {}
+                """
+            },
+        )
+        findings, _ = self._run(tmp_path)
+        assert [f.rule for f in findings] == ["bench-wiring"]
+        assert "ghost_bench_xyzzy" in findings[0].message
+        assert "unreachable" in findings[0].message
+
+    def test_stale_metadata_section_flagged(self, tmp_path):
+        self._mini_repo(
+            tmp_path,
+            **{
+                "script/bench_diff.py": """
+                    METADATA_SECTIONS = frozenset({"recovery", "ghosts"})
+                """
+            },
+        )
+        findings, _ = self._run(tmp_path)
+        assert [f.rule for f in findings] == ["metadata-section"]
+        assert "ghosts" in findings[0].message
+
+
+class TestIncrementalCache:
+    """The content-hash cache contract: a warm run recomputes nothing,
+    an edit recomputes exactly the edited file, and the cache can
+    neither hide a fresh finding nor resurrect a fixed one."""
+
+    def _engine(self, tmp_path, rels):
+        return Engine(
+            str(tmp_path),
+            [DeterminismRule(scope=tuple(rels))],
+            cache_path=str(tmp_path / "cache.json"),
+        )
+
+    def test_warm_run_is_fully_cached(self, tmp_path):
+        rels = [
+            write(tmp_path, "a.py", "# bit-identical\nX = 1\n"),
+            write(tmp_path, "b.py", "# bit-identical\nY = 1\n"),
+        ]
+        e1 = self._engine(tmp_path, rels)
+        assert e1.run() == ([], 0)
+        assert e1.stats["determinism"] == {"analyzed": 2, "cached": 0}
+        e2 = self._engine(tmp_path, rels)
+        assert e2.run() == ([], 0)
+        assert e2.stats["determinism"] == {"analyzed": 0, "cached": 2}
+
+    def test_edit_recomputes_only_the_edited_file(self, tmp_path):
+        rels = [
+            write(tmp_path, "a.py", "# bit-identical\nX = 1\n"),
+            write(tmp_path, "b.py", "# bit-identical\nY = 1\n"),
+        ]
+        self._engine(tmp_path, rels).run()
+        # introduce a finding in b only: the stale cache entry must not
+        # hide it, and a must stay served from cache
+        (tmp_path / "b.py").write_text(
+            "# bit-identical\nimport time\nT = time.time()\n"
+        )
+        e = self._engine(tmp_path, rels)
+        findings, _ = e.run()
+        assert e.stats["determinism"] == {"analyzed": 1, "cached": 1}
+        assert [(f.path, f.line) for f in findings] == [("b.py", 3)]
+        # revert: the finding disappears (the key is the content hash,
+        # so the bad entry cannot be served for the fixed file); the
+        # save-only-touched policy pruned the original entry, so b is
+        # re-analyzed once while a stays a hit
+        (tmp_path / "b.py").write_text("# bit-identical\nY = 1\n")
+        e2 = self._engine(tmp_path, rels)
+        assert e2.run() == ([], 0)
+        assert e2.stats["determinism"] == {"analyzed": 1, "cached": 1}
+
+    def test_cached_findings_still_pass_suppression_filter(self, tmp_path):
+        """The cache stores PRE-suppression findings; the filter runs
+        every time, so editing only a comment elsewhere cannot leak a
+        suppressed finding."""
+        rel = write(
+            tmp_path,
+            "c.py",
+            "# bit-identical\nimport time\n"
+            "T = time.time()  # pslint: disable=determinism — fixture timestamp\n",
+        )
+        e1 = self._engine(tmp_path, [rel])
+        assert e1.run() == ([], 1)
+        e2 = self._engine(tmp_path, [rel])
+        assert e2.run() == ([], 1)
+        assert e2.stats["determinism"] == {"analyzed": 0, "cached": 1}
+
+    def test_rule_version_bump_invalidates(self, tmp_path):
+        """The rule version is part of the cache key — a pass upgrade
+        must never serve findings computed by its older self."""
+        rel = write(tmp_path, "a.py", "# bit-identical\nX = 1\n")
+        self._engine(tmp_path, [rel]).run()
+
+        class Bumped(DeterminismRule):
+            version = DeterminismRule.version + "-test"
+
+        e = Engine(
+            str(tmp_path),
+            [Bumped(scope=(rel,))],
+            cache_path=str(tmp_path / "cache.json"),
+        )
+        e.run()
+        assert e.stats["determinism"] == {"analyzed": 1, "cached": 0}
+
+
 class TestRepoIsClean:
     def test_full_suite_repo_clean(self):
         """Tier-1 acceptance: the repo lints clean under every pass —
@@ -875,5 +1484,29 @@ class TestRepoIsClean:
         assert proc.returncode == 0
         assert set(proc.stdout.split()) == {
             "locks", "threads", "jit-purity", "donation", "metrics",
-            "spans",
+            "spans", "use-after-donate", "thread-affinity",
+            "determinism", "cross-artifact",
         }
+
+    def test_cli_timings_and_budget(self, tmp_path):
+        """--timings reports per-pass wall-clock; --budget turns a slow
+        run into exit 2 (the make target keeps the suite honest)."""
+        write(tmp_path, "parameter_server_tpu/__init__.py", "")
+        write(tmp_path, "bench.py", "")
+        cli = os.path.join(REPO, "script", "pslint", "cli.py")
+        base = [
+            sys.executable, cli, "--root", str(tmp_path),
+            "--rules", "spans", "--no-cache",
+        ]
+        proc = subprocess.run(
+            base + ["--timings"], capture_output=True, text=True, timeout=60
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "pslint: timing spans:" in proc.stderr
+        assert "pslint: timing total:" in proc.stderr
+        proc = subprocess.run(
+            base + ["--budget", "0"], capture_output=True, text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "BUDGET EXCEEDED" in proc.stderr
