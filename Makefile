@@ -1,6 +1,6 @@
 # Top-level build (role of the reference's make/ directory)
 
-.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint mesh-test ingest-bench wire-bench stream-prep-bench serve-bench decode-bench ftrl-bench chaos-bench rebalance-bench learning-bench history-bench roofline trace bundle bench-diff metrics-serve clean
+.PHONY: all native native-test test bench bench-all bench-watch smoke lint pslint metrics-lint donation-lint mesh-test ingest-bench wire-bench stream-prep-bench serve-bench decode-bench ftrl-bench chaos-bench rebalance-bench learning-bench consistency-bench history-bench roofline trace bundle bench-diff metrics-serve clean
 
 all: native
 
@@ -169,6 +169,19 @@ rebalance-bench:
 # dict is embedded in every bench.py record under "learning"
 learning-bench:
 	env JAX_PLATFORMS=cpu python -m parameter_server_tpu.benchmarks learning
+
+# self-driving consistency A/B (components bench, doc/PERFORMANCE.md
+# "Consistency–throughput frontier"): fixed τ=0 vs fixed τ=max vs the
+# adaptive controller on one planted-regression workload (paired-rep
+# medians, emulated pull RTT disclosed in-record), the KKT-style
+# significance filter off/on with its suppression accounting
+# reconciled against ps_push_keys_total, and the seeded divergence
+# drill through the controller's LR-backoff + snapshot-rollback
+# reaction (episode captured in one flight-recorder bundle). Full
+# record lands at $PS_CONSISTENCY_OUT (default /tmp/ps_consistency.json)
+consistency-bench:
+	env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python -m parameter_server_tpu.benchmarks consistency
 
 # history plane overhead probe (components bench, doc/OBSERVABILITY.md
 # "History plane"): the multi-resolution ring-cascade fold hook priced

@@ -812,6 +812,32 @@ def attach_learning(rec_or_headline: dict, smoke: bool) -> None:
         )
 
 
+def attach_consistency(rec_or_headline: dict, smoke: bool) -> None:
+    """Guarded embed of the self-driving consistency A/B under
+    ``consistency`` (benchmarks/components.consistency_ab): the three
+    τ arms (fixed 0 / fixed max / adaptive) with the
+    throughput-vs-final-loss frontier verdict, the KKT significance
+    filter off/on with its suppression accounting reconciled against
+    ``ps_push_keys_total``, and the seeded divergence drill through
+    the controller's backoff + rollback reaction. Paired-rep medians
+    with the emulated pull-RTT disclosed in-record — run METADATA,
+    never banded (script/bench_diff.py METADATA_SECTIONS); never
+    breaks a record. Builds its own mini-cluster (Postoffice reset),
+    so it must run among the component sections, after the run planes
+    are harvested."""
+    try:
+        from parameter_server_tpu.benchmarks.components import (
+            consistency_ab,
+        )
+
+        with telemetry_spans.parked_sink():
+            rec_or_headline["consistency"] = consistency_ab(smoke)
+    except Exception as e:
+        rec_or_headline["consistency_error"] = (
+            f"{type(e).__name__}: {str(e)[:200]}"
+        )
+
+
 def attach_learning_run(rec: dict, worker) -> None:
     """Fold the MAIN run worker's own learning plane into the record's
     ``learning`` section AFTER the timed windows — the plane object
@@ -2077,6 +2103,11 @@ def run_real(args) -> int:
     # planes it harvests first must still cover the phases above.
     _beat("learning")
     attach_learning(headline, args.smoke)
+    # self-driving consistency A/B (adaptive τ + KKT filter + rollback
+    # drill) — also Postoffice-resetting, so it rides with learning at
+    # the tail of the component sections
+    _beat("consistency")
+    attach_consistency(headline, args.smoke)
     _beat("e2e", **headline)
 
     wire_fallback = {"parts": 0, "rows": 0}
@@ -2649,6 +2680,10 @@ def run_synthetic(args) -> int:
     # component sections; see attach_learning's harvest-order note
     _beat("learning")
     attach_learning(headline, args.smoke)
+    # self-driving consistency A/B (adaptive τ + KKT filter + rollback
+    # drill) — Postoffice-resetting, rides with learning at the tail
+    _beat("consistency")
+    attach_consistency(headline, args.smoke)
     # disclose which wire the e2e stream actually rode (the flip's
     # whole point is that BENCH_r06 stops quoting the raw bits bytes)
     headline["e2e_wire"] = {

@@ -1482,7 +1482,7 @@ def sparse_update_min_slots() -> int:
 
 def _make_exact_mini_step(
     updater, loss, shard, with_aux, update, push_quant, pull_quant,
-    push_noise, pull_noise, pull_narrow,
+    push_noise, pull_noise, pull_narrow, significance=None,
 ):
     """Shared single-minibatch body for the exact (host-dedup) wire:
     (live, pulled, seed, per-device y/mask/rows/ucols/vals/uslots/umask)
@@ -1504,7 +1504,20 @@ def _make_exact_mini_step(
     The sparse form composes with the EXACT wire only: quantized/noisy
     push/pull filters are defined on dense shard vectors (per-shard
     scale factors), so they stay with ``"dense"``.
+
+    ``significance`` (ops/significance.SignificanceSpec, sparse-only):
+    the in-jit KKT filter — slots whose aggregated update provably
+    leaves the FTRL proximal weight at zero are masked out of the
+    update entirely (their rows are scatter-dropped, bit-untouched).
+    ``None`` traces the literal pre-filter program (the off =
+    bit-identical contract).
     """
+    if significance is not None and update != "sparse":
+        raise ValueError(
+            "the KKT significance filter composes with update='sparse' "
+            "only (its mask is defined on the globally-deduped unique-"
+            "slot vectors)"
+        )
     if update == "sparse":
         if push_quant or pull_quant or push_noise or pull_noise:
             raise ValueError(
@@ -1554,9 +1567,28 @@ def _make_exact_mini_step(
                 # no dense scatter, no shard-sized temp
                 g_local = g_u
                 g_u = jax.lax.psum(g_u, DATA_AXIS)
+            ok_upd = ok
+            if significance is not None:
+                with jax.named_scope("ps_kkt"):
+                    from ...ops.significance import kkt_mask
+
+                    # assemble the global z accumulator the same way
+                    # w_u was (one extra U-vector collective, disclosed
+                    # in doc/PERFORMANCE.md): the KKT test needs the
+                    # slot's z, owned by exactly one server shard
+                    z_own = jnp.where(ok, pulled_u["z"], 0.0)
+                    z_u = jax.lax.psum(z_own, SERVER_AXIS) * umask
+                    keep, n_suppressed = kkt_mask(
+                        z_u, g_u, w_u, umask, seed, spec=significance
+                    )
+                    # suppressed slots leave the push entirely: their
+                    # aggregated gradient zeroes AND their rows are
+                    # scatter-dropped below — state bit-untouched
+                    g_u = jnp.where(keep, g_u, 0.0)
+                    ok_upd = ok & keep
             with jax.named_scope("ps_update"):
                 new_state = apply_state_rows(
-                    updater, live, rel, ok, g_u, seed=seed
+                    updater, live, rel, ok_upd, g_u, seed=seed
                 )
             with jax.named_scope("ps_metrics"):
                 metrics = _progress_metrics(loss, y, xw, mask, with_aux)
@@ -1565,6 +1597,19 @@ def _make_exact_mini_step(
                 _convergence_metrics(
                     metrics, g_local, g_u, w_u, final_is_global=True
                 )
+                if significance is not None:
+                    # suppressed-key accounting, metered host-side in
+                    # collect (learner/consistency.py reconciles these
+                    # against ps_push_keys_total in-record)
+                    metrics["kkt_slots"] = jnp.sum(
+                        (umask > 0).astype(jnp.float32)
+                    )
+                    metrics["kkt_suppressed"] = n_suppressed
+                    if significance.feedback:
+                        # per-slot keep/ids for the host drop tracker —
+                        # global vectors, identical on every shard
+                        metrics["kkt_keep"] = keep
+                        metrics["kkt_uslots"] = uslots
             return new_state, metrics
 
         return mini_step_sparse
@@ -1626,7 +1671,7 @@ def make_train_step_scan(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
     pull_noise=None, pull_narrow: "bool | None" = None,
-    update: str = "dense",
+    update: str = "dense", significance=None,
 ):
     """Scan-fused superstep over the exact wire: T host-dedup'd
     minibatches per launch (the PreppedSuperBatch twin of
@@ -1634,9 +1679,13 @@ def make_train_step_scan(
     for T sequential ministeps, weights advancing every ministep)."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
+    # feedback vectors are per-ministep; the scan metric fold would sum
+    # them into garbage — scan supersteps keep the mask, drop the echo
+    if significance is not None:
+        significance = significance.without_feedback()
     mini_step = _make_exact_mini_step(
         updater, loss, shard, with_aux, update, push_quant, pull_quant,
-        push_noise, pull_noise, pull_narrow,
+        push_noise, pull_noise, pull_narrow, significance=significance,
     )
 
     def local_step(live, pulled, seed, y, mask, rows, ucols, vals,
@@ -1727,7 +1776,7 @@ def make_train_step_encoded(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
     pull_noise=None, pull_narrow: "bool | None" = None,
-    update: str = "dense",
+    update: str = "dense", significance=None,
 ):
     """Fused SPMD step over the compact wire's EncodedExactBatch: only
     the encoded buffers cross the host→device link; the jit decodes
@@ -1738,7 +1787,7 @@ def make_train_step_encoded(
     shard = num_slots // n_server
     mini_step = _make_exact_mini_step(
         updater, loss, shard, with_aux, update, push_quant, pull_quant,
-        push_noise, pull_noise, pull_narrow,
+        push_noise, pull_noise, pull_narrow, significance=significance,
     )
     decode = _encoded_shard_decoder(num_slots)
 
@@ -1766,16 +1815,18 @@ def make_train_step_encoded_scan(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
     pull_noise=None, pull_narrow: "bool | None" = None,
-    update: str = "dense",
+    update: str = "dense", significance=None,
 ):
     """Scan-fused superstep over the compact wire: T encoded minibatches
     per launch (the EncodedExactSuperBatch twin of make_train_step_scan
     — decode AND ministep both live inside the one jitted program)."""
     n_server = meshlib.num_servers(mesh)
     shard = num_slots // n_server
+    if significance is not None:  # scan fold: mask yes, echo no
+        significance = significance.without_feedback()
     mini_step = _make_exact_mini_step(
         updater, loss, shard, with_aux, update, push_quant, pull_quant,
-        push_noise, pull_noise, pull_narrow,
+        push_noise, pull_noise, pull_narrow, significance=significance,
     )
     decode = _encoded_shard_decoder(num_slots)
 
@@ -1822,7 +1873,7 @@ def make_train_step(
     updater, loss, mesh, num_slots: int, with_aux: bool = True,
     push_quant: int = 0, pull_quant: int = 0, push_noise=None,
     pull_noise=None, pull_narrow: "bool | None" = None,
-    update: str = "dense",
+    update: str = "dense", significance=None,
 ):
     """Build the fused SPMD train step. Returns jitted
     ``step(live_state, pull_state, batch_arrays) -> (new_state, metrics)``.
@@ -1836,7 +1887,7 @@ def make_train_step(
     shard = num_slots // n_server
     mini_step = _make_exact_mini_step(
         updater, loss, shard, with_aux, update, push_quant, pull_quant,
-        push_noise, pull_noise, pull_narrow,
+        push_noise, pull_noise, pull_narrow, significance=significance,
     )
 
     def local_step(live, pulled, seed, y, mask, rows, ucols, vals, uslots, umask):
@@ -2205,6 +2256,66 @@ class AsyncSGDWorker(ISGDCompNode):
             )
         self._heat_counter = 0  # feeder/trainer thread only
         self._snapshot_ts: Optional[int] = None  # submit thread only
+        # -- self-driving consistency (learner/consistency.py) --
+        # live effective τ: SGDConfig.max_delay is the CAP; the
+        # adaptive controller moves this between submissions. Plain
+        # int, single-writer (the collect thread via set_effective_tau)
+        # / read by the submit thread — int rebinding is atomic and
+        # the value is advisory scheduling state, never a shape.
+        self._effective_tau = max(0, sgd.max_delay)
+        self._tau_adaptive = bool(sgd.tau_adaptive)
+        self._significance = None
+        if sgd.kkt_filter:
+            if self._update_mode != "sparse":
+                raise ValueError(
+                    "SGDConfig.kkt_filter requires update='sparse' (the "
+                    "mask is defined on the globally-deduped unique-slot "
+                    f"vectors); resolved update mode is "
+                    f"{self._update_mode!r}"
+                )
+            if sgd.algo != "ftrl" or getattr(
+                self.penalty, "lambda1", 0.0
+            ) <= 0.0:
+                raise ValueError(
+                    "SGDConfig.kkt_filter derives its threshold from the "
+                    "FTRL proximal dead zone: algo='ftrl' and an L1 "
+                    "penalty (lambda1 > 0) are required"
+                )
+            if sgd.kkt_drop_after > 0 and sgd.ingest_workers != 1:
+                # the drop set evolves in collect order; a concurrent
+                # prep pool would apply it in racy, nondeterministic
+                # order (the stateless-or-feeder rule). ingest_workers
+                # defaults to 0 ("auto", multi-worker) — require the
+                # explicit serial setting.
+                raise ValueError(
+                    "SGDConfig.kkt_drop_after > 0 (host-side key drop) "
+                    "requires the serial prep path: set ingest_workers=1"
+                )
+            from ...ops.significance import SignificanceSpec
+
+            self._significance = SignificanceSpec(
+                l1=float(self.penalty.lambda1),
+                margin=float(sgd.kkt_margin),
+                escape=float(sgd.kkt_escape),
+                feedback=sgd.kkt_drop_after > 0,
+            )
+        if sgd.tau_adaptive or sgd.kkt_filter:
+            from ...learner.consistency import ConsistencyRuntime
+
+            self._consistency = ConsistencyRuntime.from_config(self, sgd)
+
+    def set_effective_tau(self, tau: int) -> int:
+        """Move the live bounded-delay τ (between submissions; the
+        adaptive controller's actuator). Clamped to [0, max_delay] —
+        the configured value stays the contract CAP, so realized
+        staleness under any live τ also satisfies the configured bound.
+        Never recompiles: τ only schedules snapshot refreshes (a host
+        counter), and adaptive mode pins one step executable."""
+        tau = int(min(max(0, self.sgd.max_delay), max(0, int(tau))))
+        self._effective_tau = tau
+        if self._learning is not None:
+            self._learning.set_tau(tau)
+        return tau
 
     def _resolve_update_mode(self, sgd: SGDConfig) -> str:
         """``SGDConfig.update`` → concrete formulation. "auto" flips to
@@ -2392,6 +2503,13 @@ class AsyncSGDWorker(ISGDCompNode):
 
     def prep(self, batch: SparseBatch, device_put: bool = True):
         """Localize+pad a batch for this worker (producer-thread safe)."""
+        if self._consistency is not None:
+            # host-side significance drop (learner/consistency.py):
+            # persistently-suppressed slots leave the batch BEFORE
+            # dedup/padding, so they never cost upload keys or bytes.
+            # A no-op unless kkt_drop_after > 0 (serial prep enforced
+            # at init — the drop set evolves in collect order).
+            batch = self._consistency.filter_batch(batch, self.directory)
         rows_pad, nnz_pad, uniq_pad = self._padding(batch)
         num_shards = self._num_shards()
         if self._update_mode == "sparse":
@@ -2559,7 +2677,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
-                update=self._update_mode,
+                update=self._update_mode, significance=self._significance,
             )
         elif isinstance(prepped, EncodedExactBatch):
             key = (
@@ -2572,7 +2690,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
-                update=self._update_mode,
+                update=self._update_mode, significance=self._significance,
             )
         elif isinstance(prepped, PreppedSuperBatch):
             key = ("exact_scan", (prepped.steps, self._update_mode), with_aux)
@@ -2581,7 +2699,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 with_aux=with_aux, push_quant=self._push_quant,
                 pull_quant=self._pull_quant, push_noise=self._push_noise,
                 pull_noise=self._pull_noise, pull_narrow=self._pull_narrow,
-                update=self._update_mode,
+                update=self._update_mode, significance=self._significance,
             )
         elif isinstance(prepped, ELLBitsSuperBatch):
             key = ("ell_bits_scan", (prepped.rows, prepped.steps), with_aux)
@@ -2629,6 +2747,7 @@ class AsyncSGDWorker(ISGDCompNode):
                 pull_noise=self._pull_noise,
                 pull_narrow=self._pull_narrow,
                 update=self._update_mode,
+                significance=self._significance,
             )
         if key not in self._steps:
             self._steps[key] = builder()
@@ -2653,7 +2772,9 @@ class AsyncSGDWorker(ISGDCompNode):
             EncodedExactSuperBatch,
         )
 
-        tau = self.sgd.max_delay
+        # the LIVE bounded-delay τ (== SGDConfig.max_delay unless the
+        # adaptive controller moved it; always <= the configured cap)
+        tau = self._effective_tau
         # a scan superbatch advances the weights n_steps times in one
         # submission (staleness 0 inside it — within any delay bound)
         n_steps = (
@@ -2693,8 +2814,13 @@ class AsyncSGDWorker(ISGDCompNode):
                 self._pull_state = self.state
             # donate_ok: with max_delay == 0 every step snapshots, so the
             # pull snapshot never outlives this call and the live table
-            # can be donated (halves table HBM footprint)
-            donated = tau <= 0
+            # can be donated (halves table HBM footprint). Adaptive τ
+            # pins the NON-donated variant even at τ=0: the donated and
+            # non-donated programs are different executables, and a
+            # controller clamping τ to 0 mid-run must never buy the
+            # donation with a recompile (the τ-sweep zero-recompile
+            # regression pin, tests/test_consistency.py)
+            donated = tau <= 0 and not self._tau_adaptive
             new_state, metrics = step_fn(
                 self.state, self._pull_state, prepped, seed,
                 donate_ok=donated,
@@ -2728,6 +2854,7 @@ class AsyncSGDWorker(ISGDCompNode):
             self._learning.note_submit(
                 staleness, n_steps=n_steps,
                 clock_lag=ts - self._snapshot_ts,
+                tau=tau,
             )
         return ts
 

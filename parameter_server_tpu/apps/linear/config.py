@@ -154,6 +154,34 @@ class SGDConfig:
     # exact wire (host-dedup'd slots) and composes with unfiltered
     # push/pull only.
     update: str = "auto"
+    # -- self-driving consistency (learner/consistency.py) --
+    # adaptive bounded-delay τ: max_delay becomes the CAP and the live
+    # effective τ moves in [0, max_delay] with gradient geometry —
+    # widening while grad norms hold steady (more async throughput),
+    # clamping toward 0 on divergence leading indicators, with
+    # automatic LR backoff + snapshot rollback on a divergence. Pins
+    # the non-donated step variant so τ moves never recompile.
+    tau_adaptive: bool = False
+    # in-jit KKT-style significance filter (ops/significance.py):
+    # suppress slots whose pending update provably leaves the FTRL
+    # proximal weight at zero (|z + g| <= lambda1 * kkt_margin at
+    # w == 0) — requires algo="ftrl", an L1 penalty, and the sparse
+    # update formulation. Lossy by design (a suppressed slot skips its
+    # z accumulation); the seeded kkt_escape fraction ships anyway so
+    # persistent sub-threshold features still learn. False =
+    # bit-identical to the unfiltered path (contract-tested).
+    kkt_filter: bool = False
+    kkt_margin: float = 1.0
+    kkt_escape: float = 1.0 / 64.0
+    # host-side key drop: a slot suppressed on kkt_drop_after
+    # consecutive collected steps stops being uploaded at all (prep
+    # removes it from the batch — forward-exact while its weight is
+    # zero) until the every-kkt_revisit_every-th prepped batch ships
+    # unfiltered to re-measure. 0 disables the host drop (in-jit
+    # filter only). Serial-prep path only (the drop set evolves in
+    # collect order; a concurrent ingest pool would make it racy).
+    kkt_drop_after: int = 0
+    kkt_revisit_every: int = 64
 
 
 @dataclasses.dataclass

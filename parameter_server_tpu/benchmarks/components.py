@@ -3479,3 +3479,458 @@ def rebalance_perf(smoke: bool = False) -> None:
     report("rebalance_serve_ok", reb["serve"]["completed_ok"], "requests")
     with open(out_path, "w") as f:
         _json.dump({"rebalance_record": out}, f, indent=2)
+
+def _consistency_conf(tau, *, adaptive=False, kkt=False, drop_after=0):
+    """One consistency-arm config. The τ arms run the stability-frontier
+    workload (standard SGD, square loss, constant α at the edge where
+    delayed gradients visibly cost accuracy); the KKT arms run the FTRL
+    + L1 workload the filter's threshold is derived from."""
+    from ..apps.linear.config import (
+        Config,
+        LearningRateConfig,
+        LossConfig,
+        PenaltyConfig,
+        SGDConfig,
+    )
+
+    conf = Config()
+    if kkt:
+        conf.penalty = PenaltyConfig(type="l1", lambda_=[0.1])
+        conf.learning_rate = LearningRateConfig(
+            type="decay", alpha=0.1, beta=1.0
+        )
+        conf.async_sgd = SGDConfig(
+            algo="ftrl", minibatch=128, num_slots=1 << 10, max_delay=tau,
+            update="sparse", tau_adaptive=adaptive, kkt_filter=True,
+            kkt_drop_after=drop_after,
+            kkt_revisit_every=8,
+            ingest_workers=1,
+        )
+    else:
+        conf.loss = LossConfig(type="square")
+        conf.penalty = PenaltyConfig(type="l2", lambda_=[0.0])
+        # α at the delayed-stability frontier: τ=0 converges cleanly,
+        # τ=max pays a measured final-loss penalty from stale
+        # gradients (the NIPS'14 bounded-delay degradation, made
+        # visible on purpose) — the regime where an adaptive τ earns
+        # its keep
+        conf.learning_rate = LearningRateConfig(
+            type="constant", alpha=0.03, beta=1.0
+        )
+        conf.async_sgd = SGDConfig(
+            algo="standard", minibatch=128, num_slots=1 << 10,
+            max_delay=tau, tau_adaptive=adaptive,
+        )
+    return conf
+
+
+def _consistency_batches(n, directory, num_slots, seed0=0):
+    """Planted-regression batches, labeled through the SAME key→slot
+    hash the workers use, so every arm sees an identical learnable
+    problem with a known optimum."""
+    from ..utils.sparse import random_sparse
+
+    rng = np.random.default_rng(7)
+    wstar = rng.normal(size=num_slots).astype(np.float32)
+    noise = np.random.default_rng(11)
+    out = []
+    for i in range(n):
+        b = random_sparse(128, 1 << 14, 8, seed=seed0 + i, binary=True)
+        slots = directory.slots(b.indices)
+        rows = b.row_ids()
+        xw = np.zeros(b.n, np.float32)
+        np.add.at(xw, rows, wstar[np.minimum(slots, num_slots - 1)])
+        b.y = (xw / 8.0 + 0.05 * noise.normal(size=b.n)).astype(np.float32)
+        out.append(b)
+    return out
+
+
+def _final_loss(worker_name) -> float:
+    from ..telemetry import learning as learning_mod
+
+    snap = learning_mod.get_plane(worker_name).snapshot()
+    tail = [
+        p["loss"] for p in snap["trajectory_tail"][-8:]
+        if isinstance(p["loss"], float)
+    ]
+    return float(np.median(tail)) if tail else float("inf")
+
+
+def _attach_pull_rtt(worker, rtt_s: float) -> None:
+    """Emulate the cross-host weight-pull RTT on snapshot-refresh
+    submissions — the latency τ exists to hide (OSDI'14's wait-time
+    model: a worker blocks on a fresh pull only when its snapshot has
+    aged past the delay bound).
+
+    DISCLOSED in-record as ``emulated_pull_rtt_ms``: on this CPU
+    container host and device share the same cores, so the real
+    overlap win of bounded staleness cannot physically show (there is
+    no idle resource for τ>0 to reclaim — measured here as ±25%
+    run-to-run noise around a flat line). The sleep lands exactly
+    where a multi-host deployment blocks: at the submit that refreshes
+    the pulled snapshot (async_sgd.py's ``do_snapshot``), so τ=0 pays
+    it every step, τ=max every τ-th, and the adaptive arm at its
+    CURRENT live τ — the loss trajectories stay real measurements,
+    untouched by the emulation."""
+    import time as _time
+
+    orig = worker._submit_prepped
+
+    def submit(prepped, with_aux: bool = True) -> int:
+        tau = worker._effective_tau
+        if tau <= 0 or worker._steps_since_snapshot >= tau:
+            _time.sleep(rtt_s)
+        return orig(prepped, with_aux=with_aux)
+
+    worker._submit_prepped = submit
+
+
+def _consistency_divergence_drill(mesh, smoke: bool) -> dict:
+    """Seeded divergence drill through the CONTROLLER's reaction path:
+    a poisoned batch (non-finite labels) NaNs one collected step; the
+    learning plane judges it divergent (the shipped ``loss_divergence``
+    rule fires on the counter, fake clock), and the adaptive controller
+    reacts in the same collect — τ→0, automatic LR backoff, rollback to
+    its last healthy snapshot — then the run re-converges on clean
+    data. The whole episode lands in ONE flight-recorder bundle: the
+    controller's own ``consistency_rollback`` trigger captures while
+    the pre-divergence evidence is still in the rings."""
+    from ..apps.linear.async_sgd import AsyncSGDWorker
+    from ..telemetry import alerts as alerts_mod
+    from ..telemetry import blackbox
+    from ..telemetry import learning as learning_mod
+
+    rule = next(
+        r for r in alerts_mod.default_rules() if r.name == "loss_divergence"
+    )
+    clock = [0.0]
+    mgr = alerts_mod.AlertManager([rule], clock=lambda: clock[0])
+    prev_interval = blackbox.set_min_interval(0.0)
+    was_armed = blackbox.installed_recorder() is not None
+    blackbox.arm()
+    blackbox.recorder().clear()  # a prior drill in this process must
+    # not leak into this bundle
+    conf = _consistency_conf(4, adaptive=True)
+    worker = AsyncSGDWorker(conf, mesh=mesh, name="consistency_diverge")
+    n_good = 8 if smoke else 12
+    bundles0 = len(blackbox.bundles())
+    try:
+        mgr.evaluate()  # t=0 baseline sample — a rate needs a window
+        batches = _consistency_batches(
+            n_good + 4, worker.directory, worker.num_slots, seed0=300
+        )
+        losses = []
+        for b in batches[:n_good]:
+            ts = worker._submit_prepped(
+                worker.prep(b, device_put=False), with_aux=False
+            )
+            worker.collect(ts)
+            losses.append(_final_loss("consistency_diverge"))
+        pre_alpha = float(worker.lr.alpha)
+        pre_tau = worker._consistency.controller.tau
+        bad = batches[n_good]
+        bad.y = np.full_like(bad.y, np.float32("inf"))
+        ts = worker._submit_prepped(
+            worker.prep(bad, device_put=False), with_aux=False
+        )
+        worker.collect(ts)  # the reaction happens inside this collect
+        clock[0] = 5.0
+        mgr.evaluate()  # pending → firing in one tick (for_s=0)
+        fired = rule.name in mgr.firing()
+        post = []
+        for b in batches[n_good + 1:]:
+            ts = worker._submit_prepped(
+                worker.prep(b, device_put=False), with_aux=False
+            )
+            worker.collect(ts)
+            post.append(_final_loss("consistency_diverge"))
+        episodes = list(worker._consistency.controller.episodes)
+        plane = learning_mod.get_plane("consistency_diverge")
+        divergences = dict(plane.snapshot()["divergence"])
+        bundles = blackbox.bundles()[bundles0:]
+        rollback_bundle = next(
+            (
+                b for b in bundles
+                if b["trigger"]["kind"] == "consistency_rollback"
+            ),
+            None,
+        )
+    finally:
+        worker.executor.stop()
+        blackbox.set_min_interval(prev_interval)
+        if not was_armed:
+            blackbox.disarm()
+    return {
+        "good_steps": n_good,
+        "loss_before_poison": losses[-1] if losses else None,
+        "pre_reaction": {"alpha": pre_alpha, "tau": pre_tau},
+        "episodes": episodes,
+        "divergence_counts": divergences,
+        "alert_fired": bool(fired),
+        "post_rollback_losses": [round(x, 6) for x in post],
+        "reconverged": bool(post)
+        and all(np.isfinite(post))
+        and post[-1] <= losses[0],
+        "bundle_captured": rollback_bundle is not None,
+        "bundle_trigger": (
+            dict(rollback_bundle["trigger"]) if rollback_bundle else None
+        ),
+    }
+
+
+def consistency_ab(smoke: bool = False) -> dict:
+    """Self-driving consistency A/B (ISSUE 20), embedded under
+    ``consistency`` in every bench record and run standalone via
+    ``make consistency-bench``.
+
+    Three τ arms on ONE workload (the delayed-stability frontier:
+    planted regression, constant α where staleness measurably costs
+    accuracy), back-to-back paired reps with medians: fixed τ=0
+    (serialized, fresh gradients), fixed τ=max (full async overlap,
+    stale gradients), and adaptive (the controller earns τ from
+    stability). The frontier claim quoted in-record: adaptive ≥ τ=0 on
+    e2e throughput AND < τ=max on final loss. Then the KKT significance
+    filter off/on on the FTRL+L1 workload it is derived from — shipped
+    keys/bytes measured with the suppression counters reconciled
+    against ``ps_push_keys_total`` in-record, final-loss delta
+    disclosed (the filter is lossy BY DESIGN) — and the seeded
+    divergence drill through the controller's backoff + rollback
+    reaction. Record METADATA, never banded by the bench-diff sentinel
+    (script/bench_diff.py METADATA_SECTIONS)."""
+    import time as _time
+
+    from ..apps.linear.async_sgd import AsyncSGDWorker
+    from ..parallel import mesh as meshlib
+    from ..telemetry import learning as learning_mod
+    from ..telemetry import registry as telemetry_registry
+    from ..telemetry.instruments import parameter_instruments
+
+    mesh = _learning_mesh()
+    tau_max = 8
+    n_batches = 24 if smoke else 64
+    n_warm = 4
+    reps = 1 if smoke else 3
+    rtt_s = 0.025  # emulated pull RTT — see _attach_pull_rtt
+
+    # one shared batch list, labeled through the shared hash (every
+    # worker with the same num_slots config hashes identically)
+    probe = AsyncSGDWorker(
+        _consistency_conf(0), mesh=mesh, name="consistency_probe"
+    )
+    batches = _consistency_batches(
+        n_batches, probe.directory, probe.num_slots
+    )
+    probe.executor.stop()
+
+    arms = {}
+    arm_specs = (
+        ("tau0", 0, False),
+        ("taumax", tau_max, False),
+        ("adaptive", tau_max, True),
+    )
+    for rep in range(reps):
+        for arm_name, tau, adaptive in arm_specs:
+            name = f"consistency_{arm_name}_{rep}"
+            worker = AsyncSGDWorker(
+                _consistency_conf(tau, adaptive=adaptive),
+                mesh=mesh, name=name,
+            )
+            _attach_pull_rtt(worker, rtt_s)
+            if adaptive and worker._consistency is not None:
+                # ramp scaled to the 60-batch window: the production
+                # default (+1 per 8 healthy collects,
+                # learner/consistency.py STABLE_STEPS) would spend the
+                # ENTIRE bench run below cap — disclosed in-record as
+                # adaptive_stable_steps
+                worker._consistency.controller.stable_steps = 2
+            try:
+                worker.train(iter(batches[:n_warm]))  # compile warmup
+                t0 = _time.perf_counter()
+                worker.train(iter(batches[n_warm:]))
+                dt = _time.perf_counter() - t0
+            finally:
+                worker.executor.stop()
+            st = learning_mod.get_plane(name).snapshot()["staleness"]
+            rec = arms.setdefault(
+                arm_name,
+                {"tau": tau, "adaptive": adaptive, "reps": [],
+                 "final_loss": None, "staleness": None},
+            )
+            rec["reps"].append(
+                round((n_batches - n_warm) * 128 / dt, 1)
+            )
+            if rep == 0:
+                rec["final_loss"] = round(_final_loss(name), 6)
+                rec["staleness"] = st
+                if adaptive and worker._consistency is not None:
+                    rec["controller"] = worker._consistency.snapshot()["tau"]
+    for rec in arms.values():
+        rec["examples_per_s_median"] = float(np.median(rec["reps"]))
+
+    # paired-rep discipline: each rep ran all arms back-to-back, so
+    # the adaptive-vs-τ0 throughput verdict is the median of PER-REP
+    # ratios (machine drift cancels pairwise), not a ratio of medians
+    pair_ratios = [
+        a / b
+        for a, b in zip(arms["adaptive"]["reps"], arms["tau0"]["reps"])
+    ]
+    frontier = {
+        "adaptive_vs_tau0_throughput_ratio": round(
+            float(np.median(pair_ratios)), 4
+        ),
+        "adaptive_beats_tau0_throughput": float(
+            np.median(pair_ratios)
+        ) > 1.0,
+        "adaptive_beats_taumax_loss": (
+            arms["adaptive"]["final_loss"] < arms["taumax"]["final_loss"]
+        ),
+        "tau0_loss": arms["tau0"]["final_loss"],
+        "taumax_loss": arms["taumax"]["final_loss"],
+        "adaptive_loss": arms["adaptive"]["final_loss"],
+    }
+
+    # -- KKT significance filter off/on (FTRL + L1, update='sparse') --
+    kkt_batches = _consistency_batches(
+        12 if smoke else 32, probe.directory, probe.num_slots, seed0=100
+    )
+    for b in kkt_batches:  # classification labels for the logit loss
+        b.y = np.where(b.y > 0, 1.0, -1.0).astype(np.float32)
+    kkt = {}
+    for arm_name, on in (("off", False), ("on", True)):
+        name = f"consistency_kkt_{arm_name}"
+        conf = (
+            _consistency_conf(2, kkt=True, drop_after=3)
+            if on
+            else _consistency_conf(2, kkt=True)
+        )
+        if not on:
+            conf.async_sgd.kkt_filter = False
+        worker = AsyncSGDWorker(conf, mesh=mesh, name=name)
+        # counters are process-global per label set: reconcile against
+        # the DELTA so a prior run of this bench in the same process
+        # (the test suite smoke-runs every REGISTRY entry) can't skew
+        counter0 = 0.0
+        if on and telemetry_registry.enabled():
+            counter0 = parameter_instruments(
+                telemetry_registry.default_registry()
+            )["push_keys"].value(store=name, channel=0)
+        try:
+            worker.train(iter(kkt_batches))
+        finally:
+            worker.executor.stop()
+        entry = {"final_loss": round(_final_loss(name), 6)}
+        if on:
+            summary = worker._consistency.tracker.summary()
+            counter = None
+            if telemetry_registry.enabled():
+                counter = parameter_instruments(
+                    telemetry_registry.default_registry()
+                )["push_keys"].value(store=name, channel=0) - counter0
+            baseline_nnz = sum(b.nnz for b in kkt_batches)
+            entry.update(
+                {
+                    "accounting": summary,
+                    "push_keys_counter": counter,
+                    "counter_reconciled": (
+                        counter is None or counter == summary["pushed"]
+                    ),
+                    "suppressed_key_frac": round(
+                        summary["suppressed"] / max(1, summary["candidates"]),
+                        4,
+                    ),
+                    "baseline_nnz": baseline_nnz,
+                    "dropped_entry_frac": round(
+                        summary["dropped_entries"] / max(1, baseline_nnz), 4
+                    ),
+                }
+            )
+        kkt[arm_name] = entry
+    kkt["loss_delta"] = round(
+        kkt["on"]["final_loss"] - kkt["off"]["final_loss"], 6
+    )
+
+    return {
+        "workload": {
+            "n_batches": n_batches,
+            "warmup_batches": n_warm,
+            "minibatch": 128,
+            "num_slots": probe.num_slots,
+            "num_shards": meshlib.num_servers(mesh),
+            "tau_max": tau_max,
+            "reps": reps,
+            "emulated_pull_rtt_ms": rtt_s * 1000.0,
+            "adaptive_stable_steps": 2,
+            "pairing": "back-to-back per rep; verdicts are medians of "
+                       "per-rep paired ratios; throughput includes the "
+                       "emulated pull RTT on refresh submissions "
+                       "(_attach_pull_rtt disclosure), losses are real",
+        },
+        "tau_arms": arms,
+        "frontier": frontier,
+        "significance_filter": kkt,
+        "divergence_drill": _consistency_divergence_drill(mesh, smoke),
+    }
+
+
+@benchmark("consistency")
+def consistency_perf(smoke: bool = False) -> None:
+    """`make consistency-bench`: the self-driving consistency A/B.
+    Structural contracts assert in every mode (bounded-delay holds per
+    arm, the controller widened τ, KKT accounting reconciles against
+    ``ps_push_keys_total``, the divergence drill backed off + rolled
+    back + re-converged with the episode bundled); the wall-clock
+    frontier verdicts (adaptive beats fixed τ=0 on throughput, beats
+    fixed τ=max on final loss) assert only on full runs — smoke runs on
+    a 2-core CI container where a throughput ordering would be noise."""
+    import json as _json
+    import os as _os
+    import tempfile as _tempfile
+
+    out = consistency_ab(smoke)
+    for arm in out["tau_arms"].values():
+        assert arm["staleness"]["within_bound"], arm["staleness"]
+    ctl = out["tau_arms"]["adaptive"]["controller"]
+    assert max(ctl["trace"]) > ctl["trace"][0], (
+        f"adaptive controller never widened tau: {ctl['trace']}"
+    )
+    kkt_on = out["significance_filter"]["on"]
+    assert kkt_on["accounting"]["reconciled"], kkt_on
+    assert kkt_on["counter_reconciled"], kkt_on
+    assert kkt_on["accounting"]["suppressed"] > 0, kkt_on
+    drill = out["divergence_drill"]
+    assert drill["episodes"] and drill["episodes"][0]["rolled_back"], drill
+    assert drill["alert_fired"] and drill["bundle_captured"], drill
+    assert drill["reconverged"], drill
+    if not smoke:
+        assert out["frontier"]["adaptive_beats_tau0_throughput"], (
+            out["frontier"]
+        )
+        assert out["frontier"]["adaptive_beats_taumax_loss"], (
+            out["frontier"]
+        )
+    report(
+        "consistency_adaptive_examples_per_s",
+        out["tau_arms"]["adaptive"]["examples_per_s_median"],
+        "examples/s",
+    )
+    report(
+        "consistency_tau0_examples_per_s",
+        out["tau_arms"]["tau0"]["examples_per_s_median"],
+        "examples/s",
+    )
+    report(
+        "consistency_adaptive_tau_reached", max(ctl["trace"]), "ministeps"
+    )
+    report(
+        "consistency_kkt_suppressed_keys",
+        kkt_on["accounting"]["suppressed"],
+        "keys",
+    )
+    report(
+        "consistency_drill_rollbacks", len(drill["episodes"]), "episodes"
+    )
+    out_path = _os.environ.get("PS_CONSISTENCY_OUT") or _os.path.join(
+        _tempfile.gettempdir(), "ps_consistency.json"
+    )
+    with open(out_path, "w") as f:
+        _json.dump({"consistency_record": out}, f, indent=2)

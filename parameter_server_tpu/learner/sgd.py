@@ -112,6 +112,12 @@ class ISGDCompNode(App, Checkpointable):
         # collect() folds the step's device-confirmed example count and
         # the in-jit convergence side outputs into it
         self._learning = None
+        # self-driving consistency (learner/consistency.py): installed
+        # by workers running the adaptive τ controller and/or the KKT
+        # significance filter; collect() hands it each step's metrics
+        # AFTER the learning plane folds them (the controller reads the
+        # plane's judgments, it never re-derives them)
+        self._consistency = None
         from ..telemetry import registry as telemetry_registry
 
         if telemetry_registry.enabled():
@@ -145,6 +151,12 @@ class ISGDCompNode(App, Checkpointable):
             # own num_ex output plus the in-jit loss/grad/update/weight
             # side outputs, metered host-side (PR 8 jit-purity pattern)
             self._learning.note_step(metrics)
+        if self._consistency is not None:
+            # adaptive τ / KKT accounting / divergence reaction — may
+            # back off LR, clamp τ, and roll state back to the last
+            # healthy snapshot (the exceptional path; collect-thread
+            # only, like everything else in this method)
+            self._consistency.on_collect(metrics)
         prog = SGDProgress(
             objective=[float(metrics["objective"])],
             num_examples_processed=int(metrics["num_ex"]),

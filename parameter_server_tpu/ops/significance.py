@@ -1,0 +1,125 @@
+"""KKT-style significance filter for the sparse update path (trace-pure).
+
+The reference parameter server's KKT filter (Li et al., OSDI'14 §5.2)
+drops gradient keys whose update provably cannot move the model: for an
+L1-regularized objective the FTRL-proximal weight is
+
+    w_k = prox(-z_k * eta, eta) = 0   iff   |z_k| <= lambda1,
+
+so a slot sitting at ``w == 0`` whose post-fold accumulator still lands
+inside the dead zone (``|z + g| <= lambda1``) takes an update that is a
+provable no-op on the weights — only ``z``/``n`` bookkeeping would move,
+and only within the dead zone. Suppressing those slots cuts the shipped
+key set on the binding upload path without touching any weight the model
+actually uses.
+
+This module is the in-jit half: :func:`kkt_mask` computes the per-slot
+keep mask from the GLOBAL unique-slot vectors the sparse mini-step
+already assembles (``z_u``/``g_u``/``w_u``/``umask`` — identical on
+every shard after their psums, so the mask is too). Decisions are
+deterministic and seeded: a fixed escape fraction of suppressed slots
+ships anyway (counter-hash of (position, seed), the ops/ftrl.py dither
+stream), because a persistent feature whose per-step gradient never
+exceeds the dead zone would otherwise NEVER accumulate z and never
+learn — the classic KKT-filter starvation mode, disclosed in
+doc/PERFORMANCE.md ("Consistency–throughput frontier").
+
+Honest-lossiness contract: with the filter ON, suppressed slots skip
+their z/n accumulation (their crossing into the active set is delayed
+by ~1/escape steps); with the filter OFF (:data:`SignificanceSpec` is
+``None`` at trace time) the traced program is literally unchanged —
+bit-identical to the unfiltered path, contract-tested in
+tests/test_consistency.py.
+
+jit-purity scope (script/pslint): everything here is trace-pure — no
+telemetry, no host sync, no wall clock; counts ride the metrics dict
+and are metered host-side in collect (the PR 8 pattern).
+"""
+
+# bit-identical: this module is under the replay bit-identity contract (pslint determinism pass)
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+#: 2^32 as float — escape probability -> uint32 hash threshold
+_U32_SPAN = 4294967296.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SignificanceSpec:
+    """Trace-time constants of the KKT significance filter.
+
+    Frozen and hashable: step builders close over it, so two workers
+    with the same spec share compiled-step structure and a ``None``
+    spec traces exactly the pre-filter program.
+
+    - ``l1``: the penalty's lambda1 (the proximal dead-zone radius).
+    - ``margin``: threshold scale on the dead zone. 1.0 is the exact
+      optimality condition (suppress only provable weight no-ops);
+      < 1 is conservative, > 1 trades accuracy for fewer keys.
+    - ``escape``: seeded fraction of otherwise-suppressed slots that
+      ship anyway (starvation guard). 0 disables the escape hatch.
+    - ``feedback``: emit the per-slot keep mask + slot ids as metrics
+      side outputs so the host-side tracker (learner/consistency.py)
+      can drop persistently-suppressed keys from future uploads.
+      Scan supersteps force this off (per-ministep vectors would be
+      summed into garbage by the scan metric fold).
+    """
+
+    l1: float
+    margin: float = 1.0
+    escape: float = 1.0 / 64.0
+    feedback: bool = False
+
+    def without_feedback(self) -> "SignificanceSpec":
+        return dataclasses.replace(self, feedback=False)
+
+
+def kkt_mask(z_u, g_u, w_u, umask, seed, *, spec: SignificanceSpec):
+    """Per-unique-slot keep mask for the aggregated gradient ``g_u``.
+
+    All inputs are the sparse mini-step's GLOBAL unique vectors
+    (identical on every shard): ``z_u`` the assembled FTRL z
+    accumulator, ``g_u`` the data-psum'd gradient, ``w_u`` the pulled
+    weights, ``umask`` the real-slot (non-padding) mask. Returns
+    ``(keep, suppressed)``: a bool keep vector and the scalar count of
+    suppressed real slots. Padding slots always read keep=True (their
+    gradient is already zero and they must stay out of the count).
+
+    The decision is evaluated on the τ-stale PULLED state — the same
+    snapshot the gradient itself was computed on — so it composes with
+    bounded-delay staleness exactly like the gradient does.
+    """
+    if spec.escape >= 1.0:
+        # every suppressed slot would escape: the filter is a
+        # structural no-op (the bit-identity configuration the
+        # contract tests pin) — skip the mask entirely so the traced
+        # update path is untouched
+        return (
+            jnp.ones_like(umask, dtype=bool),
+            jnp.zeros((), jnp.float32),
+        )
+    at_zero = (w_u == 0.0) & (umask > 0)
+    # the FTRL z fold at w == 0 is z' = z + g (sigma*w vanishes): the
+    # slot stays a provable weight no-op iff z' is inside the scaled
+    # dead zone
+    insig = jnp.abs(z_u + g_u) <= np.float32(spec.l1 * spec.margin)
+    suppress = at_zero & insig
+    if spec.escape > 0.0:
+        from .ftrl import dither_hash_u32
+
+        # seeded starvation escape: a fixed fraction of suppressed
+        # slots ships each step so persistent sub-threshold gradients
+        # still accumulate z at rate ~escape*g. Position-keyed on the
+        # dither stream, offset from the rounding dither's seed use so
+        # the two decision streams never correlate.
+        pos = jnp.arange(z_u.shape[0], dtype=jnp.uint32)
+        h = dither_hash_u32(pos, jnp.asarray(seed, jnp.uint32) ^ np.uint32(0x5EED5EED))
+        esc = h < np.uint32(int(spec.escape * _U32_SPAN))
+        suppress = suppress & ~esc
+    keep = ~suppress
+    return keep, jnp.sum(suppress.astype(jnp.float32))

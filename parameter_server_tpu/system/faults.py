@@ -63,6 +63,9 @@ POINTS = (
     "serve.refresh",       # read-replica refresh store path: raise
     "rebalance.migrate",   # live migration, post-snapshot host phase:
                            # stall (widen the journal window) / raise
+    "consistency.rollback",  # divergence reaction, before LR backoff +
+                             # snapshot rollback: raise / stall (drill
+                             # the recovery path itself failing)
 )
 
 

@@ -776,10 +776,12 @@ def learning_instruments(reg: MetricsRegistry) -> Dict[str, object]:
         ),
         "staleness_over_tau": reg.ensure_gauge(
             "ps_learning_staleness_over_tau",
-            "observed-max staleness minus the configured max_delay τ — "
-            "<= 0 while the bounded-delay contract holds; > 0 is a "
-            "contract breach (the staleness_breach alert rule fires "
-            "on this gauge)",
+            "worst per-submission margin of realized staleness over the "
+            "LIVE effective τ in force at submit time (the adaptive "
+            "controller's bound when tau_adaptive, else the configured "
+            "max_delay) — <= 0 while the bounded-delay contract holds; "
+            "> 0 is a contract breach (the staleness_breach alert rule "
+            "fires on this gauge)",
             labelnames=("worker",),
         ),
         "examples": reg.ensure_counter(
@@ -874,6 +876,74 @@ def partition_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     }
 
 
+def consistency_instruments(reg: MetricsRegistry) -> Dict[str, object]:
+    """Self-driving consistency (learner/consistency.py): the adaptive
+    τ controller's live bound + reactions, and the KKT significance
+    filter's key accounting. The suppression counters reconcile
+    in-record against ``ps_push_keys_total``:
+    pushed + suppressed == candidates (the in-jit mask), and
+    candidates + dropped == the unfiltered baseline (the host-side
+    persistent-drop set) — bench records assert both identities."""
+    return {
+        "tau": reg.ensure_gauge(
+            "ps_consistency_tau",
+            "the LIVE effective bounded-delay τ this worker submits "
+            "under right now (== configured max_delay while static; "
+            "the AdaptiveTauController moves it between submissions)",
+            labelnames=("worker",),
+        ),
+        "tau_changes": reg.ensure_counter(
+            "ps_consistency_tau_changes_total",
+            "τ moves the adaptive controller made, by direction: widen "
+            "(stability-earned async headroom), clamp (grad-norm spike "
+            "backoff), reset (divergence reaction to τ=0)",
+            labelnames=("worker", "direction"),
+        ),
+        "suppressed": reg.ensure_counter(
+            "ps_consistency_suppressed_keys_total",
+            "unique slots the in-jit KKT mask suppressed from pushes "
+            "(w == 0 and |z + g| inside the scaled L1 dead zone, net "
+            "of the seeded starvation escape)",
+            labelnames=("worker",),
+        ),
+        "candidates": reg.ensure_counter(
+            "ps_consistency_candidate_keys_total",
+            "unique real (non-padding) slots the filtered sparse step "
+            "considered — pushed keys + suppressed keys must equal "
+            "this (the in-record reconciliation identity)",
+            labelnames=("worker",),
+        ),
+        "dropped": reg.ensure_counter(
+            "ps_consistency_dropped_keys_total",
+            "slot occurrences removed from batches HOST-SIDE before "
+            "prep because the slot's suppression streak crossed "
+            "kkt_drop_after (these never cost upload keys or bytes; "
+            "periodically revisited via kkt_revisit_every)",
+            labelnames=("worker",),
+        ),
+        "backoff": reg.ensure_counter(
+            "ps_consistency_backoff_total",
+            "automatic LR backoffs the divergence reaction applied "
+            "(each also clamps τ to 0 and re-jits the weights fn)",
+            labelnames=("worker",),
+        ),
+        "rollback": reg.ensure_counter(
+            "ps_consistency_rollback_total",
+            "snapshot rollbacks the divergence reaction executed, by "
+            "trigger reason (nonfinite, spike, alert) — state restored "
+            "to the controller's last healthy in-memory snapshot",
+            labelnames=("worker", "reason"),
+        ),
+        "snapshot_age": reg.ensure_gauge(
+            "ps_consistency_snapshot_age_steps",
+            "collected steps since the controller's last healthy "
+            "rollback snapshot (the rollback blast radius if the next "
+            "collect diverges)",
+            labelnames=("worker",),
+        ),
+    }
+
+
 def app_instruments(reg: MetricsRegistry) -> Dict[str, object]:
     """Application layer: RPC fan-out and training volume."""
     return {
@@ -954,6 +1024,7 @@ cached_learning_instruments = _cached_family(learning_instruments)
 cached_blackbox_instruments = _cached_family(blackbox_instruments)
 cached_bundle_instruments = _cached_family(bundle_instruments)
 cached_partition_instruments = _cached_family(partition_instruments)
+cached_consistency_instruments = _cached_family(consistency_instruments)
 
 
 INSTRUMENT_FAMILIES = (
@@ -975,6 +1046,7 @@ INSTRUMENT_FAMILIES = (
     blackbox_instruments,
     bundle_instruments,
     partition_instruments,
+    consistency_instruments,
     app_instruments,
     heartbeat_instruments,
 )

@@ -17,13 +17,19 @@ chip:
   ``Executor`` timestamps the worker already holds; disclosed as
   ``executor_clock_lag_max``, not folded into the histogram: τ is a
   ministep bound and the launch-clock lag never exceeds it). The
-  observed-max gauge against the configured τ turns the bounded-delay
-  contract into a measured invariant — it meters the same counter the
-  snapshot refresh enforces, so it is a regression detector for the
-  ENFORCEMENT (a skipped or mis-scheduled refresh reads > τ and
-  fires), not an independent oracle of it (bench records assert
-  ``observed <= τ`` in-record; the ``staleness_breach`` rule fires
-  live on ``ps_learning_staleness_over_tau > 0``).
+  observed-max gauge against τ turns the bounded-delay contract into a
+  measured invariant — it meters the same counter the snapshot refresh
+  enforces, so it is a regression detector for the ENFORCEMENT (a
+  skipped or mis-scheduled refresh reads > τ and fires), not an
+  independent oracle of it (bench records assert ``observed <= τ``
+  in-record; the ``staleness_breach`` rule fires live on
+  ``ps_learning_staleness_over_tau > 0``). Since PR 20 the bound is
+  the LIVE τ: each submission is judged against the effective τ in
+  force when it was stamped (:meth:`LearningPlane.set_tau`; the
+  adaptive controller moves it between submissions), so a submission
+  that was legal under the wide τ of its era never false-fires after
+  the controller clamps down — and the current τ itself is exported
+  as the ``ps_consistency_tau`` gauge.
 - **Key heat & shard balance** (:class:`KeyHeat` /
   :meth:`LearningPlane.note_slots`): a windowed-decay count-min sketch
   (``utils/sketch.DecayCountMin`` — the same CM machinery the ingest
@@ -273,10 +279,11 @@ class LearningPlane:
         heat_every: int = 1,
         spike_factor: float = SPIKE_FACTOR,
     ):
-        from .instruments import learning_instruments
+        from .instruments import consistency_instruments, learning_instruments
 
         self.worker = worker
         self.max_delay = int(max_delay)
+        self.tau = int(max_delay)  # live effective τ; see set_tau()
         self.heat_every = max(1, int(heat_every))
         self.spike_factor = float(spike_factor)
         self.registry = (
@@ -298,8 +305,13 @@ class LearningPlane:
         self._c_heat = tel["heat_slots"].labels(worker=worker)
         self._g_share = tel["shard_share"]
         self._g_imbalance = tel["shard_imbalance"]
+        self._g_tau = consistency_instruments(self.registry)["tau"].labels(
+            worker=worker
+        )
+        self._g_tau.set(self.tau)
         self.heat = KeyHeat(num_slots, num_shards)
         self._staleness_max = 0  # guarded-by: _lock
+        self._over_tau_max = -int(max_delay)  # guarded-by: _lock
         self._clock_lag_max = 0  # guarded-by: _lock
         self._submits = 0  # guarded-by: _lock
         self._collects = 0  # guarded-by: _lock
@@ -317,24 +329,51 @@ class LearningPlane:
 
     # -- realized staleness (the submit/apply path) --
 
+    def set_tau(self, tau: int) -> None:
+        """Move the LIVE effective τ (the adaptive controller's knob).
+
+        Future submissions are judged against the new bound; already
+        stamped ones keep the verdict of the τ in force when they were
+        submitted (tracked per-submission in :meth:`note_submit`), so a
+        clamp-down never retroactively brands legal history a breach.
+        Refreshes the ``ps_consistency_tau`` gauge."""
+        tau = int(tau)
+        with self._lock:
+            self.tau = tau
+        self._g_tau.set(tau)
+
     def note_submit(
-        self, staleness: int, n_steps: int = 1, clock_lag: int = 0
+        self,
+        staleness: int,
+        n_steps: int = 1,
+        clock_lag: int = 0,
+        tau: Optional[int] = None,
     ) -> None:
         """Stamp one submitted step (or scan superstep) with its
-        realized snapshot staleness in MINISTEPS (comparable to the
-        configured τ) and the executor logical-clock lag between the
-        snapshot-taking submission and this one."""
+        realized snapshot staleness in MINISTEPS (comparable to τ) and
+        the executor logical-clock lag between the snapshot-taking
+        submission and this one. ``tau`` is the effective bound at
+        submit time (callers that plumb the live τ pass it explicitly;
+        default is the plane's current live τ) — the over-τ gauge
+        tracks the worst PER-SUBMISSION margin ``staleness - τ_then``,
+        which is what the ``staleness_breach`` rule must fire on once
+        τ adapts."""
         staleness = int(staleness)
         self._h_staleness.observe(staleness)
         with self._lock:
             self._submits += 1
             if staleness > self._staleness_max:
                 self._staleness_max = staleness
+            bound = self.tau if tau is None else int(tau)
+            over = staleness - bound
+            if over > self._over_tau_max:
+                self._over_tau_max = over
             if clock_lag > self._clock_lag_max:
                 self._clock_lag_max = int(clock_lag)
             observed = self._staleness_max
+            over_max = self._over_tau_max
         self._g_staleness_max.set(observed)
-        self._g_over_tau.set(observed - self.max_delay)
+        self._g_over_tau.set(over_max)
 
     # -- convergence (collect-side metering of in-jit side outputs) --
 
@@ -417,6 +456,8 @@ class LearningPlane:
     def staleness_summary(self) -> Dict[str, Any]:
         with self._lock:
             observed = self._staleness_max
+            over_max = self._over_tau_max
+            live_tau = self.tau
             lag = self._clock_lag_max
             submits = self._submits
         count = self._staleness_hist.count(worker=self.worker)
@@ -435,8 +476,12 @@ class LearningPlane:
             )
         return {
             "configured_tau": self.max_delay,
+            "live_tau": live_tau,
             "observed_max": observed,
-            "within_bound": observed <= self.max_delay,
+            # worst per-submission margin vs the τ in force AT SUBMIT
+            # (== observed_max - configured_tau while τ never adapts)
+            "over_tau_max": over_max,
+            "within_bound": over_max <= 0,
             "executor_clock_lag_max": lag,
             "submits": submits,
             "histogram": hist,

@@ -120,6 +120,11 @@ METADATA_SECTIONS = frozenset(
         # with host-dependent wall times; banding it would false-flag
         # every round
         "rebalance",
+        # self-driving consistency (adaptive τ + KKT filter): quotes
+        # its own paired-rep A/B medians (τ arms, filter off/on key
+        # and byte reductions) plus the divergence-drill episode —
+        # self-disclosing, never banded by the sentinel
+        "consistency",
     }
 )
 assert not ({k for k, _ in WATCHED} & METADATA_SECTIONS), (

@@ -50,6 +50,12 @@ SCOPE = (
     "parameter_server_tpu/ops/quantize.py",
     "parameter_server_tpu/ops/flash_attention.py",
     "parameter_server_tpu/ops/wire_codec.py",
+    # the KKT significance mask is trace-pure by contract (it runs
+    # inside the sparse mini-step) — in scope like the rest of ops/
+    "parameter_server_tpu/ops/significance.py",
+    # the consistency runtime is host-side by design (collect/prep
+    # thread hooks) — in scope for the same reason learning.py is
+    "parameter_server_tpu/learner/consistency.py",
     # the learning plane is host-side by design — in scope so a future
     # jit sneaking telemetry calls inside a traced body is caught here
     # like it would be in ops/

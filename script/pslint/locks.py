@@ -89,6 +89,7 @@ SCOPE = (
     "parameter_server_tpu/learner/ingest.py",
     "parameter_server_tpu/learner/workload_pool.py",
     "parameter_server_tpu/learner/wire.py",
+    "parameter_server_tpu/learner/consistency.py",
     "parameter_server_tpu/apps/linear/async_sgd.py",
 )
 

@@ -172,6 +172,29 @@ class TestDiffMath:
         assert reported
         assert not reported & bench_diff.METADATA_SECTIONS
 
+    def test_consistency_section_is_metadata_never_banded(self):
+        """The self-driving consistency `consistency` section quotes
+        its own paired-rep A/B medians (τ arms with an emulated pull
+        RTT, KKT filter off/on key reductions) plus the divergence
+        drill episode — self-disclosing run metadata whose
+        host-dependent wall clocks the sentinel must never band."""
+        assert "consistency" in bench_diff.METADATA_SECTIONS
+        assert not (
+            {k for k, _ in bench_diff.WATCHED} & bench_diff.METADATA_SECTIONS
+        )
+        new = dict(bench_diff.load_record(fx("new_ok.json")))
+        new["consistency"] = {  # catastrophic frontier, all ignored
+            "tau_arms": {"adaptive": {"examples_per_s_median": 0.01}},
+            "frontier": {"adaptive_beats_tau0_throughput": False},
+            "significance_filter": {"on": {"final_loss": 1e9}},
+            "divergence_drill": {"reconverged": False},
+        }
+        rows, regressed = bench_diff.diff(new, self._priors())
+        assert not regressed
+        reported = {r["metric"] for r in rows}
+        assert reported
+        assert not reported & bench_diff.METADATA_SECTIONS
+
     def test_device_section_is_metadata_never_banded(self):
         """The device truth plane's `device` section carries roofline
         fracs and HBM high-water — capture-HARDWARE facts (they move

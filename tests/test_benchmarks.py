@@ -16,7 +16,7 @@ def test_benchmarks_smoke_all(capsys):
         "attention", "step_phases", "executor", "host_ingest", "wire",
         "stream_prep", "serve", "decode_batching", "trace",
         "ftrl_sparse_ab", "ftrl_chain", "recovery_drill", "roofline",
-        "bundle", "learning", "history_ab", "rebalance",
+        "bundle", "learning", "history_ab", "rebalance", "consistency",
     }
     for name, fn in sorted(REGISTRY.items()):
         fn(True)
